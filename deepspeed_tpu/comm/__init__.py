@@ -1,0 +1,6 @@
+from .comm import (all_reduce, reduce_scatter, all_gather, all_to_all,
+                   broadcast, ppermute, send_forward, send_backward,
+                   axis_index, init_distributed, is_initialized, get_rank,
+                   get_world_size, get_local_device_count, barrier, configure,
+                   log_summary)
+from .logging import CommsLogger, get_comms_logger

@@ -1,0 +1,57 @@
+"""Communication volume logger.
+
+Counterpart of the reference's ``utils/comms_logging.py:67 CommsLogger`` and
+``calc_bw_log`` (:34). Because collectives execute inside compiled XLA
+programs, per-op wall times are not observable from Python; what *is* exact
+is the traffic each traced op contributes. We record (op, bytes, axis) at
+trace time and aggregate; ``log_summary`` mirrors the reference's table.
+Pair with ``jax.profiler`` traces for on-device timing.
+"""
+
+from collections import defaultdict
+
+from ..utils.logging import log_dist
+
+
+class CommsLogger:
+    def __init__(self):
+        self.enabled = False
+        self.verbose = False
+        self.prof_all = True
+        self.comms_dict = defaultdict(lambda: defaultdict(lambda: [0, 0]))
+
+    def configure(self, cfg):
+        self.enabled = getattr(cfg, "enabled", False)
+        self.verbose = getattr(cfg, "verbose", False)
+        self.prof_all = getattr(cfg, "prof_all", True)
+
+    def append(self, op_name, nbytes, axis_name):
+        rec = self.comms_dict[op_name][str(axis_name)]
+        rec[0] += 1
+        rec[1] += nbytes
+        if self.verbose:
+            log_dist(f"comm op: {op_name} | axis: {axis_name} | bytes: {nbytes}",
+                     ranks=[0])
+
+    def reset(self):
+        self.comms_dict.clear()
+
+    def log_summary(self, show_straggler=False):
+        log_dist("Communication summary (traced volumes per compilation):",
+                 ranks=[0])
+        header = f"{'Op':<20}{'Axis':<24}{'Count':>8}{'Total bytes':>16}"
+        log_dist(header, ranks=[0])
+        for op, axes in sorted(self.comms_dict.items()):
+            for axis, (count, nbytes) in sorted(axes.items()):
+                log_dist(f"{op:<20}{axis:<24}{count:>8}{nbytes:>16,}", ranks=[0])
+
+    def total_bytes(self):
+        return sum(nbytes for axes in self.comms_dict.values()
+                   for (_, nbytes) in axes.values())
+
+
+_LOGGER = CommsLogger()
+
+
+def get_comms_logger():
+    return _LOGGER

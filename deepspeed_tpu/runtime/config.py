@@ -1,0 +1,277 @@
+"""JSON config -> typed config objects.
+
+Counterpart of the reference's ``runtime/config.py:706 DeepSpeedConfig``
+(pydantic there; plain dataclasses here — no extra deps, static and
+hashable so configs can feed jit). Implements the same batch-size triad
+resolution (train_batch = micro_batch * grad_accum * dp_world) with the
+reference's error semantics, precision blocks, ZeRO block, and the fork's
+checkpoint-engine selection keys (reference runtime/config.py:909-926).
+"""
+
+import json
+from dataclasses import dataclass, field, asdict
+
+from . import constants as C
+from ..utils.logging import logger
+
+
+class DeepSpeedConfigError(Exception):
+    pass
+
+
+@dataclass
+class FP16Config:
+    enabled: bool = False
+    loss_scale: float = 0.0          # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    min_loss_scale: float = 1.0
+
+
+@dataclass
+class BF16Config:
+    enabled: bool = False
+
+
+@dataclass
+class ZeroConfig:
+    """Mirrors reference zero/config.py:82 DeepSpeedZeroConfig knobs that are
+    meaningful under XLA. Bucket sizes/overlap are accepted for config
+    compatibility; XLA's scheduler handles what streams+buckets did."""
+    stage: int = 0
+    contiguous_gradients: bool = True
+    reduce_scatter: bool = True
+    reduce_bucket_size: int = int(5e8)
+    allgather_bucket_size: int = int(5e8)
+    overlap_comm: bool = True
+    round_robin_gradients: bool = False
+    sub_group_size: int = int(1e9)
+    prefetch_bucket_size: int = int(5e7)
+    param_persistence_threshold: int = int(1e5)
+    model_persistence_threshold: int = int(1e10)
+    max_live_parameters: int = int(1e9)
+    offload_optimizer: bool = False
+    offload_param: bool = False
+    zero_quantized_weights: bool = False
+    zero_quantized_gradients: bool = False
+    hpz_partition_size: int = 1
+    mics_shard_size: int = -1
+
+    def __post_init__(self):
+        if self.stage not in (0, 1, 2, 3):
+            raise DeepSpeedConfigError(f"invalid ZeRO stage {self.stage}")
+
+
+@dataclass
+class TensorParallelConfig:
+    size: int = 1
+
+
+@dataclass
+class PipelineConfig:
+    stages: int = 1
+    micro_batches: int = 0            # 0 = use gradient_accumulation_steps
+    partition_method: str = "uniform"
+    activation_checkpoint_interval: int = 0
+
+
+@dataclass
+class OptimizerConfig:
+    type: str = "AdamW"
+    params: dict = field(default_factory=dict)
+
+
+@dataclass
+class SchedulerConfig:
+    type: str = None
+    params: dict = field(default_factory=dict)
+
+
+@dataclass
+class CheckpointEngineConfig:
+    """Fork parity: reference runtime/config.py:909-926 registers
+    datastates/async/none/torch_sn_async engine configs; we expose one
+    block with a type switch."""
+    type: str = "sync"                # sync | async | native | none
+    host_cache_bytes: int = 1 << 30   # pinned-host staging budget (async/native)
+    writer_threads: int = 2
+    max_inflight: int = 2
+
+
+@dataclass
+class ActivationCheckpointingConfig:
+    partition_activations: bool = False   # accepted for parity; XLA shards
+    contiguous_memory_optimization: bool = False
+    cpu_checkpointing: bool = False
+    number_checkpoints: int = 0
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+    # TPU-native knob: remat policy name for jax.checkpoint
+    policy: str = "nothing_saveable"
+
+
+@dataclass
+class CommsLoggerConfig:
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+
+
+@dataclass
+class MonitorConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+
+
+def _take(d, cls, key):
+    sub = d.get(key, {})
+    if isinstance(sub, cls):
+        return sub
+    if not isinstance(sub, dict):
+        raise DeepSpeedConfigError(f"'{key}' must be a dict, got {type(sub)}")
+    known = {f for f in cls.__dataclass_fields__}
+    unknown = set(sub) - known
+    if unknown:
+        logger.warning(f"config block '{key}': ignoring unknown keys {sorted(unknown)}")
+    return cls(**{k: v for k, v in sub.items() if k in known})
+
+
+class DeepSpeedConfig:
+    """Resolved, validated run config.
+
+    Batch triad resolution follows reference runtime/config.py: given any two
+    of (train_batch_size, train_micro_batch_size_per_gpu,
+    gradient_accumulation_steps) the third is derived; given one, the others
+    default to fill; all three must satisfy
+    train_batch == micro_batch * grad_accum * dp_world.
+    """
+
+    def __init__(self, config, dp_world_size=1):
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        if not isinstance(config, dict):
+            raise DeepSpeedConfigError(
+                f"expected dict or json path, got {type(config)}")
+        self._raw = dict(config)
+        self.dp_world_size = dp_world_size
+
+        self.train_batch_size = config.get(C.TRAIN_BATCH_SIZE)
+        self.train_micro_batch_size_per_gpu = config.get(
+            C.TRAIN_MICRO_BATCH_SIZE_PER_GPU)
+        self.gradient_accumulation_steps = config.get(
+            C.GRADIENT_ACCUMULATION_STEPS)
+        self._resolve_batch_size()
+
+        self.steps_per_print = config.get(C.STEPS_PER_PRINT,
+                                          C.STEPS_PER_PRINT_DEFAULT)
+        self.gradient_clipping = config.get(C.GRADIENT_CLIPPING,
+                                            C.GRADIENT_CLIPPING_DEFAULT)
+        self.prescale_gradients = config.get(C.PRESCALE_GRADIENTS, False)
+        self.gradient_predivide_factor = config.get(
+            C.GRADIENT_PREDIVIDE_FACTOR, 1.0)
+        self.wall_clock_breakdown = config.get(C.WALL_CLOCK_BREAKDOWN, False)
+
+        self.fp16 = _take(config, FP16Config, C.FP16)
+        self.bf16 = _take(config, BF16Config, C.BF16)
+        if self.fp16.enabled and self.bf16.enabled:
+            raise DeepSpeedConfigError("fp16 and bf16 cannot both be enabled")
+        self.zero = _take(config, ZeroConfig, C.ZERO_OPTIMIZATION)
+        self.tensor_parallel = _take(config, TensorParallelConfig,
+                                     C.TENSOR_PARALLEL)
+        self.pipeline = _take(config, PipelineConfig, C.PIPELINE)
+        self.seq_parallel_size = config.get(C.SEQUENCE_PARALLEL_SIZE, 1)
+        self.expert_parallel_size = config.get(C.EXPERT_PARALLEL_SIZE, 1)
+
+        opt = config.get(C.OPTIMIZER)
+        self.optimizer = None if opt is None else _take(
+            {"o": opt}, OptimizerConfig, "o")
+        sched = config.get(C.SCHEDULER)
+        self.scheduler = None if sched is None else _take(
+            {"s": sched}, SchedulerConfig, "s")
+
+        self.checkpoint_engine = _take(config, CheckpointEngineConfig,
+                                       C.CHECKPOINT_ENGINE)
+        self.activation_checkpointing = _take(
+            config, ActivationCheckpointingConfig, C.ACTIVATION_CHECKPOINTING)
+        self.comms_logger = _take(config, CommsLoggerConfig, C.COMMS_LOGGER)
+        self.monitor_csv = _take(config, MonitorConfig, C.MONITOR_CSV)
+
+        dtypes = config.get(C.DATA_TYPES, {})
+        self.grad_accum_dtype = dtypes.get(C.GRAD_ACCUM_DTYPE)
+        self.seq_parallel_comm_dtype = config.get(C.SEQ_PARALLEL_COMM_DTYPE,
+                                                  "float32")
+
+    # reference runtime/config.py batch resolution logic, same error text style
+    def _resolve_batch_size(self):
+        train = self.train_batch_size
+        micro = self.train_micro_batch_size_per_gpu
+        gas = self.gradient_accumulation_steps
+        dp = self.dp_world_size
+        for name, v in ((C.TRAIN_BATCH_SIZE, train),
+                        (C.TRAIN_MICRO_BATCH_SIZE_PER_GPU, micro),
+                        (C.GRADIENT_ACCUMULATION_STEPS, gas)):
+            if v is not None and (not isinstance(v, int) or v <= 0):
+                raise DeepSpeedConfigError(
+                    f"{name} must be a positive integer, got {v!r}")
+
+        if all(v is not None for v in (train, micro, gas)):
+            if train != micro * gas * dp:
+                raise DeepSpeedConfigError(
+                    f"Check batch related parameters. train_batch_size is not equal "
+                    f"to micro_batch_per_gpu * gradient_acc_step * world_size "
+                    f"{train} != {micro} * {gas} * {dp}")
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp)
+            if gas * micro * dp != train:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {train} not divisible by "
+                    f"micro_batch {micro} * dp world size {dp}")
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp)
+            if micro * gas * dp != train:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {train} not divisible by "
+                    f"gradient_accumulation_steps {gas} * dp world size {dp}")
+        elif micro is not None:
+            gas = 1 if gas is None else gas
+            train = micro * gas * dp
+        elif train is not None:
+            micro = train // dp
+            gas = 1
+            if micro * dp != train:
+                raise DeepSpeedConfigError(
+                    f"train_batch_size {train} not divisible by dp world size {dp}")
+        else:
+            raise DeepSpeedConfigError(
+                "Either train_batch_size or train_micro_batch_size_per_gpu "
+                "must be provided")
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    @property
+    def precision_dtype(self):
+        import jax.numpy as jnp
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    def to_dict(self):
+        out = dict(self._raw)
+        out[C.TRAIN_BATCH_SIZE] = self.train_batch_size
+        out[C.TRAIN_MICRO_BATCH_SIZE_PER_GPU] = self.train_micro_batch_size_per_gpu
+        out[C.GRADIENT_ACCUMULATION_STEPS] = self.gradient_accumulation_steps
+        return out
+
+    def print_config(self):
+        logger.info("DeepSpeedConfig:")
+        for k, v in sorted(self.__dict__.items()):
+            if k.startswith("_"):
+                continue
+            logger.info(f"  {k} = {v}")

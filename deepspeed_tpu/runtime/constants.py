@@ -1,0 +1,54 @@
+"""Config key names, mirroring the reference's ``runtime/constants.py``."""
+
+# Batch size triad (reference runtime/constants.py TRAIN_BATCH_SIZE et al.)
+TRAIN_BATCH_SIZE = "train_batch_size"
+TRAIN_MICRO_BATCH_SIZE_PER_GPU = "train_micro_batch_size_per_gpu"
+GRADIENT_ACCUMULATION_STEPS = "gradient_accumulation_steps"
+
+STEPS_PER_PRINT = "steps_per_print"
+STEPS_PER_PRINT_DEFAULT = 10
+
+OPTIMIZER = "optimizer"
+OPTIMIZER_TYPE = "type"
+OPTIMIZER_PARAMS = "params"
+SCHEDULER = "scheduler"
+SCHEDULER_TYPE = "type"
+SCHEDULER_PARAMS = "params"
+
+GRADIENT_CLIPPING = "gradient_clipping"
+GRADIENT_CLIPPING_DEFAULT = 0.0
+
+PRESCALE_GRADIENTS = "prescale_gradients"
+GRADIENT_PREDIVIDE_FACTOR = "gradient_predivide_factor"
+
+# Precision
+FP16 = "fp16"
+BF16 = "bf16"
+ENABLED = "enabled"
+FP16_LOSS_SCALE = "loss_scale"
+FP16_INITIAL_SCALE_POWER = "initial_scale_power"
+FP16_LOSS_SCALE_WINDOW = "loss_scale_window"
+FP16_HYSTERESIS = "hysteresis"
+FP16_MIN_LOSS_SCALE = "min_loss_scale"
+
+# ZeRO
+ZERO_OPTIMIZATION = "zero_optimization"
+
+# Parallel topology (TPU-native extension; the reference takes mpu/ep_size
+# through function args rather than config)
+TENSOR_PARALLEL = "tensor_parallel"
+PIPELINE = "pipeline"
+SEQUENCE_PARALLEL_SIZE = "sequence_parallel_size"
+EXPERT_PARALLEL_SIZE = "expert_parallel_size"
+
+WALL_CLOCK_BREAKDOWN = "wall_clock_breakdown"
+COMMS_LOGGER = "comms_logger"
+MONITOR_CSV = "csv_monitor"
+MONITOR_TENSORBOARD = "tensorboard"
+
+ACTIVATION_CHECKPOINTING = "activation_checkpointing"
+
+CHECKPOINT_ENGINE = "checkpoint_engine"  # {"type": "sync"|"async"|"native"|"none", ...}
+DATA_TYPES = "data_types"
+GRAD_ACCUM_DTYPE = "grad_accum_dtype"
+SEQ_PARALLEL_COMM_DTYPE = "seq_parallel_communication_data_type"

@@ -1,0 +1,3 @@
+from .logging import logger, log_dist, print_rank_0
+from .timer import SynchronizedWallClockTimer, ThroughputTimer
+from . import groups

@@ -1,0 +1,172 @@
+"""Parallel topology: one global device mesh instead of process groups.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/groups.py``
+(``initialize(ep_size, mpu)`` at utils/groups.py:51 and the DP/MP/EP/SP getters
+at utils/groups.py:317-560). Where the reference carves ``torch.distributed``
+process groups out of a world, we build a single ``jax.sharding.Mesh`` whose
+named axes play the group roles:
+
+    pipe    - pipeline-parallel stages (p2p via ppermute)
+    data    - expert-data-parallel axis: replicas that also hold ZeRO
+              partitions of expert params/optimizer state
+    expert  - expert parallelism (MoE all_to_all); expert=1 folds into data
+    seq     - Ulysses sequence parallelism (all_to_all head<->seq scatter)
+    tensor  - tensor (megatron-style) model parallelism
+
+Group semantics w.r.t. the reference:
+    * the reference's "data-parallel group" (utils/groups.py:345) for
+      NON-expert params is the combined ('data','expert') axes - every device
+      holding a replica of a non-expert param;
+    * the "expert-parallel group" (utils/groups.py:317) is the 'expert' axis;
+    * the "expert-data-parallel group" (utils/groups.py:331) is 'data';
+    * the "sequence-parallel group" (utils/groups.py:452) is 'seq';
+    * gradients of non-expert params are additionally summed over 'seq'
+      (reference stage_1_and_2.py:1070 divides by sp size);
+    * ZeRO partitions optimizer state over the data-parallel group
+      (('data','expert') here), mirroring zero/stage_1_and_2.py.
+
+XLA inserts the collectives; these axes just name them. ICI carries any axis
+within a slice; put 'data' outermost so DCN (multi-slice) traffic is the
+infrequent gradient reduction, as the reference does with hierarchical
+ZeRO++ groups (utils/groups.py:505).
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+# Canonical mesh axis order. 'data' outermost (slowest-varying) so that
+# tensor/seq/expert collectives ride the fastest ICI links.
+MESH_AXES = ("pipe", "data", "expert", "seq", "tensor")
+
+# Axis groups (tuples usable directly inside PartitionSpec / lax collectives).
+DP_AXES = ("data", "expert")          # non-expert-param data parallelism
+EXPERT_DP_AXES = ("data",)            # expert-param data parallelism
+GRAD_REDUCE_AXES = ("data", "expert", "seq")  # non-expert grad reduction
+BATCH_AXES = ("data", "expert")       # batch dim sharding of the global batch
+
+
+@dataclass(frozen=True)
+class TopologyConfig:
+    """Sizes for each mesh axis. -1 for data = fill with remaining devices."""
+    data_parallel_size: int = -1
+    tensor_parallel_size: int = 1
+    pipe_parallel_size: int = 1
+    seq_parallel_size: int = 1
+    expert_parallel_size: int = 1
+
+
+class ParallelTopology:
+    """Owns the global Mesh and answers group-size/rank queries."""
+
+    def __init__(self, config: TopologyConfig = None, devices=None):
+        config = config or TopologyConfig()
+        if devices is None:
+            devices = jax.devices()
+        n = len(devices)
+        fixed = (config.tensor_parallel_size * config.pipe_parallel_size *
+                 config.seq_parallel_size * config.expert_parallel_size)
+        dp = config.data_parallel_size
+        if dp == -1:
+            if n % fixed != 0:
+                raise ValueError(
+                    f"world size {n} not divisible by tensor*pipe*seq*expert={fixed}")
+            dp = n // fixed
+        if dp * fixed != n:
+            raise ValueError(
+                f"data({dp}) * tensor({config.tensor_parallel_size}) * "
+                f"pipe({config.pipe_parallel_size}) * seq({config.seq_parallel_size}) * "
+                f"expert({config.expert_parallel_size}) = {dp * fixed} != world size {n}")
+        self.config = TopologyConfig(
+            data_parallel_size=dp,
+            tensor_parallel_size=config.tensor_parallel_size,
+            pipe_parallel_size=config.pipe_parallel_size,
+            seq_parallel_size=config.seq_parallel_size,
+            expert_parallel_size=config.expert_parallel_size,
+        )
+        shape = (self.config.pipe_parallel_size, dp,
+                 self.config.expert_parallel_size,
+                 self.config.seq_parallel_size,
+                 self.config.tensor_parallel_size)
+        device_array = np.asarray(devices).reshape(shape)
+        self.mesh = Mesh(device_array, MESH_AXES)
+
+    # --- size getters (reference utils/groups.py:317-560 parity) ---
+    @property
+    def world_size(self):
+        return self.mesh.size
+
+    def axis_size(self, axis):
+        return self.mesh.shape[axis]
+
+    def get_data_parallel_world_size(self):
+        """Replicas of a non-expert param: data * expert axes."""
+        return self.axis_size("data") * self.axis_size("expert")
+
+    def get_expert_parallel_world_size(self):
+        return self.axis_size("expert")
+
+    def get_expert_data_parallel_world_size(self):
+        return self.axis_size("data")
+
+    def get_model_parallel_world_size(self):
+        return self.axis_size("tensor")
+
+    def get_sequence_parallel_world_size(self):
+        return self.axis_size("seq")
+
+    def get_pipe_parallel_world_size(self):
+        return self.axis_size("pipe")
+
+    # --- sharding helpers ---
+    def sharding(self, *spec):
+        return NamedSharding(self.mesh, P(*spec))
+
+    def replicated(self):
+        return NamedSharding(self.mesh, P())
+
+    def batch_sharding(self, seq_dim=None):
+        """Global-batch sharding: batch dim over DP axes, optionally the
+        sequence dim over 'seq' (Ulysses input layout)."""
+        if seq_dim is None or self.get_sequence_parallel_world_size() == 1:
+            return self.sharding(BATCH_AXES)
+        if seq_dim == 0:
+            raise ValueError("seq_dim must differ from the batch dim (0)")
+        spec = [BATCH_AXES] + [None] * seq_dim
+        spec[seq_dim] = "seq"
+        return self.sharding(*spec)
+
+
+_TOPOLOGY = None
+
+
+def initialize(config: TopologyConfig = None, devices=None, force=False):
+    """Create (or return) the global topology. Mirrors groups.initialize
+    (reference utils/groups.py:51) being idempotent: repeat calls with an
+    equivalent (post-resolution) config return the same object."""
+    global _TOPOLOGY
+    if _TOPOLOGY is None or force:
+        _TOPOLOGY = ParallelTopology(config, devices)
+    elif config is not None:
+        candidate = ParallelTopology(config, devices)
+        if candidate.config != _TOPOLOGY.config:
+            _TOPOLOGY = candidate
+    return _TOPOLOGY
+
+
+def get_topology():
+    global _TOPOLOGY
+    if _TOPOLOGY is None:
+        _TOPOLOGY = ParallelTopology()
+    return _TOPOLOGY
+
+
+def reset():
+    global _TOPOLOGY
+    _TOPOLOGY = None
+
+
+def get_mesh():
+    return get_topology().mesh

@@ -1,0 +1,63 @@
+"""Rank-aware logging.
+
+TPU-native counterpart of the reference's ``deepspeed/utils/logging.py``
+(``logger``, ``log_dist``). Under JAX's single-controller-per-host model the
+"rank" is ``jax.process_index()``; we avoid importing jax at module import time
+so logging works before distributed init.
+"""
+
+import logging
+import os
+import sys
+import functools
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+def _create_logger(name="DeepSpeedTPU", level=logging.INFO):
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        formatter = logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s")
+        handler.setFormatter(formatter)
+        lg.addHandler(handler)
+    return lg
+
+
+logger = _create_logger(
+    level=LOG_LEVELS.get(os.environ.get("DSTPU_LOG_LEVEL", "info").lower(),
+                         logging.INFO))
+
+
+def _process_index():
+    try:
+        import jax
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message, ranks=None, level=logging.INFO):
+    """Log ``message`` only on the listed process ranks (None / [-1] = all)."""
+    rank = _process_index()
+    if ranks is None or -1 in ranks or rank in ranks:
+        logger.log(level, f"[Rank {rank}] {message}")
+
+
+@functools.lru_cache(None)
+def warn_once(message):
+    logger.warning(message)
+
+
+def print_rank_0(message):
+    if _process_index() == 0:
+        logger.info(message)

@@ -1,0 +1,159 @@
+"""Wall-clock and throughput timers.
+
+Counterpart of the reference's ``deepspeed/utils/timer.py``
+(``SynchronizedWallClockTimer`` at utils/timer.py:43, ``ThroughputTimer`` at
+utils/timer.py:198). On TPU there are no CUDA events; synchronization is a
+``jax.block_until_ready`` fence on whatever arrays the caller hands us, or a
+plain device barrier via ``jax.effects_barrier`` when none are given.
+"""
+
+import time
+
+from .logging import log_dist
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+TRAIN_BATCH_TIMER = "train_batch"
+
+
+def _device_sync(arrays=None):
+    try:
+        import jax
+        if arrays is not None:
+            jax.block_until_ready(arrays)
+        else:
+            jax.effects_barrier()
+    except Exception:
+        pass
+
+
+class _Timer:
+    def __init__(self, name):
+        self.name_ = name
+        self.started_ = False
+        self.elapsed_ = 0.0
+        self.start_time = 0.0
+        self.records = []
+
+    def start(self):
+        assert not self.started_, f"{self.name_} timer has already been started"
+        self.start_time = time.time()
+        self.started_ = True
+
+    def stop(self, record=False, sync_arrays=None):
+        assert self.started_, f"{self.name_} timer is not started"
+        _device_sync(sync_arrays)
+        elapsed = time.time() - self.start_time
+        self.elapsed_ += elapsed
+        if record:
+            self.records.append(elapsed)
+        self.started_ = False
+
+    def reset(self):
+        self.started_ = False
+        self.elapsed_ = 0.0
+
+    def elapsed(self, reset=True):
+        started = self.started_
+        if started:
+            self.stop()
+        elapsed = self.elapsed_
+        if reset:
+            self.reset()
+        if started:
+            self.start()
+        return elapsed
+
+    def mean(self):
+        if not self.records:
+            return 0.0
+        return sum(self.records) / len(self.records)
+
+
+class SynchronizedWallClockTimer:
+    """Group of named timers; ``log`` prints elapsed ms like the reference."""
+
+    def __init__(self):
+        self.timers = {}
+
+    def __call__(self, name):
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def has_timer(self, name):
+        return name in self.timers
+
+    def log(self, names, normalizer=1.0, reset=True, ranks=None):
+        assert normalizer > 0.0
+        string = "time (ms)"
+        for name in names:
+            if name in self.timers:
+                elapsed = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                string += f" | {name}: {elapsed:.2f}"
+        log_dist(string, ranks=ranks or [0])
+
+    def get_mean(self, names, normalizer=1.0):
+        assert normalizer > 0.0
+        return {
+            name: self.timers[name].mean() * 1000.0 / normalizer
+            for name in names if name in self.timers
+        }
+
+
+class ThroughputTimer:
+    """Tokens/samples-per-second accounting (reference utils/timer.py:198)."""
+
+    def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False):
+        self.start_time = 0
+        self.started = False
+        self.batch_size = max(1, batch_size)
+        self.start_step = start_step
+        self.epoch_count = 0
+        self.micro_step_count = 0
+        self.global_step_count = 0
+        self.total_elapsed_time = 0
+        self.step_elapsed_time = 0
+        self.steps_per_output = steps_per_output
+
+    def update_epoch_count(self):
+        self.epoch_count += 1
+        self.micro_step_count = 0
+
+    def start(self):
+        self.started = True
+        if self.global_step_count >= self.start_step:
+            _device_sync()
+            self.start_time = time.time()
+
+    def stop(self, global_step=False, report_speed=True, sync_arrays=None):
+        if not self.started:
+            return
+        self.started = False
+        self.micro_step_count += 1
+        if global_step:
+            self.global_step_count += 1
+        if self.start_time > 0:
+            _device_sync(sync_arrays)
+            duration = time.time() - self.start_time
+            self.total_elapsed_time += duration
+            self.step_elapsed_time += duration
+            if global_step:
+                if report_speed and self.global_step_count % self.steps_per_output == 0:
+                    log_dist(
+                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                        f"global_step={self.global_step_count}, "
+                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.6g}, "
+                        f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.6g}",
+                        ranks=[0])
+                self.step_elapsed_time = 0
+
+    def avg_samples_per_sec(self):
+        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
+            samples = self.batch_size * (self.global_step_count - self.start_step)
+            return samples / self.total_elapsed_time
+        return float("-inf")

@@ -1,0 +1,36 @@
+"""Test harness configuration.
+
+The reference simulates multi-node as multi-process single-host with a real
+NCCL/GLOO backend (tests/unit/common.py:105 DistributedExec). The TPU-native
+equivalent: a *virtual 8-device CPU mesh* via
+``--xla_force_host_platform_device_count`` so every collective XLA emits is
+real (ring algorithms on host), just not timed. Must be set before jax
+imports anything.
+"""
+
+import os
+
+# Overwrite (the ambient env may pin JAX_PLATFORMS to the real TPU tunnel);
+# unit tests always run on the virtual CPU mesh. jax may already be imported
+# at interpreter startup with config captured from env, so set both the env
+# vars and the live config.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _reset_topology():
+    """Each test builds its own mesh topology."""
+    yield
+    from deepspeed_tpu.utils import groups
+    groups.reset()

@@ -1,0 +1,75 @@
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig, DeepSpeedConfigError
+
+
+def test_batch_triad_all_given():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 32, "train_micro_batch_size_per_gpu": 2,
+         "gradient_accumulation_steps": 2}, dp_world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 2
+
+
+def test_batch_triad_mismatch_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig(
+            {"train_batch_size": 33, "train_micro_batch_size_per_gpu": 2,
+             "gradient_accumulation_steps": 2}, dp_world_size=8)
+
+
+def test_batch_triad_derive_gas():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 64, "train_micro_batch_size_per_gpu": 2},
+        dp_world_size=8)
+    assert cfg.gradient_accumulation_steps == 4
+
+
+def test_batch_triad_derive_micro():
+    cfg = DeepSpeedConfig(
+        {"train_batch_size": 64, "gradient_accumulation_steps": 4},
+        dp_world_size=8)
+    assert cfg.train_micro_batch_size_per_gpu == 2
+
+
+def test_batch_triad_from_micro_only():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4}, dp_world_size=8)
+    assert cfg.train_batch_size == 32
+    assert cfg.gradient_accumulation_steps == 1
+
+
+def test_batch_triad_nothing_raises():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({}, dp_world_size=8)
+
+
+def test_precision_exclusive():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "fp16": {"enabled": True},
+                         "bf16": {"enabled": True}}, dp_world_size=8)
+
+
+def test_zero_stage_validation():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "zero_optimization": {"stage": 5}}, dp_world_size=8)
+
+
+def test_defaults_and_blocks():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 16,
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 2, "overlap_comm": False},
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+        "scheduler": {"type": "WarmupLR",
+                      "params": {"warmup_num_steps": 10}},
+        "gradient_clipping": 1.0,
+    }, dp_world_size=8)
+    assert cfg.zero.stage == 2
+    assert not cfg.zero.overlap_comm
+    assert cfg.optimizer.type == "AdamW"
+    assert cfg.scheduler.params["warmup_num_steps"] == 10
+    assert cfg.gradient_clipping == 1.0
+    import jax.numpy as jnp
+    assert cfg.precision_dtype == jnp.bfloat16

@@ -1,0 +1,43 @@
+import numpy as np
+import pytest
+
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.groups import TopologyConfig
+
+
+def test_default_topology_all_data():
+    topo = groups.initialize()
+    assert topo.world_size == 8
+    assert topo.get_data_parallel_world_size() == 8
+    assert topo.get_model_parallel_world_size() == 1
+
+
+def test_mixed_topology():
+    topo = groups.initialize(TopologyConfig(tensor_parallel_size=2,
+                                            seq_parallel_size=2), force=True)
+    assert topo.get_data_parallel_world_size() == 2
+    assert topo.get_sequence_parallel_world_size() == 2
+    assert topo.get_model_parallel_world_size() == 2
+    assert topo.mesh.shape["data"] == 2
+
+
+def test_expert_carved_from_dp():
+    # reference utils/groups.py:113 — ep_size divides dp world
+    topo = groups.initialize(TopologyConfig(expert_parallel_size=4), force=True)
+    assert topo.get_expert_parallel_world_size() == 4
+    assert topo.get_expert_data_parallel_world_size() == 2
+    # non-expert params still see the full 8-way dp group
+    assert topo.get_data_parallel_world_size() == 8
+
+
+def test_invalid_topology_raises():
+    with pytest.raises(ValueError):
+        groups.initialize(TopologyConfig(tensor_parallel_size=3), force=True)
+
+
+def test_batch_sharding_layout():
+    topo = groups.initialize(TopologyConfig(seq_parallel_size=2), force=True)
+    sh = topo.batch_sharding(seq_dim=1)
+    spec = sh.spec
+    assert spec[0] == ("data", "expert")
+    assert spec[1] == "seq"
