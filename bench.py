@@ -1,0 +1,101 @@
+"""Headline benchmark: GPT-2 350M ZeRO-2 bf16 training throughput.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "tokens/sec/chip", "vs_baseline": N}
+
+Target (BASELINE.json): tokens/sec/chip within 15% of 8xA100 running the
+reference DeepSpeed. The reference tree publishes no number for this config
+(BASELINE.md: "published" is empty), so the baseline is the analytic
+per-chip A100 figure: 312 TFLOP/s bf16 peak x 40% MFU (a strong DeepSpeed
+ZeRO-2 MFU at 350M scale) / flops-per-token. vs_baseline > 1.0 beats it.
+
+Runs on however many chips are visible (the driver gives one v5e chip);
+throughput is reported per chip.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import jax
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2, PRESETS
+from deepspeed_tpu.utils import groups
+
+
+def main():
+    preset = os.environ.get("BENCH_PRESET", "350M")
+    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+    micro = int(os.environ.get("BENCH_MICRO_BS", "8"))
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
+
+    cfg = PRESETS[preset]
+    if seq_len != cfg.max_seq_len:
+        from dataclasses import replace
+        cfg = replace(cfg, max_seq_len=seq_len)
+    model = GPT2(cfg)
+
+    n_dev = len(jax.devices())
+    groups.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 0,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 2e-4, "weight_decay": 0.01}},
+            "gradient_clipping": 1.0,
+            "bf16": {"enabled": True},
+            "zero_optimization": {"stage": stage},
+        })
+
+    bsz = engine.config.train_batch_size
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, cfg.vocab_size, (bsz, seq_len))
+             .astype(np.int32)}
+
+    def sync():
+        # force completion via host materialization: on some transports
+        # (axon tunnel) block_until_ready does not actually block.
+        return float(np.asarray(engine.state["step"]))
+
+    for _ in range(warmup):
+        loss = engine.train_batch(batch)
+    sync()
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    sync()
+    dt = time.perf_counter() - t0
+
+    tokens = bsz * seq_len * steps
+    tok_per_sec_chip = tokens / dt / n_dev
+    flops_per_token = cfg.flops_per_token()
+    mfu_peak = {"tpu": 197e12}.get("tpu")  # v5e bf16 peak per chip
+    achieved_flops = tok_per_sec_chip * flops_per_token
+    mfu = achieved_flops / mfu_peak
+
+    a100_baseline = 312e12 * 0.40 / flops_per_token  # tokens/sec/chip
+    print(json.dumps({
+        "metric": f"gpt2-{preset} zero{stage} bf16 training throughput",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/sec/chip",
+        "vs_baseline": round(tok_per_sec_chip / a100_baseline, 3),
+        "extras": {
+            "devices": n_dev, "seq_len": seq_len, "global_batch": bsz,
+            "steps": steps, "step_time_s": round(dt / steps, 4),
+            "mfu_vs_v5e_peak": round(mfu, 3),
+            "final_loss": float(loss),
+            "baseline_tokens_per_sec_chip_8xA100_est": round(a100_baseline, 1),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

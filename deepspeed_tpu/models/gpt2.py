@@ -1,0 +1,272 @@
+"""GPT-2 model family — the flagship training target.
+
+The reference has no model zoo for training (users bring Megatron/HF
+modules); its test fixtures use tiny nn.Modules (tests/unit/simple_model.py)
+and the BASELINE targets are GPT-2 125M/350M/1.3B. Here the model is a
+first-class citizen so the engine can be exercised end-to-end without torch.
+
+TPU-first design decisions:
+  * Layers are STACKED (leading layer dim) and iterated with ``lax.scan`` —
+    one compiled block regardless of depth, fast XLA compiles at 1.3B+.
+  * Tensor parallelism is *declarative*: ``partition_specs`` assigns the
+    Megatron column/row split to the 'tensor' mesh axis and the forward
+    inserts ``with_sharding_constraint`` on activations; GSPMD emits the
+    psum/all_gathers (reference achieves this imperatively via an external
+    mpu + module_inject/auto_tp.py:188).
+  * Ulysses sequence parallelism is likewise declarative: inputs arrive
+    sequence-sharded on the 'seq' axis, and attention constrains the heads
+    dim onto 'seq' instead — XLA emits exactly the head-scatter/seq-gather
+    all_to_all pair of the reference's DistributedAttention
+    (deepspeed/sequence/layer.py:60).
+  * Activation checkpointing = ``jax.checkpoint`` on the scanned block
+    (reference runtime/activation_checkpointing/checkpointing.py:485).
+  * bf16 params/activations, fp32 LayerNorm and loss, MXU-friendly dims.
+"""
+
+import math
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..utils.groups import BATCH_AXES
+
+
+@dataclass(frozen=True)
+class GPT2Config:
+    vocab_size: int = 50304          # 50257 padded to a multiple of 128 (MXU)
+    max_seq_len: int = 1024
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    dropout: float = 0.0
+    dtype: str = "bfloat16"
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"
+    use_flash_attention: bool = False  # pallas kernel (TPU only)
+
+    @property
+    def d_head(self):
+        return self.d_model // self.n_head
+
+    @property
+    def d_ff(self):
+        return 4 * self.d_model
+
+    def num_params(self):
+        wte = self.vocab_size * self.d_model
+        wpe = self.max_seq_len * self.d_model
+        block = (4 * self.d_model  # ln scales/biases
+                 + self.d_model * 3 * self.d_model + 3 * self.d_model
+                 + self.d_model * self.d_model + self.d_model
+                 + 2 * self.d_model * self.d_ff + self.d_ff + self.d_model)
+        return wte + wpe + self.n_layer * block + 2 * self.d_model
+
+    def flops_per_token(self):
+        """6*N + attention flops per token (training fwd+bwd)."""
+        n = self.num_params() - self.vocab_size * self.d_model
+        return 6 * n + 12 * self.n_layer * self.d_model * self.max_seq_len
+
+
+# BASELINE.md model points
+GPT2_TINY = GPT2Config(n_layer=2, n_head=4, d_model=128, max_seq_len=128,
+                       vocab_size=1024)
+GPT2_125M = GPT2Config(n_layer=12, n_head=12, d_model=768)
+GPT2_350M = GPT2Config(n_layer=24, n_head=16, d_model=1024)
+GPT2_1_3B = GPT2Config(n_layer=24, n_head=32, d_model=2048)
+
+PRESETS = {"tiny": GPT2_TINY, "125M": GPT2_125M, "350M": GPT2_350M,
+           "1.3B": GPT2_1_3B}
+
+
+def _dtype(cfg):
+    return jnp.dtype(cfg.dtype)
+
+
+class GPT2:
+    """Functional model: ``init(rng) -> params``; ``loss(params, batch, rng)``.
+
+    Params layout (all block tensors carry a leading n_layer dim):
+      wte (V,D) | wpe (T,D) | lnf_{scale,bias} (D,)
+      blocks: ln1_{scale,bias} (L,D), wqkv (L,D,3D), bqkv (L,3D),
+              wo (L,D,D), bo (L,D), ln2_{scale,bias} (L,D),
+              wup (L,D,F), bup (L,F), wdown (L,F,D), bdown (L,D)
+    """
+
+    def __init__(self, config: GPT2Config):
+        self.config = config
+
+    # --- init ---
+    def init(self, rng):
+        cfg = self.config
+        dt = _dtype(cfg)
+        k = iter(jax.random.split(rng, 16))
+        std = 0.02
+        # GPT-2 residual-projection scaling: std/sqrt(2L)
+        res_std = std / math.sqrt(2 * cfg.n_layer)
+        L, D, F, V, T = (cfg.n_layer, cfg.d_model, cfg.d_ff, cfg.vocab_size,
+                         cfg.max_seq_len)
+
+        def nrm(key, shape, s):
+            return (jax.random.normal(key, shape, jnp.float32) * s).astype(dt)
+
+        params = {
+            "wte": nrm(next(k), (V, D), std),
+            "wpe": nrm(next(k), (T, D), std),
+            "lnf_scale": jnp.ones((D,), dt),
+            "lnf_bias": jnp.zeros((D,), dt),
+            "blocks": {
+                "ln1_scale": jnp.ones((L, D), dt),
+                "ln1_bias": jnp.zeros((L, D), dt),
+                "wqkv": nrm(next(k), (L, D, 3 * D), std),
+                "bqkv": jnp.zeros((L, 3 * D), dt),
+                "wo": nrm(next(k), (L, D, D), res_std),
+                "bo": jnp.zeros((L, D), dt),
+                "ln2_scale": jnp.ones((L, D), dt),
+                "ln2_bias": jnp.zeros((L, D), dt),
+                "wup": nrm(next(k), (L, D, F), std),
+                "bup": jnp.zeros((L, F), dt),
+                "wdown": nrm(next(k), (L, F, D), res_std),
+                "bdown": jnp.zeros((L, D), dt),
+            },
+        }
+        return params
+
+    # --- sharding rules ---
+    def partition_specs(self, topology=None):
+        """Megatron TP split on 'tensor' (reference module_inject/auto_tp.py
+        does this by module-name heuristics; here it is the source of truth).
+        Column-parallel: wqkv/wup (out dim); row-parallel: wo/wdown (in dim).
+        Embeddings/LN replicated over 'tensor'."""
+        return {
+            "wte": P(),
+            "wpe": P(),
+            "lnf_scale": P(),
+            "lnf_bias": P(),
+            "blocks": {
+                "ln1_scale": P(None, None),
+                "ln1_bias": P(None, None),
+                "wqkv": P(None, None, "tensor"),
+                "bqkv": P(None, "tensor"),
+                "wo": P(None, "tensor", None),
+                "bo": P(None, None),
+                "ln2_scale": P(None, None),
+                "ln2_bias": P(None, None),
+                "wup": P(None, None, "tensor"),
+                "bup": P(None, "tensor"),
+                "wdown": P(None, "tensor", None),
+                "bdown": P(None, None),
+            },
+        }
+
+    # --- forward ---
+    def apply(self, params, input_ids, *, rng=None, train=False,
+              seq_sharded=False):
+        """Return logits (B, T, V) in fp32.
+
+        ``seq_sharded``: inputs/activations carry T on the 'seq' mesh axis
+        (Ulysses). Attention re-constrains heads onto 'seq' so XLA emits the
+        all_to_all pair.
+        """
+        cfg = self.config
+        dt = _dtype(cfg)
+        B, T = input_ids.shape
+        H, hd = cfg.n_head, cfg.d_head
+
+        act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
+
+        # Sharding constraints are advisory: no-ops without an active mesh
+        # (single-device tests / eager use), GSPMD directives under one.
+        if jax.sharding.get_abstract_mesh().empty:
+            def constrain(x, spec):
+                return x
+        else:
+            def constrain(x, spec):
+                return lax.with_sharding_constraint(x, spec)
+
+        pos = jnp.arange(T)[None, :]
+        x = params["wte"][input_ids] + params["wpe"][pos]
+        x = constrain(x.astype(dt), act_spec)
+        if train and cfg.dropout > 0 and rng is not None:
+            x = _dropout(x, cfg.dropout, jax.random.fold_in(rng, 0))
+
+        # causal mask built once; fp32 scores
+        causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+
+        def block(x, layer):
+            h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
+            qkv = h @ layer["wqkv"] + layer["bqkv"]
+            qkv = qkv.reshape(B, T, 3, H, hd)
+            q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            if seq_sharded:
+                # Ulysses: heads onto 'seq', sequence gathered
+                head_spec = P(BATCH_AXES, None, "seq", None)
+            else:
+                head_spec = P(BATCH_AXES, None, "tensor", None)
+            q = constrain(q, head_spec)
+            kk = constrain(kk, head_spec)
+            v = constrain(v, head_spec)
+
+            scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            scores = jnp.where(causal[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhts,bshd->bthd", probs, v)
+            attn = attn.reshape(B, T, H * hd)
+            attn = constrain(attn, act_spec)
+            x = x + attn @ layer["wo"] + layer["bo"]
+            x = constrain(x, act_spec)
+
+            h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
+            up = jax.nn.gelu(h @ layer["wup"] + layer["bup"])
+            up = constrain(up, P(BATCH_AXES, "seq" if seq_sharded else None,
+                                 "tensor"))
+            x = x + up @ layer["wdown"] + layer["bdown"]
+            x = constrain(x, act_spec)
+            return x
+
+        block_fn = block
+        if cfg.remat:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+            block_fn = jax.checkpoint(block, policy=policy)
+
+        def scan_body(carry, layer):
+            return block_fn(carry, layer), None
+
+        x, _ = lax.scan(scan_body, x, params["blocks"])
+
+        x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+        logits = jnp.einsum("btd,vd->btv", x, params["wte"],
+                            preferred_element_type=jnp.float32)
+        return logits
+
+    # --- loss ---
+    def loss(self, params, batch, *, rng=None, train=True, seq_sharded=False):
+        """Next-token cross entropy. batch: {"input_ids": (B, T) int32}."""
+        ids = batch["input_ids"]
+        logits = self.apply(params, ids, rng=rng, train=train,
+                            seq_sharded=seq_sharded)
+        targets = ids[:, 1:]
+        logits = logits[:, :-1]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+
+def _layernorm(x, scale, bias, eps=1e-5):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32) +
+            bias.astype(jnp.float32)).astype(x.dtype)
+
+
+def _dropout(x, rate, rng):
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)

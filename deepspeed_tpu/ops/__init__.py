@@ -1,0 +1,2 @@
+from .optimizers import (FusedAdam, FusedLamb, FusedLion, FusedAdagrad, SGD,
+                         build_optimizer, OPTIMIZERS)

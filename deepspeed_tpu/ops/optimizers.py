@@ -1,0 +1,233 @@
+"""Fused optimizers: Adam/AdamW, LAMB, Lion, Adagrad, SGD.
+
+Counterparts of the reference's native optimizer tier (csrc/adam/
+multi_tensor_adam.cu:168 FusedAdam, csrc/lamb/fused_lamb_cuda_kernel.cu:478,
+csrc/lion/multi_tensor_lion.cu:126, csrc/adagrad/cpu_adagrad.cpp:256, and the
+Python wrappers ops/adam/fused_adam.py:18 etc.).
+
+On TPU the multi-tensor-apply trick is unnecessary: updates are elementwise
+jnp expressions over the (sharded) param pytree, XLA fuses each leaf's
+update chain into one kernel, and sharded leaves update shard-locally —
+which *is* the ZeRO partitioned-optimizer behavior when the engine shards
+master params/optimizer state over the DP axis. A Pallas fused path exists
+for the flat-buffer hot case (ops/pallas/fused_adam.py).
+
+Protocol (self-contained; optax-style but torch-free):
+    opt.init(params)                      -> state pytree
+    opt.update(grads, state, params, lr)  -> (new_params, new_state)
+params/grads fp32 (master weights); ``lr`` a traced scalar so schedules
+don't trigger recompiles.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _tree_zeros_like(tree):
+    return jax.tree.map(jnp.zeros_like, tree)
+
+
+class FusedAdam:
+    """Adam/AdamW (reference ops/adam/fused_adam.py:18; adam_w_mode=True
+    gives AdamW decoupled weight decay, matching the reference default)."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, bias_correction=True, adam_w_mode=True):
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.bias_correction = bias_correction
+        self.adam_w_mode = adam_w_mode
+
+    def init(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": _tree_zeros_like(params),
+                "v": _tree_zeros_like(params)}
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        step = state["step"] + 1
+        b1, b2 = self.b1, self.b2
+        if self.bias_correction:
+            c1 = 1.0 - b1 ** step.astype(jnp.float32)
+            c2 = 1.0 - b2 ** step.astype(jnp.float32)
+        else:
+            c1 = c2 = 1.0
+
+        def leaf(p, g, m, v):
+            if not self.adam_w_mode and self.weight_decay:
+                g = g + self.weight_decay * p  # classic L2
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * jnp.square(g)
+            upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
+            if self.adam_w_mode and self.weight_decay:
+                upd = upd + self.weight_decay * p
+            return p - lr * upd, m, v
+
+        out = jax.tree.map(leaf, params, grads, state["m"], state["v"])
+        new_params = jax.tree.map(lambda t: t[0], out,
+                                  is_leaf=lambda t: isinstance(t, tuple))
+        new_m = jax.tree.map(lambda t: t[1], out,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        new_v = jax.tree.map(lambda t: t[2], out,
+                             is_leaf=lambda t: isinstance(t, tuple))
+        return new_params, {"step": step, "m": new_m, "v": new_v}
+
+
+class FusedLamb:
+    """LAMB (reference ops/lamb/fused_lamb.py): Adam update rescaled by the
+    per-leaf trust ratio ||p|| / ||update||. Norms over sharded leaves are
+    global under GSPMD (psum inserted automatically)."""
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-6,
+                 weight_decay=0.0, max_coeff=10.0, min_coeff=0.01):
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+
+    def init(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": _tree_zeros_like(params),
+                "v": _tree_zeros_like(params)}
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        step = state["step"] + 1
+        b1, b2 = self.b1, self.b2
+        c1 = 1.0 - b1 ** step.astype(jnp.float32)
+        c2 = 1.0 - b2 ** step.astype(jnp.float32)
+
+        def leaf(p, g, m, v):
+            m = b1 * m + (1 - b1) * g
+            v = b2 * v + (1 - b2) * jnp.square(g)
+            upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p
+            p_norm = jnp.linalg.norm(p)
+            u_norm = jnp.linalg.norm(upd)
+            trust = jnp.where(
+                (p_norm > 0) & (u_norm > 0),
+                jnp.clip(p_norm / u_norm, self.min_coeff, self.max_coeff),
+                1.0)
+            return p - lr * trust * upd, m, v
+
+        out = jax.tree.map(leaf, params, grads, state["m"], state["v"])
+        unzip = lambda i: jax.tree.map(lambda t: t[i], out,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        return unzip(0), {"step": step, "m": unzip(1), "v": unzip(2)}
+
+
+class FusedLion:
+    """Lion (reference ops/lion/fused_lion.py): sign of the interpolated
+    momentum; half the optimizer memory of Adam."""
+
+    def __init__(self, lr=1e-4, betas=(0.9, 0.99), weight_decay=0.0):
+        self.lr = lr
+        self.b1, self.b2 = betas
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "m": _tree_zeros_like(params)}
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        b1, b2 = self.b1, self.b2
+
+        def leaf(p, g, m):
+            upd = jnp.sign(b1 * m + (1 - b1) * g)
+            if self.weight_decay:
+                upd = upd + self.weight_decay * p
+            return p - lr * upd, b2 * m + (1 - b2) * g
+
+        out = jax.tree.map(leaf, params, grads, state["m"])
+        unzip = lambda i: jax.tree.map(lambda t: t[i], out,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        return unzip(0), {"step": state["step"] + 1, "m": unzip(1)}
+
+
+class FusedAdagrad:
+    """Adagrad (reference csrc/adagrad/cpu_adagrad.cpp:256)."""
+
+    def __init__(self, lr=1e-2, eps=1e-10, weight_decay=0.0):
+        self.lr = lr
+        self.eps = eps
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        return {"step": jnp.zeros((), jnp.int32),
+                "v": _tree_zeros_like(params)}
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+
+        def leaf(p, g, v):
+            if self.weight_decay:
+                g = g + self.weight_decay * p
+            v = v + jnp.square(g)
+            return p - lr * g / (jnp.sqrt(v) + self.eps), v
+
+        out = jax.tree.map(leaf, params, grads, state["v"])
+        unzip = lambda i: jax.tree.map(lambda t: t[i], out,
+                                       is_leaf=lambda t: isinstance(t, tuple))
+        return unzip(0), {"step": state["step"] + 1, "v": unzip(1)}
+
+
+class SGD:
+    def __init__(self, lr=1e-2, momentum=0.0, weight_decay=0.0):
+        self.lr = lr
+        self.momentum = momentum
+        self.weight_decay = weight_decay
+
+    def init(self, params):
+        if self.momentum:
+            return {"step": jnp.zeros((), jnp.int32),
+                    "m": _tree_zeros_like(params)}
+        return {"step": jnp.zeros((), jnp.int32)}
+
+    def update(self, grads, state, params, lr=None):
+        lr = self.lr if lr is None else lr
+        if self.weight_decay:
+            grads = jax.tree.map(lambda g, p: g + self.weight_decay * p,
+                                 grads, params)
+        if self.momentum:
+            new_m = jax.tree.map(lambda m, g: self.momentum * m + g,
+                                 state["m"], grads)
+            new_p = jax.tree.map(lambda p, m: p - lr * m, params, new_m)
+            return new_p, {"step": state["step"] + 1, "m": new_m}
+        new_p = jax.tree.map(lambda p, g: p - lr * g, params, grads)
+        return new_p, {"step": state["step"] + 1}
+
+
+# registry used by the engine's _configure_basic_optimizer
+# (reference runtime/engine.py:1294; names at engine.py:39-41)
+OPTIMIZERS = {
+    "adam": FusedAdam,
+    "adamw": FusedAdam,
+    "fusedadam": FusedAdam,
+    "lamb": FusedLamb,
+    "fusedlamb": FusedLamb,
+    "lion": FusedLion,
+    "fusedlion": FusedLion,
+    "adagrad": FusedAdagrad,
+    "sgd": SGD,
+}
+
+
+def build_optimizer(name, params_cfg):
+    key = name.lower()
+    if key not in OPTIMIZERS:
+        raise ValueError(
+            f"unknown optimizer '{name}'; available: {sorted(set(OPTIMIZERS))}")
+    cls = OPTIMIZERS[key]
+    kwargs = dict(params_cfg)
+    if key in ("adam", "fusedadam"):
+        kwargs.setdefault("adam_w_mode", True)
+    elif key == "adamw":
+        kwargs["adam_w_mode"] = True
+    kwargs.pop("torch_adam", None)
+    return cls(**kwargs)

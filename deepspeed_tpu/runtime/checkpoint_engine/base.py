@@ -1,0 +1,44 @@
+"""Checkpoint engine plugin interface.
+
+Counterpart of reference ``runtime/checkpoint_engine/checkpoint_engine.py:9``
+including the fork's additions: the base API is
+``create/save/load/commit`` and the fork adds ``wait()/shutdown()`` for
+async engines (SURVEY §5.4; engine.save_checkpoint_terminate at
+engine.py:3114 does barrier -> shutdown -> barrier).
+
+A "state_dict" here is a pytree of host numpy arrays plus JSON-able
+metadata; engines only move bytes. Device->host staging is the engine
+caller's job (runtime/engine.py save_checkpoint), mirroring how the
+reference's VELOC engine receives tensors and owns the D2H pipeline.
+"""
+
+
+class CheckpointEngine:
+    def __init__(self, config_params=None):
+        self.config = config_params
+
+    def create(self, tag):
+        """Log/prepare for a save under ``tag``."""
+
+    def makedirs(self, path, exist_ok=False):
+        import os
+        os.makedirs(path, exist_ok=exist_ok)
+
+    def save(self, state_dict, path):
+        raise NotImplementedError
+
+    def load(self, path, map_location=None):
+        raise NotImplementedError
+
+    def commit(self, tag):
+        """Mark ``tag`` durable (reference: nebula/veloc commit)."""
+        return True
+
+    def wait(self, version=None):
+        """Block until async work for ``version`` (or all) is durable.
+        Fork addition (veloc_checkpoint_engine.py wait)."""
+        return True
+
+    def shutdown(self):
+        """Drain and stop background machinery (fork addition)."""
+        return True

@@ -1,0 +1,156 @@
+"""Checkpoint engine implementations.
+
+Parity with the reference's plugin set (engine.py:931-963 selection):
+  * SyncCheckpointEngine   — torch_checkpoint_engine.py:12 equivalent.
+  * AsyncCheckpointEngine  — async_checkpoint_engine.py:17 equivalent:
+    device->host staging happens on the caller (fast path), serialization +
+    file IO on a thread pool; ``wait()`` drains, ``shutdown()`` joins.
+  * NativeCheckpointEngine — veloc_checkpoint_engine.py:42 equivalent:
+    same pipeline but the file write goes through the C++ writer pool
+    (op_builder 'native_ckpt', csrc/ckpt_writer.cpp) with pwrite'd chunks —
+    the VELOC _d2h_trf/_h2f_trf split re-imagined for TPU hosts.
+  * NoneCheckpointEngine   — none_checkpoint_engine.py:12: no-op for
+    measuring checkpoint overhead.
+"""
+
+import concurrent.futures as futures
+import os
+import threading
+
+from ...utils.logging import logger, log_dist
+from .base import CheckpointEngine
+from . import serialization as ser
+
+
+class SyncCheckpointEngine(CheckpointEngine):
+    def save(self, state_dict, path, on_durable=None):
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tree, extra = state_dict
+        ser.save_file(path, tree, extra_meta=extra)
+        if on_durable is not None:
+            on_durable()
+
+    def load(self, path, map_location=None):
+        return ser.load_file(path)
+
+
+class NoneCheckpointEngine(CheckpointEngine):
+    def save(self, state_dict, path, on_durable=None):
+        return True
+
+    def load(self, path, map_location=None):
+        raise RuntimeError("NoneCheckpointEngine cannot load")
+
+
+class AsyncCheckpointEngine(CheckpointEngine):
+    """Thread-pool writer. The caller stages device arrays to host (the
+    cheap, bandwidth-bound part — analogous to VELOC's pinned-cache D2H);
+    serialization+IO (the slow part) happens off the training thread."""
+
+    def __init__(self, config_params=None, max_workers=None, max_inflight=2):
+        super().__init__(config_params)
+        workers = max_workers or getattr(config_params, "writer_threads", 2)
+        self.max_inflight = getattr(config_params, "max_inflight",
+                                    max_inflight)
+        self._pool = futures.ThreadPoolExecutor(max_workers=workers)
+        self._inflight = {}
+        self._lock = threading.Lock()
+        self._version = 0
+
+    def save(self, state_dict, path, on_durable=None):
+        with self._lock:
+            self._version += 1
+            version = self._version
+        # backpressure: bound staged-copy memory like VELOC's host cache
+        while len([f for f in self._inflight.values() if not f.done()]) \
+                >= self.max_inflight:
+            self.wait(min(self._inflight))
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tree, extra = state_dict
+
+        def task():
+            ser.save_file(path, tree, extra_meta=extra)
+            # durability callback runs on the writer thread AFTER the bytes
+            # land, so e.g. the 'latest' pointer never names a torn file
+            if on_durable is not None:
+                on_durable()
+
+        fut = self._pool.submit(task)
+        self._inflight[version] = fut
+        return version
+
+    def load(self, path, map_location=None):
+        self.wait()
+        return ser.load_file(path)
+
+    def wait(self, version=None):
+        items = (list(self._inflight.items()) if version is None
+                 else [(version, self._inflight[version])]
+                 if version in self._inflight else [])
+        for v, fut in items:
+            fut.result()
+            self._inflight.pop(v, None)
+        return True
+
+    def commit(self, tag):
+        return True
+
+    def shutdown(self):
+        self.wait()
+        self._pool.shutdown(wait=True)
+        return True
+
+
+class NativeCheckpointEngine(AsyncCheckpointEngine):
+    """Async engine whose byte-writing goes through the C++ writer pool
+    when available (falls back to the pure-python path)."""
+
+    def __init__(self, config_params=None, **kw):
+        super().__init__(config_params, **kw)
+        try:
+            from ...ops.native import ckpt_writer
+            self._writer = ckpt_writer.Writer(
+                threads=getattr(config_params, "writer_threads", 2))
+        except Exception as e:  # noqa: BLE001 - optional native ext
+            logger.warning(f"native ckpt writer unavailable ({e}); "
+                           "using python writer")
+            self._writer = None
+
+    def save(self, state_dict, path, on_durable=None):
+        if self._writer is None:
+            return super().save(state_dict, path, on_durable=on_durable)
+        with self._lock:
+            self._version += 1
+            version = self._version
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tree, extra = state_dict
+        fut = self._pool.submit(self._native_save, path, tree, extra,
+                                on_durable)
+        self._inflight[version] = fut
+        return version
+
+    def _native_save(self, path, tree, extra, on_durable=None):
+        # serialize to bytes in-thread, write via the native pwrite pool
+        import io
+        bio = io.BytesIO()
+        ser.save_file(bio, tree, extra_meta=extra)
+        self._writer.write(path, bio.getbuffer())
+        if on_durable is not None:
+            on_durable()
+
+
+ENGINES = {
+    "sync": SyncCheckpointEngine,
+    "async": AsyncCheckpointEngine,
+    "native": NativeCheckpointEngine,
+    "none": NoneCheckpointEngine,
+}
+
+
+def create_checkpoint_engine(cfg):
+    """cfg: CheckpointEngineConfig (runtime/config.py)."""
+    typ = getattr(cfg, "type", "sync")
+    if typ not in ENGINES:
+        raise ValueError(f"unknown checkpoint engine '{typ}'; "
+                         f"available: {sorted(ENGINES)}")
+    return ENGINES[typ](cfg)

@@ -1,0 +1,74 @@
+"""Checkpoint serialization: pytree <-> flat npz-style container.
+
+Torch-free replacement for ``torch.save``: a checkpoint file is a zip
+(via numpy.savez) of leaf arrays keyed by escaped tree paths, plus a
+``__meta__`` JSON entry carrying the treedef and non-array values. The
+layout is *sharding-agnostic*: leaves are GLOBAL logical arrays, so a
+checkpoint written under one ZeRO stage / mesh loads under any other — the
+capability the reference needs offline conversion for
+(checkpoint/ds_to_universal.py)."""
+
+import io
+import json
+
+import numpy as np
+import jax
+
+
+_SEP = "/"
+
+
+def flatten_state(tree):
+    """-> (dict path->leaf, meta dict of non-array leaves)."""
+    flat = {}
+    meta = {}
+    for path, leaf in jax.tree.leaves_with_path(tree):
+        key = _SEP.join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        if isinstance(leaf, (int, float, str, bool)) or leaf is None:
+            meta[key] = leaf
+        else:
+            flat[key] = leaf
+    return flat, meta
+
+
+def unflatten_into(template, flat, meta=None):
+    """Rebuild a pytree shaped like ``template`` from flat path->array."""
+    meta = meta or {}
+
+    def pick(path, leaf):
+        key = _SEP.join(str(getattr(p, "key", getattr(p, "idx", p)))
+                        for p in path)
+        if key in flat:
+            return flat[key]
+        if key in meta:
+            return meta[key]
+        raise KeyError(f"checkpoint missing key {key}")
+
+    return jax.tree.map_with_path(pick, template)
+
+
+def save_file(path, tree, extra_meta=None):
+    flat, meta = flatten_state(tree)
+    arrays = {}
+    for k, v in flat.items():
+        arr = np.asarray(v)
+        # np.savez keys cannot contain '/': escape
+        arrays[k.replace("/", "%2F")] = arr
+    header = {"meta": meta, "extra": extra_meta or {}, "version": 1}
+    arrays["__meta__"] = np.frombuffer(
+        json.dumps(header).encode(), dtype=np.uint8)
+    if hasattr(path, "write"):
+        np.savez(path, **arrays)
+    else:
+        with open(path, "wb") as f:
+            np.savez(f, **arrays)
+
+
+def load_file(path):
+    """-> (flat dict path->array, header dict)."""
+    with np.load(path, allow_pickle=False) as z:
+        header = json.loads(bytes(z["__meta__"].tobytes()).decode())
+        flat = {k.replace("%2F", "/"): z[k] for k in z.files
+                if k != "__meta__"}
+    return flat, header

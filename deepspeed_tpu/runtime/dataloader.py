@@ -1,0 +1,63 @@
+"""Data loading helpers.
+
+Counterpart of reference ``runtime/dataloader.py`` (DeepSpeedDataLoader) and
+``engine.py:1715 deepspeed_io``. Torch-free: a dataset is any sequence or
+iterable of (dict of) numpy arrays; batches are stacked host-side and the
+engine shards them onto the mesh.
+"""
+
+import numpy as np
+
+
+class RepeatingLoader:
+    """reference runtime/dataloader.py RepeatingLoader: wraps an iterator,
+    restarting it when exhausted."""
+
+    def __init__(self, loader):
+        self.loader = loader
+        self.data_iter = iter(self.loader)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        try:
+            return next(self.data_iter)
+        except StopIteration:
+            self.data_iter = iter(self.loader)
+            return next(self.data_iter)
+
+
+class DeepSpeedDataLoader:
+    """Batches an indexable dataset of pytrees of arrays.
+
+    Each item: dict of numpy arrays (or a single array). drop_last always
+    (static shapes keep XLA happy — the reference pads instead)."""
+
+    def __init__(self, dataset, batch_size, shuffle=False, seed=0,
+                 collate_fn=None):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.collate_fn = collate_fn or _default_collate
+        self.epoch = 0
+
+    def __len__(self):
+        return len(self.dataset) // self.batch_size
+
+    def __iter__(self):
+        order = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.RandomState(self.seed + self.epoch).shuffle(order)
+        self.epoch += 1
+        for i in range(len(self)):
+            idx = order[i * self.batch_size:(i + 1) * self.batch_size]
+            yield self.collate_fn([self.dataset[int(j)] for j in idx])
+
+
+def _default_collate(items):
+    first = items[0]
+    if isinstance(first, dict):
+        return {k: np.stack([it[k] for it in items]) for k in first}
+    return np.stack(items)

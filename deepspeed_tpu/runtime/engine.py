@@ -1,0 +1,567 @@
+"""DeepSpeedEngine — the training engine.
+
+Counterpart of reference ``runtime/engine.py:181 DeepSpeedEngine`` (init
+pipeline SURVEY §3.1, fwd/bwd/step §3.2). TPU-first redesign:
+
+  * The train state (bf16 params, fp32 master, optimizer state, loss-scale
+    state, step) is ONE pytree whose leaves carry NamedShardings computed by
+    the ZeRO plan (runtime/zero/partitioning.py). What the reference does
+    with hooks + buckets + streams, XLA does from the sharding annotations:
+    stage-1 partitioned update + step-end allgather, stage-2 reduce_scatter,
+    stage-3 per-layer gather, all overlapped by XLA's latency-hiding
+    scheduler (the `overlap_comm` analogue).
+  * `train_batch()` is one jitted program: `lax.scan` over gradient
+    accumulation micro-steps, grad clip, overflow-safe optimizer update with
+    in-state dynamic loss scaling (no host sync per step, unlike the
+    reference's CheckOverflow).
+  * The staged `forward()/backward()/step()` API is kept for parity: forward
+    computes loss+grads in one jitted call (autodiff is a transform, not a
+    tape), backward accumulates into a sharded grad buffer, step applies the
+    update at the accumulation boundary (reference
+    is_gradient_accumulation_boundary semantics).
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .. import comm as dist
+from ..ops.optimizers import build_optimizer
+from ..utils import groups
+from ..utils.groups import TopologyConfig, BATCH_AXES
+from ..utils.logging import logger, log_dist
+from ..utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
+                           TRAIN_BATCH_TIMER)
+from .config import DeepSpeedConfig
+from .fp16.loss_scaler import create_loss_scaler, grads_finite
+from .lr_schedules import build_scheduler
+from .zero.partitioning import ZeroShardingPlan
+
+
+def _tree_cast(tree, dtype):
+    return jax.tree.map(lambda x: x.astype(dtype), tree)
+
+
+def _is_spec(x):
+    return isinstance(x, P)
+
+
+class DeepSpeedEngine:
+    def __init__(self, model, config, optimizer=None, lr_scheduler=None,
+                 topology=None, seed=0):
+        # --- topology & config (reference engine.py:1112
+        #     _configure_distributed_model) ---
+        if topology is None:
+            if isinstance(config, dict):
+                raw = config
+            elif isinstance(config, DeepSpeedConfig):
+                raw = config._raw
+            else:
+                raw = DeepSpeedConfig(config, dp_world_size=1)._raw
+            topology = groups.initialize(TopologyConfig(
+                tensor_parallel_size=raw.get("tensor_parallel", {}).get("size", 1),
+                pipe_parallel_size=raw.get("pipeline", {}).get("stages", 1),
+                seq_parallel_size=raw.get("sequence_parallel_size", 1),
+                expert_parallel_size=raw.get("expert_parallel_size", 1),
+            ))
+        self.topology = topology
+        self.mesh = topology.mesh
+        dp_world = topology.get_data_parallel_world_size()
+        self.config = (config if isinstance(config, DeepSpeedConfig)
+                       else DeepSpeedConfig(config, dp_world_size=dp_world))
+        dist.configure(self.config)
+
+        self.model = model
+        self.zero_stage = self.config.zero.stage
+        self.param_dtype = self.config.precision_dtype
+        self.global_step = 0
+        self.micro_steps = 0
+
+        # --- optimizer / scheduler (reference engine.py:1246,:915) ---
+        if optimizer is None:
+            if self.config.optimizer is None:
+                raise ValueError("no optimizer: pass one or set config['optimizer']")
+            optimizer = build_optimizer(self.config.optimizer.type,
+                                        self.config.optimizer.params)
+        self.optimizer = optimizer
+        if lr_scheduler is None and self.config.scheduler is not None:
+            lr_scheduler = build_scheduler(self.config.scheduler.type,
+                                           self.config.scheduler.params)
+        self.lr_scheduler = lr_scheduler
+
+        self.loss_scaler = create_loss_scaler(self.config.fp16,
+                                              self.param_dtype)
+
+        # --- sharding plan + state materialization (reference zero.Init +
+        #     _configure_zero_optimizer) ---
+        self._build_state(seed)
+        self._build_programs()
+
+        from .checkpoint_engine.engines import create_checkpoint_engine
+        self.checkpoint_engine = create_checkpoint_engine(
+            self.config.checkpoint_engine)
+
+        self.timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=self.config.train_batch_size,
+            steps_per_output=self.config.steps_per_print)
+        log_dist(
+            f"engine ready: zero_stage={self.zero_stage} dtype={self.param_dtype} "
+            f"dp={dp_world} tp={topology.get_model_parallel_world_size()} "
+            f"sp={topology.get_sequence_parallel_world_size()} "
+            f"ep={topology.get_expert_parallel_world_size()} "
+            f"micro_bs={self.config.train_micro_batch_size_per_gpu} "
+            f"gas={self.config.gradient_accumulation_steps}", ranks=[0])
+
+    # ------------------------------------------------------------------ state
+    def _build_state(self, seed):
+        rng = jax.random.key(seed)
+        abstract = jax.eval_shape(self.model.init, rng)
+        shapes = jax.tree.map(lambda l: l.shape, abstract)
+        tp_specs = self.model.partition_specs(self.topology)
+        self.plan = ZeroShardingPlan(self.zero_stage, self.mesh, tp_specs,
+                                     shapes)
+        param_sh = self.plan.shardings("param")
+        master_sh = self.plan.shardings("master")
+        self.param_shardings = param_sh
+        self.master_shardings = master_sh
+        self.grad_shardings = self.plan.shardings("grad")
+
+        self.use_master = self.param_dtype != jnp.float32
+
+        with jax.set_mesh(self.mesh):
+            params = jax.jit(
+                lambda r: _tree_cast(self.model.init(r), self.param_dtype),
+                out_shardings=param_sh)(rng)
+            if self.use_master:
+                master = jax.jit(lambda p: _tree_cast(p, jnp.float32),
+                                 out_shardings=master_sh)(params)
+            else:
+                # fp32 training: master IS params (sharded per master plan
+                # from stage>=1; the update allgathers into param specs)
+                master = jax.jit(lambda p: p, out_shardings=master_sh)(params)
+            opt_sh = self._opt_state_shardings(master)
+            opt_state = jax.jit(self.optimizer.init,
+                                out_shardings=opt_sh)(master)
+        self.opt_shardings = opt_sh
+
+        scale_state = jax.device_put(
+            self.loss_scaler.init_state(),
+            jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
+                         self.loss_scaler.init_state()))
+        self.state = {
+            "params": params,
+            "master": master,
+            "opt": opt_state,
+            "scale": scale_state,
+            "step": jax.device_put(jnp.zeros((), jnp.int32),
+                                   NamedSharding(self.mesh, P())),
+            # overflow-skip counter lives on device so counting it never
+            # forces a host sync (reference syncs CheckOverflow every step)
+            "skipped": jax.device_put(jnp.zeros((), jnp.int32),
+                                      NamedSharding(self.mesh, P())),
+            "rng": jax.device_put(jax.random.key(seed + 1),
+                                  NamedSharding(self.mesh, P())),
+        }
+        self.state_shardings = {
+            "params": param_sh, "master": master_sh, "opt": opt_sh,
+            "scale": jax.tree.map(
+                lambda _: NamedSharding(self.mesh, P()), scale_state),
+            "step": NamedSharding(self.mesh, P()),
+            "skipped": NamedSharding(self.mesh, P()),
+            "rng": NamedSharding(self.mesh, P()),
+        }
+        # grad accumulation buffer for the staged API (lazy)
+        self._acc_grads = None
+        self._pending_loss = None
+
+    def _opt_state_shardings(self, master):
+        """Optimizer state sharding: subtrees structurally matching the
+        param tree inherit master shardings (m/v/etc.); scalars replicate."""
+        master_def = jax.tree.structure(master)
+        state_shape = jax.eval_shape(self.optimizer.init, master)
+        repl = NamedSharding(self.mesh, P())
+        out = {}
+        for key, sub in state_shape.items():
+            if jax.tree.structure(sub) == master_def:
+                out[key] = self.master_shardings
+            else:
+                out[key] = jax.tree.map(lambda _: repl, sub)
+        return out
+
+    # -------------------------------------------------------------- programs
+    def _model_loss(self, params, batch, rng):
+        kwargs = {}
+        if self.topology.get_sequence_parallel_world_size() > 1:
+            kwargs["seq_sharded"] = True
+        return self.model.loss(params, batch, rng=rng, train=True, **kwargs)
+
+    def _build_programs(self):
+        gas = self.config.gradient_accumulation_steps
+        clip = self.config.gradient_clipping
+        opt = self.optimizer
+        scaler = self.loss_scaler
+        grad_specs = self.plan.grad_specs
+        param_specs = self.plan.param_specs
+        pdtype = self.param_dtype
+        use_master = self.use_master
+        constrain = jax.lax.with_sharding_constraint
+
+        def micro_loss_and_grads(params, micro_batch, rng, scale):
+            def scaled(p):
+                return self._model_loss(p, micro_batch, rng) * scale
+            loss_scaled, grads = jax.value_and_grad(scaled)(params)
+            # accumulate/reduce in fp32 (reference grad_accum_dtype default)
+            grads = _tree_cast(grads, jnp.float32)
+            return loss_scaled / scale, grads
+
+        def apply_update(state, grads, lr):
+            """grads: fp32 tree, already averaged over GAS; scale included."""
+            scale = state["scale"]["scale"]
+            grads = jax.tree.map(lambda g, s: constrain(g / scale, s),
+                                 grads, grad_specs)
+            finite = grads_finite(grads)
+            # global grad norm (GSPMD inserts the cross-shard psum)
+            if clip and clip > 0:
+                sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+                gnorm = jnp.sqrt(sq)
+                coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+                grads = jax.tree.map(lambda g: g * coef, grads)
+            else:
+                sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
+                gnorm = jnp.sqrt(sq)
+            new_master, new_opt = opt.update(grads, state["opt"],
+                                             state["master"], lr=lr)
+            # skip-on-overflow: keep old state where not finite
+            sel = lambda new, old: jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new, old)
+            new_master = sel(new_master, state["master"])
+            new_opt = sel(new_opt, state["opt"])
+            new_params = jax.tree.map(
+                lambda m, s: constrain(m.astype(pdtype), s),
+                new_master, param_specs) if use_master else jax.tree.map(
+                lambda m, s: constrain(m, s), new_master, param_specs)
+            new_scale = scaler.update(state["scale"], ~finite)
+            new_state = dict(state)
+            new_state.update(params=new_params, master=new_master,
+                             opt=new_opt, scale=new_scale,
+                             step=state["step"] + 1,
+                             skipped=state["skipped"]
+                             + jnp.where(finite, 0, 1).astype(jnp.int32),
+                             rng=jax.random.fold_in(state["rng"], 0))
+            metrics = {"grad_norm": gnorm, "overflow": ~finite,
+                       "loss_scale": scale}
+            return new_state, metrics
+
+        def train_step(state, batch, lr):
+            """batch leaves: (gas, per_step_batch, ...)"""
+            scale = state["scale"]["scale"]
+
+            def body(carry, micro):
+                acc, rng, i = carry
+                loss, grads = micro_loss_and_grads(
+                    state["params"], micro, jax.random.fold_in(rng, i), scale)
+                grads = jax.tree.map(lambda g, s: constrain(g, s),
+                                     grads, grad_specs)
+                acc = jax.tree.map(lambda a, g: a + g / gas, acc, grads)
+                return (acc, rng, i + 1), loss
+
+            zero_grads = jax.tree.map(
+                lambda s: jnp.zeros(s.shape, jnp.float32),
+                jax.eval_shape(lambda p: _tree_cast(p, jnp.float32),
+                               state["params"]))
+            zero_grads = jax.tree.map(lambda g, s: constrain(g, s),
+                                      zero_grads, grad_specs)
+            (grads, _, _), losses = jax.lax.scan(
+                body, (zero_grads, state["rng"], 0), batch)
+            # accumulated grads carry the loss scale; apply_update divides
+            # it out once.
+            new_state, metrics = apply_update(state, grads, lr)
+            metrics["loss"] = jnp.mean(losses)
+            return new_state, metrics
+
+        def micro_step(state, batch, micro_idx):
+            scale = state["scale"]["scale"]
+            rng = jax.random.fold_in(state["rng"], micro_idx)
+            loss, grads = micro_loss_and_grads(state["params"], batch, rng,
+                                               scale)
+            grads = jax.tree.map(lambda g, s: constrain(g, s), grads,
+                                 grad_specs)
+            return loss, grads
+
+        def acc_add(acc, grads):
+            return jax.tree.map(lambda a, g: a + g / gas, acc, grads)
+
+        st_sh = lambda: self.state_shardings
+        with jax.set_mesh(self.mesh):
+            self._train_step_jit = jax.jit(
+                train_step, donate_argnums=(0,),
+                in_shardings=(st_sh(), None, None),
+                out_shardings=(st_sh(), None))
+            self._micro_step_jit = jax.jit(
+                micro_step, in_shardings=(st_sh(), None, None),
+                out_shardings=(None, self.grad_shardings))
+            eval_kwargs = {}
+            if self.topology.get_sequence_parallel_world_size() > 1:
+                eval_kwargs["seq_sharded"] = True
+            self._eval_loss_jit = jax.jit(functools.partial(
+                self.model.loss, train=False, **eval_kwargs))
+            self._acc_add_jit = jax.jit(
+                acc_add, donate_argnums=(0,),
+                in_shardings=(self.grad_shardings, self.grad_shardings),
+                out_shardings=self.grad_shardings)
+            self._apply_update_jit = jax.jit(
+                apply_update, donate_argnums=(0, 1),
+                in_shardings=(st_sh(), self.grad_shardings, None),
+                out_shardings=(st_sh(), None))
+
+    # ----------------------------------------------------------------- batch
+    def _current_lr(self):
+        if self.lr_scheduler is not None:
+            return jnp.asarray(self.lr_scheduler(self.global_step),
+                               jnp.float32)
+        return jnp.asarray(self.optimizer.lr, jnp.float32)
+
+    def _shard_batch(self, batch, with_gas_dim):
+        """Host batch -> global sharded arrays. Leaves (B_total, ...) or
+        (gas, B, ...) when with_gas_dim."""
+        seq_sharded = self.topology.get_sequence_parallel_world_size() > 1
+
+        def put(x):
+            x = np.asarray(x)
+            dims = [None] * x.ndim
+            b_dim = 1 if with_gas_dim else 0
+            dims[b_dim] = BATCH_AXES
+            if seq_sharded and x.ndim > b_dim + 1:
+                dims[b_dim + 1] = "seq"
+            return jax.device_put(
+                x, NamedSharding(self.mesh, P(*dims)))
+
+        return jax.tree.map(put, batch)
+
+    def train_batch(self, batch):
+        """One full optimizer step over a global batch.
+
+        batch leaves: (train_batch_size, ...) host arrays; reshaped to
+        (gas, train_batch_size // gas, ...) and scanned.
+        """
+        gas = self.config.gradient_accumulation_steps
+        self.tput_timer.start()
+
+        def add_gas(x):
+            x = np.asarray(x)
+            assert x.shape[0] == self.config.train_batch_size, (
+                f"batch dim {x.shape[0]} != train_batch_size "
+                f"{self.config.train_batch_size}")
+            return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+
+        batch = jax.tree.map(add_gas, batch)
+        batch = self._shard_batch(batch, with_gas_dim=True)
+        with jax.set_mesh(self.mesh):
+            self.state, metrics = self._train_step_jit(
+                self.state, batch, self._current_lr())
+        self.global_step += 1
+        self.micro_steps += gas
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self.tput_timer.stop(global_step=True,
+                             sync_arrays=metrics["loss"])
+        self._maybe_print(metrics)
+        return metrics["loss"]
+
+    # ------------------------------------------- staged fwd/bwd/step (parity)
+    def forward(self, batch):
+        """loss = engine(batch): computes loss AND grads (one fused jitted
+        call — autodiff is a transform, not a tape) for the current micro
+        batch; grads are staged for step()."""
+        batch = self._shard_batch(batch, with_gas_dim=False)
+        micro_idx = jnp.asarray(
+            self.micro_steps % max(1, self.config.gradient_accumulation_steps),
+            jnp.int32)
+        with jax.set_mesh(self.mesh):
+            loss, grads = self._micro_step_jit(self.state, batch, micro_idx)
+            if self._acc_grads is None:
+                zeros = jax.jit(
+                    lambda g: jax.tree.map(jnp.zeros_like, g),
+                    out_shardings=self.grad_shardings)(grads)
+                self._acc_grads = zeros
+            self._acc_grads = self._acc_add_jit(self._acc_grads, grads)
+        self._pending_loss = loss
+        return loss
+
+    __call__ = forward
+
+    def backward(self, loss=None):
+        """Grads were produced in forward(); kept for API parity
+        (reference engine.py:1968)."""
+        self.micro_steps += 1
+        return loss if loss is not None else self._pending_loss
+
+    def is_gradient_accumulation_boundary(self):
+        return self.micro_steps % self.config.gradient_accumulation_steps == 0
+
+    def step(self):
+        """Apply the optimizer at accumulation boundaries (reference
+        engine.py:2170: non-boundary steps are no-ops)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        assert self._acc_grads is not None, "step() before forward()"
+        with jax.set_mesh(self.mesh):
+            self.state, metrics = self._apply_update_jit(
+                self.state, self._acc_grads, self._current_lr())
+        self._acc_grads = None
+        self.global_step += 1
+        if self.lr_scheduler is not None:
+            self.lr_scheduler.step()
+        self._maybe_print(metrics)
+        return metrics
+
+    # ------------------------------------------------------------------ misc
+    def _maybe_print(self, metrics):
+        if (self.config.steps_per_print and
+                self.global_step % self.config.steps_per_print == 0):
+            loss = metrics.get("loss")
+            loss_s = f"loss={float(loss):.4f} " if loss is not None else ""
+            log_dist(
+                f"step={self.global_step} {loss_s}"
+                f"lr={float(self._current_lr()):.3e} "
+                f"grad_norm={float(metrics['grad_norm']):.3f} "
+                f"scale={float(metrics['loss_scale']):.0f} "
+                f"overflow={bool(metrics['overflow'])}", ranks=[0])
+
+    def get_lr(self):
+        return [float(self._current_lr())]
+
+    def get_global_grad_norm(self):
+        return None  # computed in-step; exposed via metrics
+
+    @property
+    def params(self):
+        return self.state["params"]
+
+    @property
+    def skipped_steps(self):
+        return int(np.asarray(self.state["skipped"]))
+
+    # ------------------------------------------------------------ checkpoint
+    def _ckpt_tree(self):
+        """Device state staged for saving: fp32 master + optimizer + scale +
+        counters. bf16 params are re-derived on load (cast of master)."""
+        return {"master": self.state["master"], "opt": self.state["opt"],
+                "scale": self.state["scale"], "step": self.state["step"],
+                "skipped": self.state["skipped"],
+                "rng_data": jax.random.key_data(self.state["rng"])}
+
+    def save_checkpoint(self, save_dir, tag=None, client_state=None,
+                        save_latest=True):
+        """reference engine.py:3124. Layout:
+        {save_dir}/{tag}/state.npz + {save_dir}/latest (shared FS, like the
+        reference assumes).
+
+        Arrays are saved as GLOBAL logical tensors (shards gathered), so any
+        ZeRO stage / mesh can load any checkpoint — the property the
+        reference needs checkpoint/ds_to_universal.py for. The 'latest'
+        pointer is written by the checkpoint engine only after the bytes are
+        durable, so a crash mid-write can't leave it naming a torn file.
+        """
+        import os
+        tag = tag or f"global_step{self.global_step}"
+        self.checkpoint_engine.create(tag)
+        # D2H staging (the VELOC _d2h_trf analogue; synchronous,
+        # bandwidth-bound), then the engine writes async if configured.
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            host_tree = multihost_utils.process_allgather(self._ckpt_tree())
+        else:
+            host_tree = jax.device_get(self._ckpt_tree())
+        if jax.process_index() != 0:
+            return tag
+        extra = {
+            "global_step": self.global_step,
+            "micro_steps": self.micro_steps,
+            "zero_stage": self.zero_stage,
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if self.lr_scheduler is not None else None),
+            "client_state": client_state or {},
+        }
+        path = os.path.join(save_dir, tag, "state.npz")
+
+        def mark_latest():
+            os.makedirs(save_dir, exist_ok=True)
+            tmp = os.path.join(save_dir, ".latest.tmp")
+            with open(tmp, "w") as f:
+                f.write(tag)
+            os.replace(tmp, os.path.join(save_dir, "latest"))
+
+        self.checkpoint_engine.save(
+            (host_tree, extra), path,
+            on_durable=mark_latest if save_latest else None)
+        self.checkpoint_engine.commit(tag)
+        return tag
+
+    def load_checkpoint(self, load_dir, tag=None,
+                        load_optimizer_states=True,
+                        load_lr_scheduler_states=True):
+        """reference engine.py:2750. Returns (path, client_state)."""
+        import os
+        from .checkpoint_engine import serialization as ser
+        if tag is None:
+            latest = os.path.join(load_dir, "latest")
+            if not os.path.exists(latest):
+                return None, {}
+            with open(latest) as f:
+                tag = f.read().strip()
+        path = os.path.join(load_dir, tag, "state.npz")
+        if not os.path.exists(path):
+            return None, {}
+        flat, header = self.checkpoint_engine.load(path)
+        # structural template only — no device transfer
+        template = jax.eval_shape(self._ckpt_tree)
+        tree = ser.unflatten_into(template, flat, header.get("meta"))
+        extra = header["extra"]
+
+        master = tree["master"]
+        with jax.set_mesh(self.mesh):
+            new_master = jax.device_put(master, self.master_shardings)
+            new_params = jax.jit(
+                lambda m: _tree_cast(m, self.param_dtype),
+                out_shardings=self.param_shardings)(new_master)
+            state = dict(self.state)
+            state["master"] = new_master
+            state["params"] = new_params
+            if load_optimizer_states:
+                state["opt"] = jax.device_put(tree["opt"],
+                                              self.opt_shardings)
+            state["scale"] = jax.device_put(tree["scale"],
+                                            self.state_shardings["scale"])
+            state["step"] = jax.device_put(
+                jnp.asarray(tree["step"], jnp.int32),
+                self.state_shardings["step"])
+            state["skipped"] = jax.device_put(
+                jnp.asarray(tree.get("skipped", 0), jnp.int32),
+                self.state_shardings["skipped"])
+            state["rng"] = jax.device_put(
+                jax.random.wrap_key_data(tree["rng_data"]),
+                self.state_shardings["rng"])
+        self.state = state
+        self.global_step = int(extra.get("global_step", 0))
+        self.micro_steps = int(extra.get("micro_steps", 0))
+        if (load_lr_scheduler_states and self.lr_scheduler is not None
+                and extra.get("lr_scheduler") is not None):
+            self.lr_scheduler.load_state_dict(extra["lr_scheduler"])
+        return path, extra.get("client_state", {})
+
+    def save_checkpoint_terminate(self):
+        """Fork parity (engine.py:3114): drain async checkpoint work."""
+        dist.barrier()
+        self.checkpoint_engine.wait()
+        self.checkpoint_engine.shutdown()
+        dist.barrier()
+
+    def eval_loss(self, batch):
+        batch = self._shard_batch(batch, with_gas_dim=False)
+        with jax.set_mesh(self.mesh):
+            return self._eval_loss_jit(self.state["params"], batch)

@@ -1,0 +1,101 @@
+"""Static and dynamic loss scaling.
+
+Counterpart of reference ``runtime/fp16/loss_scaler.py:91 DynamicLossScaler``.
+The scale lives *inside* the jitted train state as an fp32 scalar so the
+skip-on-overflow / grow-after-window logic is pure lax arithmetic — no host
+round-trip per step (the reference syncs an overflow flag to host every
+step; under XLA that would stall the pipeline).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScaler:
+    """Static scale (reference LossScalerBase). scale=1 for bf16/fp32."""
+
+    def __init__(self, scale=1.0):
+        self.static_scale = float(scale)
+        self.dynamic = False
+
+    def init_state(self):
+        return {"scale": jnp.asarray(self.static_scale, jnp.float32),
+                "good_steps": jnp.zeros((), jnp.int32)}
+
+    def update(self, state, overflow):
+        return state
+
+    def should_skip(self, state, overflow):
+        # with static scaling the reference still skips on overflow
+        return overflow
+
+
+class DynamicLossScaler(LossScaler):
+    """reference runtime/fp16/loss_scaler.py:91 semantics:
+    * on overflow: scale /= 2 (bounded below), reset window, skip step
+      (hysteresis consumes before halving)
+    * after `scale_window` consecutive good steps: scale *= 2
+    """
+
+    def __init__(self, init_scale=2**16, scale_factor=2.0, scale_window=1000,
+                 min_scale=1.0, delayed_shift=1, consecutive_hysteresis=False):
+        super().__init__(init_scale)
+        self.dynamic = True
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def init_state(self):
+        return {"scale": jnp.asarray(self.static_scale, jnp.float32),
+                "good_steps": jnp.zeros((), jnp.int32),
+                "hysteresis": jnp.asarray(self.delayed_shift, jnp.int32)}
+
+    def update(self, state, overflow):
+        scale, good, hyst = (state["scale"], state["good_steps"],
+                             state["hysteresis"])
+        hyst_after = jnp.where(overflow, jnp.maximum(hyst - 1, 0), hyst)
+        drop = overflow & (hyst_after == 0)
+        new_scale = jnp.where(
+            drop, jnp.maximum(scale / self.scale_factor, self.min_scale),
+            scale)
+        new_good = jnp.where(overflow, 0, good + 1)
+        grow = new_good >= self.scale_window
+        new_scale = jnp.where(grow, new_scale * self.scale_factor, new_scale)
+        new_good = jnp.where(grow, 0, new_good)
+        if self.consecutive_hysteresis:
+            # refill on good steps: only N *consecutive* overflows drop scale
+            new_hyst = jnp.where(overflow, hyst_after,
+                                 jnp.asarray(self.delayed_shift, jnp.int32))
+        else:
+            # hysteresis is a budget: any N overflows (consecutive or not)
+            # drop the scale (reference default semantics)
+            new_hyst = hyst_after
+        return {"scale": new_scale, "good_steps": new_good,
+                "hysteresis": new_hyst}
+
+    def should_skip(self, state, overflow):
+        return overflow
+
+
+def grads_finite(grads):
+    """Global overflow check (reference CheckOverflow, runtime/utils.py):
+    True iff every grad element is finite."""
+    leaves = jax.tree.leaves(grads)
+    finite = jnp.asarray(True)
+    for g in leaves:
+        finite = finite & jnp.all(jnp.isfinite(g))
+    return finite
+
+
+def create_loss_scaler(fp16_cfg=None, dtype=None):
+    import jax.numpy as jnp_
+    if fp16_cfg is None or not fp16_cfg.enabled or dtype != jnp_.float16:
+        return LossScaler(1.0)
+    if fp16_cfg.loss_scale and fp16_cfg.loss_scale > 0:
+        return LossScaler(fp16_cfg.loss_scale)
+    return DynamicLossScaler(init_scale=2.0 ** fp16_cfg.initial_scale_power,
+                             scale_window=fp16_cfg.loss_scale_window,
+                             min_scale=fp16_cfg.min_loss_scale,
+                             delayed_shift=fp16_cfg.hysteresis)

@@ -1,0 +1,114 @@
+"""ZeRO stages as sharding plans.
+
+The heart of the reference is 10k+ lines of hook-driven bucketing
+(zero/stage_1_and_2.py:98, zero/stage3.py:75, partition_parameters.py:780).
+On TPU the same *memory states* are expressed as sharding specs and the
+collectives fall out of GSPMD:
+
+  stage 0: params/master/opt replicated over DP; grads allreduced.
+  stage 1: optimizer state + fp32 master partitioned over the DP axes
+           (reference: bit16_groups_flat partitions, stage_1_and_2.py:1575).
+           Grads allreduce, each shard updates its partition, params
+           re-materialize replicated (the step-end allgather,
+           stage_1_and_2.py:1815).
+  stage 2: + gradients partitioned: the grad->master path is constrained
+           to the partitioned spec so XLA lowers the backward reduction to
+           reduce_scatter instead of all_reduce (reference
+           reduce_independent_p_g_buckets_and_remove_grads:926).
+  stage 3: + bf16 params partitioned; forward/backward all_gathers emerge
+           where GSPMD needs full weights, freed after use — the
+           declarative form of PartitionedParameterCoordinator
+           fetch/release (partitioned_param_coordinator.py:261,395).
+
+A "partition" here = sharding a leaf along its first dimension divisible by
+the partition count and not already sharded (the reference flattens to 1-D
+and pads instead: runtime/utils.py partition helpers; dimension-sharding
+keeps XLA layouts natural and avoids materializing a flat copy).
+
+MiCS / ZeRO++ hpZ (zero/mics.py:64, utils/groups.py:505) map to partitioning
+over a *sub*-axis of DP so params replicate across slice boundaries; hook:
+``partition_axes`` lets the engine pass ('data',) instead of
+('data','expert') or a hierarchical split.
+"""
+
+from jax.sharding import PartitionSpec as P
+
+from ...utils.groups import DP_AXES
+
+
+def _axes_size(mesh, axes):
+    n = 1
+    for a in (axes if isinstance(axes, tuple) else (axes,)):
+        n *= mesh.shape[a]
+    return n
+
+
+def _used_axes(spec):
+    used = set()
+    for entry in spec:
+        if entry is None:
+            continue
+        for a in (entry if isinstance(entry, tuple) else (entry,)):
+            used.add(a)
+    return used
+
+
+def add_partition_axis(shape, base_spec, axes, count):
+    """Return base_spec with ``axes`` added on the LAST eligible dim:
+    divisible by count, not already sharded. Last (not first) because models
+    stack layers on dim 0 and ``lax.scan`` slices that dim each iteration —
+    partitioning an inner dim makes stage-3 materialize one layer per scan
+    step (the fetch/release pattern) instead of re-gathering the whole
+    stack. Falls back to the unmodified spec (replicated over ``axes``) —
+    the reference similarly keeps small tensors whole below
+    param_persistence_threshold."""
+    if count == 1:
+        return base_spec
+    spec = list(base_spec) + [None] * (len(shape) - len(base_spec))
+    used = _used_axes(spec)
+    ax_tuple = axes if isinstance(axes, tuple) else (axes,)
+    if any(a in used for a in ax_tuple):
+        return P(*spec)
+    for dim in reversed(range(len(shape))):
+        if spec[dim] is None and shape[dim] % count == 0 and shape[dim] >= count:
+            spec[dim] = ax_tuple if len(ax_tuple) > 1 else ax_tuple[0]
+            return P(*spec)
+    return P(*spec)
+
+
+class ZeroShardingPlan:
+    """Computes param/master/grad sharding specs for a model + mesh."""
+
+    def __init__(self, stage, mesh, tp_specs, shapes,
+                 partition_axes=DP_AXES):
+        """tp_specs/shapes: pytrees (same structure) of PartitionSpec and
+        shape tuples. partition_axes: mesh axes forming the ZeRO partition
+        group (DP group by default; a sub-axis for MiCS-style plans)."""
+        import jax
+        self.stage = stage
+        self.mesh = mesh
+        self.partition_axes = partition_axes
+        n = _axes_size(mesh, partition_axes)
+
+        def partitioned(spec, shape):
+            return add_partition_axis(shape, spec, partition_axes, n)
+
+        is_spec = lambda x: isinstance(x, P)
+        # bf16 params: partitioned only at stage 3
+        self.param_specs = (
+            jax.tree.map(partitioned, tp_specs, shapes, is_leaf=is_spec)
+            if stage >= 3 else tp_specs)
+        # fp32 master + optimizer state: partitioned from stage 1
+        self.master_specs = (
+            jax.tree.map(partitioned, tp_specs, shapes, is_leaf=is_spec)
+            if stage >= 1 else tp_specs)
+        # gradients: partitioned (reduce-scatter) from stage 2
+        self.grad_specs = self.master_specs if stage >= 2 else tp_specs
+
+    def shardings(self, which):
+        import jax
+        from jax.sharding import NamedSharding
+        specs = {"param": self.param_specs, "master": self.master_specs,
+                 "grad": self.grad_specs}[which]
+        return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
+                            is_leaf=lambda x: isinstance(x, P))

@@ -143,7 +143,8 @@ class ThroughputTimer:
             self.total_elapsed_time += duration
             self.step_elapsed_time += duration
             if global_step:
-                if report_speed and self.global_step_count % self.steps_per_output == 0:
+                if (report_speed and self.steps_per_output
+                        and self.global_step_count % self.steps_per_output == 0):
                     log_dist(
                         f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
                         f"global_step={self.global_step_count}, "

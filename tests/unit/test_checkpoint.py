@@ -1,0 +1,139 @@
+"""Checkpoint tests, mirroring the reference's tests/unit/checkpoint/ focus:
+save/load roundtrip, cross-stage resharding (their DistributedFixture
+pattern), async engines, and the native C++ writer."""
+
+import os
+
+import numpy as np
+import jax
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2, GPT2Config
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.runtime.checkpoint_engine.engines import (
+    SyncCheckpointEngine, AsyncCheckpointEngine, NativeCheckpointEngine,
+    NoneCheckpointEngine)
+from deepspeed_tpu.runtime.checkpoint_engine import serialization as ser
+
+CFG = GPT2Config(n_layer=2, n_head=2, d_model=64, max_seq_len=32,
+                 vocab_size=256, remat=False, dtype="float32")
+
+
+def _engine(stage=2, ckpt_type="sync"):
+    groups.reset()
+    model = GPT2(CFG)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": 2,
+        "steps_per_print": 0,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage},
+        "checkpoint_engine": {"type": ckpt_type},
+    })
+    return engine
+
+
+def _batch(seed=0, bsz=16):
+    rng = np.random.RandomState(seed)
+    return {"input_ids": rng.randint(0, CFG.vocab_size,
+                                     (bsz, CFG.max_seq_len)).astype(np.int32)}
+
+
+def test_serialization_roundtrip(tmp_path):
+    tree = {"a": np.arange(6, dtype=np.float32).reshape(2, 3),
+            "b": {"c": np.ones((4,), np.int32)}}
+    p = str(tmp_path / "x.npz")
+    ser.save_file(p, tree, extra_meta={"step": 7})
+    flat, header = ser.load_file(p)
+    out = ser.unflatten_into(tree, flat, header["meta"])
+    np.testing.assert_array_equal(out["a"], tree["a"])
+    np.testing.assert_array_equal(out["b"]["c"], tree["b"]["c"])
+    assert header["extra"]["step"] == 7
+
+
+def test_save_load_roundtrip(tmp_path):
+    e1 = _engine(stage=2)
+    b = _batch()
+    for _ in range(3):
+        e1.train_batch(b)
+    tag = e1.save_checkpoint(str(tmp_path), client_state={"note": "hi"})
+    loss_before = float(e1.eval_loss(_batch(seed=5)))
+
+    e2 = _engine(stage=2)
+    path, client = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and client["note"] == "hi"
+    assert e2.global_step == 3
+    loss_after = float(e2.eval_loss(_batch(seed=5)))
+    np.testing.assert_allclose(loss_after, loss_before, rtol=1e-6)
+    # training continues identically
+    l1 = float(e1.train_batch(b))
+    l2 = float(e2.train_batch(b))
+    np.testing.assert_allclose(l1, l2, rtol=1e-5)
+
+
+@pytest.mark.parametrize("save_stage,load_stage", [(2, 0), (0, 3), (3, 1)])
+def test_cross_stage_reshard(tmp_path, save_stage, load_stage):
+    """A checkpoint saved at one ZeRO stage loads at another (the
+    reference's universal-checkpoint capability, natively)."""
+    e1 = _engine(stage=save_stage)
+    for _ in range(2):
+        e1.train_batch(_batch())
+    e1.save_checkpoint(str(tmp_path))
+    ref = float(e1.eval_loss(_batch(seed=9)))
+
+    e2 = _engine(stage=load_stage)
+    e2.load_checkpoint(str(tmp_path))
+    got = float(e2.eval_loss(_batch(seed=9)))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_missing_checkpoint_returns_none(tmp_path):
+    e = _engine()
+    path, client = e.load_checkpoint(str(tmp_path))
+    assert path is None
+
+
+def test_async_engine_roundtrip(tmp_path):
+    e1 = _engine(stage=1, ckpt_type="async")
+    e1.train_batch(_batch())
+    e1.save_checkpoint(str(tmp_path))
+    e1.checkpoint_engine.wait()
+    e2 = _engine(stage=1, ckpt_type="async")
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    e1.save_checkpoint_terminate()
+
+
+def test_none_engine_writes_nothing(tmp_path):
+    eng = NoneCheckpointEngine()
+    eng.save(({"x": np.ones(3)}, {}), str(tmp_path / "no" / "x.npz"))
+    assert not os.path.exists(str(tmp_path / "no"))
+
+
+def test_native_writer_direct(tmp_path):
+    """C++ writer pool writes bytes correctly (chunked pwrite)."""
+    pytest.importorskip("ctypes")
+    from deepspeed_tpu.ops.native.ckpt_writer import Writer
+    try:
+        w = Writer(threads=4)
+    except Exception as e:
+        pytest.skip(f"native build unavailable: {e}")
+    data = np.random.bytes(1 << 20)
+    p = str(tmp_path / "blob.bin")
+    w.write(p, data)
+    with open(p, "rb") as f:
+        assert f.read() == data
+    w.close()
+
+
+def test_native_engine_roundtrip(tmp_path):
+    e1 = _engine(stage=2, ckpt_type="native")
+    e1.train_batch(_batch())
+    e1.save_checkpoint(str(tmp_path))
+    e1.checkpoint_engine.wait()
+    e2 = _engine(stage=2, ckpt_type="native")
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None
+    ref = float(e1.eval_loss(_batch(seed=3)))
+    got = float(e2.eval_loss(_batch(seed=3)))
+    np.testing.assert_allclose(got, ref, rtol=1e-6)

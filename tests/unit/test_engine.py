@@ -1,0 +1,134 @@
+"""Engine end-to-end: ZeRO stage parity (the reference's core correctness
+test — tests/unit/runtime/zero/test_zero.py compares stages against DDP)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2, GPT2Config
+from deepspeed_tpu.utils import groups
+
+
+CFG = GPT2Config(n_layer=2, n_head=2, d_model=64, max_seq_len=32,
+                 vocab_size=256, remat=False, dtype="float32")
+
+
+def _config(stage=0, micro=2, gas=1, dp=8, **over):
+    cfg = {
+        "train_micro_batch_size_per_gpu": micro,
+        "gradient_accumulation_steps": gas,
+        "steps_per_print": 0,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+        "gradient_clipping": 1.0,
+        "zero_optimization": {"stage": stage},
+    }
+    cfg.update(over)
+    return cfg
+
+
+def _batches(n, bsz, seed=0):
+    rng = np.random.RandomState(seed)
+    return [{"input_ids": rng.randint(0, CFG.vocab_size,
+                                      (bsz, CFG.max_seq_len)).astype(np.int32)}
+            for _ in range(n)]
+
+
+def _train(stage, steps=4, gas=1, **over):
+    """Repeatedly fit one fixed batch (random tokens are otherwise
+    irreducible); parity tests compare trajectories, decrease tests rely on
+    memorization."""
+    groups.reset()
+    model = GPT2(CFG)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=_config(stage=stage, gas=gas, **over))
+    batch = _batches(1, engine.config.train_batch_size)[0]
+    losses = [float(engine.train_batch(batch)) for _ in range(steps)]
+    return losses, engine
+
+
+def test_zero0_trains():
+    losses, eng = _train(stage=0, steps=5)
+    assert losses[-1] < losses[0]
+    assert eng.global_step == 5
+
+
+@pytest.mark.parametrize("stage", [1, 2, 3])
+def test_zero_stage_loss_parity(stage):
+    """Stages must produce identical losses to stage 0 (same math, different
+    memory layout). XLA is deterministic on CPU => tight tolerance."""
+    base, _ = _train(stage=0, steps=4)
+    got, eng = _train(stage=stage, steps=4)
+    np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-6)
+    # check the partitioning actually happened: master leaves sharded over dp
+    from jax.sharding import PartitionSpec as P
+    specs = jax.tree.leaves(
+        eng.plan.master_specs, is_leaf=lambda x: isinstance(x, P))
+    assert any(any(e is not None for e in s) for s in specs), \
+        f"stage {stage} master specs all replicated"
+    if stage >= 3:
+        pspecs = jax.tree.leaves(
+            eng.plan.param_specs, is_leaf=lambda x: isinstance(x, P))
+        assert any(any(e is not None for e in s) for s in pspecs)
+
+
+def test_grad_accumulation_equivalence():
+    """gas=2 with half micro-batch == gas=1 (same global batch)."""
+    base, _ = _train(stage=0, steps=3, gas=1, train_micro_batch_size_per_gpu=2)
+    got, _ = _train(stage=0, steps=3, gas=2, train_micro_batch_size_per_gpu=1)
+    np.testing.assert_allclose(got, base, rtol=1e-5, atol=1e-6)
+
+
+def test_staged_fwd_bwd_step_matches_train_batch():
+    groups.reset()
+    model = GPT2(CFG)
+    e1, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                           config=_config(stage=2, gas=2))
+    groups.reset()
+    e2, _, _, _ = deepspeed_tpu.initialize(model=model,
+                                           config=_config(stage=2, gas=2))
+    batches = _batches(2, e1.config.train_batch_size)
+    l_fused = [float(e1.train_batch(b)) for b in batches]
+
+    l_staged = []
+    gas = e2.config.gradient_accumulation_steps
+    per_micro = e2.config.train_batch_size // gas
+    for b in batches:
+        micro_losses = []
+        for i in range(gas):
+            micro = {k: v[i * per_micro:(i + 1) * per_micro]
+                     for k, v in b.items()}
+            loss = e2(micro)
+            e2.backward(loss)
+            e2.step()
+        l_staged.append(float(np.mean([float(l) for l in [loss]])))
+    assert e2.global_step == 2
+    # same state evolution => same final eval loss
+    probe = _batches(1, 8, seed=99)[0]
+    np.testing.assert_allclose(float(e1.eval_loss(probe)),
+                               float(e2.eval_loss(probe)),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_tp_with_zero2():
+    """dp=4 x tp=2 must match pure-dp=8 given the same global batch (16)."""
+    base, _ = _train(stage=0, steps=3, micro=2)
+    got, eng = _train(stage=2, steps=3, micro=4,
+                      tensor_parallel={"size": 2})
+    assert eng.config.train_batch_size == 16
+    assert eng.topology.get_model_parallel_world_size() == 2
+    np.testing.assert_allclose(got, base, rtol=1e-4, atol=1e-5)
+
+
+def test_bf16_trains():
+    groups.reset()
+    model = GPT2(GPT2Config(**{**CFG.__dict__, "dtype": "bfloat16"}))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, config=_config(stage=2, bf16={"enabled": True}))
+    losses = [float(engine.train_batch(b))
+              for b in _batches(6, engine.config.train_batch_size)]
+    assert losses[-1] < losses[0]
+    # master kept in fp32
+    assert engine.state["master"]["wte"].dtype == jnp.float32
+    assert engine.state["params"]["wte"].dtype == jnp.bfloat16

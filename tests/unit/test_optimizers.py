@@ -1,0 +1,132 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.optimizers import (FusedAdam, FusedLamb, FusedLion,
+                                          FusedAdagrad, SGD, build_optimizer)
+from deepspeed_tpu.runtime.lr_schedules import (WarmupLR, WarmupDecayLR,
+                                                WarmupCosineLR, OneCycle,
+                                                build_scheduler)
+from deepspeed_tpu.runtime.fp16.loss_scaler import (DynamicLossScaler,
+                                                    grads_finite)
+
+
+def _params():
+    return {"w": jnp.ones((4, 8)), "b": jnp.zeros((8,))}
+
+
+def _grads():
+    return {"w": jnp.full((4, 8), 0.5), "b": jnp.full((8,), -0.25)}
+
+
+@pytest.mark.parametrize("opt", [
+    FusedAdam(lr=1e-2), FusedAdam(lr=1e-2, weight_decay=0.01),
+    FusedAdam(lr=1e-2, adam_w_mode=False, weight_decay=0.01),
+    FusedLamb(lr=1e-2), FusedLion(lr=1e-3), FusedAdagrad(lr=1e-2),
+    SGD(lr=1e-2, momentum=0.9)])
+def test_optimizer_step_moves_params(opt):
+    p = _params()
+    s = opt.init(p)
+    p2, s2 = opt.update(_grads(), s, p)
+    assert int(s2["step"]) == 1
+    assert not np.allclose(np.asarray(p2["w"]), np.asarray(p["w"]))
+    # gradient descent direction on w (positive grads -> weights shrink)
+    assert float(p2["w"].mean()) < float(p["w"].mean())
+
+
+def test_adam_matches_reference_formula():
+    opt = FusedAdam(lr=0.1, betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0)
+    p = {"w": jnp.asarray([1.0])}
+    g = {"w": jnp.asarray([0.5])}
+    s = opt.init(p)
+    p2, _ = opt.update(g, s, p)
+    m = 0.1 * 0.5
+    v = 0.001 * 0.25
+    mh, vh = m / (1 - 0.9), v / (1 - 0.999)
+    expect = 1.0 - 0.1 * mh / (np.sqrt(vh) + 1e-8)
+    np.testing.assert_allclose(float(p2["w"][0]), expect, rtol=1e-6)
+
+
+def test_adam_jits_with_traced_lr():
+    opt = FusedAdam(lr=1e-3)
+    p = _params()
+    s = opt.init(p)
+    f = jax.jit(lambda g, s, p, lr: opt.update(g, s, p, lr=lr))
+    p2, s2 = f(_grads(), s, p, jnp.float32(0.01))
+    p3, _ = f(_grads(), s2, p2, jnp.float32(0.02))  # no recompile for new lr
+    assert np.isfinite(np.asarray(p3["w"])).all()
+
+
+def test_build_optimizer_registry():
+    opt = build_optimizer("AdamW", {"lr": 1e-4, "weight_decay": 0.01})
+    assert isinstance(opt, FusedAdam) and opt.adam_w_mode
+    with pytest.raises(ValueError):
+        build_optimizer("muon", {})
+
+
+def test_warmup_lr():
+    s = WarmupLR(warmup_min_lr=0.0, warmup_max_lr=1.0, warmup_num_steps=10,
+                 warmup_type="linear")
+    assert float(s(0)) == 0.0
+    assert abs(float(s(5)) - 0.5) < 1e-6
+    assert float(s(100)) == 1.0
+
+
+def test_warmup_decay_lr():
+    s = WarmupDecayLR(total_num_steps=100, warmup_max_lr=1.0,
+                      warmup_num_steps=10, warmup_type="linear")
+    assert abs(float(s(10)) - 1.0) < 1e-6
+    assert float(s(100)) == 0.0
+    assert 0.0 < float(s(55)) < 1.0
+
+
+def test_warmup_cosine_lr():
+    s = WarmupCosineLR(total_num_steps=100, warmup_num_steps=10, lr=1.0)
+    assert abs(float(s(10)) - 1.0) < 1e-2
+    assert float(s(100)) <= 0.01
+
+
+def test_one_cycle():
+    s = OneCycle(cycle_min_lr=0.1, cycle_max_lr=1.0,
+                 cycle_first_step_size=10)
+    assert abs(float(s(0)) - 0.1) < 1e-6
+    assert abs(float(s(10)) - 1.0) < 1e-6
+    assert abs(float(s(20)) - 0.1) < 1e-6
+
+
+def test_scheduler_registry():
+    s = build_scheduler("WarmupLR", {"warmup_num_steps": 5})
+    assert callable(s)
+    with pytest.raises(ValueError):
+        build_scheduler("Nope", {})
+
+
+def test_scheduler_stateful_surface():
+    s = build_scheduler("WarmupLR",
+                        {"warmup_max_lr": 1.0, "warmup_num_steps": 10,
+                         "warmup_type": "linear"})
+    s.step()
+    s.step()
+    assert s.state_dict() == {"last_batch_iteration": 1}
+    assert s.get_lr()[0] > 0
+
+
+def test_dynamic_loss_scaler():
+    sc = DynamicLossScaler(init_scale=16.0, scale_window=2, min_scale=1.0,
+                           delayed_shift=1)
+    st = sc.init_state()
+    # overflow halves
+    st = sc.update(st, jnp.asarray(True))
+    assert float(st["scale"]) == 8.0
+    # two good steps double
+    st = sc.update(st, jnp.asarray(False))
+    st = sc.update(st, jnp.asarray(False))
+    assert float(st["scale"]) == 16.0
+    assert int(st["good_steps"]) == 0
+
+
+def test_grads_finite():
+    assert bool(grads_finite({"a": jnp.ones(3)}))
+    assert not bool(grads_finite({"a": jnp.asarray([1.0, jnp.inf])}))
+    assert not bool(grads_finite({"a": jnp.asarray([jnp.nan])}))
