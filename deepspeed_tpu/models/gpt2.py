@@ -163,9 +163,19 @@ class GPT2:
         }
 
     # --- forward ---
+    moe_loss_coeff = 0.0  # overridden by GPT2MoE
+
     def apply(self, params, input_ids, *, rng=None, train=False,
               seq_sharded=False):
-        """Return logits (B, T, V) in fp32.
+        """Return logits (B, T, V) fp32 (aux loss dropped)."""
+        logits, _ = self.apply_with_aux(params, input_ids, rng=rng,
+                                        train=train, seq_sharded=seq_sharded)
+        return logits
+
+    def apply_with_aux(self, params, input_ids, *, rng=None, train=False,
+                       seq_sharded=False):
+        """Return (logits (B, T, V) fp32, summed aux loss) — aux is the MoE
+        load-balance loss (0 for dense models).
 
         ``seq_sharded``: inputs/activations carry T on the 'seq' mesh axis
         (Ulysses). Attention re-constrains heads onto 'seq' so XLA emits the
@@ -196,7 +206,7 @@ class GPT2:
         # causal mask built once; fp32 scores
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
 
-        def block(x, layer):
+        def block(x, layer, lrng):
             h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
             qkv = h @ layer["wqkv"] + layer["bqkv"]
             qkv = qkv.reshape(B, T, 3, H, hd)
@@ -222,40 +232,54 @@ class GPT2:
             x = constrain(x, act_spec)
 
             h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
-            up = jax.nn.gelu(h @ layer["wup"] + layer["bup"])
-            up = constrain(up, P(BATCH_AXES, "seq" if seq_sharded else None,
-                                 "tensor"))
-            x = x + up @ layer["wdown"] + layer["bdown"]
+            mlp_out, aux = self._mlp(h, layer, lrng, train=train,
+                                     seq_sharded=seq_sharded,
+                                     constrain=constrain)
+            x = x + mlp_out
             x = constrain(x, act_spec)
-            return x
+            return x, aux
 
         block_fn = block
         if cfg.remat:
             policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
             block_fn = jax.checkpoint(block, policy=policy)
 
-        def scan_body(carry, layer):
-            return block_fn(carry, layer), None
+        layer_rngs = jax.random.split(
+            rng if rng is not None else jax.random.key(0), cfg.n_layer)
 
-        x, _ = lax.scan(scan_body, x, params["blocks"])
+        def scan_body(carry, xs):
+            layer, lrng = xs
+            x, aux = block_fn(carry, layer, lrng)
+            return x, aux
+
+        x, auxs = lax.scan(scan_body, x, (params["blocks"], layer_rngs))
 
         x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
         logits = jnp.einsum("btd,vd->btv", x, params["wte"],
                             preferred_element_type=jnp.float32)
-        return logits
+        return logits, jnp.sum(auxs)
+
+    def _mlp(self, h, layer, rng, *, train, seq_sharded, constrain):
+        """Dense MLP; overridden by GPT2MoE with an expert-parallel MoE.
+        Returns (output, aux_loss)."""
+        up = jax.nn.gelu(h @ layer["wup"] + layer["bup"])
+        up = constrain(up, P(BATCH_AXES, "seq" if seq_sharded else None,
+                             "tensor"))
+        return (up @ layer["wdown"] + layer["bdown"],
+                jnp.zeros((), jnp.float32))
 
     # --- loss ---
     def loss(self, params, batch, *, rng=None, train=True, seq_sharded=False):
         """Next-token cross entropy. batch: {"input_ids": (B, T) int32}."""
         ids = batch["input_ids"]
-        logits = self.apply(params, ids, rng=rng, train=train,
-                            seq_sharded=seq_sharded)
+        logits, aux = self.apply_with_aux(params, ids, rng=rng, train=train,
+                                          seq_sharded=seq_sharded)
         targets = ids[:, 1:]
         logits = logits[:, :-1]
         logz = jax.nn.logsumexp(logits, axis=-1)
         gold = jnp.take_along_axis(logits, targets[..., None],
                                    axis=-1)[..., 0]
-        return jnp.mean(logz - gold)
+        return jnp.mean(logz - gold) + self.moe_loss_coeff * aux
 
 
 def _layernorm(x, scale, bias, eps=1e-5):

@@ -1,0 +1,80 @@
+"""GPT-2 with Mixture-of-Experts MLPs (expert parallelism flagship).
+
+Counterpart of the reference's MoE training targets (deepspeed/moe/layer.py
+MoE wrapping an expert MLP; test fixture tests/unit/simple_model.py
+SimpleMoEModel). Every block's dense MLP is replaced by a top-k routed MoE;
+expert weights carry a leading (L, E, ...) layout so the same ``lax.scan``
+block iteration works, and the 'expert' mesh axis shards E (EP) while
+'tensor' shards the FFN dim (TP) — EP x TP experts like the reference's
+module_inject MoE sharding.
+"""
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+from ..moe.layer import MoE
+from .gpt2 import GPT2, GPT2Config
+
+
+@dataclass(frozen=True)
+class GPT2MoEConfig(GPT2Config):
+    num_experts: int = 8
+    moe_top_k: int = 1
+    capacity_factor: float = 1.25
+    eval_capacity_factor: float = 2.0
+    min_capacity: int = 4
+    noisy_gate_policy: str = None        # None | 'RSample' | 'Jitter'
+    moe_loss_coeff: float = 0.01
+    moe_drop_tokens: bool = True
+
+    def num_params(self):
+        dense = super().num_params()
+        # replace per-layer dense MLP params with E experts + gate
+        mlp = 2 * self.d_model * self.d_ff + self.d_ff + self.d_model
+        moe = (self.num_experts * mlp + self.d_model * self.num_experts)
+        return dense + self.n_layer * (moe - mlp)
+
+
+class GPT2MoE(GPT2):
+    def __init__(self, config: GPT2MoEConfig):
+        super().__init__(config)
+        self.moe_loss_coeff = config.moe_loss_coeff
+        self.moe = MoE(
+            hidden_size=config.d_model, ffn_hidden_size=config.d_ff,
+            num_experts=config.num_experts, k=config.moe_top_k,
+            capacity_factor=config.capacity_factor,
+            eval_capacity_factor=config.eval_capacity_factor,
+            min_capacity=config.min_capacity,
+            noisy_gate_policy=config.noisy_gate_policy,
+            drop_tokens=config.moe_drop_tokens,
+            dtype=jnp.dtype(config.dtype))
+
+    def init(self, rng):
+        import math
+        params = super().init(rng)
+        cfg = self.config
+        blocks = dict(params["blocks"])
+        for k in ("wup", "bup", "wdown", "bdown"):
+            del blocks[k]
+        moe_params = self.moe.init(
+            jax.random.fold_in(rng, 17), stack=cfg.n_layer,
+            out_std=0.02 / math.sqrt(2 * cfg.n_layer))
+        blocks["moe"] = moe_params
+        params["blocks"] = blocks
+        return params
+
+    def partition_specs(self, topology=None):
+        specs = super().partition_specs(topology)
+        blocks = dict(specs["blocks"])
+        for k in ("wup", "bup", "wdown", "bdown"):
+            del blocks[k]
+        blocks["moe"] = self.moe.partition_specs(stacked=True)
+        specs["blocks"] = blocks
+        return specs
+
+    def _mlp(self, h, layer, rng, *, train, seq_sharded, constrain):
+        y, aux, _ = self.moe.apply(layer["moe"], h, rng=rng, train=train,
+                                   seq_sharded=seq_sharded)
+        return y, aux

@@ -1,0 +1,9 @@
+"""Mixture-of-Experts (expert parallelism).
+
+Counterpart of the reference's ``deepspeed/moe/`` package (layer.py:17 MoE,
+sharded_moe.py, experts.py, mappings.py)."""
+
+from .sharded_moe import (TopKGate, moe_layer, top1gating, top2gating)
+from .layer import MoE
+
+__all__ = ["MoE", "TopKGate", "moe_layer", "top1gating", "top2gating"]

@@ -1,0 +1,212 @@
+"""Mixture-of-Experts: gating + expert-parallel dispatch.
+
+Counterpart of the reference's ``deepspeed/moe/sharded_moe.py`` (TopKGate
+:348, MOELayer :425, top1gating :184, top2gating :282) and
+``deepspeed/moe/experts.py``. TPU-first redesign:
+
+  * Gating is pure jnp over the full (tokens, experts) matrix — top-1/top-2
+    selection, capacity enforcement by cumsum position, auxiliary
+    load-balance loss, gumbel (RSample) noisy gating — no host sync, no
+    dynamic shapes.
+  * Dispatch/combine are dense one-hot einsums (the Mesh-TensorFlow/GShard
+    formulation): ``dispatch (S,E,C) x tokens (S,M) -> (E,C,M)``. On the MXU
+    a dense einsum beats gather/scatter; XLA fuses the one-hot.
+  * Expert parallelism is declarative: the (E,C,M) dispatched buffer and the
+    (E,...) expert weights are sharded on the 'expert' mesh axis, so the
+    contraction from batch-sharded tokens to expert-sharded buffers lowers
+    to exactly the all_to_all pair the reference issues by hand
+    (sharded_moe.py:505-520 _AllToAll), but fused and overlapped by XLA.
+  * Experts compute as one grouped GEMM over the leading E dim (the
+    megablox/ragged-dot pattern with static capacity), not a Python loop
+    over expert modules (reference experts.py:13 loops; fine for GPUs,
+    wasteful under jit).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..utils.groups import BATCH_AXES
+
+
+def _constrain(x, spec):
+    if jax.sharding.get_abstract_mesh().empty:
+        return x
+    return lax.with_sharding_constraint(x, spec)
+
+
+def _capacity(num_tokens, num_experts, capacity_factor, min_capacity):
+    """Static per-expert capacity (reference sharded_moe.py:_capacity)."""
+    cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
+    return max(cap, int(min_capacity))
+
+
+def _gumbel(rng, shape):
+    return -jnp.log(-jnp.log(
+        jax.random.uniform(rng, shape, jnp.float32, 1e-20, 1.0 - 1e-20)))
+
+
+def top1gating(logits, capacity_factor=1.0, min_capacity=4,
+               noisy_gate_policy=None, rng=None, drop_tokens=True):
+    """Switch-style top-1 gating (reference sharded_moe.py:184).
+
+    logits: (S, E) fp32. Returns (l_aux, combine_weights (S,E,C) fp32,
+    dispatch_mask (S,E,C) bool, exp_counts (E,)).
+    """
+    S, E = logits.shape
+    C = _capacity(S, E, capacity_factor, min_capacity)
+    if not drop_tokens:
+        C = S  # full capacity: nothing dropped, memory = dense routing
+
+    gates = jax.nn.softmax(logits, axis=-1)
+
+    select_logits = logits
+    if noisy_gate_policy == "RSample":
+        if rng is None:
+            raise ValueError("RSample noisy gating needs an rng")
+        select_logits = logits + _gumbel(rng, logits.shape)
+    elif noisy_gate_policy == "Jitter":
+        if rng is None:
+            raise ValueError("Jitter noisy gating needs an rng")
+        select_logits = logits * jax.random.uniform(
+            rng, logits.shape, jnp.float32, 0.99, 1.01)
+
+    idx1 = jnp.argmax(select_logits, axis=-1)                   # (S,)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)          # (S, E)
+    exp_counts = jnp.sum(mask1, axis=0)
+
+    # load-balance aux loss (reference :241): E * <fraction routed> . <prob>
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    # position of each token within its expert's queue; drop overflow
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1              # (S, E)
+    mask1 = mask1 * (locations1 < C)
+    loc1_s = jnp.sum(locations1 * mask1, axis=-1).astype(jnp.int32)  # (S,)
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)                     # (S,)
+    cap_oh = jax.nn.one_hot(loc1_s, C, dtype=jnp.float32)       # (S, C)
+    combine = (gate1[:, None] * mask1)[:, :, None] * cap_oh[:, None, :]
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, exp_counts
+
+
+def top2gating(logits, capacity_factor=1.0, min_capacity=4, rng=None,
+               drop_tokens=True, top2_2nd_expert_sampling=True):
+    """GShard top-2 gating (reference sharded_moe.py:282): capacity doubles,
+    second expert chosen after masking the first (optionally with gumbel
+    sampling), gate weights renormalized over the kept pair."""
+    S, E = logits.shape
+    C = _capacity(S, E, 2 * capacity_factor, min_capacity)
+    if not drop_tokens:
+        C = S
+
+    gates = jax.nn.softmax(logits, axis=-1)
+    idx1 = jnp.argmax(gates, axis=-1)
+    mask1 = jax.nn.one_hot(idx1, E, dtype=jnp.float32)
+
+    logits2 = logits
+    if top2_2nd_expert_sampling:
+        if rng is None:
+            raise ValueError("top2 2nd-expert sampling needs an rng")
+        logits2 = logits + _gumbel(rng, logits.shape)
+    logits2 = jnp.where(mask1 > 0, -jnp.inf, logits2)
+    idx2 = jnp.argmax(logits2, axis=-1)
+    mask2 = jax.nn.one_hot(idx2, E, dtype=jnp.float32)
+
+    exp_counts = jnp.sum(mask1 + mask2, axis=0)
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask1, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    locations1 = jnp.cumsum(mask1, axis=0) - mask1
+    # second-choice queue starts after all first choices (reference :300)
+    locations2 = jnp.cumsum(mask2, axis=0) - mask2 + jnp.sum(mask1, axis=0)
+    mask1 = mask1 * (locations1 < C)
+    mask2 = mask2 * (locations2 < C)
+    loc1_s = jnp.sum(locations1 * mask1, axis=-1).astype(jnp.int32)
+    loc2_s = jnp.sum(locations2 * mask2, axis=-1).astype(jnp.int32)
+
+    gate1 = jnp.sum(gates * mask1, axis=-1)
+    gate2 = jnp.sum(gates * mask2, axis=-1)
+    denom = jnp.clip(gate1 + gate2, 1e-9, None)
+    gate1, gate2 = gate1 / denom, gate2 / denom
+
+    cap1 = jax.nn.one_hot(loc1_s, C, dtype=jnp.float32)
+    cap2 = jax.nn.one_hot(loc2_s, C, dtype=jnp.float32)
+    combine = ((gate1[:, None] * mask1)[:, :, None] * cap1[:, None, :] +
+               (gate2[:, None] * mask2)[:, :, None] * cap2[:, None, :])
+    dispatch = combine > 0
+    return l_aux, combine, dispatch, exp_counts
+
+
+class TopKGate:
+    """Gate config + apply (reference sharded_moe.py:348 TopKGate)."""
+
+    def __init__(self, k=1, capacity_factor=1.0, eval_capacity_factor=1.0,
+                 min_capacity=4, noisy_gate_policy=None, drop_tokens=True,
+                 top2_2nd_expert_sampling=True):
+        if k not in (1, 2):
+            raise ValueError("only top-1 and top-2 gating supported")
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+        self.top2_2nd_expert_sampling = top2_2nd_expert_sampling
+
+    def __call__(self, logits, rng=None, train=True):
+        cf = self.capacity_factor if train else self.eval_capacity_factor
+        if self.k == 1:
+            return top1gating(
+                logits, cf, self.min_capacity,
+                self.noisy_gate_policy if train else None, rng,
+                self.drop_tokens)
+        return top2gating(
+            logits, cf, self.min_capacity, rng, self.drop_tokens,
+            self.top2_2nd_expert_sampling and train and rng is not None)
+
+
+def moe_layer(tokens, gate_w, wi, bi, wo, bo, gate: TopKGate, *, rng=None,
+              train=True, activation=jax.nn.gelu, seq_sharded=False):
+    """Full MoE layer over flattened tokens.
+
+    tokens: (..., M) — leading dims flattened to S internally.
+    gate_w: (M, E); wi: (E, M, F); bi: (E, F); wo: (E, F, M); bo: (E, M).
+
+    Data flow (reference MOELayer.forward sharded_moe.py:505-520):
+    gate -> dispatch einsum [all_to_all in] -> grouped expert FFN
+    -> [all_to_all out] -> combine einsum. The all_to_alls materialize from
+    the 'expert'-axis sharding constraints under GSPMD.
+    """
+    orig_shape = tokens.shape
+    M = orig_shape[-1]
+    x = tokens.reshape(-1, M)
+    S = x.shape[0]
+    E = gate_w.shape[-1]
+
+    logits = (x.astype(jnp.float32) @ gate_w.astype(jnp.float32))
+    l_aux, combine, dispatch, exp_counts = gate(logits, rng=rng, train=train)
+
+    combine = combine.astype(tokens.dtype)
+    dispatched = jnp.einsum("sec,sm->ecm", dispatch.astype(tokens.dtype), x,
+                            preferred_element_type=tokens.dtype)
+    # expert-sharded buffers: the einsum above becomes the first all_to_all
+    dispatched = _constrain(dispatched, P("expert", None, None))
+    h = activation(jnp.einsum("ecm,emf->ecf", dispatched, wi) + bi[:, None])
+    h = _constrain(h, P("expert", None, "tensor"))
+    out = jnp.einsum("ecf,efm->ecm", h, wo) + bo[:, None]
+    out = _constrain(out, P("expert", None, None))
+    # second all_to_all back to token sharding, then weighted combine
+    y = jnp.einsum("sec,ecm->sm", combine, out,
+                   preferred_element_type=tokens.dtype)
+    y = _constrain(
+        y.reshape(orig_shape),
+        P(BATCH_AXES, "seq" if seq_sharded else None, None)
+        if len(orig_shape) == 3 else P(BATCH_AXES, None))
+    return y, l_aux, exp_counts

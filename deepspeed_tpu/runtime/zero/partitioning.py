@@ -53,22 +53,26 @@ def _used_axes(spec):
     return used
 
 
-def add_partition_axis(shape, base_spec, axes, count):
+def add_partition_axis(shape, base_spec, axes, mesh):
     """Return base_spec with ``axes`` added on the LAST eligible dim:
-    divisible by count, not already sharded. Last (not first) because models
-    stack layers on dim 0 and ``lax.scan`` slices that dim each iteration —
-    partitioning an inner dim makes stage-3 materialize one layer per scan
-    step (the fetch/release pattern) instead of re-gathering the whole
-    stack. Falls back to the unmodified spec (replicated over ``axes``) —
+    divisible by the partition count, not already sharded. Last (not first)
+    because models stack layers on dim 0 and ``lax.scan`` slices that dim
+    each iteration — partitioning an inner dim makes stage-3 materialize one
+    layer per scan step (the fetch/release pattern) instead of re-gathering
+    the whole stack. Axes already present in the spec are dropped from the
+    partition group (e.g. expert weights TP/EP-sharded on 'expert' partition
+    over 'data' only — the reference's expert-DP group,
+    utils/groups.py:331). Falls back to the unmodified spec (replicated) —
     the reference similarly keeps small tensors whole below
     param_persistence_threshold."""
-    if count == 1:
-        return base_spec
     spec = list(base_spec) + [None] * (len(shape) - len(base_spec))
     used = _used_axes(spec)
-    ax_tuple = axes if isinstance(axes, tuple) else (axes,)
-    if any(a in used for a in ax_tuple):
-        return P(*spec)
+    ax_tuple = tuple(a for a in
+                     (axes if isinstance(axes, tuple) else (axes,))
+                     if a not in used)
+    count = _axes_size(mesh, ax_tuple) if ax_tuple else 1
+    if count == 1:
+        return P(*spec) if spec else base_spec
     for dim in reversed(range(len(shape))):
         if spec[dim] is None and shape[dim] % count == 0 and shape[dim] >= count:
             spec[dim] = ax_tuple if len(ax_tuple) > 1 else ax_tuple[0]
@@ -88,10 +92,9 @@ class ZeroShardingPlan:
         self.stage = stage
         self.mesh = mesh
         self.partition_axes = partition_axes
-        n = _axes_size(mesh, partition_axes)
 
         def partitioned(spec, shape):
-            return add_partition_axis(shape, spec, partition_axes, n)
+            return add_partition_axis(shape, spec, partition_axes, mesh)
 
         is_spec = lambda x: isinstance(x, P)
         # bf16 params: partitioned only at stage 3

@@ -1,0 +1,184 @@
+"""MoE: gating math, layer numerics, expert parallelism, engine e2e.
+
+Mirrors the reference's tests/unit/moe/test_moe.py strategy (EP groups,
+top-k gating correctness) on the virtual 8-device CPU mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.moe import MoE, TopKGate, top1gating, top2gating
+from deepspeed_tpu.models import GPT2MoE, GPT2MoEConfig
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.groups import TopologyConfig
+
+
+def _logits(S=64, E=8, seed=0):
+    return jax.random.normal(jax.random.key(seed), (S, E), jnp.float32)
+
+
+class TestGating:
+    def test_top1_shapes_and_capacity(self):
+        S, E = 64, 8
+        l_aux, combine, dispatch, counts = top1gating(
+            _logits(S, E), capacity_factor=1.0, min_capacity=4)
+        C = S // E
+        assert combine.shape == (S, E, C)
+        assert dispatch.shape == (S, E, C)
+        # each token goes to at most one (expert, slot)
+        assert np.all(np.sum(np.asarray(dispatch), axis=(1, 2)) <= 1)
+        # each (expert, slot) holds at most one token
+        assert np.all(np.sum(np.asarray(dispatch), axis=0) <= 1)
+        assert float(l_aux) > 0
+
+    def test_top1_combine_weights_match_softmax(self):
+        S, E = 32, 4
+        logits = _logits(S, E, seed=1)
+        _, combine, dispatch, _ = top1gating(logits, capacity_factor=4.0)
+        gates = jax.nn.softmax(logits, axis=-1)
+        kept = np.asarray(jnp.sum(combine, axis=(1, 2)))
+        routed = np.asarray(jnp.sum(dispatch, axis=(1, 2))) > 0
+        expect = np.asarray(jnp.max(gates, axis=-1))
+        np.testing.assert_allclose(kept[routed], expect[routed], rtol=1e-5)
+
+    def test_top1_drops_overflow(self):
+        # all tokens prefer expert 0 -> only C survive
+        S, E = 32, 4
+        logits = jnp.zeros((S, E)).at[:, 0].set(10.0)
+        _, _, dispatch, _ = top1gating(logits, capacity_factor=1.0,
+                                       min_capacity=4)
+        assert int(jnp.sum(dispatch)) == S // E
+
+    def test_top1_no_drop_tokens(self):
+        S, E = 32, 4
+        logits = jnp.zeros((S, E)).at[:, 0].set(10.0)
+        _, _, dispatch, _ = top1gating(logits, capacity_factor=1.0,
+                                       drop_tokens=False)
+        assert int(jnp.sum(dispatch)) == S
+
+    def test_top2_two_experts_per_token(self):
+        S, E = 64, 8
+        _, combine, dispatch, _ = top2gating(
+            _logits(S, E), capacity_factor=4.0, rng=jax.random.key(2))
+        per_token = np.sum(np.asarray(dispatch), axis=(1, 2))
+        assert np.all(per_token <= 2)
+        assert np.mean(per_token) > 1.5  # ample capacity: most keep both
+        # normalized pair weights sum to ~1 where both kept
+        sums = np.asarray(jnp.sum(combine, axis=(1, 2)))
+        np.testing.assert_allclose(sums[per_token == 2], 1.0, atol=1e-5)
+
+    def test_gate_object_dispatches_k(self):
+        g1 = TopKGate(k=1)
+        g2 = TopKGate(k=2, top2_2nd_expert_sampling=False)
+        out1 = g1(_logits())
+        out2 = g2(_logits())
+        assert len(out1) == 4 and len(out2) == 4
+        with pytest.raises(ValueError):
+            TopKGate(k=3)
+
+
+class TestMoELayer:
+    def test_forward_and_identity_expert(self):
+        """With ample capacity and experts = identity-ish maps, the layer
+        output equals the gate-weighted expert output."""
+        M, E = 16, 4
+        moe = MoE(hidden_size=M, ffn_hidden_size=M, num_experts=E, k=1,
+                  capacity_factor=8.0, dtype=jnp.float32,
+                  activation=lambda x: x)
+        params = moe.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (8, M), jnp.float32)
+        y, l_aux, counts = moe.apply(params, x, train=False)
+        assert y.shape == x.shape
+        assert float(l_aux) > 0
+        assert int(jnp.sum(counts)) == 8
+        # hand-computed: every token routed (capacity ample)
+        logits = x @ params["gate_w"]
+        top = jnp.argmax(logits, -1)
+        gates = jax.nn.softmax(logits, -1)
+        w = jnp.take_along_axis(gates, top[:, None], -1)[:, 0]
+        expect = jax.vmap(
+            lambda xi, e, wi: wi * ((xi @ params["wi"][e] + params["bi"][e])
+                                    @ params["wo"][e] + params["bo"][e]))(
+            x, top, w)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(expect),
+                                   rtol=2e-4, atol=2e-5)
+
+    def test_expert_parallel_matches_single(self):
+        """EP=4 sharded forward == unsharded forward (same params)."""
+        M, E = 16, 8
+        moe = MoE(hidden_size=M, ffn_hidden_size=32, num_experts=E, k=1,
+                  capacity_factor=2.0, dtype=jnp.float32)
+        params = moe.init(jax.random.key(0))
+        x = jax.random.normal(jax.random.key(1), (16, M), jnp.float32)
+        y_ref, _, _ = jax.jit(
+            lambda p, x: moe.apply(p, x, train=False))(params, x)
+
+        groups.reset()
+        topo = groups.initialize(TopologyConfig(expert_parallel_size=4))
+        specs = moe.partition_specs()
+        sh = jax.tree.map(
+            lambda s: jax.sharding.NamedSharding(topo.mesh, s), specs,
+            is_leaf=lambda s: isinstance(s, jax.sharding.PartitionSpec))
+        with jax.set_mesh(topo.mesh):
+            params_sh = jax.device_put(params, sh)
+            y_ep, _, _ = jax.jit(
+                lambda p, x: moe.apply(p, x, train=False))(params_sh, x)
+        np.testing.assert_allclose(np.asarray(y_ref), np.asarray(y_ep),
+                                   rtol=1e-4, atol=1e-5)
+
+
+class TestGPT2MoEEngine:
+    def _cfg(self, **kw):
+        return GPT2MoEConfig(n_layer=2, n_head=2, d_model=32, max_seq_len=16,
+                             vocab_size=128, remat=False, dtype="float32",
+                             num_experts=4, **kw)
+
+    def test_param_count(self):
+        cfg = self._cfg()
+        model = GPT2MoE(cfg)
+        params = model.init(jax.random.key(0))
+        n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert n == cfg.num_params()
+
+    @pytest.mark.parametrize("zero_stage", [0, 2])
+    def test_train_decreases_loss_ep(self, zero_stage):
+        import deepspeed_tpu
+        groups.reset()
+        topo = groups.initialize(TopologyConfig(expert_parallel_size=2))
+        cfg = self._cfg(moe_top_k=2)
+        model = GPT2MoE(cfg)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, topology=topo,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "gradient_accumulation_steps": 1,
+                    "steps_per_print": 0,
+                    "optimizer": {"type": "Adam", "params": {"lr": 3e-3}},
+                    "zero_optimization": {"stage": zero_stage}})
+        rng = np.random.RandomState(0)
+        batch = {"input_ids": rng.randint(
+            0, cfg.vocab_size,
+            (engine.config.train_batch_size, cfg.max_seq_len)).astype(
+            np.int32)}
+        losses = [float(engine.train_batch(batch)) for _ in range(10)]
+        assert losses[-1] < losses[0] * 0.9, losses
+
+    def test_expert_shardings_applied(self):
+        import deepspeed_tpu
+        groups.reset()
+        topo = groups.initialize(TopologyConfig(expert_parallel_size=4))
+        model = GPT2MoE(self._cfg())
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, topology=topo,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "steps_per_print": 0,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1}})
+        wi = engine.state["params"]["blocks"]["moe"]["wi"]
+        spec = wi.sharding.spec
+        assert "expert" in jax.tree.leaves(tuple(spec))
+        # ZeRO-1 master of expert weights partitioned over 'data' only
+        mwi = engine.state["master"]["blocks"]["moe"]["wi"]
+        flat = jax.tree.leaves(tuple(mwi.sharding.spec))
+        assert "data" in flat and "expert" in flat
