@@ -47,6 +47,10 @@ class GPT2Config:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     use_flash_attention: bool = False  # pallas kernel (TPU only)
+    # 'dense': GSPMD Ulysses resharding (all_to_all pair) when seq-sharded.
+    # 'ring': ring/context-parallel attention (sequence/ring.py) — KV blocks
+    #         rotate over the 'seq' axis; no head-count constraint.
+    attention_backend: str = "dense"
 
     @property
     def d_head(self):
@@ -186,6 +190,13 @@ class GPT2:
         B, T = input_ids.shape
         H, hd = cfg.n_head, cfg.d_head
 
+        if train and rng is None and self._requires_train_rng():
+            # without this, the key(0) fallback below would silently make
+            # dropout/noisy gating deterministic across steps
+            raise ValueError(
+                "train=True requires rng= (model uses stochastic "
+                "dropout/routing)")
+
         act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
 
         # Sharding constraints are advisory: no-ops without an active mesh
@@ -211,21 +222,29 @@ class GPT2:
             qkv = h @ layer["wqkv"] + layer["bqkv"]
             qkv = qkv.reshape(B, T, 3, H, hd)
             q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            if seq_sharded:
-                # Ulysses: heads onto 'seq', sequence gathered
-                head_spec = P(BATCH_AXES, None, "seq", None)
+            if (seq_sharded and cfg.attention_backend == "ring"
+                    and not jax.sharding.get_abstract_mesh().empty):
+                # context parallel: KV rotates the 'seq' ring (ppermute)
+                from ..sequence.ring import ring_attention_sharded
+                attn = ring_attention_sharded(
+                    q, kk, v, jax.sharding.get_abstract_mesh(),
+                    batch_spec=P(BATCH_AXES), head_axis="tensor")
             else:
-                head_spec = P(BATCH_AXES, None, "tensor", None)
-            q = constrain(q, head_spec)
-            kk = constrain(kk, head_spec)
-            v = constrain(v, head_spec)
+                if seq_sharded:
+                    # Ulysses: heads onto 'seq', sequence gathered
+                    head_spec = P(BATCH_AXES, None, "seq", None)
+                else:
+                    head_spec = P(BATCH_AXES, None, "tensor", None)
+                q = constrain(q, head_spec)
+                kk = constrain(kk, head_spec)
+                v = constrain(v, head_spec)
 
-            scores = jnp.einsum("bthd,bshd->bhts", q, kk,
-                                preferred_element_type=jnp.float32)
-            scores = scores / math.sqrt(hd)
-            scores = jnp.where(causal[None, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-            attn = jnp.einsum("bhts,bshd->bthd", probs, v)
+                scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                                    preferred_element_type=jnp.float32)
+                scores = scores / math.sqrt(hd)
+                scores = jnp.where(causal[None, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+                attn = jnp.einsum("bhts,bshd->bthd", probs, v)
             attn = attn.reshape(B, T, H * hd)
             attn = constrain(attn, act_spec)
             x = x + attn @ layer["wo"] + layer["bo"]
@@ -258,6 +277,11 @@ class GPT2:
         logits = jnp.einsum("btd,vd->btv", x, params["wte"],
                             preferred_element_type=jnp.float32)
         return logits, jnp.sum(auxs)
+
+    def _requires_train_rng(self):
+        """True when a training forward is stochastic (overridden by
+        GPT2MoE for noisy gating / top-2 sampling)."""
+        return self.config.dropout > 0
 
     def _mlp(self, h, layer, rng, *, train, seq_sharded, constrain):
         """Dense MLP; overridden by GPT2MoE with an expert-parallel MoE.

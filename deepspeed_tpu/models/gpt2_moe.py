@@ -74,6 +74,13 @@ class GPT2MoE(GPT2):
         specs["blocks"] = blocks
         return specs
 
+    def _requires_train_rng(self):
+        cfg = self.config
+        return (super()._requires_train_rng()
+                or cfg.noisy_gate_policy is not None
+                or (cfg.moe_top_k == 2
+                    and self.moe.gate.top2_2nd_expert_sampling))
+
     def _mlp(self, h, layer, rng, *, train, seq_sharded, constrain):
         y, aux, _ = self.moe.apply(layer["moe"], h, rng=rng, train=train,
                                    seq_sharded=seq_sharded)

@@ -1,0 +1,11 @@
+"""Sequence/context parallelism for long sequences.
+
+Counterpart of the reference's ``deepspeed/sequence/`` (Ulysses,
+layer.py:60 DistributedAttention) plus ring attention — absent in the
+reference (SURVEY §2.5 notes Ulysses-only) but first-class here."""
+
+from .layer import DistributedAttention, single_all_to_all, ulysses_attention
+from .ring import ring_attention, ring_attention_sharded
+
+__all__ = ["DistributedAttention", "single_all_to_all", "ulysses_attention",
+           "ring_attention", "ring_attention_sharded"]

@@ -1,0 +1,85 @@
+"""Ulysses sequence parallelism: head-scatter / seq-gather all-to-all.
+
+Counterpart of the reference's ``deepspeed/sequence/layer.py``
+(single_all_to_all :15, _SeqAllToAll :44, DistributedAttention :60). Same
+dataflow — q/k/v arrive sequence-sharded, an all-to-all trades the head dim
+for the full sequence, any local attention runs, and the reverse all-to-all
+restores sequence sharding — but expressed as ``lax.all_to_all`` inside
+``shard_map`` on the 'seq' mesh axis instead of torch.distributed
+all_to_all_single on an SP process group. Autodiff differentiates through
+the collective, so no hand-written backward (_SeqAllToAll.backward) is
+needed.
+
+GPT-2's declarative path (models/gpt2.py: resharding constraints) lets
+GSPMD place the same pair automatically; this module is the *explicit*
+form for wrapping arbitrary local-attention implementations (the
+reference's use case: flash-attn under Ulysses).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..utils.groups import BATCH_AXES
+
+
+def single_all_to_all(x, scatter_idx, gather_idx, axis_name):
+    """All-to-all inside shard_map: split ``scatter_idx`` across the axis
+    group, concatenate along ``gather_idx`` (reference sequence/layer.py:15;
+    tiled=True matches its reshape+all_to_all_single layout)."""
+    return lax.all_to_all(x, axis_name, split_axis=scatter_idx,
+                          concat_axis=gather_idx, tiled=True)
+
+
+class DistributedAttention:
+    """Wrap a local attention fn for Ulysses SP (reference layer.py:60).
+
+    ``local_attn(q, k, v, *args, **kwargs)`` operates on (B, T, H/P, D)
+    full-sequence, head-sharded blocks. __call__ receives (B, T/P, H, D)
+    sequence-sharded blocks (must run inside shard_map over ``axis_name``).
+    """
+
+    def __init__(self, local_attn, axis_name="seq", scatter_idx=2,
+                 gather_idx=1):
+        self.local_attn = local_attn
+        self.axis_name = axis_name
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        s, g = self.scatter_idx, self.gather_idx
+        q = single_all_to_all(query, s, g, self.axis_name)
+        k = single_all_to_all(key, s, g, self.axis_name)
+        v = single_all_to_all(value, s, g, self.axis_name)
+        out = self.local_attn(q, k, v, *args, **kwargs)
+        # reverse: scatter seq back, gather heads
+        return single_all_to_all(out, g, s, self.axis_name)
+
+
+def _dense_causal_attention(q, k, v):
+    """Reference local attention: causal softmax(QK^T/sqrt(d))V, fp32
+    scores. q/k/v: (B, T, H, D)."""
+    T = q.shape[1]
+    scores = jnp.einsum("bthd,bshd->bhts", q, k,
+                        preferred_element_type=jnp.float32)
+    scores = scores / math.sqrt(q.shape[-1])
+    causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+    scores = jnp.where(causal[None, None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+def ulysses_attention(q, k, v, mesh, *, axis_name="seq", local_attn=None,
+                      batch_spec=P(BATCH_AXES)):
+    """Global-array entry: q/k/v (B, T, H, D) sequence-sharded on
+    ``axis_name``; returns attention output with the same sharding."""
+    local_attn = local_attn or _dense_causal_attention
+    dist = DistributedAttention(local_attn, axis_name)
+    spec = P(*batch_spec, axis_name, None, None)
+    fn = jax.shard_map(dist, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    return fn(q, k, v)

@@ -1,0 +1,99 @@
+"""Ring attention: context parallelism for arbitrarily long sequences.
+
+NOT in the reference (SURVEY §2.5/§5.7: this DeepSpeed version's only long
+-sequence tool is Ulysses + sparse attention) — built here because ring/
+blockwise attention is the natural TPU extension: KV blocks rotate around
+the 'seq' axis ring via ``ppermute`` (ICI neighbor traffic, fully
+overlappable with the per-block attention compute), and softmax is
+accumulated online flash-style, so no device ever materializes the full
+(T, T) score matrix OR the full KV — sequence length scales linearly with
+ring size at constant memory per chip.
+
+Ulysses vs ring trade-off (why both exist): Ulysses needs head_count >=
+ring size and moves activations twice through all-to-all; ring moves KV
+P-1 times through neighbor exchange but has no head-count constraint and
+composes with any per-block kernel (e.g. the Pallas flash kernel).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..utils.groups import BATCH_AXES
+
+NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, axis_name="seq", causal=True):
+    """Blockwise ring attention over an axis group; call inside shard_map.
+
+    q, k, v: (B, T_local, H, D) — this device's sequence block.
+    Returns (B, T_local, H, D) attention output, exact (not approximate):
+    online-softmax accumulation is algebraically identical to dense
+    softmax attention.
+    """
+    ring = lax.psum(1, axis_name)
+    my_block = lax.axis_index(axis_name)
+    B, T, H, D = q.shape
+    scale = 1.0 / math.sqrt(D)
+
+    m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, T), jnp.float32)
+    acc0 = jnp.zeros((B, T, H, D), jnp.float32)
+    perm = [(j, (j + 1) % ring) for j in range(ring)]
+
+    @jax.checkpoint
+    def accumulate(m, l, acc, kk, vv, i):
+        # after i rotations this device holds block (my_block - i) mod ring
+        src = (my_block - i) % ring
+        scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = my_block * T + jnp.arange(T)
+            kv_pos = src * T + jnp.arange(T)
+            mask = q_pos[:, None] >= kv_pos[None, :]
+            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        s_max = jnp.max(scores, axis=-1)
+        m_new = jnp.maximum(m, s_max)
+        p = jnp.exp(scores - m_new[..., None])          # (B,H,T,S) fp32
+        corr = jnp.exp(m - m_new)                       # (B,H,T)
+        l = l * corr + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bhts,bshd->bthd", p, vv.astype(jnp.float32))
+        acc = acc * corr.transpose(0, 2, 1)[..., None] + pv
+        return m_new, l, acc
+
+    def step(carry, i):
+        m, l, acc, kk, vv = carry
+        m, l, acc = accumulate(m, l, acc, kk, vv, i)
+        kk = lax.ppermute(kk, axis_name, perm)
+        vv = lax.ppermute(vv, axis_name, perm)
+        return (m, l, acc, kk, vv), None
+
+    carry = (m0, l0, acc0, k, v)
+    if ring > 1:
+        # scan the first ring-1 blocks (rotation at step end); the final
+        # block accumulates outside so no dead last rotation is issued
+        carry, _ = lax.scan(step, carry, jnp.arange(ring - 1))
+    m, l, acc, kk, vv = carry
+    m, l, acc = accumulate(m, l, acc, kk, vv, ring - 1)
+    out = acc / jnp.clip(l, 1e-30, None).transpose(0, 2, 1)[..., None]
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(q, k, v, mesh, *, axis_name="seq", causal=True,
+                           batch_spec=P(BATCH_AXES),
+                           head_axis=None):
+    """Global-array entry: q/k/v (B, T, H, D) sequence-sharded on
+    ``axis_name``; exact causal attention over the full sequence.
+    ``head_axis``: optionally shard heads too (ring-CP x TP composition)."""
+    spec = P(*batch_spec, axis_name, head_axis, None)
+    fn = jax.shard_map(
+        functools.partial(ring_attention, axis_name=axis_name,
+                          causal=causal),
+        mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
+        check_vma=False)
+    return fn(q, k, v)
