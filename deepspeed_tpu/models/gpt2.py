@@ -51,6 +51,9 @@ class GPT2Config:
     # 'ring': ring/context-parallel attention (sequence/ring.py) — KV blocks
     #         rotate over the 'seq' axis; no head-count constraint.
     attention_backend: str = "dense"
+    # pipeline parallelism (GPT2Pipe): microbatches in flight; 0 = auto
+    # (2x the pipe axis size, amortizing the fill/drain bubble)
+    pipe_microbatches: int = 0
 
     @property
     def d_head(self):
@@ -186,77 +189,20 @@ class GPT2:
         all_to_all pair.
         """
         cfg = self.config
-        dt = _dtype(cfg)
-        B, T = input_ids.shape
-        H, hd = cfg.n_head, cfg.d_head
+        T = input_ids.shape[1]
 
-        if train and rng is None and self._requires_train_rng():
-            # without this, the key(0) fallback below would silently make
-            # dropout/noisy gating deterministic across steps
-            raise ValueError(
-                "train=True requires rng= (model uses stochastic "
-                "dropout/routing)")
-
+        constrain = self._constrain_fn()
         act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
-
-        # Sharding constraints are advisory: no-ops without an active mesh
-        # (single-device tests / eager use), GSPMD directives under one.
-        if jax.sharding.get_abstract_mesh().empty:
-            def constrain(x, spec):
-                return x
-        else:
-            def constrain(x, spec):
-                return lax.with_sharding_constraint(x, spec)
-
-        pos = jnp.arange(T)[None, :]
-        x = params["wte"][input_ids] + params["wpe"][pos]
-        x = constrain(x.astype(dt), act_spec)
-        if train and cfg.dropout > 0 and rng is not None:
-            x = _dropout(x, cfg.dropout, jax.random.fold_in(rng, 0))
+        x = self.embed(params, input_ids, rng=rng, train=train,
+                       constrain=constrain, act_spec=act_spec)
 
         # causal mask built once; fp32 scores
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
 
         def block(x, layer, lrng):
-            h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
-            qkv = h @ layer["wqkv"] + layer["bqkv"]
-            qkv = qkv.reshape(B, T, 3, H, hd)
-            q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            if (seq_sharded and cfg.attention_backend == "ring"
-                    and not jax.sharding.get_abstract_mesh().empty):
-                # context parallel: KV rotates the 'seq' ring (ppermute)
-                from ..sequence.ring import ring_attention_sharded
-                attn = ring_attention_sharded(
-                    q, kk, v, jax.sharding.get_abstract_mesh(),
-                    batch_spec=P(BATCH_AXES), head_axis="tensor")
-            else:
-                if seq_sharded:
-                    # Ulysses: heads onto 'seq', sequence gathered
-                    head_spec = P(BATCH_AXES, None, "seq", None)
-                else:
-                    head_spec = P(BATCH_AXES, None, "tensor", None)
-                q = constrain(q, head_spec)
-                kk = constrain(kk, head_spec)
-                v = constrain(v, head_spec)
-
-                scores = jnp.einsum("bthd,bshd->bhts", q, kk,
-                                    preferred_element_type=jnp.float32)
-                scores = scores / math.sqrt(hd)
-                scores = jnp.where(causal[None, None], scores, -1e30)
-                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-                attn = jnp.einsum("bhts,bshd->bthd", probs, v)
-            attn = attn.reshape(B, T, H * hd)
-            attn = constrain(attn, act_spec)
-            x = x + attn @ layer["wo"] + layer["bo"]
-            x = constrain(x, act_spec)
-
-            h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
-            mlp_out, aux = self._mlp(h, layer, lrng, train=train,
-                                     seq_sharded=seq_sharded,
-                                     constrain=constrain)
-            x = x + mlp_out
-            x = constrain(x, act_spec)
-            return x, aux
+            return self.block_forward(x, layer, lrng, causal=causal,
+                                      constrain=constrain, act_spec=act_spec,
+                                      seq_sharded=seq_sharded, train=train)
 
         block_fn = block
         if cfg.remat:
@@ -272,11 +218,97 @@ class GPT2:
             return x, aux
 
         x, auxs = lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+        return self.head(params, x), jnp.sum(auxs)
 
+    def _constrain_fn(self):
+        """Sharding constraints are advisory: no-ops without an active mesh
+        (single-device tests / eager use), GSPMD directives under one."""
+        if jax.sharding.get_abstract_mesh().empty:
+            return lambda x, spec: x
+        return lax.with_sharding_constraint
+
+    def embed(self, params, input_ids, *, rng, train, constrain, act_spec):
+        """Token + position embedding (B, T) -> (B, T, D); validates the
+        train rng. Shared by the dense and pipelined paths."""
+        cfg = self.config
+        if train and rng is None and self._requires_train_rng():
+            # without this, the key(0) fallback in apply_with_aux would
+            # silently make dropout/noisy gating deterministic across steps
+            raise ValueError(
+                "train=True requires rng= (model uses stochastic "
+                "dropout/routing)")
+        T = input_ids.shape[1]
+        pos = jnp.arange(T)[None, :]
+        x = params["wte"][input_ids] + params["wpe"][pos]
+        x = constrain(x.astype(_dtype(cfg)), act_spec)
+        if train and cfg.dropout > 0 and rng is not None:
+            x = _dropout(x, cfg.dropout, jax.random.fold_in(rng, 0))
+        return x
+
+    def head(self, params, x):
+        """Final LN + tied-embedding unembed: (B, T, D) -> fp32 logits."""
         x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
-        logits = jnp.einsum("btd,vd->btv", x, params["wte"],
-                            preferred_element_type=jnp.float32)
-        return logits, jnp.sum(auxs)
+        return jnp.einsum("btd,vd->btv", x, params["wte"],
+                          preferred_element_type=jnp.float32)
+
+    def block_forward(self, x, layer, lrng, *, causal, constrain, act_spec,
+                      seq_sharded, train):
+        """One transformer block: (B, T, D) -> (B, T, D), plus aux loss.
+        Shared by the dense scan path and the pipelined executor
+        (models/gpt2_pipe.py)."""
+        cfg = self.config
+        dt = _dtype(cfg)
+        B, T = x.shape[0], x.shape[1]
+        H, hd = cfg.n_head, cfg.d_head
+
+        h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
+        qkv = h @ layer["wqkv"] + layer["bqkv"]
+        qkv = qkv.reshape(B, T, 3, H, hd)
+        q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if (seq_sharded and cfg.attention_backend == "ring"
+                and not jax.sharding.get_abstract_mesh().empty):
+            # context parallel: KV rotates the 'seq' ring (ppermute)
+            from ..sequence.ring import ring_attention_sharded
+            attn = ring_attention_sharded(
+                q, kk, v, jax.sharding.get_abstract_mesh(),
+                batch_spec=P(BATCH_AXES), head_axis="tensor")
+        elif cfg.use_flash_attention and not seq_sharded:
+            # pallas fused attention: O(T) memory, fp32 accumulation
+            # (ops/pallas/flash_attention.py). Heads shard over 'tensor'.
+            from ..ops.pallas.flash_attention import flash_attention
+            head_spec = P(BATCH_AXES, None, "tensor", None)
+            q = constrain(q, head_spec)
+            kk = constrain(kk, head_spec)
+            v = constrain(v, head_spec)
+            attn = flash_attention(q, kk, v, causal=True).astype(dt)
+        else:
+            if seq_sharded:
+                # Ulysses: heads onto 'seq', sequence gathered
+                head_spec = P(BATCH_AXES, None, "seq", None)
+            else:
+                head_spec = P(BATCH_AXES, None, "tensor", None)
+            q = constrain(q, head_spec)
+            kk = constrain(kk, head_spec)
+            v = constrain(v, head_spec)
+
+            scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            scores = jnp.where(causal[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhts,bshd->bthd", probs, v)
+        attn = attn.reshape(B, T, H * hd)
+        attn = constrain(attn, act_spec)
+        x = x + attn @ layer["wo"] + layer["bo"]
+        x = constrain(x, act_spec)
+
+        h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
+        mlp_out, aux = self._mlp(h, layer, lrng, train=train,
+                                 seq_sharded=seq_sharded,
+                                 constrain=constrain)
+        x = x + mlp_out
+        x = constrain(x, act_spec)
+        return x, aux
 
     def _requires_train_rng(self):
         """True when a training forward is stochastic (overridden by

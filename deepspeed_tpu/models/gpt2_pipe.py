@@ -1,0 +1,115 @@
+"""GPT2Pipe — GPT-2 with pipeline parallelism over the 'pipe' mesh axis.
+
+The reference expresses pipelined GPT-style models as a PipelineModule of
+LayerSpecs interpreted by PipelineEngine (runtime/pipe/module.py:87,
+engine.py:56). Here the pipeline is *inside* the model's forward: the
+stacked block params shard over 'pipe' (each stage owns n_layer/S layers)
+and spmd_pipeline (runtime/pipe/spmd.py) rotates microbatch activations
+through the stages with ppermute. Embedding and the LM head run outside the
+pipelined region, replicated over 'pipe' — their grads psum across stages
+automatically, which is exactly the reference's tied-weight allreduce
+(pipe/engine.py:260 _exec_reduce_tied_grads) in declarative form.
+
+Composes with the rest of the mesh: batch stays sharded over data/expert,
+Megatron TP over 'tensor', and ZeRO partitioning applies on top of the
+'pipe'-sharded layer dim (the reference needs a dedicated PipelineEngine +
+grid for this; here it is the same DeepSpeedEngine).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.pipe.spmd import (spmd_pipeline, split_microbatches,
+                                 merge_microbatches)
+from ..utils.groups import BATCH_AXES
+from .gpt2 import GPT2
+
+
+class GPT2Pipe(GPT2):
+    """Same params / math / init as GPT2; pipelined forward when the active
+    mesh has pipe > 1 (falls back to the dense scan otherwise, so one model
+    object serves any topology)."""
+
+    def partition_specs(self, topology=None):
+        specs = super().partition_specs(topology)
+        pipe = 1
+        if topology is not None:
+            pipe = topology.get_pipe_parallel_world_size()
+        if pipe <= 1:
+            return specs
+        if self.config.n_layer % pipe:
+            raise ValueError(
+                f"n_layer {self.config.n_layer} not divisible by pipeline "
+                f"stages {pipe}")
+        blocks = {k: P(*(("pipe",) + tuple(s)[1:]))
+                  for k, s in specs["blocks"].items()}
+        specs = dict(specs)
+        specs["blocks"] = blocks
+        return specs
+
+    def _pipe_size(self):
+        mesh = jax.sharding.get_abstract_mesh()
+        if mesh.empty or "pipe" not in mesh.shape:
+            return 1
+        return mesh.shape["pipe"]
+
+    def apply_with_aux(self, params, input_ids, *, rng=None, train=False,
+                       seq_sharded=False):
+        S = self._pipe_size()
+        if S == 1:
+            return super().apply_with_aux(params, input_ids, rng=rng,
+                                          train=train,
+                                          seq_sharded=seq_sharded)
+        cfg = self.config
+        if cfg.attention_backend == "ring":
+            raise NotImplementedError(
+                "ring attention inside the pipelined region (nested "
+                "shard_map) is not supported; use Ulysses (dense) with pipe")
+        if cfg.use_flash_attention:
+            raise NotImplementedError(
+                "flash attention inside the pipelined region is not "
+                "supported yet (pallas_call under a partial-manual "
+                "shard_map); use the dense backend with pipe")
+        B, T = input_ids.shape
+        M = cfg.pipe_microbatches or 2 * S
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by "
+                             f"pipe_microbatches {M}")
+
+        act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
+        mb_act_spec = P(None, BATCH_AXES, "seq" if seq_sharded else None,
+                        None)
+        constrain = lax.with_sharding_constraint
+
+        # --- embedding (outside the pipe; replicated over 'pipe') ---
+        x = self.embed(params, input_ids, rng=rng, train=train,
+                       constrain=constrain, act_spec=act_spec)
+
+        # --- pipelined blocks ---
+        causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+
+        def block_fn(x, layer_and_rng):
+            layer, lrng = layer_and_rng
+            y, _aux = self.block_forward(
+                x, layer, lrng, causal=causal, constrain=constrain,
+                act_spec=act_spec, seq_sharded=seq_sharded, train=train)
+            return y
+
+        if cfg.remat:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+            block_fn = jax.checkpoint(block_fn, policy=policy)
+
+        layer_rngs = jax.random.split(
+            rng if rng is not None else jax.random.key(0), cfg.n_layer)
+
+        x_mb = split_microbatches(x, M)
+        x_mb = constrain(x_mb, mb_act_spec)
+        out_mb = spmd_pipeline(block_fn, (params["blocks"], layer_rngs),
+                               x_mb)
+        x = merge_microbatches(out_mb)
+        x = constrain(x, act_spec)
+
+        # --- head (outside the pipe) ---
+        return self.head(params, x), jnp.zeros((), jnp.float32)

@@ -1,0 +1,18 @@
+from .module import LayerSpec, TiedLayerSpec, PipelineModule
+from .topology import (ProcessTopology, PipeDataParallelTopology,
+                       PipeModelDataParallelTopology, PipelineParallelGrid)
+from .schedule import (TrainSchedule, InferenceSchedule, PipeSchedule,
+                       ForwardPass, BackwardPass, SendActivation,
+                       RecvActivation, SendGrad, RecvGrad, LoadMicroBatch,
+                       ReduceGrads, OptimizerStep)
+from .spmd import spmd_pipeline
+
+__all__ = [
+    "LayerSpec", "TiedLayerSpec", "PipelineModule",
+    "ProcessTopology", "PipeDataParallelTopology",
+    "PipeModelDataParallelTopology", "PipelineParallelGrid",
+    "TrainSchedule", "InferenceSchedule", "PipeSchedule",
+    "ForwardPass", "BackwardPass", "SendActivation", "RecvActivation",
+    "SendGrad", "RecvGrad", "LoadMicroBatch", "ReduceGrads", "OptimizerStep",
+    "spmd_pipeline",
+]

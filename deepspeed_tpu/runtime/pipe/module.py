@@ -1,0 +1,220 @@
+"""PipelineModule: express a model as a layer sequence, partition to stages.
+
+Counterpart of reference ``runtime/pipe/module.py`` (``LayerSpec:31``,
+``TiedLayerSpec:78``, ``PipelineModule:87``, ``_partition_layers:372``).
+Functional-JAX redesign: a layer is either a plain callable ``x -> x`` or an
+object with ``init(rng) -> params`` and ``apply(params, x) -> x``. The module
+owns layer construction, stage partitioning (uniform / parameters /
+type:regex, same vocabulary as the reference), and two execution paths:
+
+  * ``apply``: sequential composition — the correctness/reference path and
+    the single-stage fallback;
+  * ``stacked_params`` + the spmd executor (spmd.py): when layers are
+    structurally homogeneous their params stack on a leading layer dim that
+    shards over the 'pipe' mesh axis; heterogeneous embed/head layers stay
+    outside the pipelined region (how the flagship GPT2Pipe is built).
+"""
+
+import re
+
+import jax
+import numpy as np
+
+
+class LayerSpec:
+    """Lazily-built layer: stores class + ctor args, builds on demand —
+    avoids materializing all stages' layers everywhere (the reference's
+    motivation too: module.py:31)."""
+
+    def __init__(self, typename, *module_args, **module_kwargs):
+        self.typename = typename
+        self.module_args = module_args
+        self.module_kwargs = module_kwargs
+
+    def build(self):
+        return self.typename(*self.module_args, **self.module_kwargs)
+
+    def __repr__(self):
+        return f"LayerSpec({getattr(self.typename, '__name__', self.typename)})"
+
+
+class TiedLayerSpec(LayerSpec):
+    """A layer whose params are shared with every other TiedLayerSpec of the
+    same ``key`` (reference module.py:78 — e.g. tied embedding/unembedding).
+    In the SPMD engine tied params are simply replicated over 'pipe' and
+    GSPMD psums their grads — the declarative form of the reference's
+    tied-weight allreduce (pipe/engine.py:260 _exec_reduce_tied_grads)."""
+
+    def __init__(self, key, typename, *module_args, forward_fn=None,
+                 **module_kwargs):
+        super().__init__(typename, *module_args, **module_kwargs)
+        self.key = key
+        self.forward_fn = forward_fn
+
+
+def partition_balanced(weights, num_parts):
+    """Split ``weights`` into ``num_parts`` contiguous chunks minimizing the
+    max chunk sum. Binary search on the bottleneck + greedy feasibility —
+    O(n log sum). Returns part boundary indices, len num_parts+1.
+    (Reference uses ds_utils.partition_balanced for method='parameters'.)"""
+    weights = list(weights)
+    n = len(weights)
+    if num_parts > n:
+        raise ValueError(f"cannot split {n} layers into {num_parts} stages")
+
+    def feasible(cap):
+        parts, acc = 1, 0
+        for w in weights:
+            if w > cap:
+                return False
+            if acc + w > cap:
+                parts += 1
+                acc = w
+            else:
+                acc += w
+        return parts <= num_parts
+
+    lo, hi = max(weights, default=0), sum(weights)
+    while lo < hi:
+        mid = (lo + hi) // 2
+        if feasible(mid):
+            hi = mid
+        else:
+            lo = mid + 1
+    # materialize boundaries at bottleneck lo, greedily, but never leave
+    # fewer layers than remaining parts
+    bounds = [0]
+    acc = 0
+    for i, w in enumerate(weights):
+        remaining_parts = num_parts - (len(bounds) - 1)
+        remaining_layers = n - i
+        if (acc + w > lo or remaining_layers < remaining_parts + 1) and acc > 0 \
+                and len(bounds) < num_parts:
+            bounds.append(i)
+            acc = 0
+        acc += w
+    while len(bounds) < num_parts:
+        bounds.append(n - (num_parts - len(bounds)))
+    bounds.append(n)
+    return bounds
+
+
+def _param_count(layer):
+    if not hasattr(layer, "init"):
+        return 0
+    shapes = jax.eval_shape(layer.init, jax.random.key(0))
+    return sum(int(np.prod(l.shape)) for l in jax.tree.leaves(shapes))
+
+
+class PipelineModule:
+    """A sequence of layers partitioned into pipeline stages."""
+
+    def __init__(self, layers, num_stages=1, partition_method="parameters",
+                 loss_fn=None):
+        self.specs = list(layers)
+        self.layers = [s.build() if isinstance(s, LayerSpec) else s
+                       for s in self.specs]
+        self.num_stages = num_stages
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.parts = self._partition_layers(partition_method)
+        # tied keys -> layer indices
+        self.tied_groups = {}
+        for i, s in enumerate(self.specs):
+            if isinstance(s, TiedLayerSpec):
+                self.tied_groups.setdefault(s.key, []).append(i)
+
+    # ----------------------------------------------------------- partitioning
+    def _partition_layers(self, method):
+        """Stage boundaries (reference module.py:372 _partition_layers).
+        methods: 'uniform' (equal layer counts), 'parameters' (balance param
+        counts), 'type:REGEX' (balance count of layers whose class name
+        matches REGEX)."""
+        n, S = len(self.layers), self.num_stages
+        method = method.lower() if isinstance(method, str) else method
+        if method == "uniform":
+            weights = [1] * n
+        elif method == "parameters":
+            weights = [max(_param_count(l), 0) + 1 for l in self.layers]
+        elif isinstance(method, str) and method.startswith("type:"):
+            pat = method.split(":", 1)[1]
+            weights = [1 if re.search(pat, type(l).__name__, re.IGNORECASE)
+                       else 0 for l in self.layers]
+            if sum(weights) == 0:
+                raise ValueError(f"no layer class matches {pat!r}")
+            # every stage still needs >= 1 layer: give zeros epsilon weight
+            weights = [w * 1000 + 1 for w in weights]
+        else:
+            raise ValueError(f"unknown partition_method {method!r}")
+        return partition_balanced(weights, S)
+
+    def stage_layer_indices(self, stage_id):
+        return list(range(self.parts[stage_id], self.parts[stage_id + 1]))
+
+    def stage_of_layer(self, layer_idx):
+        for s in range(self.num_stages):
+            if self.parts[s] <= layer_idx < self.parts[s + 1]:
+                return s
+        raise IndexError(layer_idx)
+
+    # ------------------------------------------------------------- execution
+    def init(self, rng):
+        """Per-layer params tuple; tied layers share (first occurrence owns,
+        later ones get None and resolve through the tie at apply time)."""
+        params = []
+        tied_owner = {}
+        keys = jax.random.split(rng, max(len(self.layers), 1))
+        for i, (layer, spec) in enumerate(zip(self.layers, self.specs)):
+            key = spec.key if isinstance(spec, TiedLayerSpec) else None
+            if key is not None and key in tied_owner:
+                params.append(None)
+                continue
+            p = layer.init(keys[i]) if hasattr(layer, "init") else None
+            params.append(p)
+            if key is not None:
+                tied_owner[key] = i
+        self._tied_owner = tied_owner
+        return tuple(params)
+
+    def _resolve_params(self, params, i):
+        spec = self.specs[i]
+        if isinstance(spec, TiedLayerSpec) and params[i] is None:
+            return params[self._tied_owner[spec.key]]
+        return params[i]
+
+    def apply(self, params, x, first_layer=0, last_layer=None):
+        """Sequential forward over [first_layer, last_layer) — full model by
+        default; a single stage's slice when given its bounds."""
+        last_layer = len(self.layers) if last_layer is None else last_layer
+        for i in range(first_layer, last_layer):
+            layer, spec = self.layers[i], self.specs[i]
+            p = self._resolve_params(params, i)
+            if isinstance(spec, TiedLayerSpec) and spec.forward_fn is not None:
+                x = spec.forward_fn(p, x)
+            elif hasattr(layer, "apply"):
+                x = layer.apply(p, x)
+            else:
+                x = layer(x)
+        return x
+
+    def apply_stage(self, params, x, stage_id):
+        return self.apply(params, x, self.parts[stage_id],
+                          self.parts[stage_id + 1])
+
+    def loss(self, params, batch):
+        out = self.apply(params, batch["input"])
+        if self.loss_fn is None:
+            raise ValueError("PipelineModule built without loss_fn")
+        return self.loss_fn(out, batch)
+
+    # -------------------------------------------------------------- analysis
+    def stage_param_counts(self):
+        counts = []
+        for s in range(self.num_stages):
+            counts.append(sum(_param_count(self.layers[i])
+                              for i in self.stage_layer_indices(s)))
+        return counts
+
+    def __repr__(self):
+        return (f"PipelineModule(layers={len(self.layers)}, "
+                f"stages={self.num_stages}, parts={self.parts})")

@@ -1,0 +1,170 @@
+"""Pipeline instruction schedules (1F1B and inference).
+
+Counterpart of reference ``runtime/pipe/schedule.py`` (``TrainSchedule:189``
+1F1B, ``InferenceSchedule:135``, ``PipeInstruction`` vocabulary). There the
+schedule drives an imperative per-rank interpreter (``_exec_schedule``,
+engine.py:1382). Here the compute path is one SPMD program (spmd.py) whose
+reverse-mode AD produces the backward pipeline — so these instruction
+streams serve as the *specification*: they document the logical order,
+power the deadlock/dataflow tests, and give schedule-analysis tooling
+(bubble fraction, peak in-flight buffers) the same surface the reference
+exposes.
+"""
+
+
+class PipeInstruction:
+    """One step of work for one pipeline stage."""
+
+    def __init__(self, **kwargs):
+        self.kwargs = kwargs
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def __repr__(self):
+        args = ", ".join(f"{k}={v}" for k, v in self.kwargs.items())
+        return f"{type(self).__name__}({args})"
+
+    def __eq__(self, other):
+        return type(self) is type(other) and self.kwargs == other.kwargs
+
+    def __hash__(self):
+        return hash((type(self).__name__, tuple(sorted(self.kwargs.items()))))
+
+
+class OptimizerStep(PipeInstruction):
+    pass
+
+
+class ReduceGrads(PipeInstruction):
+    pass
+
+
+class LoadMicroBatch(PipeInstruction):
+    """kwargs: micro_batch, buffer_id."""
+
+
+class ForwardPass(PipeInstruction):
+    """kwargs: micro_batch, buffer_id."""
+
+
+class BackwardPass(PipeInstruction):
+    """kwargs: micro_batch, buffer_id."""
+
+
+class SendActivation(PipeInstruction):
+    """kwargs: micro_batch, buffer_id."""
+
+
+class RecvActivation(PipeInstruction):
+    """kwargs: micro_batch, buffer_id."""
+
+
+class SendGrad(PipeInstruction):
+    """kwargs: micro_batch, buffer_id."""
+
+
+class RecvGrad(PipeInstruction):
+    """kwargs: micro_batch, buffer_id."""
+
+
+class PipeSchedule:
+    """Generates the instruction stream for one (stage, config)."""
+
+    def __init__(self, micro_batches, stages, stage_id):
+        if not 0 <= stage_id < stages:
+            raise ValueError(f"stage_id {stage_id} out of range [0,{stages})")
+        self.micro_batches = micro_batches
+        self.stages = stages
+        self.stage_id = stage_id
+
+    @property
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    @property
+    def is_last_stage(self):
+        return self.stage_id == self.stages - 1
+
+    def num_pipe_buffers(self):
+        raise NotImplementedError
+
+    def steps(self):
+        """Yield lists of PipeInstructions (one list = one logical step)."""
+        raise NotImplementedError
+
+    def __iter__(self):
+        return iter(self.steps())
+
+    def bubble_fraction(self):
+        """Idle fraction of the pipeline fill/drain: (S-1)/(M+S-1)."""
+        return (self.stages - 1) / (self.micro_batches + self.stages - 1)
+
+
+class InferenceSchedule(PipeSchedule):
+    """Forward-only pipeline: fill, stream, drain."""
+
+    def num_pipe_buffers(self):
+        return 2
+
+    def steps(self):
+        M, S, s = self.micro_batches, self.stages, self.stage_id
+        for t in range(M + S - 1):
+            mb = t - s
+            step = []
+            if 0 <= mb < M:
+                buf = mb % self.num_pipe_buffers()
+                if self.is_first_stage or self.is_last_stage:
+                    step.append(LoadMicroBatch(micro_batch=mb, buffer_id=buf))
+                if not self.is_first_stage:
+                    step.append(RecvActivation(micro_batch=mb, buffer_id=buf))
+                step.append(ForwardPass(micro_batch=mb, buffer_id=buf))
+                if not self.is_last_stage:
+                    step.append(SendActivation(micro_batch=mb, buffer_id=buf))
+            yield step
+
+
+class TrainSchedule(PipeSchedule):
+    """1F1B: warmup forwards, steady one-forward-one-backward, cooldown
+    backwards. Peak in-flight activations on stage s = min(S - s, M) —
+    the memory property that motivates 1F1B over GPipe."""
+
+    def num_pipe_buffers(self):
+        return min(self.stages - self.stage_id, self.micro_batches)
+
+    def _phases(self):
+        """Sequence of ('F'|'B', micro_batch) for this stage."""
+        M, S, s = self.micro_batches, self.stages, self.stage_id
+        warmup = min(S - s - 1, M)
+        seq = [("F", i) for i in range(warmup)]
+        f, b = warmup, 0
+        while f < M:
+            seq.append(("F", f))
+            seq.append(("B", b))
+            f += 1
+            b += 1
+        while b < M:
+            seq.append(("B", b))
+            b += 1
+        return seq
+
+    def steps(self):
+        nbuf = self.num_pipe_buffers()
+        for kind, mb in self._phases():
+            buf = mb % nbuf
+            step = []
+            if kind == "F":
+                if self.is_first_stage or self.is_last_stage:
+                    step.append(LoadMicroBatch(micro_batch=mb, buffer_id=buf))
+                if not self.is_first_stage:
+                    step.append(RecvActivation(micro_batch=mb, buffer_id=buf))
+                step.append(ForwardPass(micro_batch=mb, buffer_id=buf))
+                if not self.is_last_stage:
+                    step.append(SendActivation(micro_batch=mb, buffer_id=buf))
+            else:
+                if not self.is_last_stage:
+                    step.append(RecvGrad(micro_batch=mb, buffer_id=buf))
+                step.append(BackwardPass(micro_batch=mb, buffer_id=buf))
+                if not self.is_first_stage:
+                    step.append(SendGrad(micro_batch=mb, buffer_id=buf))
+            yield step
+        yield [ReduceGrads(), OptimizerStep()]

@@ -1,0 +1,185 @@
+"""SPMD pipeline executor: collective-permute over the 'pipe' mesh axis.
+
+The reference's pipeline engine (runtime/pipe/engine.py:56) is an imperative
+instruction interpreter: per-rank processes walk a 1F1B instruction stream
+(runtime/pipe/schedule.py:189) exchanging activations over NCCL p2p
+(runtime/pipe/p2p.py:50,71). On TPU the same dataflow is ONE jitted SPMD
+program:
+
+  * the stacked layer dim of the model params is sharded over the 'pipe'
+    mesh axis — each pipe shard owns L/S contiguous layers (the
+    PipelineModule partitioning, reference runtime/pipe/module.py:372);
+  * a ``shard_map`` manual only over 'pipe' (data/tensor/seq stay
+    GSPMD-automatic, so the block's internal sharding constraints keep
+    working) runs the rotation loop: at tick t, stage s computes microbatch
+    t-s and ``ppermute``s its activation to stage s+1 — the p2p send/recv
+    of the reference, but expressed as a collective XLA can schedule;
+  * reverse-mode AD through the scan yields the backward pipeline (reverse
+    ppermutes) automatically — the schedule the reference hand-codes.
+
+The forward fills the pipe GPipe-style (all M microbatches in flight);
+memory is bounded by rematerializing each block (``jax.checkpoint``), the
+same trade the reference makes with activation checkpointing. The 1F1B
+instruction stream in schedule.py documents/verifies the logical order for
+parity tests; this executor is the compute path.
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def spmd_pipeline(block_fn, layers, x_mb, *, pipe_axis="pipe",
+                  unroll_local=False):
+    """Run ``x`` through all L layers, pipelined over the pipe axis.
+
+    Args:
+      block_fn: ``(x, layer_slice) -> x`` — one layer's forward. ``x`` is a
+        single microbatch activation; ``layer_slice`` is the layers pytree
+        with the leading layer dim removed (bundle rngs etc. into it).
+      layers: pytree whose leaves have leading dim L (== S * layers_per_
+        stage); sharded P(pipe_axis) on that dim by the caller's param specs.
+      x_mb: microbatch-stacked input, leaves (M, ...) — replicated over the
+        pipe axis, sharded however the caller likes on auto axes.
+      pipe_axis: manual mesh axis name.
+      unroll_local: unroll the per-stage layer scan (faster for tiny depth).
+
+    Returns outputs with the same (M, ...) structure as ``x_mb``, replicated
+    over the pipe axis.
+
+    Must be called under an active mesh (``jax.set_mesh``) that has
+    ``pipe_axis``. Total ticks = M + S - 1; per-stage bubble fraction
+    (S-1)/(M+S-1) — choose M >= S (reference guidance for 1F1B too).
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty or pipe_axis not in mesh.shape:
+        raise ValueError(f"spmd_pipeline needs an active mesh with a "
+                         f"'{pipe_axis}' axis; got {mesh}")
+    S = mesh.shape[pipe_axis]
+    if S == 1:
+        # degenerate: plain scan over layers, no collectives
+        def body(c, layer):
+            return block_fn(c, layer), None
+
+        def run(x):
+            y, _ = lax.scan(body, x, layers, unroll=unroll_local)
+            return y
+        return jax.vmap(run)(x_mb) if _leading(x_mb) else run(x_mb)
+
+    M = _leading(x_mb)
+    if M is None:
+        raise ValueError("x_mb must have a leading microbatch dim")
+
+    # Activations cross the shard_map boundary in f32: the transpose of a
+    # replicated input is a psum over 'pipe', and XLA-CPU check-fails
+    # promoting partial-manual sub-f32 all-reduces (f32 is also the safe
+    # accumulation dtype for the cotangent sum).
+    def _is_lowp(x):
+        return (jnp.issubdtype(x.dtype, jnp.floating)
+                and jnp.finfo(x.dtype).bits < 32)
+    in_dtypes = jax.tree.map(lambda x: x.dtype, x_mb)
+    x_mb = jax.tree.map(
+        lambda x: x.astype(jnp.float32) if _is_lowp(x) else x, x_mb)
+
+    def stage_fn(layers_local, x_local):
+        sid = lax.axis_index(pipe_axis)
+
+        def run_local(state):
+            def body(c, layer):
+                return block_fn(c, layer), None
+            y, _ = lax.scan(body, state, layers_local, unroll=unroll_local)
+            return y
+
+        def varying_zeros(x):
+            # pcast in f32, cast after: the transpose of pcast(to='varying')
+            # is a psum over 'pipe', and it must not be sub-f32 (same
+            # XLA-CPU promotion check-fail as the output broadcast below)
+            z = lax.pcast(jnp.zeros(x.shape, jnp.float32), (pipe_axis,),
+                          to="varying")
+            return z.astype(x.dtype)
+
+        state = jax.tree.map(lambda x: varying_zeros(x[0]), x_local)
+        outputs = jax.tree.map(varying_zeros, x_local)
+        perm = [(i, (i + 1) % S) for i in range(S)]
+
+        def tick(carry, t):
+            state, outputs = carry
+            # stage 0 ingests microbatch t (clamped index; garbage ticks at
+            # t >= M never reach the output buffer). The pipe-invariant
+            # slice is promoted to pipe-varying EXPLICITLY, in f32, before
+            # the dtype cast — otherwise shard_map's vma machinery inserts
+            # the promotion inside the where in the compute dtype, and that
+            # lowers to a sub-f32 all-reduce XLA-CPU cannot promote.
+            inject = jax.tree.map(
+                lambda x, dt: lax.pcast(
+                    x[jnp.minimum(t, M - 1)], (pipe_axis,),
+                    to="varying").astype(dt),
+                x_local, in_dtypes)
+            state = jax.tree.map(
+                lambda i, s: jnp.where(sid == 0, i, s), inject, state)
+            out = run_local(state)
+            # last stage owns microbatch t-(S-1) at tick t
+            idx = t - (S - 1)
+            safe = jnp.clip(idx, 0, M - 1)
+            valid = (sid == S - 1) & (idx >= 0)
+
+            def write(buf, o):
+                cur = lax.dynamic_index_in_dim(buf, safe, 0, keepdims=False)
+                return lax.dynamic_update_index_in_dim(
+                    buf, jnp.where(valid, o, cur), safe, 0)
+            outputs = jax.tree.map(write, outputs, out)
+            nxt = jax.tree.map(lambda o: lax.ppermute(o, pipe_axis, perm),
+                               out)
+            return (nxt, outputs), None
+
+        (_, outputs), _ = lax.scan(tick, (state, outputs),
+                                   jnp.arange(M + S - 1))
+
+        # non-last stages hold zeros: psum broadcasts the result pipe-wide.
+        # Sub-f32 floats go through f32 (XLA-CPU check-fails promoting a
+        # partial-manual bf16 all-reduce; f32 is also the safe accumulation
+        # dtype on TPU and this is one collective of activations).
+        def bcast(o):
+            if jnp.issubdtype(o.dtype, jnp.floating) and \
+                    jnp.finfo(o.dtype).bits < 32:
+                return lax.psum(o.astype(jnp.float32),
+                                pipe_axis).astype(o.dtype)
+            return lax.psum(o, pipe_axis)
+        return jax.tree.map(bcast, outputs)
+
+    return jax.shard_map(
+        stage_fn,
+        in_specs=(P(pipe_axis), P()),
+        out_specs=P(),
+        axis_names={pipe_axis},
+    )(layers, x_mb)
+
+
+def _leading(tree):
+    leaves = jax.tree.leaves(tree)
+    if not leaves:
+        return None
+    n = leaves[0].shape[0] if leaves[0].ndim else None
+    return n
+
+
+def split_microbatches(x, num_microbatches, batch_dim=0):
+    """(B, ...) -> (M, B//M, ...) with stride-M row sampling so each
+    microbatch draws evenly from every data-parallel shard of the batch dim
+    (a contiguous split would put whole microbatches on single DP shards).
+    Inverse: merge_microbatches."""
+    M = num_microbatches
+    B = x.shape[batch_dim]
+    assert B % M == 0, f"batch {B} not divisible by microbatches {M}"
+    x = jnp.moveaxis(x, batch_dim, 0)
+    x = x.reshape((B // M, M) + x.shape[1:])
+    x = jnp.swapaxes(x, 0, 1)           # (M, B//M, ...)
+    return x
+
+
+def merge_microbatches(x, batch_dim=0):
+    """Inverse of split_microbatches: (M, B//M, ...) -> (B, ...)."""
+    x = jnp.swapaxes(x, 0, 1)
+    x = x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
+    return jnp.moveaxis(x, 0, batch_dim) if batch_dim else x

@@ -1,0 +1,170 @@
+"""Cartesian process topology — coordinate math over named axes.
+
+Counterpart of reference ``runtime/pipe/topology.py`` (``ProcessTopology:12``,
+``PipelineParallelGrid:251``). Pure coordinate bookkeeping, so the design
+carries over naturally; here it doubles as the bridge between flat "rank"
+reasoning (launcher, schedules, tests) and the named-axis world of the
+global ``jax.sharding.Mesh`` (utils/groups.py) — a rank is just a position
+in the row-major enumeration of mesh devices.
+"""
+
+import itertools
+from collections import namedtuple
+
+
+class ProcessTopology:
+    """Maps ranks <-> coordinates over named axes, row-major (first axis
+    varies slowest), matching Mesh device-array order."""
+
+    def __init__(self, axes, dims):
+        if len(axes) != len(dims):
+            raise ValueError("axes and dims must have equal length")
+        self.axes = list(axes)
+        self.dims = list(dims)
+        self.ProcessCoord = namedtuple("ProcessCoord", self.axes)
+        self._coord_to_rank = {}
+        for rank, coord in enumerate(itertools.product(
+                *[range(d) for d in self.dims])):
+            self._coord_to_rank[self.ProcessCoord(*coord)] = rank
+        self._rank_to_coord = {r: c for c, r in self._coord_to_rank.items()}
+
+    @property
+    def world_size(self):
+        n = 1
+        for d in self.dims:
+            n *= d
+        return n
+
+    def get_rank(self, **coords):
+        if set(coords) != set(self.axes):
+            raise ValueError(f"need all axes {self.axes}, got {list(coords)}")
+        return self._coord_to_rank[self.ProcessCoord(**coords)]
+
+    def get_coord(self, rank):
+        return self._rank_to_coord[rank]
+
+    def get_dim(self, axis):
+        return self.dims[self.axes.index(axis)]
+
+    def get_axis_names(self):
+        return list(self.axes)
+
+    def filter_match(self, **filters):
+        """Ranks whose coordinates match every given axis=value filter."""
+        out = []
+        for rank in range(self.world_size):
+            coord = self._rank_to_coord[rank]
+            if all(getattr(coord, ax) == v for ax, v in filters.items()):
+                out.append(rank)
+        return out
+
+    def get_axis_comm_lists(self, axis):
+        """Groups of ranks that differ only along ``axis`` — the reference's
+        process-group construction (topology.py: get_axis_comm_lists); here
+        these are the device groups a collective over that mesh axis spans."""
+        if axis not in self.axes:
+            return []
+        other = [ax for ax in self.axes if ax != axis]
+        lists = []
+        for combo in itertools.product(
+                *[range(self.get_dim(ax)) for ax in other]):
+            fixed = dict(zip(other, combo))
+            group = [self.get_rank(**fixed, **{axis: i})
+                     for i in range(self.get_dim(axis))]
+            lists.append(group)
+        return lists
+
+    def get_rank_repr(self, rank, omit_axes=("data", "pipe"), inner_sep="_",
+                      outer_sep="-"):
+        """String like 'tensor_00' used in checkpoint filenames (reference
+        uses it for layer file naming)."""
+        coord = self.get_coord(rank)
+        parts = [f"{ax}{inner_sep}{getattr(coord, ax):02d}"
+                 for ax in self.axes if ax not in omit_axes]
+        return outer_sep.join(parts)
+
+    def __str__(self):
+        return (f"ProcessTopology(axes={self.axes}, dims={self.dims})")
+
+
+class PipeDataParallelTopology(ProcessTopology):
+    """pipe x data (reference topology.py: PipeDataParallelTopology)."""
+
+    def __init__(self, num_pp, num_dp):
+        super().__init__(axes=["pipe", "data"], dims=[num_pp, num_dp])
+
+
+class PipeModelDataParallelTopology(ProcessTopology):
+    """pipe x data x model — 3D parallelism."""
+
+    def __init__(self, num_pp, num_dp, num_mp):
+        super().__init__(axes=["pipe", "data", "model"],
+                         dims=[num_pp, num_dp, num_mp])
+
+
+class PipelineParallelGrid:
+    """Stage/data/model coordinate queries for a rank (reference
+    topology.py:251). Answers "which stage am I", "who are my pipeline
+    neighbors" — consumed by schedules and checkpoint naming. On TPU the
+    p2p neighbors become the ppermute permutation."""
+
+    def __init__(self, topology=None, rank=0):
+        self._topo = topology or PipeDataParallelTopology(1, 1)
+        self.global_rank = rank
+        self.world_size = self._topo.world_size
+        coord = self._topo.get_coord(rank)
+        self.stage_id = getattr(coord, "pipe", 0)
+        self.data_parallel_id = getattr(coord, "data", 0)
+        self.model_parallel_id = getattr(coord, "model", 0)
+        self.pipe_parallel_size = (self._topo.get_dim("pipe")
+                                   if "pipe" in self._topo.axes else 1)
+        self.data_parallel_size = (self._topo.get_dim("data")
+                                   if "data" in self._topo.axes else 1)
+        self.model_parallel_size = (self._topo.get_dim("model")
+                                    if "model" in self._topo.axes else 1)
+
+    @property
+    def topology(self):
+        return self._topo
+
+    def get_stage_id(self):
+        return self.stage_id
+
+    def get_data_parallel_id(self):
+        return self.data_parallel_id
+
+    def get_pipe_parallel_world_size(self):
+        return self.pipe_parallel_size
+
+    def get_data_parallel_world_size(self):
+        return self.data_parallel_size
+
+    def get_model_parallel_world_size(self):
+        return self.model_parallel_size
+
+    def is_first_stage(self):
+        return self.stage_id == 0
+
+    def is_last_stage(self):
+        return self.stage_id == self.pipe_parallel_size - 1
+
+    def stage_to_global(self, stage_id):
+        """Rank holding ``stage_id`` with my other coordinates."""
+        coord = self._topo.get_coord(self.global_rank)
+        kwargs = {ax: getattr(coord, ax) for ax in self._topo.axes}
+        kwargs["pipe"] = stage_id
+        return self._topo.get_rank(**kwargs)
+
+    @property
+    def prev_stage(self):
+        return (self.stage_id - 1) % self.pipe_parallel_size
+
+    @property
+    def next_stage(self):
+        return (self.stage_id + 1) % self.pipe_parallel_size
+
+    def ppermute_perm(self):
+        """The cyclic (src, dst) stage permutation the SPMD executor uses in
+        place of p2p send/recv (reference runtime/pipe/p2p.py:50,71)."""
+        S = self.pipe_parallel_size
+        return [(i, (i + 1) % S) for i in range(S)]
