@@ -1,0 +1,300 @@
+"""Fused causal attention (flash attention) as a Pallas TPU kernel.
+
+Counterpart of the reference's fused attention kernels: training softmax
+(csrc/transformer/softmax_kernels.cu), inference attention
+(csrc/transformer/inference/csrc/softmax.cu) and the memory-efficient
+Evoformer kernel (csrc/deepspeed4science/evoformer_attn/) — all of which
+exist because materializing the (T, T) score matrix is HBM-bound. Same
+motivation here: the online-softmax streaming form never materializes
+scores, so HBM traffic drops from O(T^2) to O(T * d) per head and the MXU
+stays busy on the two matmuls.
+
+Layout: (batch, seq, heads, head_dim) at the API (the model's layout);
+kernels run per (batch*head) on (seq, head_dim) slabs, grid over query
+blocks. K/V for one head live in VMEM whole (T*d*2B at bf16 — up to ~32k
+tokens at d=128 inside the 16 MB budget); the backward recomputes
+attention probabilities from the saved logsumexp instead of storing them
+(the standard flash backward).
+
+Off-TPU (unit tests / dryrun) the kernels run in Pallas interpreter mode.
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # pltpu only imports on TPU-enabled builds; interpret mode needs it not
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct whose varying-manual-axes match ``like`` — needed
+    when the kernel runs inside a shard_map region (e.g. the pipelined
+    blocks, runtime/pipe/spmd.py)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _divisor_block(T, block):
+    """Largest divisor of T that is <= block (so any T works; powers of two
+    and multiples of 128 keep the full block size)."""
+    for b in range(min(block, T), 0, -1):
+        if T % b == 0:
+            return b
+    return 1
+
+
+def _block_sizes(T, block_q, block_k):
+    return _divisor_block(T, block_q), _divisor_block(T, block_k)
+
+
+NEG_INF = -1e30
+
+
+# ------------------------------------------------------------------ forward
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
+                causal):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale              # (bq, d)
+    T = k_ref.shape[1]
+    nk = T // bk
+    # causal: query block qi attends k blocks 0..ceil((qi+1)*bq / bk)-1
+    kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
+
+    def body(j, carry):
+        acc, m, l = carry
+        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[:, None])
+        alpha = jnp.exp(m - m_new)
+        l = l * alpha + jnp.sum(p, axis=-1)
+        acc = acc * alpha[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return acc, m_new, l
+
+    d = q_ref.shape[-1]
+    acc = jnp.zeros((bq, d), jnp.float32)
+    m = jnp.full((bq,), NEG_INF, jnp.float32)
+    l = jnp.zeros((bq,), jnp.float32)
+    acc, m, l = jax.lax.fori_loop(0, kmax, body, (acc, m, l))
+    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l)
+
+
+def _fwd(q, k, v, scale, causal, bq, bk, interpret):
+    BH, T, d = q.shape
+    grid = (BH, T // bq)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel, bq=bq, bk=bk, scale=scale,
+                          causal=causal),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            _sds((BH, T, d), q.dtype, q),
+            _sds((BH, T), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ----------------------------------------------------------------- backward
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   *, bq, bk, scale, causal):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * scale
+    do = do_ref[0].astype(jnp.float32)
+    lse = lse_ref[0]
+    delta = delta_ref[0]
+    T = k_ref.shape[1]
+    nk = T // bk
+    kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
+
+    def body(j, dq):
+        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])                      # (bq, bk)
+        return dq + jax.lax.dot_general(
+            ds, kb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    d = q_ref.shape[-1]
+    dq = jax.lax.fori_loop(0, kmax, body, jnp.zeros((bq, d), jnp.float32))
+    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, *, bq, bk, scale, causal):
+    ki = pl.program_id(1)
+    kb = k_ref[0].astype(jnp.float32)                       # (bk, d)
+    vb = v_ref[0].astype(jnp.float32)
+    T = q_ref.shape[1]
+    nq = T // bq
+    qmin = (ki * bk) // bq if causal else 0
+
+    def body(i, carry):
+        dk, dv = carry
+        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
+        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
+        lse = lse_ref[0, pl.ds(i * bq, bq)]
+        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
+        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta[:, None])
+        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
+                                      preferred_element_type=jnp.float32)
+        return dk, dv
+
+    d = q_ref.shape[-1]
+    dk = jnp.zeros((bk, d), jnp.float32)
+    dv = jnp.zeros((bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qmin, nq, body, (dk, dv))
+    # dk accumulated against scaled q: scale folded in already via q*scale
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret):
+    BH, T, d = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                    axis=-1)                                # (BH, T)
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, scale=scale,
+                          causal=causal),
+        grid=(BH, T // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_shape=_sds((BH, T, d), q.dtype, q),
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, scale=scale,
+                          causal=causal),
+        grid=(BH, T // bk),
+        in_specs=[
+            pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            _sds((BH, T, d), q.dtype, q),
+            _sds((BH, T, d), q.dtype, q),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------- public API
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash(q, k, v, scale, causal, bq, bk, interpret):
+    o, _ = _fwd(q, k, v, scale, causal, bq, bk, interpret)
+    return o
+
+
+def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret):
+    o, lse = _fwd(q, k, v, scale, causal, bq, bk, interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(scale, causal, bq, bk, interpret, res, do):
+    q, k, v, o, lse = res
+    return _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
+                    block_k=128, interpret=None):
+    """Fused attention over (batch, seq, heads, head_dim) inputs.
+
+    Equivalent math to softmax(scale * q k^T + causal_mask) v with fp32
+    accumulation, O(T) memory. Differentiable (custom flash backward).
+    """
+    B, T, H, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret_default()
+    bq, bk = _block_sizes(T, block_q, block_k)
+
+    def fold(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+
+    o = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal),
+               bq, bk, bool(interpret))
+    return o.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+
+
+def attention_reference(q, k, v, *, causal=True, scale=None):
+    """Dense reference used by parity tests (same fp32 score math)."""
+    B, T, H, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    s = jnp.einsum("bthd,bshd->bhts", q, k,
+                   preferred_element_type=jnp.float32) * scale
+    if causal:
+        mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        s = jnp.where(mask[None, None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhts,bshd->bthd", p.astype(q.dtype), v)

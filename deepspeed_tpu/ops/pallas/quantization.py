@@ -1,0 +1,167 @@
+"""Blockwise int8 quantization kernels (Pallas) + quantized collectives.
+
+Counterpart of reference ``csrc/quantization/`` (pt_binding.cpp:298,
+quantize_intX.cu, quant_reduce.cu, swizzled_quantize.cu): symmetric
+per-block int8 quant used by ZeRO++ to compress weight all-gathers
+(``zero_quantized_weights``, partition_parameters.py:725 CUDAQuantizer)
+and gradient reduce-scatters (``zero_quantized_gradients``,
+runtime/comm/coalesced_collectives.py:32 all_to_all_quant_reduce).
+
+TPU design: one VPU pass computes per-block absmax scales and the scaled
+round in VMEM; the collectives then move int8 (4x fewer bytes over
+ICI/DCN) and dequantize on arrival. Off-TPU the same kernels run in
+Pallas interpreter mode; `quantize_blockwise(..., use_pallas=False)` is
+the jnp reference implementation (bitwise-identical math).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+QUANT_BLOCK = 2048  # elements per scale block (reference default group size)
+
+
+def _interpret_default():
+    return jax.default_backend() != "tpu"
+
+
+def _sds(shape, dtype, like):
+    """ShapeDtypeStruct whose varying-manual-axes match ``like`` — required
+    when these kernels run inside a shard_map (e.g. quantized collectives)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def _quant_kernel(x_ref, q_ref, s_ref):
+    x = x_ref[:].astype(jnp.float32)                  # (blocks, block)
+    absmax = jnp.max(jnp.abs(x), axis=-1, keepdims=True)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(x / scale), -127, 127)
+    q_ref[:] = q.astype(jnp.int8)
+    s_ref[:] = scale
+
+
+def _dequant_kernel(q_ref, s_ref, o_ref):
+    o_ref[:] = (q_ref[:].astype(jnp.float32) * s_ref[:]).astype(o_ref.dtype)
+
+
+def _pad_reshape(flat, block):
+    n = flat.shape[0]
+    nblocks = -(-n // block)
+    pad = nblocks * block - n
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), flat.dtype)])
+    return flat.reshape(nblocks, block), pad
+
+
+def quantize_blockwise(x, block=QUANT_BLOCK, use_pallas=True,
+                       interpret=None):
+    """x: any-shape float array -> (q int8 (nblocks, block), scales
+    (nblocks, 1) f32, meta). Symmetric absmax scaling per block."""
+    flat = x.reshape(-1)
+    blocked, pad = _pad_reshape(flat, block)
+    meta = {"shape": x.shape, "dtype": x.dtype, "pad": pad}
+    if interpret is None:
+        interpret = _interpret_default()
+    if use_pallas:
+        q, s = pl.pallas_call(
+            _quant_kernel,
+            out_shape=[
+                _sds(blocked.shape, jnp.int8, blocked),
+                _sds((blocked.shape[0], 1), jnp.float32, blocked),
+            ],
+            interpret=interpret,
+        )(blocked)
+    else:
+        xf = blocked.astype(jnp.float32)
+        absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
+        s = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+        q = jnp.clip(jnp.round(xf / s), -127, 127).astype(jnp.int8)
+    return q, s, meta
+
+
+def dequantize_blockwise(q, s, meta, use_pallas=True, interpret=None):
+    """Inverse of quantize_blockwise."""
+    if interpret is None:
+        interpret = _interpret_default()
+    if use_pallas:
+        out = pl.pallas_call(
+            _dequant_kernel,
+            out_shape=_sds(q.shape, meta["dtype"], q),
+            interpret=interpret,
+        )(q, s)
+    else:
+        out = (q.astype(jnp.float32) * s).astype(meta["dtype"])
+    flat = out.reshape(-1)
+    if meta["pad"]:
+        flat = flat[:flat.shape[0] - meta["pad"]]
+    return flat.reshape(meta["shape"])
+
+
+def quantization_error(x, block=QUANT_BLOCK):
+    """Max abs error of a quant/dequant round trip (diagnostics)."""
+    q, s, meta = quantize_blockwise(x, block)
+    return jnp.max(jnp.abs(dequantize_blockwise(q, s, meta) - x))
+
+
+# ------------------------------------------------- quantized collectives
+def quantized_all_gather(x, axis_name, block=QUANT_BLOCK, use_pallas=True):
+    """all_gather moving int8+scales instead of full precision — the
+    ZeRO++ quantized-weight gather (reference partition_parameters.py:1156
+    all_gather_coalesced with quantization). Call inside shard_map.
+
+    Returns the gathered array stacked on a leading axis (like
+    lax.all_gather)."""
+    q, s, meta = quantize_blockwise(x, block, use_pallas=use_pallas)
+    qg = jax.lax.all_gather(q, axis_name)
+    sg = jax.lax.all_gather(s, axis_name)
+    n = qg.shape[0]
+
+    def deq(i):
+        return dequantize_blockwise(qg[i], sg[i], meta,
+                                    use_pallas=use_pallas)
+    return jax.vmap(deq)(jnp.arange(n))
+
+
+def quantized_psum_scatter(x, axis_name, block=QUANT_BLOCK,
+                           use_pallas=True):
+    """reduce_scatter with int8 transport: quantize per destination piece,
+    all_to_all, dequantize, sum locally — the single-hop form of the
+    reference's all_to_all_quant_reduce (coalesced_collectives.py:32),
+    which exists precisely because int8 cannot be summed over the wire
+    without overflow: dequantize-then-reduce per hop. Call inside
+    shard_map; returns this rank's reduced piece (shape x.shape[0]//world,
+    *x.shape[1:])."""
+    world = jax.lax.axis_size(axis_name)
+    assert x.shape[0] % world == 0, (
+        f"leading dim {x.shape[0]} not divisible by axis size {world}")
+    piece_shape = (x.shape[0] // world,) + x.shape[1:]
+    piece = x.reshape((world,) + piece_shape)
+
+    def qfn(p):
+        q, s, _ = quantize_blockwise(p, block, use_pallas=use_pallas)
+        return q, s
+
+    q, s = jax.vmap(qfn)(piece)            # (world, nb, block), (world, nb, 1)
+    qx = jax.lax.all_to_all(q, axis_name, 0, 0)
+    sx = jax.lax.all_to_all(s, axis_name, 0, 0)
+    meta32 = {"shape": piece_shape, "dtype": jnp.float32,
+              "pad": q.shape[1] * block - int(np_prod(piece_shape))}
+
+    def dfn(qq, ss):
+        return dequantize_blockwise(qq, ss, meta32, use_pallas=use_pallas)
+
+    deq = jax.vmap(dfn)(qx, sx)            # (world,) + piece_shape, f32
+    return jnp.sum(deq, axis=0).astype(x.dtype)
+
+
+def np_prod(shape):
+    n = 1
+    for d in shape:
+        n *= d
+    return n

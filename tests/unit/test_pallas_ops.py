@@ -1,0 +1,184 @@
+"""Pallas kernel parity tests (interpret mode on the CPU mesh).
+
+Mirrors the reference's per-kernel numerics tests (tests/unit/ops/: adam,
+quantizer, transformer vs torch references — SURVEY §4): each kernel is
+checked against a dense jnp reference implementation.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.ops.pallas.flash_attention import (flash_attention,
+                                                      attention_reference)
+from deepspeed_tpu.ops.pallas.quantization import (
+    quantize_blockwise, dequantize_blockwise, quantized_all_gather,
+    quantized_psum_scatter)
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.groups import TopologyConfig
+
+
+class TestFlashAttention:
+    def _qkv(self, B=2, T=128, H=4, d=32, dtype=jnp.float32, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda s: jnp.asarray(rng.randn(B, T, H, d), dtype) * 0.3
+        return mk(0), mk(1), mk(2)
+
+    def test_forward_matches_dense(self):
+        q, k, v = self._qkv()
+        o = flash_attention(q, k, v, block_q=64, block_k=64)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_non_causal(self):
+        q, k, v = self._qkv(T=64)
+        o = flash_attention(q, k, v, causal=False, block_q=32, block_k=32)
+        ref = attention_reference(q, k, v, causal=False)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_uneven_blocks(self):
+        # block_q != block_k exercises the causal block-boundary logic
+        q, k, v = self._qkv(T=128)
+        o = flash_attention(q, k, v, block_q=32, block_k=64)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        o = flash_attention(q, k, v, block_q=64, block_k=32)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_non_multiple_of_block_seq_len(self):
+        # T=96 with default 128 blocks: falls back to divisor block sizes
+        q, k, v = self._qkv(T=96)
+        o = flash_attention(q, k, v)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_grads_match_dense(self):
+        q, k, v = self._qkv(T=64)
+
+        def loss_f(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, block_q=32,
+                                           block_k=32) ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(attention_reference(q, k, v) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_in_model(self):
+        """GPT2(use_flash_attention=True) is loss- and grad-identical to
+        the dense model."""
+        from dataclasses import replace
+        from deepspeed_tpu.models import GPT2, GPT2Config
+        cfg = GPT2Config(n_layer=2, n_head=4, d_model=64, max_seq_len=64,
+                         vocab_size=256, dtype="float32", remat=False)
+        dense, flash = GPT2(cfg), GPT2(replace(cfg,
+                                               use_flash_attention=True))
+        params = dense.init(jax.random.key(0))
+        ids = np.random.RandomState(0).randint(0, 256, (2, 64)).astype(
+            np.int32)
+        l0 = float(dense.loss(params, {"input_ids": ids}, train=False))
+        l1 = float(flash.loss(params, {"input_ids": ids}, train=False))
+        assert l1 == pytest.approx(l0, rel=1e-6)
+        g0 = jax.grad(lambda p: dense.loss(p, {"input_ids": ids},
+                                           train=False))(params)
+        g1 = jax.grad(lambda p: flash.loss(p, {"input_ids": ids},
+                                           train=False))(params)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-5)
+
+
+class TestQuantization:
+    @pytest.mark.parametrize("use_pallas", [True, False])
+    def test_roundtrip_error_bound(self, use_pallas):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(1000) * 3.0, jnp.float32)
+        q, s, meta = quantize_blockwise(x, block=256, use_pallas=use_pallas)
+        assert q.dtype == jnp.int8
+        back = dequantize_blockwise(q, s, meta, use_pallas=use_pallas)
+        assert back.shape == x.shape
+        # per-block absmax symmetric quant: error <= scale/2 per block
+        scales = np.asarray(s).reshape(-1)
+        err = np.abs(np.asarray(back) - np.asarray(x))
+        blocked = np.pad(err, (0, 1024 - 1000)).reshape(4, 256)
+        for b in range(4):
+            assert blocked[b].max() <= scales[b] / 2 + 1e-7
+
+    def test_pallas_matches_jnp(self):
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(4, 512), jnp.float32)
+        qp, sp, _ = quantize_blockwise(x, block=512, use_pallas=True)
+        qr, sr, _ = quantize_blockwise(x, block=512, use_pallas=False)
+        np.testing.assert_array_equal(np.asarray(qp), np.asarray(qr))
+        np.testing.assert_allclose(np.asarray(sp), np.asarray(sr),
+                                   rtol=1e-7)
+
+    def test_zero_block(self):
+        x = jnp.zeros((256,), jnp.float32)
+        q, s, meta = quantize_blockwise(x, block=256)
+        back = dequantize_blockwise(q, s, meta)
+        np.testing.assert_array_equal(np.asarray(back), np.zeros(256))
+
+    def test_bf16_roundtrip(self):
+        rng = np.random.RandomState(2)
+        x = jnp.asarray(rng.randn(512), jnp.bfloat16)
+        q, s, meta = quantize_blockwise(x, block=256)
+        back = dequantize_blockwise(q, s, meta)
+        assert back.dtype == jnp.bfloat16
+        assert float(jnp.max(jnp.abs(back.astype(jnp.float32)
+                                     - x.astype(jnp.float32)))) < 0.1
+
+
+class TestQuantizedCollectives:
+    def _mesh(self):
+        groups.reset()
+        topo = groups.initialize(TopologyConfig(data_parallel_size=8),
+                                 force=True)
+        return topo.mesh
+
+    def test_quantized_all_gather(self):
+        mesh = self._mesh()
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(8, 256), jnp.float32)
+
+        def f(x):
+            return quantized_all_gather(x[0], "data", block=256)
+
+        with jax.set_mesh(mesh):
+            # check_vma off: every rank returns the same gathered value,
+            # which the static vma analysis cannot prove
+            out = jax.jit(jax.shard_map(
+                f, in_specs=P("data"), out_specs=P(),
+                axis_names={"data"}, check_vma=False))(x)
+        # gathered result approximates the full array on every rank
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x),
+                                   atol=0.05)
+
+    def test_quantized_psum_scatter(self):
+        mesh = self._mesh()
+        rng = np.random.RandomState(1)
+        # each rank holds (64, 32); reduce-scatter over 8 ranks -> (8, 32)
+        x = jnp.asarray(rng.randn(8, 64, 32), jnp.float32)
+
+        def f(xs):
+            return quantized_psum_scatter(xs[0], "data", block=256)
+
+        with jax.set_mesh(mesh):
+            out = jax.jit(jax.shard_map(
+                f, in_specs=P("data"),
+                out_specs=P("data"),
+                axis_names={"data"}, check_vma=False))(x)
+        ref = np.asarray(x).sum(axis=0)  # (64, 32) full reduction
+        np.testing.assert_allclose(np.asarray(out).reshape(64, 32), ref,
+                                   atol=8 * 0.05)
