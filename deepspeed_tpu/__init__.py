@@ -10,6 +10,7 @@ reference's ``deepspeed/__init__.py``: ``initialize`` (:69),
 __version__ = "0.1.0"
 
 from . import comm
+from .accelerator import get_accelerator
 from .comm import init_distributed
 from .runtime.config import DeepSpeedConfig
 from .runtime.engine import DeepSpeedEngine

@@ -1,0 +1,8 @@
+"""Accelerator abstraction package (reference ``accelerator/``)."""
+
+from .abstract_accelerator import DeepSpeedAccelerator
+from .real_accelerator import get_accelerator, set_accelerator
+from .tpu_accelerator import CpuAccelerator, TpuAccelerator
+
+__all__ = ["DeepSpeedAccelerator", "get_accelerator", "set_accelerator",
+           "TpuAccelerator", "CpuAccelerator"]

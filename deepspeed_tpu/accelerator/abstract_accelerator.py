@@ -1,0 +1,224 @@
+"""Accelerator abstraction — the hardware seam.
+
+Counterpart of reference ``accelerator/abstract_accelerator.py:10
+DeepSpeedAccelerator`` (~70 abstract methods). Every subsystem reaches
+hardware through ``get_accelerator()`` so a backend swap is one class.
+
+TPU-idiomatic deltas from the CUDA ABC:
+  * Streams/Events (reference :91-111) have no raw analogue — XLA dispatch
+    is already async.  ``Stream`` is a no-op context; ``Event`` records via
+    ``jax.block_until_ready`` fencing.  ``synchronize()`` is a real barrier.
+  * Pinned memory (reference :258-267) maps to ordinary host numpy — TPU
+    D2H goes through the runtime's own staging buffers.
+  * Graphs (reference :209-219): ``jax.jit`` IS the graph capture; the
+    graph API here just tags functions.
+"""
+
+import abc
+
+
+class DeepSpeedAccelerator(abc.ABC):
+    """Reference accelerator/abstract_accelerator.py:10."""
+
+    def __init__(self):
+        self._name = None
+        self._communication_backend_name = None
+
+    # ------------------------------------------------------- device mgmt
+    # reference :33-59
+    @abc.abstractmethod
+    def is_available(self):
+        ...
+
+    @abc.abstractmethod
+    def device_name(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def device_count(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device(self):
+        ...
+
+    @abc.abstractmethod
+    def current_device_name(self):
+        ...
+
+    def set_device(self, device_index):
+        """No-op under SPMD: jax owns device placement."""
+        return None
+
+    @abc.abstractmethod
+    def synchronize(self, device_index=None):
+        ...
+
+    # ------------------------------------------------------------- RNG
+    # reference :62-88 — jax PRNG keys are functional; the accelerator
+    # carries a convenience root key for non-functional call sites.
+    @abc.abstractmethod
+    def manual_seed(self, seed):
+        ...
+
+    @abc.abstractmethod
+    def initial_seed(self):
+        ...
+
+    @abc.abstractmethod
+    def default_generator(self):
+        """Returns the current root PRNG key."""
+        ...
+
+    # --------------------------------------------------- streams/events
+    # reference :91-111
+    def stream(self, stream=None):
+        return _NullStream()
+
+    def current_stream(self, device_index=None):
+        return _NullStream()
+
+    def default_stream(self, device_index=None):
+        return _NullStream()
+
+    def Stream(self, *args, **kwargs):
+        return _NullStream()
+
+    def Event(self, *args, **kwargs):
+        return _NullEvent()
+
+    # ------------------------------------------------------ memory stats
+    # reference :114-164
+    @abc.abstractmethod
+    def memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def max_memory_allocated(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def total_memory(self, device_index=None):
+        ...
+
+    @abc.abstractmethod
+    def available_memory(self, device_index=None):
+        ...
+
+    def memory_stats(self, device_index=None):
+        return {}
+
+    def reset_peak_memory_stats(self, device_index=None):
+        return None
+
+    def empty_cache(self):
+        return None
+
+    # ----------------------------------------------------- dtype support
+    # reference :167-177
+    @abc.abstractmethod
+    def is_bf16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def is_fp16_supported(self):
+        ...
+
+    @abc.abstractmethod
+    def supported_dtypes(self):
+        ...
+
+    # ----------------------------------------------------------- naming
+    # reference :201
+    def communication_backend_name(self):
+        return self._communication_backend_name
+
+    # ------------------------------------------------------------ graphs
+    # reference :209-219
+    def create_graph(self):
+        return None
+
+    def capture_to_graph(self, graph, **kwargs):
+        import contextlib
+        return contextlib.nullcontext()
+
+    def replay_graph(self, graph):
+        return None
+
+    # ----------------------------------------------------- profiler tags
+    # reference :189-194 range_push/pop (NVTX)
+    def range_push(self, msg):
+        return None
+
+    def range_pop(self):
+        return None
+
+    # ------------------------------------------------------ pinned memory
+    # reference :258-267
+    def pin_memory(self, tensor, align_bytes=1):
+        return tensor
+
+    def is_pinned(self, tensor):
+        return True
+
+    # -------------------------------------------------------- op builders
+    # reference :270-289
+    @abc.abstractmethod
+    def op_builder_dir(self):
+        ...
+
+    @abc.abstractmethod
+    def create_op_builder(self, op_name):
+        ...
+
+    @abc.abstractmethod
+    def get_op_builder(self, op_name):
+        ...
+
+
+class _NullStream:
+    """XLA dispatch is already asynchronous; a stream is a no-op scope."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def synchronize(self):
+        import jax
+        jax.effects_barrier()
+
+    def wait_event(self, event):
+        return None
+
+    def wait_stream(self, stream):
+        return None
+
+
+class _NullEvent:
+    """Event semantics via value fencing (jax.block_until_ready)."""
+
+    def __init__(self):
+        self._fence = None
+
+    def record(self, stream=None, value=None):
+        self._fence = value
+
+    def synchronize(self):
+        if self._fence is not None:
+            import jax
+            jax.block_until_ready(self._fence)
+
+    def wait(self, stream=None):
+        self.synchronize()
+
+    def query(self):
+        return True
+
+    def elapsed_time(self, end_event):
+        return 0.0

@@ -1,0 +1,157 @@
+"""TPU (and CPU-mesh) accelerator implementations.
+
+Counterpart of reference ``accelerator/cuda_accelerator.py`` (~360 LoC) —
+the jax backend fills the role torch.cuda does there. A single class body
+serves both platforms; only the platform string and comm backend name
+differ (reference keeps per-backend files: cuda:27 nccl, cpu:18 ccl).
+"""
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .abstract_accelerator import DeepSpeedAccelerator
+
+
+class TpuAccelerator(DeepSpeedAccelerator):
+    _PLATFORM = "tpu"
+
+    def __init__(self):
+        super().__init__()
+        self._name = self._PLATFORM
+        # XLA collectives over ICI/DCN — the role NCCL plays on CUDA.
+        self._communication_backend_name = "xla"
+        self._seed = 0
+        self._root_key = jax.random.key(0)
+
+    # ------------------------------------------------------- device mgmt
+    def _devices(self):
+        try:
+            return jax.devices(self._PLATFORM)
+        except RuntimeError:
+            return []
+
+    def is_available(self):
+        return len(self._devices()) > 0
+
+    def device_name(self, device_index=None):
+        if device_index is None:
+            return self._PLATFORM
+        return f"{self._PLATFORM}:{device_index}"
+
+    def device(self, device_index=None):
+        devs = self._devices()
+        return devs[device_index or 0] if devs else None
+
+    def device_count(self):
+        return len(self._devices())
+
+    def current_device(self):
+        return 0  # SPMD: one process drives all local devices
+
+    def current_device_name(self):
+        return self.device_name(0)
+
+    def synchronize(self, device_index=None):
+        jax.effects_barrier()
+
+    # ------------------------------------------------------------- RNG
+    def manual_seed(self, seed):
+        self._seed = int(seed)
+        self._root_key = jax.random.key(self._seed)
+
+    def initial_seed(self):
+        return self._seed
+
+    def default_generator(self):
+        return self._root_key
+
+    def split_key(self):
+        """Functional convenience: advance and return a fresh subkey."""
+        self._root_key, sub = jax.random.split(self._root_key)
+        return sub
+
+    # ------------------------------------------------------ memory stats
+    def _stats(self, device_index=None):
+        dev = self.device(device_index)
+        if dev is None:
+            return {}
+        try:
+            return dev.memory_stats() or {}
+        except (AttributeError, jax.errors.JaxRuntimeError):
+            return {}
+
+    def memory_allocated(self, device_index=None):
+        return self._stats(device_index).get("bytes_in_use", 0)
+
+    def max_memory_allocated(self, device_index=None):
+        return self._stats(device_index).get("peak_bytes_in_use", 0)
+
+    def total_memory(self, device_index=None):
+        return self._stats(device_index).get("bytes_limit", 0)
+
+    def available_memory(self, device_index=None):
+        s = self._stats(device_index)
+        return s.get("bytes_limit", 0) - s.get("bytes_in_use", 0)
+
+    def memory_stats(self, device_index=None):
+        return self._stats(device_index)
+
+    # ----------------------------------------------------- dtype support
+    def is_bf16_supported(self):
+        return True
+
+    def is_fp16_supported(self):
+        return True
+
+    def supported_dtypes(self):
+        return [jnp.float32, jnp.bfloat16, jnp.float16, jnp.int8,
+                jnp.int32, jnp.float8_e4m3fn, jnp.float8_e5m2]
+
+    # ------------------------------------------------------ pinned memory
+    def pin_memory(self, tensor, align_bytes=1):
+        # Host staging: contiguous numpy is what the TPU runtime DMAs from.
+        return np.ascontiguousarray(tensor)
+
+    # -------------------------------------------------------- op builders
+    def op_builder_dir(self):
+        return "deepspeed_tpu.op_builder"
+
+    def create_op_builder(self, op_name):
+        from ..op_builder.builder import create_op_builder
+        return create_op_builder(op_name)
+
+    def get_op_builder(self, op_name):
+        from ..op_builder.builder import BUILDERS
+        return BUILDERS.get(op_name)
+
+
+class CpuAccelerator(TpuAccelerator):
+    """CPU mesh (tests, virtual-device sharding validation).
+
+    Reference accelerator/cpu_accelerator.py — comm backend 'ccl' (:18);
+    here the same XLA collectives run over the host backend.
+    """
+    _PLATFORM = "cpu"
+
+    def __init__(self):
+        super().__init__()
+        self._name = "cpu"
+        self._communication_backend_name = "xla"
+
+    def is_bf16_supported(self):
+        return True  # emulated, numerically correct
+
+    def total_memory(self, device_index=None):
+        try:
+            return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_PHYS_PAGES")
+        except (ValueError, OSError):
+            return 0
+
+    def available_memory(self, device_index=None):
+        try:
+            return os.sysconf("SC_PAGE_SIZE") * os.sysconf("SC_AVPHYS_PAGES")
+        except (ValueError, OSError):
+            return 0
