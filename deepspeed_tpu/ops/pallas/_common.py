@@ -1,0 +1,24 @@
+"""Shared Pallas kernel helpers (counterpart of reference
+``csrc/includes/`` — the template library every CUDA kernel includes)."""
+
+import jax
+
+
+def interpret_default():
+    """Kernels run in Pallas interpreter mode off-TPU (unit tests, the
+    virtual CPU mesh)."""
+    return jax.default_backend() != "tpu"
+
+
+def sds(shape, dtype, like):
+    """ShapeDtypeStruct whose varying-manual-axes match ``like`` — required
+    when a kernel runs inside a shard_map region (e.g. quantized
+    collectives, pipelined blocks)."""
+    vma = getattr(jax.typeof(like), "vma", None)
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def round_up(n, m):
+    return -(-n // m) * m

@@ -26,45 +26,47 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
-try:  # pltpu only imports on TPU-enabled builds; interpret mode needs it not
-    from jax.experimental.pallas import tpu as pltpu
-except ImportError:  # pragma: no cover
-    pltpu = None
-
-
-def _interpret_default():
-    return jax.default_backend() != "tpu"
-
-
-def _sds(shape, dtype, like):
-    """ShapeDtypeStruct whose varying-manual-axes match ``like`` — needed
-    when the kernel runs inside a shard_map region (e.g. the pipelined
-    blocks, runtime/pipe/spmd.py)."""
-    vma = getattr(jax.typeof(like), "vma", None)
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
-
-
-def _divisor_block(T, block):
-    """Largest divisor of T that is <= block (so any T works; powers of two
-    and multiples of 128 keep the full block size)."""
-    for b in range(min(block, T), 0, -1):
-        if T % b == 0:
-            return b
-    return 1
+from ._common import interpret_default as _interpret_default
+from ._common import round_up as _round_up
+from ._common import sds as _sds
 
 
 def _block_sizes(T, block_q, block_k):
-    return _divisor_block(T, block_q), _divisor_block(T, block_k)
+    """Pick block sizes and the padded sequence length.
+
+    Any T works: rather than shrinking blocks to a divisor of T (which
+    degenerates to tiny blocks that violate the TPU (8,128) tiling and
+    explode the grid for prime T), the sequence is padded up to a common
+    multiple of the blocks and padded keys are masked in-kernel."""
+    bq = min(block_q, _round_up(T, 8))
+    bk = min(block_k, _round_up(T, 8))
+    T_pad = _round_up(T, math.lcm(bq, bk))
+    return bq, bk, T_pad
 
 
 NEG_INF = -1e30
 
 
 # ------------------------------------------------------------------ forward
+def _mask_scores(s, qi_start, kj_start, bq, bk, causal, t_real, T):
+    """Apply causal and/or padded-key masking to a (bq, bk) score block.
+    ``t_real < T`` means the sequence was padded; padded keys must never
+    contribute. Static no-op when neither mask applies."""
+    if not causal and t_real >= T:
+        return s
+    qpos = qi_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
+    kpos = kj_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
+    ok = None
+    if causal:
+        ok = qpos >= kpos
+    if t_real < T:
+        valid = kpos < t_real
+        ok = valid if ok is None else jnp.logical_and(ok, valid)
+    return jnp.where(ok, s, NEG_INF)
+
+
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
-                causal):
+                causal, t_real):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale              # (bq, d)
     T = k_ref.shape[1]
@@ -78,10 +80,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
         vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = _mask_scores(s, qi * bq, j * bk, bq, bk, causal, t_real, T)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1))
         p = jnp.exp(s - m_new[:, None])
         alpha = jnp.exp(m - m_new)
@@ -100,12 +99,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
     lse_ref[0] = m + jnp.log(l)
 
 
-def _fwd(q, k, v, scale, causal, bq, bk, interpret):
+def _fwd(q, k, v, scale, causal, bq, bk, t_real, interpret):
     BH, T, d = q.shape
     grid = (BH, T // bq)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, bq=bq, bk=bk, scale=scale,
-                          causal=causal),
+                          causal=causal, t_real=t_real),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
@@ -127,7 +126,7 @@ def _fwd(q, k, v, scale, causal, bq, bk, interpret):
 
 # ----------------------------------------------------------------- backward
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, bq, bk, scale, causal):
+                   *, bq, bk, scale, causal, t_real):
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
@@ -142,10 +141,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            qpos = qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = j * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = _mask_scores(s, qi * bq, j * bk, bq, bk, causal, t_real, T)
         p = jnp.exp(s - lse[:, None])                       # (bq, bk)
         dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                  preferred_element_type=jnp.float32)
@@ -160,7 +156,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, bq, bk, scale, causal):
+                    dk_ref, dv_ref, *, bq, bk, scale, causal, t_real):
     ki = pl.program_id(1)
     kb = k_ref[0].astype(jnp.float32)                       # (bk, d)
     vb = v_ref[0].astype(jnp.float32)
@@ -176,10 +172,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         delta = delta_ref[0, pl.ds(i * bq, bq)]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
-        if causal:
-            qpos = i * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
-            kpos = ki * bk + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
-            s = jnp.where(qpos >= kpos, s, NEG_INF)
+        s = _mask_scores(s, i * bq, ki * bk, bq, bk, causal, t_real, T)
         p = jnp.exp(s - lse[:, None])                       # (bq, bk)
         dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
                                       preferred_element_type=jnp.float32)
@@ -199,13 +192,13 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[0] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret):
+def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, t_real, interpret):
     BH, T, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                # (BH, T)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, scale=scale,
-                          causal=causal),
+                          causal=causal, t_real=t_real),
         grid=(BH, T // bq),
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
@@ -221,7 +214,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret):
     )(q, k, v, do, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, scale=scale,
-                          causal=causal),
+                          causal=causal, t_real=t_real),
         grid=(BH, T // bk),
         in_specs=[
             pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
@@ -245,20 +238,21 @@ def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret):
 
 
 # --------------------------------------------------------------- public API
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q, k, v, scale, causal, bq, bk, interpret):
-    o, _ = _fwd(q, k, v, scale, causal, bq, bk, interpret)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, scale, causal, bq, bk, t_real, interpret):
+    o, _ = _fwd(q, k, v, scale, causal, bq, bk, t_real, interpret)
     return o
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk, interpret):
-    o, lse = _fwd(q, k, v, scale, causal, bq, bk, interpret)
+def _flash_fwd(q, k, v, scale, causal, bq, bk, t_real, interpret):
+    o, lse = _fwd(q, k, v, scale, causal, bq, bk, t_real, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(scale, causal, bq, bk, interpret, res, do):
+def _flash_bwd(scale, causal, bq, bk, t_real, interpret, res, do):
     q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, interpret)
+    return _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, t_real,
+                interpret)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -270,19 +264,27 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
 
     Equivalent math to softmax(scale * q k^T + causal_mask) v with fp32
     accumulation, O(T) memory. Differentiable (custom flash backward).
+    Sequences that don't divide the block sizes are zero-padded and the
+    padded keys masked in-kernel (slicing the output transposes to
+    zero-padded cotangents, so the backward stays correct).
     """
     B, T, H, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if interpret is None:
         interpret = _interpret_default()
-    bq, bk = _block_sizes(T, block_q, block_k)
+    bq, bk, T_pad = _block_sizes(T, block_q, block_k)
 
     def fold(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+        x = x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+        if T_pad != T:
+            x = jnp.pad(x, ((0, 0), (0, T_pad - T), (0, 0)))
+        return x
 
     o = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal),
-               bq, bk, bool(interpret))
+               bq, bk, T, bool(interpret))
+    if T_pad != T:
+        o = o[:, :T]
     return o.reshape(B, H, T, d).transpose(0, 2, 1, 3)
 
 

@@ -14,27 +14,21 @@ Pallas interpreter mode; `quantize_blockwise(..., use_pallas=False)` is
 the jnp reference implementation (bitwise-identical math).
 """
 
-import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._common import interpret_default as _interpret_default
+from ._common import round_up as _round_up
+from ._common import sds as _sds
+
 
 QUANT_BLOCK = 2048  # elements per scale block (reference default group size)
-
-
-def _interpret_default():
-    return jax.default_backend() != "tpu"
-
-
-def _sds(shape, dtype, like):
-    """ShapeDtypeStruct whose varying-manual-axes match ``like`` — required
-    when these kernels run inside a shard_map (e.g. quantized collectives)."""
-    vma = getattr(jax.typeof(like), "vma", None)
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
-    return jax.ShapeDtypeStruct(shape, dtype)
+# Rows per VMEM tile: 256 x 2048 el x 4 B = 2 MiB input, well under the
+# ~16 MiB VMEM budget even with the int8+scale outputs resident.
+_TILE_ROWS = 256
 
 
 def _quant_kernel(x_ref, q_ref, s_ref):
@@ -69,14 +63,29 @@ def quantize_blockwise(x, block=QUANT_BLOCK, use_pallas=True,
     if interpret is None:
         interpret = _interpret_default()
     if use_pallas:
+        # Grid over row tiles so arbitrarily large tensors stream through
+        # VMEM (a full ZeRO shard does not fit at once).
+        nb = blocked.shape[0]
+        rows = min(_TILE_ROWS, nb)
+        nbp = _round_up(nb, rows)
+        padded = (jnp.pad(blocked, ((0, nbp - nb), (0, 0)))
+                  if nbp != nb else blocked)
         q, s = pl.pallas_call(
             _quant_kernel,
+            grid=(nbp // rows,),
+            in_specs=[pl.BlockSpec((rows, block), lambda i: (i, 0))],
+            out_specs=[
+                pl.BlockSpec((rows, block), lambda i: (i, 0)),
+                pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            ],
             out_shape=[
-                _sds(blocked.shape, jnp.int8, blocked),
-                _sds((blocked.shape[0], 1), jnp.float32, blocked),
+                _sds((nbp, block), jnp.int8, padded),
+                _sds((nbp, 1), jnp.float32, padded),
             ],
             interpret=interpret,
-        )(blocked)
+        )(padded)
+        if nbp != nb:
+            q, s = q[:nb], s[:nb]
     else:
         xf = blocked.astype(jnp.float32)
         absmax = jnp.max(jnp.abs(xf), axis=-1, keepdims=True)
@@ -90,11 +99,24 @@ def dequantize_blockwise(q, s, meta, use_pallas=True, interpret=None):
     if interpret is None:
         interpret = _interpret_default()
     if use_pallas:
+        nb, block = q.shape
+        rows = min(_TILE_ROWS, nb)
+        nbp = _round_up(nb, rows)
+        qp = jnp.pad(q, ((0, nbp - nb), (0, 0))) if nbp != nb else q
+        sp = jnp.pad(s, ((0, nbp - nb), (0, 0))) if nbp != nb else s
         out = pl.pallas_call(
             _dequant_kernel,
-            out_shape=_sds(q.shape, meta["dtype"], q),
+            grid=(nbp // rows,),
+            in_specs=[
+                pl.BlockSpec((rows, block), lambda i: (i, 0)),
+                pl.BlockSpec((rows, 1), lambda i: (i, 0)),
+            ],
+            out_specs=pl.BlockSpec((rows, block), lambda i: (i, 0)),
+            out_shape=_sds((nbp, block), meta["dtype"], qp),
             interpret=interpret,
-        )(q, s)
+        )(qp, sp)
+        if nbp != nb:
+            out = out[:nb]
     else:
         out = (q.astype(jnp.float32) * s).astype(meta["dtype"])
     flat = out.reshape(-1)
@@ -120,12 +142,8 @@ def quantized_all_gather(x, axis_name, block=QUANT_BLOCK, use_pallas=True):
     q, s, meta = quantize_blockwise(x, block, use_pallas=use_pallas)
     qg = jax.lax.all_gather(q, axis_name)
     sg = jax.lax.all_gather(s, axis_name)
-    n = qg.shape[0]
-
-    def deq(i):
-        return dequantize_blockwise(qg[i], sg[i], meta,
-                                    use_pallas=use_pallas)
-    return jax.vmap(deq)(jnp.arange(n))
+    return jax.vmap(lambda qq, ss: dequantize_blockwise(
+        qq, ss, meta, use_pallas=use_pallas))(qg, sg)
 
 
 def quantized_psum_scatter(x, axis_name, block=QUANT_BLOCK,
@@ -151,17 +169,10 @@ def quantized_psum_scatter(x, axis_name, block=QUANT_BLOCK,
     qx = jax.lax.all_to_all(q, axis_name, 0, 0)
     sx = jax.lax.all_to_all(s, axis_name, 0, 0)
     meta32 = {"shape": piece_shape, "dtype": jnp.float32,
-              "pad": q.shape[1] * block - int(np_prod(piece_shape))}
+              "pad": q.shape[1] * block - math.prod(piece_shape)}
 
     def dfn(qq, ss):
         return dequantize_blockwise(qq, ss, meta32, use_pallas=use_pallas)
 
     deq = jax.vmap(dfn)(qx, sx)            # (world,) + piece_shape, f32
     return jnp.sum(deq, axis=0).astype(x.dtype)
-
-
-def np_prod(shape):
-    n = 1
-    for d in shape:
-        n *= d
-    return n

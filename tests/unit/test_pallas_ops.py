@@ -52,12 +52,32 @@ class TestFlashAttention:
                                    rtol=1e-5, atol=1e-5)
 
     def test_non_multiple_of_block_seq_len(self):
-        # T=96 with default 128 blocks: falls back to divisor block sizes
+        # T=96 with default 128 blocks: padded to one 104-wide block
         q, k, v = self._qkv(T=96)
         o = flash_attention(q, k, v)
         ref = attention_reference(q, k, v)
         np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
                                    rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_prime_seq_len_padded(self, causal):
+        # T=101 (prime): zero-padding + in-kernel key masking, fwd + bwd
+        q, k, v = self._qkv(T=101)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal,
+                                           block_q=32, block_k=32) ** 2)
+
+        def loss_ref(q, k, v):
+            return jnp.sum(attention_reference(q, k, v, causal=causal) ** 2)
+
+        np.testing.assert_allclose(float(loss_flash(q, k, v)),
+                                   float(loss_ref(q, k, v)), rtol=1e-5)
+        g = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
 
     def test_grads_match_dense(self):
         q, k, v = self._qkv(T=64)
@@ -129,6 +149,21 @@ class TestQuantization:
         q, s, meta = quantize_blockwise(x, block=256)
         back = dequantize_blockwise(q, s, meta)
         np.testing.assert_array_equal(np.asarray(back), np.zeros(256))
+
+    def test_tiled_grid_matches_jnp(self):
+        # more blocks than one VMEM tile (_TILE_ROWS=256) + a ragged tile:
+        # exercises the grid/BlockSpec streaming path end to end
+        rng = np.random.RandomState(3)
+        x = jnp.asarray(rng.randn(300 * 64 + 17), jnp.float32)
+        qp, sp, meta = quantize_blockwise(x, block=64, use_pallas=True)
+        qr, sr, _ = quantize_blockwise(x, block=64, use_pallas=False)
+        assert qp.shape[0] == 301  # 300 full + 1 padded block
+        np.testing.assert_array_equal(np.asarray(qp), np.asarray(qr))
+        np.testing.assert_allclose(np.asarray(sp), np.asarray(sr), rtol=1e-6)
+        back = dequantize_blockwise(qp, sp, meta, use_pallas=True)
+        backr = dequantize_blockwise(qr, sr, meta, use_pallas=False)
+        np.testing.assert_allclose(np.asarray(back), np.asarray(backr),
+                                   rtol=1e-6)
 
     def test_bf16_roundtrip(self):
         rng = np.random.RandomState(2)
