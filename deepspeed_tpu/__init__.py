@@ -50,6 +50,16 @@ def initialize(args=None, model=None, optimizer=None, model_parameters=None,
     return engine, engine.optimizer, dataloader, engine.lr_scheduler
 
 
+def init_inference(model=None, config=None, **kwargs):
+    """Build an InferenceEngine (reference deepspeed/__init__.py:268).
+
+    ``deepspeed_tpu.init_inference(model, tensor_parallel={"tp_size": 2},
+    dtype="bfloat16")`` — TP sharding comes from the model's declarative
+    ``partition_specs`` (the module_inject/AutoTP equivalent)."""
+    from .inference.engine import InferenceEngine
+    return InferenceEngine(model, config=config, **kwargs)
+
+
 def add_config_arguments(parser):
     """argparse passthrough (reference deepspeed/__init__.py:245)."""
     group = parser.add_argument_group("DeepSpeed-TPU",

@@ -1,0 +1,44 @@
+"""Inference configuration.
+
+Counterpart of reference ``inference/config.py DeepSpeedInferenceConfig``
+(dtype, tensor_parallel, max_out_tokens, replace_with_kernel_inject).
+Kernel injection has no TPU meaning — the model's ``partition_specs`` are
+the declarative equivalent of module_inject — so the knob is accepted and
+ignored for API compatibility.
+"""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TensorParallelConfig:
+    tp_size: int = 1
+
+
+@dataclass
+class DeepSpeedInferenceConfig:
+    dtype: str = "bfloat16"
+    tensor_parallel: TensorParallelConfig = field(
+        default_factory=TensorParallelConfig)
+    max_out_tokens: int = 1024          # KV-cache capacity per sequence
+    min_out_tokens: int = 1
+    max_batch_size: int = 8
+    replace_with_kernel_inject: bool = False   # accepted, no-op on TPU
+    # sampling defaults (generate() kwargs override)
+    temperature: float = 1.0
+    top_k: int = 0
+    top_p: float = 1.0
+    # pad prompt lengths up to a multiple of this to bound recompiles
+    prompt_bucket: int = 64
+
+    @classmethod
+    def from_dict(cls, d):
+        d = dict(d or {})
+        tp = d.pop("tensor_parallel", {})
+        if isinstance(tp, int):
+            tp = {"tp_size": tp}
+        if "mp_size" in d:  # reference alias (init_inference(mp_size=N))
+            tp = {"tp_size": d.pop("mp_size")}
+        known = {k: v for k, v in d.items()
+                 if k in cls.__dataclass_fields__}
+        return cls(tensor_parallel=TensorParallelConfig(**tp), **known)

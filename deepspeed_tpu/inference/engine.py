@@ -1,0 +1,244 @@
+"""InferenceEngine — TP-sharded generation with a KV cache.
+
+Counterpart of reference ``inference/engine.py:39 InferenceEngine``:
+  * TP group creation (:254) → a ('data','tensor') inference mesh; the
+    model's ``partition_specs`` shard weights Megatron-style (the
+    declarative equivalent of module_inject/auto_tp.py:188 AutoTP).
+  * CUDA-graph capture/replay (:524,543) → ``jax.jit``: the whole
+    prefill+decode loop is ONE compiled XLA program per shape bucket
+    (prompt lengths round up to ``prompt_bucket`` so recompiles are
+    bounded), with the decode loop as ``lax.scan`` — no per-token Python.
+  * generate wrapper (:613) → ``generate()`` with greedy / temperature /
+    top-k / top-p sampling and EOS early-stop masking.
+
+Prompts are LEFT-padded into the cache so every sequence decodes at the
+same cache slot; pad slots are masked out of attention forever
+(models/gpt2.py block_forward_cached).
+"""
+
+import functools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..utils import groups
+from ..utils.groups import TopologyConfig, BATCH_AXES
+from ..utils.logging import log_dist
+from .config import DeepSpeedInferenceConfig
+
+
+def _sample(logits, rng, temperature, top_k, top_p):
+    """logits: (B, V) fp32 -> (B,) int32. Static sampling config."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / jnp.maximum(temperature, 1e-6)
+    if top_k > 0:
+        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+        logits = jnp.where(logits < kth, -1e30, logits)
+    if top_p < 1.0:
+        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+        probs = jax.nn.softmax(sorted_l, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest set with cumulative prob >= top_p
+        keep = cum - probs < top_p
+        cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1,
+                         keepdims=True)
+        logits = jnp.where(logits < cutoff, -1e30, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+class InferenceEngine:
+    """``engine = init_inference(model, ...); engine.generate(ids)``.
+
+    ``model`` is a functional model with ``init/apply/partition_specs`` and
+    the cached-decode surface ``init_cache/cache_specs/apply_cached``
+    (models/gpt2.py). ``params`` may be passed or freshly initialized.
+    """
+
+    def __init__(self, model, config=None, params=None, topology=None,
+                 seed=0, **kwargs):
+        if isinstance(config, dict):
+            # explicit kwargs win over config-dict keys (reference
+            # init_inference merges kwargs into the config the same way)
+            config = DeepSpeedInferenceConfig.from_dict({**config, **kwargs})
+        elif config is None:
+            config = DeepSpeedInferenceConfig.from_dict(kwargs)
+        self.config = config
+        self.model = model
+        self._generate_cache = {}
+
+        if topology is None:
+            topology = groups.initialize(TopologyConfig(
+                tensor_parallel_size=config.tensor_parallel.tp_size))
+        self.topology = topology
+        self.mesh = topology.mesh
+
+        dtype = jnp.dtype(config.dtype)
+        self.dtype = dtype
+        specs = model.partition_specs(topology)
+        self.param_shardings = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), specs,
+            is_leaf=lambda x: isinstance(x, P))
+        with jax.set_mesh(self.mesh):
+            if params is None:
+                params = jax.jit(
+                    lambda r: jax.tree.map(
+                        lambda x: x.astype(dtype), model.init(r)),
+                    out_shardings=self.param_shardings)(jax.random.key(seed))
+            else:
+                params = jax.jit(
+                    lambda p: jax.tree.map(lambda x: x.astype(dtype), p),
+                    out_shardings=self.param_shardings)(params)
+        self.params = params
+        self._forward_jit = None
+        self._rng = jax.random.key(seed + 17)
+        log_dist(f"inference engine ready: tp={config.tensor_parallel.tp_size} "
+                 f"dtype={config.dtype}", ranks=[0])
+
+    # ------------------------------------------------------------------ fwd
+    def forward(self, input_ids):
+        """Full logits for a batch (no cache) — parity with calling the
+        injected module directly."""
+        ids = jnp.asarray(input_ids, jnp.int32)
+        if self._forward_jit is None:
+            self._forward_jit = jax.jit(self.model.apply)
+        with jax.set_mesh(self.mesh):
+            return self._forward_jit(self.params, ids)
+
+    __call__ = forward
+
+    # ------------------------------------------------------------- generate
+    def _build_generate(self, B, T_pad, max_new, temperature, top_k, top_p,
+                        eos_id):
+        model = self.model
+        # shard the batch over the data axes only when it divides evenly
+        # (generation batches are often 1); otherwise replicate
+        dp = int(np.prod([self.mesh.shape[a] for a in BATCH_AXES]))
+        batch_axes = BATCH_AXES if B % dp == 0 else None
+        cache_specs = model.cache_specs(batch_axes=batch_axes)
+        constrain = lax.with_sharding_constraint
+
+        def gen(params, ids, lengths, rng):
+            """ids: (B, T_pad) LEFT-padded prompts; lengths: (B,)."""
+            B = ids.shape[0]
+            Tmax = T_pad + max_new
+            cache = model.init_cache(B, Tmax, dtype=self.dtype)
+            cache = jax.tree.map(
+                lambda c, s: constrain(c, s), cache, cache_specs,
+                is_leaf=lambda x: isinstance(x, P))
+            pad = T_pad - lengths  # (B,) left-pad counts
+            valid = jnp.arange(Tmax)[None, :] >= pad[:, None]
+            valid = valid & (jnp.arange(Tmax)[None, :] < T_pad)
+            pos = jnp.maximum(jnp.arange(T_pad)[None, :] - pad[:, None], 0)
+            logits, cache = model.apply_cached(
+                params, ids, pos.astype(jnp.int32), cache, 0, valid,
+                last_token_only=True)
+            rng, sub = jax.random.split(rng)
+            last = _sample(logits[:, -1], sub, temperature, top_k, top_p)
+
+            def step(carry, i):
+                cache, tok, valid, done, rng = carry
+                rng, sub = jax.random.split(rng)
+                slot = T_pad + i
+                valid = valid.at[:, slot].set(~done)
+                pos_t = (slot - pad).astype(jnp.int32)[:, None]
+                logits, cache = model.apply_cached(
+                    params, tok[:, None], pos_t, cache, slot, valid)
+                nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
+                nxt = jnp.where(done, eos_id, nxt)
+                done = done | (nxt == eos_id) if eos_id >= 0 else done
+                return (cache, nxt, valid, done, rng), tok
+
+            done0 = (last == eos_id) if eos_id >= 0 else jnp.zeros(
+                (B,), jnp.bool_)
+            (_, last_tok, _, _, _), toks = lax.scan(
+                step, (cache, last, valid, done0, rng),
+                jnp.arange(max_new - 1))
+            # toks: (max_new-1, B) holds tokens 0..max_new-2; append final
+            out = jnp.concatenate(
+                [jnp.swapaxes(toks, 0, 1), last_tok[:, None]], axis=1)
+            return out
+
+        batch_spec = NamedSharding(self.mesh, P(batch_axes))
+        return jax.jit(gen, in_shardings=(
+            self.param_shardings, batch_spec, batch_spec,
+            NamedSharding(self.mesh, P())))
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=None,
+                 top_k=None, top_p=None, eos_token_id=-1, pad_token_id=0,
+                 seed=None):
+        """input_ids: (B, T) or list of variable-length prompts.
+        Returns (B, max_new_tokens) int32 generated tokens (post-EOS
+        positions filled with eos)."""
+        cfg = self.config
+        temperature = cfg.temperature if temperature is None else temperature
+        top_k = cfg.top_k if top_k is None else top_k
+        top_p = cfg.top_p if top_p is None else top_p
+
+        if isinstance(input_ids, (list, tuple)):
+            seqs = [np.asarray(s, np.int32) for s in input_ids]
+        else:
+            arr = np.asarray(input_ids, np.int32)
+            seqs = [arr[i] for i in range(arr.shape[0])]
+        lengths = np.array([len(s) for s in seqs], np.int32)
+        bucket = cfg.prompt_bucket
+        T_pad = int(-(-max(lengths.max(), 1) // bucket) * bucket)
+        B = len(seqs)
+        # position-embedding capacity guard: positions run to
+        # max(len)+max_new-1 and wpe indexing would silently clamp past it
+        model_cap = getattr(getattr(self.model, "config", None),
+                            "max_seq_len", None)
+        needed = int(lengths.max()) + max_new_tokens
+        if model_cap is not None and needed > model_cap:
+            raise ValueError(
+                f"prompt_len+max_new_tokens={needed} exceeds the model's "
+                f"max_seq_len={model_cap}")
+        if T_pad + max_new_tokens > cfg.max_out_tokens:
+            raise ValueError(
+                f"padded_prompt+max_new_tokens={T_pad + max_new_tokens} "
+                f"exceeds config.max_out_tokens={cfg.max_out_tokens}")
+        ids = np.full((B, T_pad), pad_token_id, np.int32)
+        for i, s in enumerate(seqs):  # LEFT pad
+            ids[i, T_pad - len(s):] = s
+
+        key = (B, T_pad, max_new_tokens, float(temperature), int(top_k),
+               float(top_p), int(eos_token_id))
+        if key not in self._generate_cache:
+            self._generate_cache[key] = self._build_generate(
+                B, T_pad, max_new_tokens, float(temperature), int(top_k),
+                float(top_p), int(eos_token_id))
+        fn = self._generate_cache[key]
+
+        if seed is not None:
+            rng = jax.random.key(seed)
+        else:
+            self._rng, rng = jax.random.split(self._rng)
+        with jax.set_mesh(self.mesh):
+            out = fn(self.params, ids, lengths, rng)
+        return np.asarray(out)
+
+    # ------------------------------------------------------------- weights
+    def load_checkpoint(self, load_dir, tag=None):
+        """Load a training checkpoint's master weights into the inference
+        shardings (reference load_model_with_checkpoint:331 — MP-sharded
+        load falls out of device_put with NamedShardings)."""
+        import os
+        from ..runtime.checkpoint_engine import serialization as ser
+        from ..runtime.checkpoint_engine.engines import SyncCheckpointEngine
+        if tag is None:
+            with open(os.path.join(load_dir, "latest")) as f:
+                tag = f.read().strip()
+        path = os.path.join(load_dir, tag, "state.npz")
+        flat, header = SyncCheckpointEngine().load(path)
+        abstract = jax.eval_shape(self.model.init, jax.random.key(0))
+        tree = ser.unflatten_into({"master": abstract}, {
+            k: v for k, v in flat.items() if k.startswith("master")
+        }, header.get("meta"))["master"]
+        with jax.set_mesh(self.mesh):
+            self.params = jax.jit(
+                lambda p: jax.tree.map(lambda x: x.astype(self.dtype), p),
+                out_shardings=self.param_shardings)(tree)
+        return tag

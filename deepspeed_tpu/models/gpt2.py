@@ -324,6 +324,93 @@ class GPT2:
         return (up @ layer["wdown"] + layer["bdown"],
                 jnp.zeros((), jnp.float32))
 
+    # --- KV-cache inference path (reference ops/transformer/inference/
+    #     ds_attention.py:16 + inference_context.h workspace mgmt; here the
+    #     cache is an explicit pytree threaded through jitted steps) ---
+    def init_cache(self, batch_size, max_len, dtype=None):
+        """Allocate the KV cache: {'k','v'}: (L, B, max_len, H, hd)."""
+        cfg = self.config
+        dt = jnp.dtype(dtype) if dtype is not None else _dtype(cfg)
+        shape = (cfg.n_layer, batch_size, max_len, cfg.n_head, cfg.d_head)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def cache_specs(self, batch_axes=BATCH_AXES):
+        """Sharding for the KV cache: batch over data axes, heads over
+        'tensor' (matches the attention TP split)."""
+        spec = P(None, batch_axes, None, "tensor", None)
+        return {"k": spec, "v": spec}
+
+    def block_forward_cached(self, x, layer, k_cache, v_cache, slot,
+                             valid_mask):
+        """One block over new tokens with a KV cache.
+
+        x: (B, T, D) new-token activations, written at cache slots
+        [slot, slot+T). k_cache/v_cache: (B, Tmax, H, hd).
+        valid_mask: (B, Tmax) bool — True where the cache holds a real
+        token AFTER this write (left-padded prompts carry False slots).
+        Returns (x_out, k_cache, v_cache).
+        """
+        cfg = self.config
+        dt = _dtype(cfg)
+        B, T = x.shape[0], x.shape[1]
+        H, hd = cfg.n_head, cfg.d_head
+        Tmax = k_cache.shape[1]
+
+        h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
+        qkv = h @ layer["wqkv"] + layer["bqkv"]
+        qkv = qkv.reshape(B, T, 3, H, hd)
+        q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        k_cache = lax.dynamic_update_slice(k_cache, kk.astype(k_cache.dtype),
+                                           (0, slot, 0, 0))
+        v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                           (0, slot, 0, 0))
+
+        scores = jnp.einsum("bthd,bshd->bhts", q, k_cache,
+                            preferred_element_type=jnp.float32)
+        scores = scores / math.sqrt(hd)
+        # slot-causal: query at slot s_q = slot+t sees slots s <= s_q that
+        # hold valid tokens (pads masked out forever)
+        s_idx = jnp.arange(Tmax)[None, None, None, :]
+        q_idx = (slot + jnp.arange(T))[None, None, :, None]
+        mask = (s_idx <= q_idx) & valid_mask[:, None, None, :]
+        scores = jnp.where(mask, scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        attn = jnp.einsum("bhts,bshd->bthd", probs, v_cache)
+        attn = attn.reshape(B, T, H * hd)
+        x = x + attn @ layer["wo"] + layer["bo"]
+
+        h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
+        mlp_out, _ = self._mlp(h, layer, None, train=False,
+                               seq_sharded=False,
+                               constrain=lambda t, s: t)
+        return x + mlp_out, k_cache, v_cache
+
+    def apply_cached(self, params, input_ids, pos_ids, cache, slot,
+                     valid_mask, last_token_only=False):
+        """Forward T new tokens through all layers with the KV cache.
+
+        input_ids: (B, T); pos_ids: (B, T) absolute position-embedding
+        indices (left-padded prompts offset these); slot: scalar cache
+        write offset; valid_mask: (B, Tmax) validity AFTER the write.
+        Returns (logits (B, T, V) fp32, new cache); ``last_token_only``
+        unembeds just the final position (prefill only samples there —
+        skips the (B, T, V) fp32 logits materialization).
+        """
+        x = (params["wte"][input_ids]
+             + params["wpe"][pos_ids]).astype(_dtype(self.config))
+
+        def body(carry, xs):
+            layer, kc, vc = xs
+            y, kc, vc = self.block_forward_cached(carry, layer, kc, vc,
+                                                  slot, valid_mask)
+            return y, (kc, vc)
+
+        x, (kc, vc) = lax.scan(body, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+        if last_token_only:
+            x = x[:, -1:]
+        return self.head(params, x), {"k": kc, "v": vc}
+
     # --- loss ---
     def loss(self, params, batch, *, rng=None, train=True, seq_sharded=False):
         """Next-token cross entropy. batch: {"input_ids": (B, T) int32}."""

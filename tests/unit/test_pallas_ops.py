@@ -52,8 +52,15 @@ class TestFlashAttention:
                                    rtol=1e-5, atol=1e-5)
 
     def test_non_multiple_of_block_seq_len(self):
-        # T=96 with default 128 blocks: padded to one 104-wide block
+        # T=96 < the default 128 block: single exact block (no padding)
         q, k, v = self._qkv(T=96)
+        o = flash_attention(q, k, v)
+        ref = attention_reference(q, k, v)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+        # T=101 rounds up to a 104-wide block: exercises the padded-tail
+        # masking path
+        q, k, v = self._qkv(T=101)
         o = flash_attention(q, k, v)
         ref = attention_reference(q, k, v)
         np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
