@@ -1,2 +1,4 @@
 from .config import DeepSpeedInferenceConfig
 from .engine import InferenceEngine
+from .v2 import (InferenceEngineV2, RaggedInferenceEngineConfig,
+                 BlockedAllocator, DSStateManager)

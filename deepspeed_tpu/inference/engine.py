@@ -78,21 +78,10 @@ class InferenceEngine:
 
         dtype = jnp.dtype(config.dtype)
         self.dtype = dtype
-        specs = model.partition_specs(topology)
-        self.param_shardings = jax.tree.map(
-            lambda s: NamedSharding(self.mesh, s), specs,
-            is_leaf=lambda x: isinstance(x, P))
-        with jax.set_mesh(self.mesh):
-            if params is None:
-                params = jax.jit(
-                    lambda r: jax.tree.map(
-                        lambda x: x.astype(dtype), model.init(r)),
-                    out_shardings=self.param_shardings)(jax.random.key(seed))
-            else:
-                params = jax.jit(
-                    lambda p: jax.tree.map(lambda x: x.astype(dtype), p),
-                    out_shardings=self.param_shardings)(params)
-        self.params = params
+        from .utils import shard_params
+        self.params, self.param_shardings = shard_params(
+            model, self.mesh, dtype, params=params, seed=seed,
+            topology=topology)
         self._forward_jit = None
         self._rng = jax.random.key(seed + 17)
         log_dist(f"inference engine ready: tp={config.tensor_parallel.tp_size} "
@@ -179,9 +168,15 @@ class InferenceEngine:
         top_p = cfg.top_p if top_p is None else top_p
 
         if isinstance(input_ids, (list, tuple)):
-            seqs = [np.asarray(s, np.int32) for s in input_ids]
+            if input_ids and np.isscalar(input_ids[0]):
+                seqs = [np.asarray(input_ids, np.int32)]  # one flat prompt
+            else:
+                seqs = [np.asarray(s, np.int32).reshape(-1)
+                        for s in input_ids]
         else:
             arr = np.asarray(input_ids, np.int32)
+            if arr.ndim == 1:
+                arr = arr[None, :]
             seqs = [arr[i] for i in range(arr.shape[0])]
         lengths = np.array([len(s) for s in seqs], np.int32)
         bucket = cfg.prompt_bucket

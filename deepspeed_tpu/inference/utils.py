@@ -1,0 +1,25 @@
+"""Shared inference-engine helpers."""
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard_params(model, mesh, dtype, params=None, seed=0, topology=None):
+    """Build NamedShardings from the model's ``partition_specs`` and place
+    (or initialize) params under them, cast to ``dtype``.
+
+    Returns (params, param_shardings)."""
+    specs = model.partition_specs(topology)
+    shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
+                             is_leaf=lambda x: isinstance(x, P))
+    with jax.set_mesh(mesh):
+        if params is None:
+            params = jax.jit(
+                lambda r: jax.tree.map(lambda x: x.astype(dtype),
+                                       model.init(r)),
+                out_shardings=shardings)(jax.random.key(seed))
+        else:
+            params = jax.jit(
+                lambda p: jax.tree.map(lambda x: x.astype(dtype), p),
+                out_shardings=shardings)(params)
+    return params, shardings

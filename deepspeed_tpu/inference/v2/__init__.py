@@ -1,0 +1,3 @@
+from .blocked_allocator import BlockedAllocator
+from .ragged import DSSequenceDescriptor, DSStateManager, RaggedBatchWrapper
+from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig

@@ -1,0 +1,46 @@
+"""KV-block free-list allocator.
+
+Counterpart of reference ``inference/v2/ragged/blocked_allocator.py:11
+BlockedAllocator`` (a torch-tensor linked list on the host). Here: a plain
+python free list — the allocator is host-side bookkeeping either way; the
+device only ever sees block-id arrays.
+
+Block 0 is RESERVED as the scratch block: pad tokens and inactive batch
+slots write their KV there, so the allocator never hands it out.
+"""
+
+
+class BlockedAllocator:
+    SCRATCH = 0
+
+    def __init__(self, num_blocks: int):
+        if num_blocks < 2:
+            raise ValueError("need at least 2 blocks (1 scratch + 1 usable)")
+        self._num_blocks = num_blocks
+        self._free = list(range(num_blocks - 1, 0, -1))  # pop() -> block 1
+
+    @property
+    def total_blocks(self):
+        return self._num_blocks - 1  # scratch excluded
+
+    @property
+    def free_blocks(self):
+        return len(self._free)
+
+    def allocate(self, n: int):
+        """-> list of n block ids; raises if not enough free."""
+        if n > len(self._free):
+            raise RuntimeError(
+                f"out of KV blocks: want {n}, have {len(self._free)}")
+        out = [self._free.pop() for _ in range(n)]
+        return out
+
+    def free(self, blocks):
+        seen = set(self._free)
+        for b in blocks:
+            if b == self.SCRATCH:
+                raise ValueError("cannot free the scratch block")
+            if b in seen or not (0 < b < self._num_blocks):
+                raise ValueError(f"double-free / bad block {b}")
+            seen.add(b)
+        self._free.extend(blocks)

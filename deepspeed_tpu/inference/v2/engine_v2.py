@@ -1,0 +1,256 @@
+"""InferenceEngineV2 — continuous-batching serving over a paged KV cache.
+
+Counterpart of reference ``inference/v2/engine_v2.py:30 InferenceEngineV2``
+(FastGen). TPU redesign:
+  * The blocked KV cache is ONE device pytree {'k','v'}:
+    (L, num_blocks, block_size, H, hd); per-sequence block tables index it
+    (reference BlockedKVCache, kv_cache.py:40). Heads shard over 'tensor'.
+  * Two compiled programs replace the ragged kernel zoo: a per-bucket
+    prefill (one sequence, causal over its prompt, KV scattered into its
+    blocks) and a fixed-shape decode (whole batch, one token each,
+    block-table gather + masked attention). Fixed shapes mean exactly two
+    XLA compilations per bucket — the CUDA-graph-like property FastGen gets
+    from its kernel design.
+  * Scheduling (reference DSStateManager + the put/schedule loop in
+    mii/ragged batching): admit pending requests while slots+blocks allow,
+    prefill them, then batched decode steps; sequences retire on EOS or
+    max_new_tokens and their blocks return to the free list immediately —
+    the continuous-batching property.
+"""
+
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ...utils import groups
+from ...utils.groups import TopologyConfig
+from ...utils.logging import log_dist
+from ..engine import _sample
+from ..utils import shard_params
+from .ragged import DSStateManager
+
+
+@dataclass
+class RaggedInferenceEngineConfig:
+    """Reference config_v2.py RaggedInferenceEngineConfig (condensed)."""
+    dtype: str = "bfloat16"
+    tensor_parallel: int = 1
+    max_batch_size: int = 8          # concurrent sequences
+    kv_block_size: int = 64
+    num_kv_blocks: int = 0           # 0 = auto from max_seq_len * max_batch
+    prompt_bucket: int = 64
+    temperature: float = 0.0         # 0 = greedy
+    top_k: int = 0
+    seed: int = 0
+
+
+@dataclass
+class _Request:
+    uid: int
+    prompt: np.ndarray
+    max_new_tokens: int
+    eos_token_id: int = -1
+
+
+class InferenceEngineV2:
+    """``put(uid, prompt)`` then ``step()`` until ``is_done(uid)``;
+    ``get(uid)`` returns the generated tokens."""
+
+    def __init__(self, model, config=None, params=None, topology=None,
+                 **kwargs):
+        if isinstance(config, dict):
+            config = RaggedInferenceEngineConfig(**{**config, **kwargs})
+        elif config is None:
+            config = RaggedInferenceEngineConfig(**kwargs)
+        self.config = config
+        self.model = model
+        mcfg = model.config
+        self.max_seq_len = mcfg.max_seq_len
+
+        if topology is None:
+            topology = groups.initialize(TopologyConfig(
+                tensor_parallel_size=config.tensor_parallel))
+        self.topology = topology
+        self.mesh = topology.mesh
+
+        BS = config.kv_block_size
+        self.max_blocks_per_seq = -(-self.max_seq_len // BS)
+        num_blocks = config.num_kv_blocks or (
+            1 + config.max_batch_size * self.max_blocks_per_seq)
+        self.state_mgr = DSStateManager(
+            num_blocks=num_blocks, block_size=BS,
+            max_batch=config.max_batch_size,
+            max_blocks_per_seq=self.max_blocks_per_seq)
+
+        dtype = jnp.dtype(config.dtype)
+        self.dtype = dtype
+        self.params, self.param_shardings = shard_params(
+            model, self.mesh, dtype, params=params, seed=config.seed,
+            topology=topology)
+        cache_sh = jax.tree.map(
+            lambda s: NamedSharding(self.mesh, s), model.paged_cache_specs(),
+            is_leaf=lambda x: isinstance(x, P))
+        self._cache_sh = cache_sh
+        with jax.set_mesh(self.mesh):
+            self.cache = jax.jit(
+                lambda: model.init_paged_cache(num_blocks, BS, dtype=dtype),
+                out_shardings=cache_sh)()
+
+        self._pending = deque()
+        self._results = {}            # uid -> generated tokens (finished)
+        self._rng = jax.random.key(config.seed + 23)
+        self._prefill_jit = None
+        self._decode_jit = None
+        self._uid_next = 0
+        log_dist(
+            f"v2 engine ready: tp={config.tensor_parallel} blocks="
+            f"{num_blocks}x{BS} max_batch={config.max_batch_size}",
+            ranks=[0])
+
+    # ------------------------------------------------------------- requests
+    def put(self, prompt, max_new_tokens=32, eos_token_id=-1, uid=None):
+        """Queue a generation request. Returns its uid."""
+        if uid is None:
+            uid = self._uid_next
+            self._uid_next += 1
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) + max_new_tokens > self.max_seq_len:
+            raise ValueError(
+                f"prompt+max_new={len(prompt) + max_new_tokens} exceeds "
+                f"model max_seq_len={self.max_seq_len}")
+        self._pending.append(_Request(uid, prompt, max_new_tokens,
+                                      eos_token_id))
+        return uid
+
+    def is_done(self, uid):
+        if uid in self._results:
+            return True
+        if any(r.uid == uid for r in self._pending):
+            return False
+        if uid in self.state_mgr._seqs:
+            return False
+        raise KeyError(f"unknown uid {uid} (never submitted or already "
+                       "fetched with get())")
+
+    def get(self, uid, flush=True):
+        """Generated tokens for a finished request (``flush`` forgets the
+        result afterwards; in-flight requests return their tokens so far)."""
+        if uid in self._results:
+            return self._results.pop(uid) if flush else self._results[uid]
+        seq = self.state_mgr.get_sequence(uid)
+        return np.asarray(seq.generated, np.int32)
+
+    @property
+    def has_work(self):
+        return bool(self._pending) or self.state_mgr.n_active > 0
+
+    # ------------------------------------------------------------- programs
+    def _sample_logits(self, logits, rng):
+        # shared with the v1 engine; v2 config has no top_p knob
+        return _sample(logits, rng, self.config.temperature,
+                       self.config.top_k, 1.0)
+
+    def _get_prefill(self):
+        # one jit object; jax specializes per T_pad bucket shape itself
+        if self._prefill_jit is None:
+            model = self.model
+
+            def prefill(params, cache, ids, tb, to, length, rng):
+                logits, cache = model.apply_paged_prefill(
+                    params, ids, cache, tb, to, length)
+                return self._sample_logits(logits, rng), cache
+
+            self._prefill_jit = jax.jit(
+                prefill, donate_argnums=(1,),
+                in_shardings=(self.param_shardings, self._cache_sh,
+                              None, None, None, None, None),
+                out_shardings=(None, self._cache_sh))
+        return self._prefill_jit
+
+    def _get_decode(self):
+        if self._decode_jit is None:
+            model = self.model
+
+            def decode(params, cache, tokens, lengths, tables, rng):
+                logits, cache = model.apply_paged_decode(
+                    params, tokens, lengths, cache, tables)
+                return self._sample_logits(logits, rng), cache
+
+            self._decode_jit = jax.jit(
+                decode, donate_argnums=(1,),
+                in_shardings=(self.param_shardings, self._cache_sh,
+                              None, None, None, None),
+                out_shardings=(None, self._cache_sh))
+        return self._decode_jit
+
+    # ----------------------------------------------------------------- step
+    def _admit_pending(self):
+        mgr = self.state_mgr
+        bucket = self.config.prompt_bucket
+        while self._pending:
+            req = self._pending[0]
+            if not mgr.can_admit(len(req.prompt), req.max_new_tokens):
+                break
+            self._pending.popleft()
+            slot, seq = mgr.admit(req.uid, req.prompt, req.max_new_tokens,
+                                  req.eos_token_id)
+            T = len(req.prompt)
+            T_pad = -(-max(T, 1) // bucket) * bucket
+            ids = np.zeros((1, T_pad), np.int32)
+            ids[0, :T] = req.prompt
+            tb = np.zeros((T_pad,), np.int32)       # scratch for pads
+            to = np.zeros((T_pad,), np.int32)
+            tb[:T], to[:T] = mgr.token_placement(seq)
+            self._rng, sub = jax.random.split(self._rng)
+            fn = self._get_prefill()
+            with jax.set_mesh(self.mesh):
+                tok, self.cache = fn(self.params, self.cache, ids, tb, to,
+                                     np.int32(T), sub)
+            self._post_token(seq, int(np.asarray(tok)[0]))
+
+    def _post_token(self, seq, token):
+        seq.generated.append(token)
+        if ((seq.eos_token_id >= 0 and token == seq.eos_token_id)
+                or len(seq.generated) >= seq.max_new_tokens):
+            self._results[seq.uid] = np.asarray(seq.generated, np.int32)
+            self.state_mgr.retire(seq.uid)
+            self.state_mgr.flush(seq.uid)
+
+    def step(self):
+        """One scheduler iteration: admit+prefill pending, then one decode
+        step for every active sequence. Returns list of (uid, token) pairs
+        produced this step."""
+        self._admit_pending()
+        mgr = self.state_mgr
+        if mgr.n_active == 0:
+            return []
+        batch = mgr.decode_batch()
+        self._rng, sub = jax.random.split(self._rng)
+        fn = self._get_decode()
+        with jax.set_mesh(self.mesh):
+            toks, self.cache = fn(self.params, self.cache,
+                                  batch.tokens, batch.lengths,
+                                  batch.block_tables, sub)
+        toks = np.asarray(toks)
+        out = []
+        slots = list(mgr._slots)  # snapshot: retire mutates
+        for slot, uid in enumerate(slots):
+            if uid is None or not batch.active[slot]:
+                continue
+            seq = mgr.get_sequence(uid)
+            tok = int(toks[slot])
+            self._post_token(seq, tok)
+            out.append((uid, tok))
+        return out
+
+    def generate_all(self, prompts, max_new_tokens=32, eos_token_id=-1):
+        """Convenience: run the scheduler to completion over a request
+        list; returns generated-token arrays in submission order."""
+        uids = [self.put(p, max_new_tokens, eos_token_id) for p in prompts]
+        while self.has_work:
+            self.step()
+        return [self.get(u) for u in uids]

@@ -1,0 +1,147 @@
+"""Ragged-batch state management for the v2 serving engine.
+
+Counterparts of reference ``inference/v2/ragged/``:
+  * ``DSSequenceDescriptor`` (sequence_descriptor.py:59) — one live
+    sequence: tokens seen, KV blocks held, generation state.
+  * ``RaggedBatchWrapper`` (ragged_wrapper.py:31) — the fixed-shape
+    device-facing metadata for one engine step (token ids, lengths, block
+    tables). The reference fills pinned host buffers; here plain numpy
+    arrays handed to a jitted program (the XLA transfer is the H2D copy).
+  * ``DSStateManager`` (ragged_manager.py:19) — owns the allocator and the
+    id -> descriptor map, builds RaggedBatchWrapper for each step.
+"""
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .blocked_allocator import BlockedAllocator
+
+
+@dataclass
+class DSSequenceDescriptor:
+    uid: int
+    prompt: np.ndarray                    # (T,) int32
+    max_new_tokens: int
+    eos_token_id: int = -1
+    blocks: list = field(default_factory=list)
+    generated: list = field(default_factory=list)
+    done: bool = False
+
+    @property
+    def seen_tokens(self):
+        return len(self.prompt) + len(self.generated)
+
+    def cur_allocated_capacity(self, block_size):
+        return len(self.blocks) * block_size
+
+
+@dataclass
+class RaggedBatchWrapper:
+    """Fixed-shape step metadata (B = engine max_batch)."""
+    tokens: np.ndarray        # (B,) int32 — next input token per slot
+    lengths: np.ndarray       # (B,) int32 — tokens already in cache
+    block_tables: np.ndarray  # (B, MB) int32 — scratch-0 padded
+    active: np.ndarray        # (B,) bool
+
+
+class DSStateManager:
+    def __init__(self, num_blocks, block_size, max_batch, max_blocks_per_seq):
+        self.allocator = BlockedAllocator(num_blocks)
+        self.block_size = block_size
+        self.max_batch = max_batch
+        self.max_blocks_per_seq = max_blocks_per_seq
+        self._seqs = {}                  # uid -> descriptor
+        self._slots = [None] * max_batch  # batch slot -> uid
+
+    # ------------------------------------------------------------- tracking
+    @property
+    def n_active(self):
+        return sum(s is not None for s in self._slots)
+
+    def get_sequence(self, uid):
+        return self._seqs[uid]
+
+    def free_slot(self):
+        for i, s in enumerate(self._slots):
+            if s is None:
+                return i
+        return None
+
+    def blocks_needed(self, n_tokens):
+        return -(-n_tokens // self.block_size)
+
+    def can_admit(self, prompt_len, max_new):
+        total = prompt_len + max_new
+        if total > self.max_blocks_per_seq * self.block_size:
+            return False  # can never fit; admit() would raise
+        return (self.free_slot() is not None
+                and self.allocator.free_blocks >= self.blocks_needed(total))
+
+    def admit(self, uid, prompt, max_new_tokens, eos_token_id=-1):
+        """Allocate blocks for the full prompt+generation budget and bind
+        the sequence to a batch slot. Returns (slot, descriptor)."""
+        slot = self.free_slot()
+        assert slot is not None, "no free batch slot"
+        prompt = np.asarray(prompt, np.int32)
+        total = len(prompt) + max_new_tokens
+        cap = self.max_blocks_per_seq * self.block_size
+        if total > cap:
+            raise ValueError(f"prompt+max_new={total} exceeds per-sequence "
+                             f"KV capacity {cap}")
+        seq = DSSequenceDescriptor(uid=uid, prompt=prompt,
+                                   max_new_tokens=max_new_tokens,
+                                   eos_token_id=eos_token_id)
+        seq.blocks = self.allocator.allocate(self.blocks_needed(total))
+        self._seqs[uid] = seq
+        self._slots[slot] = uid
+        return slot, seq
+
+    def retire(self, uid):
+        """Free the sequence's blocks and slot; keep the descriptor (the
+        caller reads .generated) until ``flush``."""
+        seq = self._seqs[uid]
+        self.allocator.free(seq.blocks)
+        seq.blocks = []
+        seq.done = True
+        self._slots[self._slots.index(uid)] = None
+
+    def flush(self, uid):
+        seq = self._seqs.pop(uid)
+        if seq.blocks:
+            self.allocator.free(seq.blocks)
+            if self._slots.count(uid):
+                self._slots[self._slots.index(uid)] = None
+
+    # ---------------------------------------------------------- step builds
+    def token_placement(self, seq):
+        """(token_blocks, token_offsets) for prefilling ``seq``'s prompt
+        padded to T_pad (caller pads); positions past the prompt map to the
+        scratch block."""
+        T = len(seq.prompt)
+        idx = np.arange(T)
+        blocks = np.asarray(seq.blocks, np.int32)[idx // self.block_size]
+        offs = (idx % self.block_size).astype(np.int32)
+        return blocks, offs
+
+    def decode_batch(self):
+        """RaggedBatchWrapper for one decode step over all active slots."""
+        B, MB = self.max_batch, self.max_blocks_per_seq
+        tokens = np.zeros((B,), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        tables = np.zeros((B, MB), np.int32)   # scratch
+        active = np.zeros((B,), bool)
+        for slot, uid in enumerate(self._slots):
+            if uid is None:
+                continue
+            seq = self._seqs[uid]
+            active[slot] = True
+            # input token = last generated (prefill produced the first);
+            # it is not yet in the cache, so its write position is
+            # seen_tokens - 1
+            tokens[slot] = seq.generated[-1]
+            lengths[slot] = seq.seen_tokens - 1
+            nb = len(seq.blocks)
+            tables[slot, :nb] = seq.blocks
+        return RaggedBatchWrapper(tokens=tokens, lengths=lengths,
+                                  block_tables=tables, active=active)

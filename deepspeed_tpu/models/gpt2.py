@@ -411,6 +411,126 @@ class GPT2:
             x = x[:, -1:]
         return self.head(params, x), {"k": kc, "v": vc}
 
+    # --- paged (blocked) KV-cache path for the v2 serving engine
+    #     (reference inference/v2/kernels/ragged_ops blocked_flash +
+    #     ragged/kv_cache.py BlockedKVCache; here the cache is a pool of
+    #     fixed-size blocks indexed by per-sequence block tables) ---
+    def init_paged_cache(self, num_blocks, block_size, dtype=None):
+        """{'k','v'}: (L, num_blocks, block_size, H, hd). Block 0 is the
+        scratch block (pad/inactive writes land there)."""
+        cfg = self.config
+        dt = jnp.dtype(dtype) if dtype is not None else _dtype(cfg)
+        shape = (cfg.n_layer, num_blocks, block_size, cfg.n_head, cfg.d_head)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def paged_cache_specs(self):
+        spec = P(None, None, None, "tensor", None)
+        return {"k": spec, "v": spec}
+
+    def apply_paged_prefill(self, params, input_ids, cache, token_blocks,
+                            token_offsets, length):
+        """Prefill ONE sequence into the paged cache.
+
+        input_ids: (1, T_pad) right-padded prompt; token_blocks/
+        token_offsets: (T_pad,) destination block / in-block slot per
+        position (pads point at scratch block 0); length: scalar true
+        prompt length. Returns (logits (1, V) at position length-1, cache).
+        """
+        cfg = self.config
+        dt = _dtype(cfg)
+        T = input_ids.shape[1]
+        H, hd = cfg.n_head, cfg.d_head
+        pos = jnp.arange(T)[None, :]
+        x = (params["wte"][input_ids] + params["wpe"][pos]).astype(dt)
+        valid = (jnp.arange(T) < length)
+        causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        mask = causal & valid[None, :]
+
+        def body(carry, xs):
+            layer, kc, vc = xs
+            x = carry
+            h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
+            qkv = (h @ layer["wqkv"] + layer["bqkv"]).reshape(1, T, 3, H, hd)
+            q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+            kc = kc.at[token_blocks, token_offsets].set(
+                kk[0].astype(kc.dtype))
+            vc = vc.at[token_blocks, token_offsets].set(
+                v[0].astype(vc.dtype))
+            scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(1, T,
+                                                                   H * hd)
+            x = x + attn @ layer["wo"] + layer["bo"]
+            h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
+            mlp_out, _ = self._mlp(h, layer, None, train=False,
+                                   seq_sharded=False,
+                                   constrain=lambda t, s: t)
+            return x + mlp_out, (kc, vc)
+
+        x, (kc, vc) = lax.scan(body, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+        last = jnp.take_along_axis(
+            x, jnp.maximum(length - 1, 0)[None, None, None], axis=1)
+        return self.head(params, last)[:, 0], {"k": kc, "v": vc}
+
+    def apply_paged_decode(self, params, tokens, lengths, cache,
+                           block_tables):
+        """One decode step for a fixed-size batch over the paged cache.
+
+        tokens: (B,) next input token per slot; lengths: (B,) tokens
+        already in cache (the new token's position); block_tables:
+        (B, MB) int32 block ids (inactive slots point at scratch block 0).
+        Returns (logits (B, V), cache).
+        """
+        cfg = self.config
+        dt = _dtype(cfg)
+        B = tokens.shape[0]
+        H, hd = cfg.n_head, cfg.d_head
+        BS = cache["k"].shape[2]
+        MB = block_tables.shape[1]
+        S = MB * BS
+
+        pos = jnp.minimum(lengths, cfg.max_seq_len - 1)
+        x = (params["wte"][tokens[:, None]]
+             + params["wpe"][pos[:, None]]).astype(dt)
+        dst_block = jnp.take_along_axis(
+            block_tables, (lengths // BS)[:, None], axis=1)[:, 0]
+        dst_off = lengths % BS
+        # attend over slots 0..lengths (inclusive of the new token)
+        attn_mask = jnp.arange(S)[None, :] <= lengths[:, None]
+
+        def body(carry, xs):
+            layer, kc, vc = xs
+            x = carry
+            h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
+            qkv = (h @ layer["wqkv"] + layer["bqkv"]).reshape(B, 3, H, hd)
+            q, kk, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
+            kc = kc.at[dst_block, dst_off].set(kk.astype(kc.dtype))
+            vc = vc.at[dst_block, dst_off].set(v.astype(vc.dtype))
+            # gather this batch's blocks: (B, MB, BS, H, hd) -> (B, S, ...)
+            gk = kc[block_tables].reshape(B, S, H, hd)
+            gv = vc[block_tables].reshape(B, S, H, hd)
+            scores = jnp.einsum("bhd,bshd->bhs", q, gk,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            scores = jnp.where(attn_mask[:, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhs,bshd->bhd", probs, gv).reshape(B, 1,
+                                                                  H * hd)
+            x = x + attn @ layer["wo"] + layer["bo"]
+            h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
+            mlp_out, _ = self._mlp(h, layer, None, train=False,
+                                   seq_sharded=False,
+                                   constrain=lambda t, s: t)
+            return x + mlp_out, (kc, vc)
+
+        x, (kc, vc) = lax.scan(body, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+        return self.head(params, x)[:, 0], {"k": kc, "v": vc}
+
     # --- loss ---
     def loss(self, params, batch, *, rng=None, train=True, seq_sharded=False):
         """Next-token cross entropy. batch: {"input_ids": (B, T) int32}."""
