@@ -1,0 +1,91 @@
+"""Environment/compatibility report — the ``dstpu_report`` CLI.
+
+Counterpart of reference ``deepspeed/env_report.py`` (``ds_report``):
+versions, detected hardware, and an op-compatibility matrix (there: which
+CUDA extensions build; here: which Pallas kernels and native host
+extensions are usable on this machine).
+"""
+
+import sys
+
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_NO = "\033[91m[NO]\033[0m"
+
+
+def _try(fn):
+    try:
+        fn()
+        return True, ""
+    except Exception as e:  # noqa: BLE001 - report, don't crash
+        return False, f"{type(e).__name__}: {e}"
+
+
+def op_compatibility():
+    """[(op_name, ok, detail)]. Mirrors ds_report's op matrix."""
+    import numpy as np
+
+    def flash():
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.flash_attention import flash_attention
+        q = jnp.zeros((1, 8, 1, 8), jnp.float32)
+        flash_attention(q, q, q)
+
+    def quant():
+        import jax.numpy as jnp
+        from deepspeed_tpu.ops.pallas.quantization import quantize_blockwise
+        quantize_blockwise(jnp.zeros((256,), jnp.float32))
+
+    def native_ckpt():
+        from deepspeed_tpu.ops.native.ckpt_writer import Writer
+        w = Writer(threads=1)
+        w.close()
+
+    rows = []
+    for name, fn in [("pallas_flash_attention", flash),
+                     ("pallas_quantizer", quant),
+                     ("native_ckpt_writer", native_ckpt)]:
+        ok, detail = _try(fn)
+        rows.append((name, ok, detail))
+    return rows
+
+
+def report(file=sys.stdout):
+    import jax
+    import jaxlib
+    import numpy as np
+
+    p = lambda *a: print(*a, file=file)
+    p("-" * 64)
+    p("DeepSpeed-TPU environment report")
+    p("-" * 64)
+    import deepspeed_tpu
+    p(f"deepspeed_tpu ........ {deepspeed_tpu.__version__}")
+    p(f"python ............... {sys.version.split()[0]}")
+    p(f"jax .................. {jax.__version__}")
+    p(f"jaxlib ............... {jaxlib.__version__}")
+    p(f"numpy ................ {np.__version__}")
+    p("-" * 64)
+    try:
+        devs = jax.devices()
+        p(f"default backend ...... {jax.default_backend()}")
+        p(f"devices .............. {len(devs)} x {devs[0].platform}"
+          f" ({devs[0].device_kind})")
+        p(f"process count ........ {jax.process_count()}")
+    except Exception as e:  # noqa: BLE001
+        p(f"device probe failed .. {e}")
+    p("-" * 64)
+    p("op compatibility")
+    for name, ok, detail in op_compatibility():
+        mark = GREEN_OK if ok else RED_NO
+        p(f"  {name:28s} {mark}{'  ' + detail if detail else ''}")
+    p("-" * 64)
+
+
+def main():
+    report()
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -118,10 +118,19 @@ class InferenceEngineV2:
             uid = self._uid_next
             self._uid_next += 1
         prompt = np.asarray(prompt, np.int32).reshape(-1)
-        if len(prompt) + max_new_tokens > self.max_seq_len:
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        total = len(prompt) + max_new_tokens
+        if total > self.max_seq_len:
             raise ValueError(
-                f"prompt+max_new={len(prompt) + max_new_tokens} exceeds "
+                f"prompt+max_new={total} exceeds "
                 f"model max_seq_len={self.max_seq_len}")
+        mgr = self.state_mgr
+        if mgr.blocks_needed(total) > mgr.allocator.total_blocks:
+            raise ValueError(
+                f"request needs {mgr.blocks_needed(total)} KV blocks but "
+                f"the pool only has {mgr.allocator.total_blocks}; raise "
+                "num_kv_blocks")
         self._pending.append(_Request(uid, prompt, max_new_tokens,
                                       eos_token_id))
         return uid
@@ -141,6 +150,8 @@ class InferenceEngineV2:
         result afterwards; in-flight requests return their tokens so far)."""
         if uid in self._results:
             return self._results.pop(uid) if flush else self._results[uid]
+        if any(r.uid == uid for r in self._pending):
+            return np.zeros((0,), np.int32)  # queued, nothing yet
         seq = self.state_mgr.get_sequence(uid)
         return np.asarray(seq.generated, np.int32)
 

@@ -1,0 +1,211 @@
+"""Multi-host launcher — the ``dstpu`` CLI.
+
+Counterpart of reference ``launcher/runner.py:388 main`` (the ``deepspeed``
+command): parse a hostfile (fetch_hostfile:200), apply --include/--exclude
+filters (:255), pick a multi-node runner (PDSH/ssh), and start one worker
+per HOST. TPU difference from the CUDA design: JAX is one PROCESS per host
+driving all local chips (multi-controller SPMD), so there is no per-rank
+``launch.py`` fan-out — each host runs the user script once with
+``COORDINATOR_ADDRESS/NUM_PROCESSES/PROCESS_ID`` env for
+``jax.distributed.initialize`` (comm/comm.py:130 init_distributed reads
+these). ``--num_hosts 1`` (default with no hostfile) just execs locally.
+"""
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+
+from ..utils.logging import logger
+
+DEFAULT_COORD_PORT = 8476
+
+
+def fetch_hostfile(path):
+    """Parse a DeepSpeed-style hostfile: ``hostname slots=N`` per line,
+    '#' comments. Returns ordered {hostname: slots} (slots = TPU chips on
+    that host; informational for JAX, which discovers local chips itself).
+    """
+    resource_pool = {}
+    with open(path) as f:
+        for ln, line in enumerate(f, 1):
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            parts = line.split()
+            host = parts[0]
+            slots = 0
+            if len(parts) > 1:
+                if not parts[1].startswith("slots="):
+                    raise ValueError(
+                        f"{path}:{ln}: malformed line {line!r} "
+                        "(want 'host slots=N')")
+                slots = int(parts[1].split("=", 1)[1])
+            if host in resource_pool:
+                raise ValueError(f"{path}:{ln}: duplicate host {host}")
+            resource_pool[host] = slots
+    return resource_pool
+
+
+def parse_inclusion_exclusion(resource_pool, include_str="",
+                              exclude_str=""):
+    """Apply ``--include``/``--exclude`` host filters (reference
+    runner.py:255 parse_resource_filter, host-granularity; TPU chips are
+    not individually maskable from the launcher). Syntax:
+    ``host1@host2`` selects hosts; '@' separates entries."""
+    if include_str and exclude_str:
+        raise ValueError("--include and --exclude are mutually exclusive")
+    hosts = list(resource_pool)
+
+    def split(s):
+        out = []
+        for part in s.split("@"):
+            part = part.strip()
+            if not part:
+                continue
+            if part not in resource_pool:
+                raise ValueError(f"unknown host {part!r} in filter")
+            out.append(part)
+        return out
+
+    if include_str:
+        keep = split(include_str)
+        return {h: resource_pool[h] for h in hosts if h in keep}
+    if exclude_str:
+        drop = split(exclude_str)
+        return {h: resource_pool[h] for h in hosts if h not in drop}
+    return dict(resource_pool)
+
+
+def build_worker_cmds(hosts, coordinator, script, script_args,
+                      env_passthrough=()):
+    """One (host, argv, env) per host. env carries the jax.distributed
+    rendezvous triplet."""
+    cmds = []
+    n = len(hosts)
+    for pid, host in enumerate(hosts):
+        env = {
+            "COORDINATOR_ADDRESS": coordinator,
+            "NUM_PROCESSES": str(n),
+            "PROCESS_ID": str(pid),
+        }
+        for k in env_passthrough:
+            if k in os.environ:
+                env[k] = os.environ[k]
+        argv = [sys.executable, script] + list(script_args)
+        cmds.append((host, argv, env))
+    return cmds
+
+
+class PDSHRunner:
+    """reference multinode_runner.py:51 — pdsh fan-out."""
+
+    def __init__(self, args):
+        self.args = args
+
+    def available(self):
+        from shutil import which
+        return which("pdsh") is not None
+
+    def launch(self, cmds):
+        procs = []
+        for host, argv, env in cmds:
+            exports = " ".join(f"{k}={shlex.quote(v)}"
+                               for k, v in env.items())
+            remote = (f"cd {shlex.quote(os.getcwd())} && {exports} "
+                      + " ".join(shlex.quote(a) for a in argv))
+            procs.append(subprocess.Popen(
+                ["pdsh", "-R", "ssh", "-w", host, remote]))
+        return procs
+
+
+class SSHRunner:
+    """Plain ssh fan-out (covers the reference's OpenMPI/MVAPICH role of
+    'just start my processes' without an MPI dependency)."""
+
+    def __init__(self, args):
+        self.args = args
+
+    def available(self):
+        return True
+
+    def launch(self, cmds):
+        procs = []
+        for host, argv, env in cmds:
+            exports = " ".join(f"{k}={shlex.quote(v)}"
+                               for k, v in env.items())
+            remote = (f"cd {shlex.quote(os.getcwd())} && {exports} "
+                      + " ".join(shlex.quote(a) for a in argv))
+            if host in ("localhost", "127.0.0.1"):
+                procs.append(subprocess.Popen(
+                    ["bash", "-c", remote]))
+            else:
+                procs.append(subprocess.Popen(["ssh", host, remote]))
+        return procs
+
+
+def parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="dstpu", description="DeepSpeed-TPU multi-host launcher")
+    parser.add_argument("-H", "--hostfile", default=None,
+                        help="'host slots=N' lines; omit for single-host")
+    parser.add_argument("-i", "--include", default="",
+                        help="host filter, e.g. host1@host2")
+    parser.add_argument("-e", "--exclude", default="",
+                        help="host filter, e.g. host3")
+    parser.add_argument("--master_addr", default=None,
+                        help="coordinator host (default: first host)")
+    parser.add_argument("--master_port", type=int,
+                        default=DEFAULT_COORD_PORT)
+    parser.add_argument("--launcher", default="ssh",
+                        choices=["ssh", "pdsh"])
+    parser.add_argument("--env", action="append", default=[],
+                        help="env var names to pass through to workers")
+    parser.add_argument("script", help="training script")
+    parser.add_argument("script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def main(argv=None):
+    args = parse_args(argv)
+    if args.hostfile is None:
+        # single host: exec in place; jax discovers local chips
+        os.execvpe(sys.executable,
+                   [sys.executable, args.script] + args.script_args,
+                   os.environ.copy())
+
+    pool = fetch_hostfile(args.hostfile)
+    pool = parse_inclusion_exclusion(pool, args.include, args.exclude)
+    if not pool:
+        raise SystemExit("no hosts left after filters")
+    hosts = list(pool)
+    coordinator = (f"{args.master_addr or hosts[0]}:{args.master_port}")
+    cmds = build_worker_cmds(
+        hosts, coordinator, args.script, args.script_args,
+        env_passthrough=tuple(args.env) + ("PYTHONPATH", "JAX_PLATFORMS",
+                                           "XLA_FLAGS"))
+    runner = (PDSHRunner(args) if args.launcher == "pdsh"
+              else SSHRunner(args))
+    if not runner.available():
+        raise SystemExit(f"launcher {args.launcher} not available")
+    logger.info(f"launching on {len(hosts)} hosts via {args.launcher}; "
+                f"coordinator {coordinator}")
+    procs = runner.launch(cmds)
+    rc = 0
+    try:
+        for p in procs:
+            rc |= p.wait()
+    except KeyboardInterrupt:
+        # kill-switch semantics (reference launch.py:118): tear everyone
+        # down on interrupt so no stragglers hold the TPU
+        for p in procs:
+            p.terminate()
+        for p in procs:
+            p.wait()
+        raise
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -340,6 +340,25 @@ class GPT2:
         spec = P(None, batch_axes, None, "tensor", None)
         return {"k": spec, "v": spec}
 
+    def _block_core(self, x, layer, attn_fn):
+        """Shared block scaffolding for every cache-backed inference path:
+        ln1 -> qkv projection -> ``attn_fn`` -> output projection residual
+        -> ln2 -> mlp residual. ``attn_fn((B,T,H,hd) q, k, v) -> (attn
+        (B,T,H,hd), carry)`` owns masking and any cache reads/writes.
+        Returns (x_out, carry)."""
+        cfg = self.config
+        B, T = x.shape[0], x.shape[1]
+        H, hd = cfg.n_head, cfg.d_head
+        h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
+        qkv = (h @ layer["wqkv"] + layer["bqkv"]).reshape(B, T, 3, H, hd)
+        attn, carry = attn_fn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
+        x = x + attn.reshape(B, T, H * hd) @ layer["wo"] + layer["bo"]
+        h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
+        mlp_out, _ = self._mlp(h, layer, None, train=False,
+                               seq_sharded=False,
+                               constrain=lambda t, s: t)
+        return x + mlp_out, carry
+
     def block_forward_cached(self, x, layer, k_cache, v_cache, slot,
                              valid_mask):
         """One block over new tokens with a KV cache.
@@ -352,38 +371,29 @@ class GPT2:
         """
         cfg = self.config
         dt = _dtype(cfg)
-        B, T = x.shape[0], x.shape[1]
-        H, hd = cfg.n_head, cfg.d_head
+        T = x.shape[1]
+        hd = cfg.d_head
         Tmax = k_cache.shape[1]
 
-        h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
-        qkv = h @ layer["wqkv"] + layer["bqkv"]
-        qkv = qkv.reshape(B, T, 3, H, hd)
-        q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        k_cache = lax.dynamic_update_slice(k_cache, kk.astype(k_cache.dtype),
-                                           (0, slot, 0, 0))
-        v_cache = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
-                                           (0, slot, 0, 0))
+        def attn_fn(q, kk, v):
+            kc = lax.dynamic_update_slice(k_cache, kk.astype(k_cache.dtype),
+                                          (0, slot, 0, 0))
+            vc = lax.dynamic_update_slice(v_cache, v.astype(v_cache.dtype),
+                                          (0, slot, 0, 0))
+            scores = jnp.einsum("bthd,bshd->bhts", q, kc,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            # slot-causal: query at slot s_q = slot+t sees slots s <= s_q
+            # that hold valid tokens (pads masked out forever)
+            s_idx = jnp.arange(Tmax)[None, None, None, :]
+            q_idx = (slot + jnp.arange(T))[None, None, :, None]
+            mask = (s_idx <= q_idx) & valid_mask[:, None, None, :]
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            return jnp.einsum("bhts,bshd->bthd", probs, vc), (kc, vc)
 
-        scores = jnp.einsum("bthd,bshd->bhts", q, k_cache,
-                            preferred_element_type=jnp.float32)
-        scores = scores / math.sqrt(hd)
-        # slot-causal: query at slot s_q = slot+t sees slots s <= s_q that
-        # hold valid tokens (pads masked out forever)
-        s_idx = jnp.arange(Tmax)[None, None, None, :]
-        q_idx = (slot + jnp.arange(T))[None, None, :, None]
-        mask = (s_idx <= q_idx) & valid_mask[:, None, None, :]
-        scores = jnp.where(mask, scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        attn = jnp.einsum("bhts,bshd->bthd", probs, v_cache)
-        attn = attn.reshape(B, T, H * hd)
-        x = x + attn @ layer["wo"] + layer["bo"]
-
-        h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
-        mlp_out, _ = self._mlp(h, layer, None, train=False,
-                               seq_sharded=False,
-                               constrain=lambda t, s: t)
-        return x + mlp_out, k_cache, v_cache
+        x, (kc, vc) = self._block_core(x, layer, attn_fn)
+        return x, kc, vc
 
     def apply_cached(self, params, input_ids, pos_ids, cache, slot,
                      valid_mask, last_token_only=False):
@@ -439,7 +449,7 @@ class GPT2:
         cfg = self.config
         dt = _dtype(cfg)
         T = input_ids.shape[1]
-        H, hd = cfg.n_head, cfg.d_head
+        hd = cfg.d_head
         pos = jnp.arange(T)[None, :]
         x = (params["wte"][input_ids] + params["wpe"][pos]).astype(dt)
         valid = (jnp.arange(T) < length)
@@ -447,28 +457,22 @@ class GPT2:
         mask = causal & valid[None, :]
 
         def body(carry, xs):
-            layer, kc, vc = xs
-            x = carry
-            h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
-            qkv = (h @ layer["wqkv"] + layer["bqkv"]).reshape(1, T, 3, H, hd)
-            q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-            kc = kc.at[token_blocks, token_offsets].set(
-                kk[0].astype(kc.dtype))
-            vc = vc.at[token_blocks, token_offsets].set(
-                v[0].astype(vc.dtype))
-            scores = jnp.einsum("bthd,bshd->bhts", q, kk,
-                                preferred_element_type=jnp.float32)
-            scores = scores / math.sqrt(hd)
-            scores = jnp.where(mask[None, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-            attn = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(1, T,
-                                                                   H * hd)
-            x = x + attn @ layer["wo"] + layer["bo"]
-            h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
-            mlp_out, _ = self._mlp(h, layer, None, train=False,
-                                   seq_sharded=False,
-                                   constrain=lambda t, s: t)
-            return x + mlp_out, (kc, vc)
+            layer, kc0, vc0 = xs
+
+            def attn_fn(q, kk, v):
+                kc = kc0.at[token_blocks, token_offsets].set(
+                    kk[0].astype(kc0.dtype))
+                vc = vc0.at[token_blocks, token_offsets].set(
+                    v[0].astype(vc0.dtype))
+                scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                                    preferred_element_type=jnp.float32)
+                scores = scores / math.sqrt(hd)
+                scores = jnp.where(mask[None, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+                return jnp.einsum("bhts,bshd->bthd", probs, v), (kc, vc)
+
+            x, (kc, vc) = self._block_core(carry, layer, attn_fn)
+            return x, (kc, vc)
 
         x, (kc, vc) = lax.scan(body, x,
                                (params["blocks"], cache["k"], cache["v"]))
@@ -503,29 +507,27 @@ class GPT2:
         attn_mask = jnp.arange(S)[None, :] <= lengths[:, None]
 
         def body(carry, xs):
-            layer, kc, vc = xs
-            x = carry
-            h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
-            qkv = (h @ layer["wqkv"] + layer["bqkv"]).reshape(B, 3, H, hd)
-            q, kk, v = qkv[:, 0], qkv[:, 1], qkv[:, 2]
-            kc = kc.at[dst_block, dst_off].set(kk.astype(kc.dtype))
-            vc = vc.at[dst_block, dst_off].set(v.astype(vc.dtype))
-            # gather this batch's blocks: (B, MB, BS, H, hd) -> (B, S, ...)
-            gk = kc[block_tables].reshape(B, S, H, hd)
-            gv = vc[block_tables].reshape(B, S, H, hd)
-            scores = jnp.einsum("bhd,bshd->bhs", q, gk,
-                                preferred_element_type=jnp.float32)
-            scores = scores / math.sqrt(hd)
-            scores = jnp.where(attn_mask[:, None, :], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-            attn = jnp.einsum("bhs,bshd->bhd", probs, gv).reshape(B, 1,
-                                                                  H * hd)
-            x = x + attn @ layer["wo"] + layer["bo"]
-            h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
-            mlp_out, _ = self._mlp(h, layer, None, train=False,
-                                   seq_sharded=False,
-                                   constrain=lambda t, s: t)
-            return x + mlp_out, (kc, vc)
+            layer, kc0, vc0 = xs
+
+            def attn_fn(q, kk, v):
+                # q/kk/v: (B, 1, H, hd) — the single new token per slot
+                kc = kc0.at[dst_block, dst_off].set(kk[:, 0].astype(
+                    kc0.dtype))
+                vc = vc0.at[dst_block, dst_off].set(v[:, 0].astype(
+                    vc0.dtype))
+                # gather each slot's blocks: (B, MB, BS, H, hd) -> (B, S, .)
+                gk = kc[block_tables].reshape(B, S, H, hd)
+                gv = vc[block_tables].reshape(B, S, H, hd)
+                scores = jnp.einsum("bhd,bshd->bhs", q[:, 0], gk,
+                                    preferred_element_type=jnp.float32)
+                scores = scores / math.sqrt(hd)
+                scores = jnp.where(attn_mask[:, None, :], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+                attn = jnp.einsum("bhs,bshd->bhd", probs, gv)
+                return attn[:, None], (kc, vc)
+
+            x, (kc, vc) = self._block_core(carry, layer, attn_fn)
+            return x, (kc, vc)
 
         x, (kc, vc) = lax.scan(body, x,
                                (params["blocks"], cache["k"], cache["v"]))

@@ -1,0 +1,101 @@
+"""Launcher + env-report tests (reference tests/unit/launcher/)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.launcher.runner import (build_worker_cmds, fetch_hostfile,
+                                           parse_inclusion_exclusion,
+                                           parse_args)
+
+
+@pytest.fixture
+def hostfile(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text("""
+# training pod
+tpu-a slots=4
+tpu-b slots=4
+tpu-c slots=8
+""")
+    return str(f)
+
+
+class TestHostfile:
+    def test_parse(self, hostfile):
+        pool = fetch_hostfile(hostfile)
+        assert pool == {"tpu-a": 4, "tpu-b": 4, "tpu-c": 8}
+
+    def test_malformed_raises(self, tmp_path):
+        f = tmp_path / "bad"
+        f.write_text("hostx gpus=4\n")
+        with pytest.raises(ValueError, match="malformed"):
+            fetch_hostfile(str(f))
+
+    def test_duplicate_raises(self, tmp_path):
+        f = tmp_path / "dup"
+        f.write_text("h1 slots=2\nh1 slots=4\n")
+        with pytest.raises(ValueError, match="duplicate"):
+            fetch_hostfile(str(f))
+
+
+class TestFilters:
+    POOL = {"a": 4, "b": 4, "c": 8}
+
+    def test_include(self):
+        assert parse_inclusion_exclusion(self.POOL, include_str="a@c") == \
+            {"a": 4, "c": 8}
+
+    def test_exclude(self):
+        assert parse_inclusion_exclusion(self.POOL, exclude_str="b") == \
+            {"a": 4, "c": 8}
+
+    def test_both_raises(self):
+        with pytest.raises(ValueError):
+            parse_inclusion_exclusion(self.POOL, "a", "b")
+
+    def test_unknown_host_raises(self):
+        with pytest.raises(ValueError, match="unknown host"):
+            parse_inclusion_exclusion(self.POOL, include_str="zzz")
+
+
+class TestWorkerCmds:
+    def test_env_triplet(self):
+        cmds = build_worker_cmds(["h0", "h1", "h2"], "h0:8476",
+                                 "train.py", ["--lr", "1e-4"])
+        assert len(cmds) == 3
+        for pid, (host, argv, env) in enumerate(cmds):
+            assert env["COORDINATOR_ADDRESS"] == "h0:8476"
+            assert env["NUM_PROCESSES"] == "3"
+            assert env["PROCESS_ID"] == str(pid)
+            assert argv[-3:] == ["train.py", "--lr", "1e-4"]
+
+    def test_passthrough(self, monkeypatch):
+        monkeypatch.setenv("MY_FLAG", "7")
+        cmds = build_worker_cmds(["h0"], "h0:1", "t.py", [],
+                                 env_passthrough=("MY_FLAG", "ABSENT"))
+        assert cmds[0][2]["MY_FLAG"] == "7"
+        assert "ABSENT" not in cmds[0][2]
+
+
+class TestArgs:
+    def test_script_args_remainder(self):
+        a = parse_args(["--launcher", "ssh", "train.py", "--deepspeed_config",
+                        "ds.json"])
+        assert a.script == "train.py"
+        assert a.script_args == ["--deepspeed_config", "ds.json"]
+
+
+class TestEnvReport:
+    def test_report_runs(self, capsys):
+        from deepspeed_tpu.env_report import report, op_compatibility
+        report()
+        out = capsys.readouterr().out
+        assert "deepspeed_tpu" in out and "jax" in out
+        rows = {name: ok for name, ok, _ in op_compatibility()}
+        # quantizer + flash attention are interpretable on CPU via jit;
+        # they must at least import and trace
+        assert set(rows) == {"pallas_flash_attention", "pallas_quantizer",
+                             "native_ckpt_writer"}
