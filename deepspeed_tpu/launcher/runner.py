@@ -141,7 +141,10 @@ class SSHRunner:
                 procs.append(subprocess.Popen(
                     ["bash", "-c", remote]))
             else:
-                procs.append(subprocess.Popen(["ssh", host, remote]))
+                # -tt forces a pty so killing the local ssh client HUPs the
+                # remote session (otherwise a compute-bound worker only
+                # dies on its next write to the closed socket)
+                procs.append(subprocess.Popen(["ssh", "-tt", host, remote]))
         return procs
 
 

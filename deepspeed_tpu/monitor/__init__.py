@@ -1,0 +1,2 @@
+from .monitor import MonitorMaster
+from .config import DeepSpeedMonitorConfig, TensorBoardConfig, WandbConfig, CSVConfig

@@ -1,0 +1,59 @@
+"""Monitor configuration (reference monitor/config.py)."""
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class TensorBoardConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+@dataclass
+class WandbConfig:
+    enabled: bool = False
+    group: str = ""
+    team: str = ""
+    project: str = "deepspeed_tpu"
+
+
+@dataclass
+class CSVConfig:
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJobName"
+
+
+@dataclass
+class DeepSpeedMonitorConfig:
+    """Aggregates the three writer configs (reference
+    monitor/config.py:DeepSpeedMonitorConfig)."""
+    tensorboard: TensorBoardConfig = field(default_factory=TensorBoardConfig)
+    wandb: WandbConfig = field(default_factory=WandbConfig)
+    csv_monitor: CSVConfig = field(default_factory=CSVConfig)
+
+    @property
+    def enabled(self):
+        return (self.tensorboard.enabled or self.wandb.enabled
+                or self.csv_monitor.enabled)
+
+    @classmethod
+    def from_dict(cls, d):
+        d = d or {}
+
+        def take(cls_, key):
+            sub = d.get(key, {})
+            if isinstance(sub, cls_):
+                return sub
+            known = set(cls_.__dataclass_fields__)
+            unknown = set(sub) - known
+            if unknown:
+                from ..utils.logging import logger
+                logger.warning(f"monitor block '{key}': ignoring unknown "
+                               f"keys {sorted(unknown)}")
+            return cls_(**{k: v for k, v in sub.items() if k in known})
+
+        return cls(tensorboard=take(TensorBoardConfig, "tensorboard"),
+                   wandb=take(WandbConfig, "wandb"),
+                   csv_monitor=take(CSVConfig, "csv_monitor"))

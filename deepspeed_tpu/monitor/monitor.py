@@ -1,0 +1,107 @@
+"""Experiment monitors: TensorBoard / W&B / CSV fan-out.
+
+Counterpart of reference ``monitor/monitor.py:29 MonitorMaster`` +
+``tensorboard.py`` / ``wandb.py`` / ``csv_monitor.py``. Events are
+``(tag, value, step)`` triples; only process 0 writes (reference gates on
+rank via dist; here jax.process_index()).
+"""
+
+import os
+
+from ..utils.logging import logger
+
+
+class Monitor:
+    def write_events(self, event_list):
+        raise NotImplementedError
+
+    def flush(self):
+        pass
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        from torch.utils.tensorboard import SummaryWriter  # may raise
+        path = os.path.join(config.output_path or "runs", config.job_name)
+        self.writer = SummaryWriter(log_dir=path)
+
+    def write_events(self, event_list):
+        for tag, value, step in event_list:
+            self.writer.add_scalar(tag, float(value), int(step))
+
+    def flush(self):
+        self.writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        import wandb  # may raise
+        self.wandb = wandb
+        wandb.init(project=config.project or None,
+                   group=config.group or None,
+                   entity=config.team or None)
+
+    def write_events(self, event_list):
+        for tag, value, step in event_list:
+            self.wandb.log({tag: float(value)}, step=int(step))
+
+
+class csvMonitor(Monitor):  # noqa: N801 - reference class name
+    """One csv file per tag: ``{output_path}/{job_name}/{tag}.csv`` with
+    ``step,value`` rows (reference csv_monitor.py layout)."""
+
+    def __init__(self, config):
+        self.dir = os.path.join(config.output_path or "csv_out",
+                                config.job_name)
+        os.makedirs(self.dir, exist_ok=True)
+        self._files = {}
+
+    def _file(self, tag):
+        if tag not in self._files:
+            safe = tag.replace("/", "_")
+            # line-buffered: rows survive preemption/SIGKILL mid-run
+            self._files[tag] = open(
+                os.path.join(self.dir, f"{safe}.csv"), "a", buffering=1)
+        return self._files[tag]
+
+    def write_events(self, event_list):
+        for tag, value, step in event_list:
+            self._file(tag).write(f"{int(step)},{float(value)}\n")
+
+    def flush(self):
+        for f in self._files.values():
+            f.flush()
+
+
+class MonitorMaster(Monitor):
+    """Instantiates every enabled writer; failures to import optional
+    backends degrade to a warning (reference hard-requires the package)."""
+
+    def __init__(self, config):
+        import jax
+        self.enabled = config.enabled and jax.process_index() == 0
+        self.monitors = []
+        if not self.enabled:
+            return
+        for flag, cls, sub in [
+                (config.tensorboard.enabled, TensorBoardMonitor,
+                 config.tensorboard),
+                (config.wandb.enabled, WandbMonitor, config.wandb),
+                (config.csv_monitor.enabled, csvMonitor,
+                 config.csv_monitor)]:
+            if not flag:
+                continue
+            try:
+                self.monitors.append(cls(sub))
+            except Exception as e:  # noqa: BLE001 - optional backend
+                logger.warning(f"monitor {cls.__name__} unavailable: {e}")
+        self.enabled = bool(self.monitors)
+
+    def write_events(self, event_list):
+        if self.enabled and event_list:
+            for m in self.monitors:
+                m.write_events(event_list)
+
+    def flush(self):
+        for m in self.monitors:
+            m.flush()

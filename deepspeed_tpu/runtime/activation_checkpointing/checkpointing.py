@@ -1,0 +1,181 @@
+"""Activation checkpointing (rematerialization).
+
+Counterpart of reference ``runtime/activation_checkpointing/
+checkpointing.py`` (Megatron-compatible: ``configure():1010-area``,
+``checkpoint():1010``, ``CheckpointFunction:485``, ``CudaRNGStatesTracker
+:123``). TPU redesign:
+
+  * ``checkpoint(fn, *args)`` = ``jax.checkpoint`` (remat): recompute in
+    backward instead of saving — the same FLOPs-for-HBM trade the
+    reference implements by hand with torch.autograd.Function.
+  * Policies replace the reference's save/offload knob set:
+    ``partition_activations`` (reference shards saved activations across
+    TP ranks) maps to saving with a sharding constraint — under GSPMD the
+    saved residuals are already sharded by the activation specs, so the
+    knob is accepted and folded into the policy choice. ``cpu_checkpointing``
+    maps to ``save_and_offload_only_these_names``-style host offload
+    policies where the jax version provides them.
+  * ``CudaRNGStatesTracker`` maps to an explicit named-PRNG tracker: jax
+    RNG is functional, so "states" are just named keys; ``fork(name)``
+    yields a fresh deterministic key per use — reproducible dropout across
+    TP ranks without device RNG-state mutation.
+"""
+
+import contextlib
+
+import jax
+
+from ...utils.logging import logger
+
+_config = None
+
+
+# --------------------------------------------------------------- rng tracker
+class RNGStatesTracker:
+    """Named deterministic PRNG streams (reference CudaRNGStatesTracker).
+
+    ``add(name, seed)`` registers a stream; ``with tracker.fork(name) as
+    key:`` yields a fresh key (folded with a per-fork counter) — the
+    functional analogue of swapping device RNG state in and out."""
+
+    def __init__(self):
+        self.reset()
+
+    def reset(self):
+        self._seeds = {}
+        self._counters = {}
+
+    def get_states(self):
+        return dict(self._seeds), dict(self._counters)
+
+    def set_states(self, states):
+        self._seeds, self._counters = dict(states[0]), dict(states[1])
+
+    def add(self, name, seed):
+        if name in self._seeds:
+            raise ValueError(f"rng state {name} already present")
+        if seed in self._seeds.values():
+            raise ValueError(f"seed {seed} already used")
+        self._seeds[name] = seed
+        self._counters[name] = 0
+
+    @contextlib.contextmanager
+    def fork(self, name="model-parallel-rng"):
+        if name not in self._seeds:
+            raise KeyError(f"rng state {name} not added")
+        key = jax.random.fold_in(jax.random.key(self._seeds[name]),
+                                 self._counters[name])
+        self._counters[name] += 1
+        yield key
+
+
+_RNG_TRACKER = RNGStatesTracker()
+_MODEL_PARALLEL_RNG = "model-parallel-rng"
+
+
+def get_cuda_rng_tracker():
+    """Name kept for drop-in compatibility with Megatron-style callers."""
+    return _RNG_TRACKER
+
+
+def model_parallel_rng_seed(seed, tp_rank=0):
+    """reference model_parallel_cuda_manual_seed:200 — distinct dropout
+    streams per TP rank, one shared default stream."""
+    _RNG_TRACKER.reset()
+    _RNG_TRACKER.add("default", seed)
+    _RNG_TRACKER.add(_MODEL_PARALLEL_RNG, seed + 2718 + tp_rank)
+
+
+# ------------------------------------------------------------------ policies
+_POLICY_ALIASES = {
+    "nothing_saveable": "nothing_saveable",
+    "everything_saveable": "everything_saveable",
+    "dots_saveable": "dots_saveable",
+    "checkpoint_dots": "dots_saveable",
+    "dots_with_no_batch_dims_saveable": "dots_with_no_batch_dims_saveable",
+    "checkpoint_dots_with_no_batch_dims":
+        "dots_with_no_batch_dims_saveable",
+}
+
+
+def resolve_policy(name_or_none, cpu_checkpointing=False):
+    """Map a policy name (+ cpu_checkpointing) to a jax.checkpoint policy."""
+    if cpu_checkpointing:
+        # offload matmul residuals to pinned host memory instead of
+        # recomputing (the reference copies saved activations to CPU)
+        maker = getattr(jax.checkpoint_policies,
+                        "offload_dot_with_no_batch_dims", None)
+        if maker is not None:
+            return maker("device", "pinned_host")
+        logger.warning("cpu_checkpointing requested but this jax has no "
+                       "offload policy; using the remat policy instead")
+    if not name_or_none:
+        return None
+    canonical = _POLICY_ALIASES.get(name_or_none, name_or_none)
+    policy = getattr(jax.checkpoint_policies, canonical, None)
+    if policy is None:
+        raise ValueError(f"unknown remat policy {name_or_none!r}")
+    return policy
+
+
+# ----------------------------------------------------------------- configure
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None,
+              policy=None):
+    """reference checkpointing.configure — store the knob set used by
+    subsequent ``checkpoint()`` calls."""
+    global _config
+    cfg = {"partition_activations": False, "contiguous_checkpointing": False,
+           "num_checkpoints": 0, "checkpoint_in_cpu": False,
+           "synchronize": False, "profile": False,
+           "policy": "nothing_saveable"}
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing", None)
+        if ac is not None:
+            cfg.update(partition_activations=ac.partition_activations,
+                       contiguous_checkpointing=(
+                           ac.contiguous_memory_optimization),
+                       num_checkpoints=ac.number_checkpoints,
+                       checkpoint_in_cpu=ac.cpu_checkpointing,
+                       synchronize=ac.synchronize_checkpoint_boundary,
+                       profile=ac.profile, policy=ac.policy)
+    for k, v in [("partition_activations", partition_activations),
+                 ("contiguous_checkpointing", contiguous_checkpointing),
+                 ("num_checkpoints", num_checkpoints),
+                 ("checkpoint_in_cpu", checkpoint_in_cpu),
+                 ("synchronize", synchronize), ("profile", profile),
+                 ("policy", policy)]:
+        if v is not None:
+            cfg[k] = v
+    _config = cfg
+
+
+def is_configured():
+    return _config is not None
+
+
+def reset():
+    global _config
+    _config = None
+
+
+# ---------------------------------------------------------------- checkpoint
+def checkpoint(function, *args, policy=None):
+    """Remat ``function(*args)`` (reference checkpoint():1010 — there it
+    runs fn under no_grad and replays in backward; jax.checkpoint is that
+    transform natively). Usable unconfigured (defaults to full remat)."""
+    cfg = _config or {}
+    pol = resolve_policy(
+        policy if policy is not None else cfg.get("policy"),
+        cpu_checkpointing=cfg.get("checkpoint_in_cpu", False))
+    return jax.checkpoint(function, policy=pol)(*args)
+
+
+def checkpoint_wrapper(function, policy=None):
+    """Return the remat-wrapped callable (for use inside scans)."""
+    cfg = _config or {}
+    pol = resolve_policy(
+        policy if policy is not None else cfg.get("policy"),
+        cpu_checkpointing=cfg.get("checkpoint_in_cpu", False))
+    return jax.checkpoint(function, policy=pol)

@@ -119,13 +119,6 @@ class CommsLoggerConfig:
     debug: bool = False
 
 
-@dataclass
-class MonitorConfig:
-    enabled: bool = False
-    output_path: str = ""
-    job_name: str = "DeepSpeedTPUJob"
-
-
 def _take(d, cls, key):
     sub = d.get(key, {})
     if isinstance(sub, cls):
@@ -198,7 +191,9 @@ class DeepSpeedConfig:
         self.activation_checkpointing = _take(
             config, ActivationCheckpointingConfig, C.ACTIVATION_CHECKPOINTING)
         self.comms_logger = _take(config, CommsLoggerConfig, C.COMMS_LOGGER)
-        self.monitor_csv = _take(config, MonitorConfig, C.MONITOR_CSV)
+        from ..monitor.config import DeepSpeedMonitorConfig
+        self.monitor_config = DeepSpeedMonitorConfig.from_dict(config)
+        self.monitor_csv = self.monitor_config.csv_monitor  # back-compat
 
         dtypes = config.get(C.DATA_TYPES, {})
         self.grad_accum_dtype = dtypes.get(C.GRAD_ACCUM_DTYPE)

@@ -1,0 +1,99 @@
+"""Curriculum learning difficulty scheduler.
+
+Counterpart of reference ``runtime/data_pipeline/curriculum_scheduler.py``
+(CurriculumScheduler): maps global step -> difficulty (e.g. sequence
+length) under fixed_linear / fixed_root / fixed_discrete / custom
+schedules. Pure python — identical semantics are correct on any backend.
+"""
+
+import math
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    """config keys (reference constants):
+      curriculum_type: metric name (informational, e.g. 'seqlen')
+      min_difficulty, max_difficulty: ints
+      schedule_type: fixed_linear | fixed_root | fixed_discrete | custom
+      schedule_config:
+        fixed_linear/fixed_root: {total_curriculum_step, difficulty_step,
+                                  root_degree (root only)}
+        fixed_discrete: {difficulty: [..], max_step: [..]} (len-1 bounds)
+        custom: set via set_custom_get_difficulty(fn(step)->difficulty)
+    """
+
+    def __init__(self, config):
+        self.state = {}
+        for key in ("min_difficulty", "max_difficulty", "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum config missing '{key}'")
+        self.min_difficulty = int(config["min_difficulty"])
+        self.max_difficulty = int(config["max_difficulty"])
+        self.schedule_type = config["schedule_type"]
+        sched = config.get("schedule_config", {})
+        self.custom_get_difficulty = None
+
+        if self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in sched:
+                    raise ValueError(
+                        f"{self.schedule_type} schedule missing '{key}'")
+            self.total_step = int(sched["total_curriculum_step"])
+            self.difficulty_step = int(sched["difficulty_step"])
+            self.root_degree = int(sched.get("root_degree", 1))
+            if self.schedule_type == FIXED_ROOT and "root_degree" not in sched:
+                raise ValueError("fixed_root schedule missing 'root_degree'")
+        elif self.schedule_type == FIXED_DISCRETE:
+            self.difficulties = list(sched["difficulty"])
+            self.max_steps = list(sched["max_step"])
+            if len(self.max_steps) != len(self.difficulties) - 1:
+                raise ValueError("fixed_discrete: len(max_step) must be "
+                                 "len(difficulty) - 1")
+        elif self.schedule_type == CUSTOM:
+            pass
+        else:
+            raise ValueError(f"unknown schedule_type {self.schedule_type}")
+
+        self.current_difficulty = self.min_difficulty
+
+    def set_custom_get_difficulty(self, fn):
+        self.custom_get_difficulty = fn
+
+    def get_difficulty(self, global_step):
+        s = self.schedule_type
+        if s == CUSTOM:
+            if self.custom_get_difficulty is None:
+                raise RuntimeError("custom schedule: call "
+                                   "set_custom_get_difficulty first")
+            return self.custom_get_difficulty(global_step)
+        if s == FIXED_DISCRETE:
+            for d, m in zip(self.difficulties, self.max_steps):
+                if global_step <= m:
+                    return d
+            return self.difficulties[-1]
+        # linear / root ramp from min to max over total_step, quantized to
+        # difficulty_step multiples (reference semantics)
+        frac = min(1.0, max(global_step, 1) / self.total_step)
+        if s == FIXED_ROOT:
+            frac = frac ** (1.0 / self.root_degree)
+        diff = self.min_difficulty + frac * (self.max_difficulty
+                                             - self.min_difficulty)
+        diff = int(diff // self.difficulty_step) * self.difficulty_step
+        return max(self.min_difficulty, min(self.max_difficulty, diff))
+
+    def update_difficulty(self, global_step):
+        self.current_difficulty = self.get_difficulty(global_step)
+        return self.current_difficulty
+
+    def get_current_difficulty(self):
+        return self.current_difficulty
+
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = sd["current_difficulty"]

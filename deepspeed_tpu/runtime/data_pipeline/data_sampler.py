@@ -1,0 +1,96 @@
+"""Deterministic distributed data sampler with curriculum support.
+
+Counterpart of reference ``runtime/data_pipeline/data_sampling/
+data_sampler.py:349 DeepSpeedDataSampler``: per-step index batches that
+are (a) identical across processes given the same seed/step — each DP
+rank slices its own shard, (b) resumable from a consumed-samples count,
+and (c) curriculum-aware (a CurriculumScheduler can shrink the effective
+batch/sequence as configured). Host-side numpy; the engine turns indices
+into device batches.
+"""
+
+import numpy as np
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, total_samples, micro_batch_size, data_parallel_rank,
+                 data_parallel_size, gradient_accumulation_steps=1,
+                 shuffle=True, seed=1234, drop_last=True,
+                 curriculum_scheduler=None):
+        self.total_samples = int(total_samples)
+        self.micro_batch_size = int(micro_batch_size)
+        self.dp_rank = int(data_parallel_rank)
+        self.dp_size = int(data_parallel_size)
+        self.gas = int(gradient_accumulation_steps)
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.curriculum_scheduler = curriculum_scheduler
+        self.consumed_samples = 0
+        if self.dp_rank >= self.dp_size:
+            raise ValueError("data_parallel_rank >= data_parallel_size")
+        self.global_batch_size = (self.micro_batch_size * self.dp_size
+                                  * self.gas)
+        if self.total_samples < self.global_batch_size:
+            raise ValueError(
+                f"total_samples={self.total_samples} < global batch "
+                f"{self.global_batch_size}; no full batch can be formed")
+
+    def __len__(self):
+        n = self.total_samples // self.global_batch_size
+        if not self.drop_last and self.total_samples % self.global_batch_size:
+            n += 1
+        return n
+
+    @property
+    def curriculum_difficulty(self):
+        """Difficulty for the most recently drawn global batch (1-based
+        step = batches consumed so far)."""
+        if self.curriculum_scheduler is None:
+            return None
+        step = self.consumed_samples // self.global_batch_size
+        return self.curriculum_scheduler.update_difficulty(step)
+
+    def _epoch_order(self, epoch):
+        order = np.arange(self.total_samples)
+        if self.shuffle:
+            np.random.RandomState(self.seed + epoch).shuffle(order)
+        return order
+
+    def set_consumed_samples(self, n):
+        """Resume mid-epoch (reference: consumed_samples from ckpt)."""
+        self.consumed_samples = int(n)
+
+    def state_dict(self):
+        return {"consumed_samples": self.consumed_samples,
+                "curriculum": (self.curriculum_scheduler.state_dict()
+                               if self.curriculum_scheduler else None)}
+
+    def load_state_dict(self, sd):
+        self.consumed_samples = sd["consumed_samples"]
+        if sd.get("curriculum") and self.curriculum_scheduler:
+            self.curriculum_scheduler.load_state_dict(sd["curriculum"])
+
+    def __iter__(self):
+        """Yields this rank's (micro_batch_size * gas,) index array per
+        global step, epoch after epoch."""
+        while True:
+            epoch = self.consumed_samples // self.total_samples
+            offset = self.consumed_samples % self.total_samples
+            order = self._epoch_order(epoch)
+            remaining = self.total_samples - offset
+            if remaining < self.global_batch_size:
+                if self.drop_last or remaining == 0:
+                    # skip the tail into the next epoch
+                    self.consumed_samples += remaining
+                    continue
+            start = offset
+            end = min(start + self.global_batch_size, self.total_samples)
+            batch = order[start:end]
+            if len(batch) < self.global_batch_size:  # not drop_last: pad by
+                batch = np.resize(batch, self.global_batch_size)  # tiling
+            self.consumed_samples += (end - start)
+            per_rank = self.global_batch_size // self.dp_size
+            mine = batch[self.dp_rank * per_rank:(self.dp_rank + 1)
+                         * per_rank]
+            yield mine

@@ -1,0 +1,62 @@
+"""Random layerwise token dropping (random-LTD).
+
+Counterpart of reference ``runtime/data_pipeline/data_routing/`` +
+``csrc/random_ltd/`` (token_sort.cu / gather_scatter.cu): during training,
+middle layers see a random subset of tokens; the kept-token count ramps up
+on a schedule. The CUDA kernels (sort, gather/scatter) are one
+``jax.random.permutation`` + ``jnp.take_along_axis`` here — XLA fuses the
+gather/scatter fine on TPU.
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def token_drop(x, keep, rng):
+    """Keep ``keep`` random tokens of ``x``: (B, T, D) -> (B, keep, D),
+    plus the sorted kept indices (B, keep) for ``token_restore``. Indices
+    are sorted so relative order (and position information) is preserved —
+    the reference sorts for the same reason (token_sort.cu)."""
+    B, T = x.shape[0], x.shape[1]
+    idx = jax.vmap(lambda k: jax.random.permutation(k, T)[:keep])(
+        jax.random.split(rng, B))
+    idx = jnp.sort(idx, axis=-1)
+    gathered = jnp.take_along_axis(x, idx[..., None], axis=1)
+    return gathered, idx
+
+
+def token_restore(x_small, idx, x_full):
+    """Scatter processed kept tokens back over the full sequence: dropped
+    positions keep their (skip-connection) values from ``x_full``."""
+    return x_full.at[
+        jnp.arange(x_full.shape[0])[:, None], idx].set(x_small)
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference data_routing/scheduler.py):
+    linear ramp from min_value to max_value (= full seq len) over
+    schedule_config total steps, quantized by seq_step."""
+
+    def __init__(self, config):
+        sched = config.get("random_ltd_schedule", {})
+        self.min_value = int(config["random_ltd_min_value"])
+        self.max_value = int(config["random_ltd_max_value"])
+        self.seq_step = int(sched.get("seq_step", 16))
+        self.total_steps = int(sched.get("require_steps", 1))
+        self.current_seq = self.min_value
+
+    def get_current_seq(self):
+        return self.current_seq
+
+    def update_seq(self, global_step):
+        frac = min(1.0, max(global_step, 0) / self.total_steps)
+        seq = self.min_value + frac * (self.max_value - self.min_value)
+        seq = int(seq // self.seq_step) * self.seq_step
+        self.current_seq = max(self.min_value, min(self.max_value, seq))
+        return self.current_seq
+
+    def state_dict(self):
+        return {"current_seq": self.current_seq}
+
+    def load_state_dict(self, sd):
+        self.current_seq = sd["current_seq"]

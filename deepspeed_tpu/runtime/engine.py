@@ -108,6 +108,11 @@ class DeepSpeedEngine:
         self.tput_timer = ThroughputTimer(
             batch_size=self.config.train_batch_size,
             steps_per_output=self.config.steps_per_print)
+
+        # monitoring fan-out (reference engine.py:253 MonitorMaster; events
+        # written at step boundaries like engine.py:1993-2001)
+        from ..monitor.monitor import MonitorMaster
+        self.monitor = MonitorMaster(self.config.monitor_config)
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} dtype={self.param_dtype} "
             f"dp={dp_world} tp={topology.get_model_parallel_world_size()} "
@@ -325,6 +330,15 @@ class DeepSpeedEngine:
                                jnp.float32)
         return jnp.asarray(self.optimizer.lr, jnp.float32)
 
+    def _add_gas_dim(self, x):
+        """(train_batch_size, ...) -> (gas, train_batch_size//gas, ...)."""
+        gas = self.config.gradient_accumulation_steps
+        x = np.asarray(x)
+        assert x.shape[0] == self.config.train_batch_size, (
+            f"batch dim {x.shape[0]} != train_batch_size "
+            f"{self.config.train_batch_size}")
+        return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
+
     def _shard_batch(self, batch, with_gas_dim):
         """Host batch -> global sharded arrays. Leaves (B_total, ...) or
         (gas, B, ...) when with_gas_dim."""
@@ -350,15 +364,7 @@ class DeepSpeedEngine:
         """
         gas = self.config.gradient_accumulation_steps
         self.tput_timer.start()
-
-        def add_gas(x):
-            x = np.asarray(x)
-            assert x.shape[0] == self.config.train_batch_size, (
-                f"batch dim {x.shape[0]} != train_batch_size "
-                f"{self.config.train_batch_size}")
-            return x.reshape((gas, x.shape[0] // gas) + x.shape[1:])
-
-        batch = jax.tree.map(add_gas, batch)
+        batch = jax.tree.map(self._add_gas_dim, batch)
         batch = self._shard_batch(batch, with_gas_dim=True)
         with jax.set_mesh(self.mesh):
             self.state, metrics = self._train_step_jit(
@@ -420,7 +426,22 @@ class DeepSpeedEngine:
         return metrics
 
     # ------------------------------------------------------------------ misc
+    def _write_monitor_events(self, metrics):
+        if not self.monitor.enabled:
+            return
+        events = [("Train/Samples/lr", float(self._current_lr()),
+                   self.global_step)]
+        loss = metrics.get("loss")
+        if loss is not None:
+            events.append(("Train/Samples/train_loss", float(loss),
+                           self.global_step))
+        if self.loss_scaler.dynamic:
+            events.append(("Train/Samples/loss_scale",
+                           float(metrics["loss_scale"]), self.global_step))
+        self.monitor.write_events(events)
+
     def _maybe_print(self, metrics):
+        self._write_monitor_events(metrics)
         if (self.config.steps_per_print and
                 self.global_step % self.config.steps_per_print == 0):
             loss = metrics.get("loss")
@@ -431,6 +452,26 @@ class DeepSpeedEngine:
                 f"grad_norm={float(metrics['grad_norm']):.3f} "
                 f"scale={float(metrics['loss_scale']):.0f} "
                 f"overflow={bool(metrics['overflow'])}", ranks=[0])
+
+    def get_flops_profile(self, batch):
+        """Flops/bytes of the compiled train-step program on ``batch``
+        (reference engine.py:2240-2252 flops-profiler hook; here the costs
+        come from XLA's own cost analysis of the program that runs)."""
+        from ..profiling.flops_profiler import FlopsProfiler
+        batch = jax.tree.map(self._add_gas_dim, batch)
+        batch = self._shard_batch(batch, with_gas_dim=True)
+        prof = FlopsProfiler(self.model)
+        prof.start_profile()
+        prof.set_params(self.state["params"])
+        with jax.set_mesh(self.mesh):
+            compiled = self._train_step_jit.lower(
+                self.state, batch, self._current_lr()).compile()
+        costs = compiled.cost_analysis()
+        if isinstance(costs, (list, tuple)):
+            costs = costs[0] if costs else {}
+        prof.record("train_step", costs.get("flops", 0.0),
+                    costs.get("bytes accessed", 0.0))
+        return prof
 
     def get_lr(self):
         return [float(self._current_lr())]
