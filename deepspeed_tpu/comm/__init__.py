@@ -4,3 +4,5 @@ from .comm import (all_reduce, reduce_scatter, all_gather, all_to_all,
                    get_world_size, get_local_device_count, barrier, configure,
                    log_summary)
 from .logging import CommsLogger, get_comms_logger
+from .quantized import (quantized_reduce_scatter, quantized_all_gather,
+                        all_to_all_quant_reduce)

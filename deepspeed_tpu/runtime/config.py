@@ -61,6 +61,14 @@ class ZeroConfig:
     def __post_init__(self):
         if self.stage not in (0, 1, 2, 3):
             raise DeepSpeedConfigError(f"invalid ZeRO stage {self.stage}")
+        mics = self.mics_shard_size not in (-1, 0)
+        hpz = self.hpz_partition_size > 1
+        if mics and hpz and self.mics_shard_size != self.hpz_partition_size:
+            raise DeepSpeedConfigError(
+                f"mics_shard_size={self.mics_shard_size} and "
+                f"hpz_partition_size={self.hpz_partition_size} disagree; "
+                "both subdivide the same inner data axis — set one (or "
+                "equal values)")
 
 
 @dataclass

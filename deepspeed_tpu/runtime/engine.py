@@ -61,11 +61,17 @@ class DeepSpeedEngine:
                 raw = config._raw
             else:
                 raw = DeepSpeedConfig(config, dp_world_size=1)._raw
+            zero_raw = raw.get("zero_optimization", {})
+            shard = int(zero_raw.get("mics_shard_size", -1))
+            if shard in (-1, 0):
+                shard = int(zero_raw.get("hpz_partition_size", 1))
+                shard = shard if shard > 1 else -1
             topology = groups.initialize(TopologyConfig(
                 tensor_parallel_size=raw.get("tensor_parallel", {}).get("size", 1),
                 pipe_parallel_size=raw.get("pipeline", {}).get("stages", 1),
                 seq_parallel_size=raw.get("sequence_parallel_size", 1),
                 expert_parallel_size=raw.get("expert_parallel_size", 1),
+                zero_shard_size=shard,
             ))
         self.topology = topology
         self.mesh = topology.mesh
@@ -127,8 +133,25 @@ class DeepSpeedEngine:
         abstract = jax.eval_shape(self.model.init, rng)
         shapes = jax.tree.map(lambda l: l.shape, abstract)
         tp_specs = self.model.partition_specs(self.topology)
-        self.plan = ZeroShardingPlan(self.zero_stage, self.mesh, tp_specs,
-                                     shapes)
+        # MiCS: everything shards over the inner group, replicates over
+        # data_outer (zero/mics.py:64). hpZ/ZeRO++: only the stage-3 bf16
+        # param shard is intra-slice; optimizer state stays global-DP
+        # (utils/groups.py:505 secondary group).
+        from ..utils.groups import DP_AXES, INNER_DP_AXES
+        zc = self.config.zero
+        mics = zc.mics_shard_size not in (-1, 0)
+        hpz = zc.hpz_partition_size > 1
+        want = max(zc.mics_shard_size, zc.hpz_partition_size)
+        if (mics or hpz) and self.topology.axis_size("data_outer") == 1 \
+                and self.topology.axis_size("data") > want:
+            log_dist(
+                f"mics/hpz shard size {want} configured but the topology "
+                "was built without zero_shard_size; sharding over the full "
+                "DP group instead", ranks=[0])
+        self.plan = ZeroShardingPlan(
+            self.zero_stage, self.mesh, tp_specs, shapes,
+            partition_axes=INNER_DP_AXES if mics else DP_AXES,
+            param_partition_axes=INNER_DP_AXES if hpz else None)
         param_sh = self.plan.shardings("param")
         master_sh = self.plan.shardings("master")
         self.param_shardings = param_sh

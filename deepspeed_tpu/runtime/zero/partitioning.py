@@ -26,9 +26,11 @@ and pads instead: runtime/utils.py partition helpers; dimension-sharding
 keeps XLA layouts natural and avoids materializing a flat copy).
 
 MiCS / ZeRO++ hpZ (zero/mics.py:64, utils/groups.py:505) map to partitioning
-over a *sub*-axis of DP so params replicate across slice boundaries; hook:
-``partition_axes`` lets the engine pass ('data',) instead of
-('data','expert') or a hierarchical split.
+over the INNER data axes so params replicate across 'data_outer' (slice
+boundaries): the engine passes ``partition_axes=INNER_DP_AXES``
+(('data','expert')) for MiCS, or ``param_partition_axes=INNER_DP_AXES``
+for hpZ's stage-3 secondary param shard while master/opt stay on the full
+DP_AXES (('data_outer','data','expert')).
 """
 
 from jax.sharding import PartitionSpec as P
@@ -84,26 +86,36 @@ class ZeroShardingPlan:
     """Computes param/master/grad sharding specs for a model + mesh."""
 
     def __init__(self, stage, mesh, tp_specs, shapes,
-                 partition_axes=DP_AXES):
+                 partition_axes=DP_AXES, param_partition_axes=None):
         """tp_specs/shapes: pytrees (same structure) of PartitionSpec and
         shape tuples. partition_axes: mesh axes forming the ZeRO partition
-        group (DP group by default; a sub-axis for MiCS-style plans)."""
+        group for master/optimizer/grads (full DP group by default; the
+        inner INNER_DP_AXES for MiCS plans — replicating over 'data_outer'
+        like MiCS replicates across sub-groups). param_partition_axes:
+        override for the stage-3 bf16 param shard (hpZ/ZeRO++ secondary
+        partition: params shard intra-slice so forward allgathers ride ICI
+        while optimizer state stays partitioned over all of DP)."""
         import jax
         self.stage = stage
         self.mesh = mesh
         self.partition_axes = partition_axes
+        self.param_partition_axes = param_partition_axes or partition_axes
 
-        def partitioned(spec, shape):
-            return add_partition_axis(shape, spec, partition_axes, mesh)
+        def partitioned(axes):
+            def f(spec, shape):
+                return add_partition_axis(shape, spec, axes, mesh)
+            return f
 
         is_spec = lambda x: isinstance(x, P)
         # bf16 params: partitioned only at stage 3
         self.param_specs = (
-            jax.tree.map(partitioned, tp_specs, shapes, is_leaf=is_spec)
+            jax.tree.map(partitioned(self.param_partition_axes), tp_specs,
+                         shapes, is_leaf=is_spec)
             if stage >= 3 else tp_specs)
         # fp32 master + optimizer state: partitioned from stage 1
         self.master_specs = (
-            jax.tree.map(partitioned, tp_specs, shapes, is_leaf=is_spec)
+            jax.tree.map(partitioned(partition_axes), tp_specs, shapes,
+                         is_leaf=is_spec)
             if stage >= 1 else tp_specs)
         # gradients: partitioned (reduce-scatter) from stage 2
         self.grad_specs = self.master_specs if stage >= 2 else tp_specs

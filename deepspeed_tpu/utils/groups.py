@@ -15,20 +15,23 @@ named axes play the group roles:
 
 Group semantics w.r.t. the reference:
     * the reference's "data-parallel group" (utils/groups.py:345) for
-      NON-expert params is the combined ('data','expert') axes - every device
-      holding a replica of a non-expert param;
+      NON-expert params is the combined ('data_outer','data','expert') axes
+      (DP_AXES) - every device holding a replica of a non-expert param;
     * the "expert-parallel group" (utils/groups.py:317) is the 'expert' axis;
-    * the "expert-data-parallel group" (utils/groups.py:331) is 'data';
+    * the "expert-data-parallel group" (utils/groups.py:331) is
+      ('data_outer','data');
     * the "sequence-parallel group" (utils/groups.py:452) is 'seq';
     * gradients of non-expert params are additionally summed over 'seq'
       (reference stage_1_and_2.py:1070 divides by sp size);
     * ZeRO partitions optimizer state over the data-parallel group
-      (('data','expert') here), mirroring zero/stage_1_and_2.py.
+      (DP_AXES), mirroring zero/stage_1_and_2.py; MiCS/hpZ partition over
+      the inner ('data','expert') only (INNER_DP_AXES), replicating across
+      'data_outer'.
 
 XLA inserts the collectives; these axes just name them. ICI carries any axis
-within a slice; put 'data' outermost so DCN (multi-slice) traffic is the
-infrequent gradient reduction, as the reference does with hierarchical
-ZeRO++ groups (utils/groups.py:505).
+within a slice; 'data_outer' is outermost (slowest-varying) so DCN
+(multi-slice) traffic is the infrequent cross-group reduction, as the
+reference does with hierarchical ZeRO++ groups (utils/groups.py:505).
 """
 
 from dataclasses import dataclass
@@ -37,25 +40,33 @@ import numpy as np
 import jax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-# Canonical mesh axis order. 'data' outermost (slowest-varying) so that
-# tensor/seq/expert collectives ride the fastest ICI links.
-MESH_AXES = ("pipe", "data", "expert", "seq", "tensor")
+# Canonical mesh axis order. Data parallelism is TWO axes — 'data_outer'
+# (slowest-varying; DCN across slices) x 'data' (ICI within a slice) — so
+# hierarchical ZeRO variants (MiCS zero/mics.py:64, ZeRO++ hpZ
+# utils/groups.py:505) are just "partition over 'data', replicate over
+# 'data_outer'". data_outer is size 1 unless zero_shard_size subdivides DP.
+MESH_AXES = ("pipe", "data_outer", "data", "expert", "seq", "tensor")
 
 # Axis groups (tuples usable directly inside PartitionSpec / lax collectives).
-DP_AXES = ("data", "expert")          # non-expert-param data parallelism
-EXPERT_DP_AXES = ("data",)            # expert-param data parallelism
-GRAD_REDUCE_AXES = ("data", "expert", "seq")  # non-expert grad reduction
-BATCH_AXES = ("data", "expert")       # batch dim sharding of the global batch
+DP_AXES = ("data_outer", "data", "expert")    # non-expert-param DP
+INNER_DP_AXES = ("data", "expert")            # intra-slice shard group
+EXPERT_DP_AXES = ("data_outer", "data")       # expert-param data parallelism
+GRAD_REDUCE_AXES = ("data_outer", "data", "expert", "seq")
+BATCH_AXES = ("data_outer", "data", "expert")  # batch dim of the global batch
 
 
 @dataclass(frozen=True)
 class TopologyConfig:
-    """Sizes for each mesh axis. -1 for data = fill with remaining devices."""
+    """Sizes for each mesh axis. -1 for data = fill with remaining devices.
+    ``zero_shard_size``: subdivide DP so the inner 'data' axis (the ZeRO
+    shard group for MiCS/hpZ) has this size, replicating over 'data_outer';
+    -1 = all of DP on the inner axis."""
     data_parallel_size: int = -1
     tensor_parallel_size: int = 1
     pipe_parallel_size: int = 1
     seq_parallel_size: int = 1
     expert_parallel_size: int = 1
+    zero_shard_size: int = -1
 
 
 class ParallelTopology:
@@ -79,14 +90,22 @@ class ParallelTopology:
                 f"data({dp}) * tensor({config.tensor_parallel_size}) * "
                 f"pipe({config.pipe_parallel_size}) * seq({config.seq_parallel_size}) * "
                 f"expert({config.expert_parallel_size}) = {dp * fixed} != world size {n}")
+        shard = config.zero_shard_size
+        if shard in (-1, 0):
+            shard = dp
+        if dp % shard != 0:
+            raise ValueError(
+                f"zero_shard_size {shard} does not divide data-parallel "
+                f"size {dp}")
         self.config = TopologyConfig(
             data_parallel_size=dp,
             tensor_parallel_size=config.tensor_parallel_size,
             pipe_parallel_size=config.pipe_parallel_size,
             seq_parallel_size=config.seq_parallel_size,
             expert_parallel_size=config.expert_parallel_size,
+            zero_shard_size=shard,
         )
-        shape = (self.config.pipe_parallel_size, dp,
+        shape = (self.config.pipe_parallel_size, dp // shard, shard,
                  self.config.expert_parallel_size,
                  self.config.seq_parallel_size,
                  self.config.tensor_parallel_size)
@@ -102,14 +121,20 @@ class ParallelTopology:
         return self.mesh.shape[axis]
 
     def get_data_parallel_world_size(self):
-        """Replicas of a non-expert param: data * expert axes."""
-        return self.axis_size("data") * self.axis_size("expert")
+        """Replicas of a non-expert param: data_outer * data * expert."""
+        return (self.axis_size("data_outer") * self.axis_size("data")
+                * self.axis_size("expert"))
 
     def get_expert_parallel_world_size(self):
         return self.axis_size("expert")
 
     def get_expert_data_parallel_world_size(self):
-        return self.axis_size("data")
+        return self.axis_size("data_outer") * self.axis_size("data")
+
+    def get_zero_shard_group_size(self):
+        """Intra-slice ZeRO shard group (MiCS shard group / hpZ secondary
+        partition): data * expert axes."""
+        return self.axis_size("data") * self.axis_size("expert")
 
     def get_model_parallel_world_size(self):
         return self.axis_size("tensor")

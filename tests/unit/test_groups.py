@@ -39,5 +39,5 @@ def test_batch_sharding_layout():
     topo = groups.initialize(TopologyConfig(seq_parallel_size=2), force=True)
     sh = topo.batch_sharding(seq_dim=1)
     spec = sh.spec
-    assert spec[0] == ("data", "expert")
+    assert spec[0] == ("data_outer", "data", "expert")
     assert spec[1] == "seq"

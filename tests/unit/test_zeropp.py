@@ -1,0 +1,180 @@
+"""ZeRO++ / MiCS tests: hierarchical topology, sharding plans, quantized
+collectives (reference tests/unit/runtime/comm/ + zero tests)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.models import GPT2, GPT2Config
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.groups import TopologyConfig
+
+
+TINY = GPT2Config(n_layer=2, n_head=2, d_model=64, max_seq_len=32,
+                  vocab_size=128, remat=False, dtype="float32")
+
+
+def _train(config_extra, topology=None, steps=4, seed=0):
+    groups.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2(TINY), topology=topology, seed=seed,
+        config={"train_micro_batch_size_per_gpu": 1,
+                "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                "steps_per_print": 0, **config_extra})
+    data = np.random.RandomState(3).randint(
+        0, TINY.vocab_size, (steps, engine.config.train_batch_size, 32)
+    ).astype(np.int32)
+    losses = [float(engine.train_batch({"input_ids": data[i]}))
+              for i in range(steps)]
+    return engine, losses
+
+
+class TestHierarchicalTopology:
+    def test_zero_shard_size_splits_data_axis(self):
+        groups.reset()
+        topo = groups.initialize(TopologyConfig(zero_shard_size=2))
+        assert topo.mesh.shape["data"] == 2
+        assert topo.mesh.shape["data_outer"] == 4
+        assert topo.get_data_parallel_world_size() == 8
+        assert topo.get_zero_shard_group_size() == 2
+
+    def test_default_no_split(self):
+        groups.reset()
+        topo = groups.initialize(TopologyConfig())
+        assert topo.mesh.shape["data_outer"] == 1
+        assert topo.mesh.shape["data"] == 8
+
+    def test_indivisible_raises(self):
+        groups.reset()
+        with pytest.raises(ValueError, match="zero_shard_size"):
+            groups.initialize(TopologyConfig(zero_shard_size=3))
+
+
+class TestMiCS:
+    def test_mics_shards_master_within_subgroup(self):
+        engine, _ = _train({"zero_optimization": {"stage": 2,
+                                                  "mics_shard_size": 2}},
+                           steps=1)
+        assert engine.topology.mesh.shape["data"] == 2
+        # master shards must NOT be partitioned over data_outer
+        wqkv_spec = engine.plan.master_specs["blocks"]["wqkv"]
+        flat_axes = [a for e in wqkv_spec if e is not None
+                     for a in (e if isinstance(e, tuple) else (e,))]
+        assert "data" in flat_axes and "data_outer" not in flat_axes
+
+    def test_mics_loss_matches_plain_zero2(self):
+        _, base = _train({"zero_optimization": {"stage": 2}})
+        _, mics = _train({"zero_optimization": {"stage": 2,
+                                                "mics_shard_size": 2}})
+        np.testing.assert_allclose(base, mics, rtol=2e-4, atol=2e-4)
+
+
+class TestHpZ:
+    def test_hpz_param_shard_is_inner_master_is_full(self):
+        engine, _ = _train({"zero_optimization": {"stage": 3,
+                                                  "hpz_partition_size": 2}},
+                           steps=1)
+
+        def axes_of(spec):
+            return [a for e in spec if e is not None
+                    for a in (e if isinstance(e, tuple) else (e,))]
+
+        p_axes = axes_of(engine.plan.param_specs["blocks"]["wqkv"])
+        m_axes = axes_of(engine.plan.master_specs["blocks"]["wqkv"])
+        assert "data_outer" not in p_axes      # secondary: intra-slice
+        assert "data" in p_axes
+        assert "data_outer" in m_axes          # optimizer: full DP
+
+    def test_hpz_loss_matches_plain_zero3(self):
+        _, base = _train({"zero_optimization": {"stage": 3}})
+        _, hpz = _train({"zero_optimization": {"stage": 3,
+                                               "hpz_partition_size": 2}})
+        np.testing.assert_allclose(base, hpz, rtol=2e-4, atol=2e-4)
+
+
+class TestQuantizedCollectives:
+    def _mesh(self, shard=4):
+        groups.reset()
+        return groups.initialize(
+            TopologyConfig(zero_shard_size=shard)).mesh
+
+    def test_quantized_reduce_scatter_close_to_exact(self):
+        mesh = self._mesh(shard=8)
+        x = np.random.RandomState(0).randn(8, 1024).astype(np.float32)
+
+        @jax.jit
+        def run(x):
+            def body(xs):
+                x = xs.reshape(-1)
+                return dist.quantized_reduce_scatter(x, "data")
+            return shard_map(body, mesh=mesh,
+                             in_specs=P("data"), out_specs=P("data"))(x)
+
+        out = np.asarray(run(x)).reshape(8, 128)
+        exact = x.sum(0).reshape(8, 128)
+        scale = np.abs(x).max()
+        np.testing.assert_allclose(out, exact, atol=scale * 8 * 2 / 127)
+
+    def test_quantized_all_gather_close_to_exact(self):
+        mesh = self._mesh(shard=8)
+        x = np.random.RandomState(1).randn(8, 256).astype(np.float32)
+
+        @jax.jit
+        def run(x):
+            def body(xs):
+                # stacked (W, M) gather like lax.all_gather; keep device
+                # 0's copy
+                return dist.quantized_all_gather(xs.reshape(-1), "data")
+            return shard_map(body, mesh=mesh,
+                             in_specs=P("data"), out_specs=P(None, "data"))(x)
+
+        out = np.asarray(run(x))   # (8, 8*256): gather dim x shard dim
+        full = out.reshape(8, 8, 256)[:, 0]  # device 0's gathered stack
+        np.testing.assert_allclose(full, x, atol=np.abs(x).max() / 100)
+
+    def test_hierarchical_a2a_quant_reduce(self):
+        mesh = self._mesh(shard=2)  # data=2, data_outer=4
+        x = np.random.RandomState(2).randn(8, 512).astype(np.float32)
+
+        @jax.jit
+        def run(x):
+            def body(xs):
+                return dist.all_to_all_quant_reduce(
+                    xs.reshape(-1), inner_axis="data",
+                    outer_axis="data_outer")
+            return shard_map(body, mesh=mesh,
+                             in_specs=P(("data_outer", "data")),
+                             out_specs=P(("data_outer", "data")))(x)
+
+        # output layout now matches a single reduce_scatter over the
+        # combined ('data_outer','data') axes: device (o,i) = chunk o*Wi+i
+        out = np.asarray(run(x)).reshape(-1)
+        exact = x.sum(0)
+        np.testing.assert_allclose(out, exact,
+                                   atol=np.abs(x).max() * 8 * 4 / 127)
+
+    def test_comm_volume_logged(self):
+        mesh = self._mesh(shard=8)
+        from deepspeed_tpu.comm import get_comms_logger
+        lg = get_comms_logger()
+        lg.enabled = True
+        lg.reset()
+        x = np.zeros((8, 1024), np.float32)
+
+        @jax.jit
+        def run(x):
+            def body(xs):
+                return dist.quantized_reduce_scatter(xs.reshape(-1), "data")
+            return shard_map(body, mesh=mesh,
+                             in_specs=P("data"), out_specs=P("data"))(x)
+
+        run(x)
+        names = list(lg.comms_dict)
+        lg.enabled = False
+        lg.reset()
+        assert any("quantized" in n for n in names), names
