@@ -22,7 +22,17 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.pallas import quantization as q8
-from .comm import _record
+from .logging import get_comms_logger
+
+
+def _record_wire(op_name, n_elems, block, axis_name):
+    """Log the ACTUAL bytes on the wire: int8 payload + one fp32 scale per
+    block (logging the fp32 input would claim quantization saves nothing).
+    """
+    lg = get_comms_logger()
+    if lg.enabled:
+        nblocks = -(-n_elems // block)
+        lg.append(op_name, n_elems + 4 * nblocks, axis_name)
 
 
 def _resolve_pallas(use_pallas):
@@ -39,7 +49,8 @@ def quantized_reduce_scatter(x, axis_name, average=False,
     """Reduce-scatter with int8-compressed exchange. x: (N, ...) with N
     divisible by the axis size W; returns this device's reduced
     (N // W, ...) fp32 piece (same piece order as ``lax.psum_scatter``)."""
-    _record("quantized_reduce_scatter", x, axis_name)
+    _record_wire("quantized_reduce_scatter", int(x.size), block,
+                 axis_name)
     out = q8.quantized_psum_scatter(x.astype(jnp.float32), axis_name,
                                     block=block,
                                     use_pallas=_resolve_pallas(use_pallas))
@@ -52,7 +63,7 @@ def quantized_all_gather(x, axis_name, block=q8.QUANT_BLOCK,
     weight allgather, partition_parameters.py:725 CUDAQuantizer path).
     Returns the gathered array stacked on a leading axis, like
     ``lax.all_gather``."""
-    _record("quantized_all_gather", x, axis_name)
+    _record_wire("quantized_all_gather", int(x.size), block, axis_name)
     return q8.quantized_all_gather(x, axis_name, block=block,
                                    use_pallas=_resolve_pallas(use_pallas))
 

@@ -222,8 +222,13 @@ class GPT2:
 
     def _constrain_fn(self):
         """Sharding constraints are advisory: no-ops without an active mesh
-        (single-device tests / eager use), GSPMD directives under one."""
-        if jax.sharding.get_abstract_mesh().empty:
+        (single-device tests / eager use) and under fully-manual meshes
+        (inside shard_map, e.g. the 1-bit trainer), GSPMD directives
+        otherwise."""
+        mesh = jax.sharding.get_abstract_mesh()
+        from jax.sharding import AxisType
+        if mesh.empty or not any(t == AxisType.Auto for t in
+                                 mesh.axis_types):
             return lambda x, spec: x
         return lax.with_sharding_constraint
 

@@ -1,0 +1,2 @@
+from .compressed import (pack_signs, unpack_signs, compressed_allreduce,
+                         CompressionState)

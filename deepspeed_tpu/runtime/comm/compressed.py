@@ -1,0 +1,107 @@
+"""Sign-compressed (1-bit) allreduce with error feedback.
+
+Counterpart of reference ``runtime/comm/nccl.py:16 NcclBackend``
+(``compressed_allreduce:51``) / ``runtime/comm/mpi.py`` — the transport
+under 1-bit Adam/LAMB and 0/1 Adam. Algorithm (NeurIPS'21 1-bit Adam):
+
+  worker:  c = x + worker_error          (error feedback)
+           scale_w = mean(|c_chunk|) per destination chunk
+           send sign(c_chunk) packed 1 bit/element + fp32 scale
+           worker_error = c - decompress(compressed c)
+  server:  (per owned chunk) avg = mean_w(scale_w * sign_w)
+           sc = avg + server_error
+           scale_s = mean(|sc|); server_error = sc - scale_s * sign(sc)
+           broadcast sign(sc) packed + scale_s
+  all:     result chunk = scale_s * sign(sc)
+
+On TPU the worker->server exchange is an ``all_to_all`` over the DP mesh
+axis and the server->all a ``all_gather`` — the same two hops the
+reference issues as gather/scatter, riding ICI. Bit-packing uses uint8
+lanes (8 signs/byte): 32x less wire traffic than fp32 + one fp32 scale
+per chunk. Runs INSIDE shard_map.
+"""
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pack_signs(x):
+    """(N,) float -> (ceil(N/8),) uint8 of sign bits (1 = non-negative).
+    N must be a multiple of 8 (pad upstream)."""
+    assert x.shape[0] % 8 == 0, f"pack_signs needs N % 8 == 0, got {x.shape}"
+    bits = (x >= 0).astype(jnp.uint8).reshape(-1, 8)
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
+    return jnp.sum(bits * weights, axis=1, dtype=jnp.uint8)
+
+
+def unpack_signs(packed, n):
+    """(ceil(n/8),) uint8 -> (n,) float32 in {-1, +1}."""
+    weights = (2 ** jnp.arange(8, dtype=jnp.uint8))
+    bits = (packed[:, None] & weights[None, :]) > 0
+    return jnp.where(bits.reshape(-1)[:n], 1.0, -1.0).astype(jnp.float32)
+
+
+class CompressionState(NamedTuple):
+    """Per-device error-feedback residuals. ``worker_error`` covers this
+    device's full local tensor; ``server_error`` covers the chunk this
+    device owns (N // W elements)."""
+    worker_error: jax.Array
+    server_error: jax.Array
+
+    @classmethod
+    def zeros(cls, n, world):
+        assert n % world == 0
+        return cls(worker_error=jnp.zeros((n,), jnp.float32),
+                   server_error=jnp.zeros((n // world,), jnp.float32))
+
+
+def compressed_allreduce(x, state: CompressionState, axis_name):
+    """1-bit averaged allreduce of (N,) ``x`` (N divisible by 8*W).
+
+    Returns (result (N,), new_state). Deterministic, in-trace; both error
+    buffers carry the compression residual into the next call (without
+    them sign-SGD style compression does not converge)."""
+    W = lax.axis_size(axis_name)
+    N = x.shape[0]
+    assert N % (8 * W) == 0, (
+        f"compressed_allreduce needs N divisible by 8*world={8 * W}, "
+        f"got {N}")
+    M = N // W
+
+    # ---- worker compression (error feedback)
+    c = x.astype(jnp.float32) + state.worker_error
+    chunks = c.reshape(W, M)
+    scale_w = jnp.mean(jnp.abs(chunks), axis=1)              # (W,)
+    signs_w = jnp.sign(chunks)
+    signs_w = jnp.where(signs_w == 0, 1.0, signs_w)
+    worker_error = c - (scale_w[:, None] * signs_w).reshape(N)
+    packed = jax.vmap(pack_signs)(chunks)                    # (W, M//8)
+
+    # ---- worker -> server: each device receives every worker's version
+    # of its own chunk
+    packed_x = lax.all_to_all(packed, axis_name, split_axis=0,
+                              concat_axis=0, tiled=True)     # (W, M//8)
+    scale_x = lax.all_to_all(scale_w.reshape(W, 1), axis_name,
+                             split_axis=0, concat_axis=0,
+                             tiled=True).reshape(W)
+    signs = jax.vmap(lambda p: unpack_signs(p, M))(packed_x)  # (W, M)
+    avg = jnp.mean(scale_x[:, None] * signs, axis=0)          # (M,)
+
+    # ---- server compression (its own error feedback)
+    sc = avg + state.server_error
+    scale_s = jnp.mean(jnp.abs(sc))
+    sign_s = jnp.sign(sc)
+    sign_s = jnp.where(sign_s == 0, 1.0, sign_s)
+    server_error = sc - scale_s * sign_s
+
+    # ---- server -> all
+    packed_s = pack_signs(sign_s)
+    gathered = lax.all_gather(packed_s, axis_name, axis=0)    # (W, M//8)
+    scales = lax.all_gather(scale_s, axis_name, axis=0)       # (W,)
+    out = (scales[:, None]
+           * jax.vmap(lambda p: unpack_signs(p, M))(gathered)).reshape(N)
+    return out, CompressionState(worker_error=worker_error,
+                                 server_error=server_error)

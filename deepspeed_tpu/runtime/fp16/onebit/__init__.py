@@ -1,0 +1,4 @@
+from .adam import OneBitAdam
+from .zoadam import ZeroOneAdam
+from .lamb import OneBitLamb
+from .trainer import OneBitTrainer

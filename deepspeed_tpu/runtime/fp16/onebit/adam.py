@@ -1,0 +1,66 @@
+"""1-bit Adam.
+
+Counterpart of reference ``runtime/fp16/onebit/adam.py:306 OnebitAdam``:
+dense Adam with exact allreduce for ``freeze_step`` warmup steps, then the
+variance term freezes and only the momentum is synchronized — through the
+sign-compressed, error-feedback allreduce (runtime/comm/compressed.py).
+Functional flat-vector design: the optimizer owns one (N,) state per
+buffer and runs INSIDE shard_map, consuming each device's LOCAL gradient
+(the compression replaces the gradient allreduce — handing it an already
+averaged gradient would defeat the point).
+"""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ...comm.compressed import CompressionState, compressed_allreduce
+
+
+class OneBitAdam:
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, freeze_step=100):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+
+    def init(self, n, world, with_comp=True):
+        """n: flat param count (divisible by 8*world — pad upstream).
+        ``with_comp=False`` lets the caller build the (possibly stacked)
+        error-feedback buffers itself without a throwaway allocation."""
+        state = {"m": jnp.zeros((n,), jnp.float32),
+                 "v": jnp.zeros((n,), jnp.float32),
+                 "step": jnp.zeros((), jnp.int32)}
+        if with_comp:
+            state["comp"] = CompressionState.zeros(n, world)
+        return state
+
+    def update(self, local_grad, state, params, lr=None, axis_name="data"):
+        """local_grad/params: (N,) fp32; returns (new_params, new_state).
+        Call inside shard_map over ``axis_name``."""
+        b1, b2 = self.betas
+        lr = self.lr if lr is None else lr
+        step = state["step"] + 1
+        W = lax.axis_size(axis_name)
+
+        def warmup(_):
+            g = lax.psum(local_grad, axis_name) / W
+            m = b1 * state["m"] + (1 - b1) * g
+            v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+            return m, v, state["comp"]
+
+        def compressed(_):
+            m_local = b1 * state["m"] + (1 - b1) * local_grad
+            m, comp = compressed_allreduce(m_local, state["comp"],
+                                           axis_name)
+            return m, state["v"], comp       # v frozen
+
+        m, v, comp = lax.cond(step <= self.freeze_step, warmup, compressed,
+                              None)
+        update = m / (jnp.sqrt(v) + self.eps)
+        if self.weight_decay:
+            update = update + self.weight_decay * params
+        new_params = params - lr * update
+        return new_params, {"m": m, "v": v, "comp": comp, "step": step}

@@ -1,0 +1,97 @@
+"""1-bit LAMB.
+
+Counterpart of reference ``runtime/fp16/onebit/lamb.py:443 OnebitLamb``:
+dense LAMB during warmup while recording each layer's trust ratio
+(||p|| / ||update||); after ``freeze_step`` the variance AND the per-layer
+trust ratios freeze, momentum syncs through the compressed allreduce, and
+the frozen ratios scale each layer's update (the reference additionally
+smooths the frozen ratio with ``coeff_beta``; we freeze the running
+average the same way).
+
+Flat-vector design with static per-layer ``segments`` (start, end) —
+layer boundaries in the flattened param vector.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...comm.compressed import CompressionState, compressed_allreduce
+
+
+class OneBitLamb:
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, freeze_step=100, coeff_beta=0.9,
+                 max_coeff=10.0, min_coeff=0.01, segments=None):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.freeze_step = freeze_step
+        self.coeff_beta = coeff_beta
+        self.max_coeff = max_coeff
+        self.min_coeff = min_coeff
+        self.segments = segments or []
+
+    def init(self, n, world, with_comp=True):
+        if not self.segments:
+            self.segments = [(0, n)]
+        state = {"m": jnp.zeros((n,), jnp.float32),
+                 "v": jnp.zeros((n,), jnp.float32),
+                 # running trust-ratio average per segment (frozen after
+                 # warmup)
+                 "coeff": jnp.ones((len(self.segments),), jnp.float32),
+                 "step": jnp.zeros((), jnp.int32)}
+        if with_comp:
+            state["comp"] = CompressionState.zeros(n, world)
+        return state
+
+    def _segment_scale(self, params, update, coeff_running, warm):
+        """Per-segment trust ratio; during warmup also advances the
+        running average. Returns (scaled update, new running coeffs)."""
+        out = update
+        new_coeffs = []
+        for i, (s, e) in enumerate(self.segments):
+            p_norm = jnp.linalg.norm(params[s:e])
+            u_norm = jnp.linalg.norm(update[s:e])
+            # either norm zero -> neutral 1.0 (reference OnebitLamb):
+            # zero-init tensors must not get pinned at min_coeff
+            ratio = jnp.where((p_norm > 0) & (u_norm > 0),
+                              jnp.clip(p_norm / (u_norm + self.eps),
+                                       self.min_coeff, self.max_coeff),
+                              1.0)
+            running = (self.coeff_beta * coeff_running[i]
+                       + (1 - self.coeff_beta) * ratio)
+            coeff = jnp.where(warm, ratio, coeff_running[i])
+            new_coeff = jnp.where(warm, running, coeff_running[i])
+            out = out.at[s:e].multiply(coeff)
+            new_coeffs.append(new_coeff)
+        return out, jnp.stack(new_coeffs)
+
+    def update(self, local_grad, state, params, lr=None, axis_name="data"):
+        b1, b2 = self.betas
+        lr = self.lr if lr is None else lr
+        step = state["step"] + 1
+        W = lax.axis_size(axis_name)
+        warm = step <= self.freeze_step
+
+        def warmup(_):
+            g = lax.psum(local_grad, axis_name) / W
+            m = b1 * state["m"] + (1 - b1) * g
+            v = b2 * state["v"] + (1 - b2) * jnp.square(g)
+            return m, v, state["comp"]
+
+        def compressed(_):
+            m_local = b1 * state["m"] + (1 - b1) * local_grad
+            m, comp = compressed_allreduce(m_local, state["comp"],
+                                           axis_name)
+            return m, state["v"], comp
+
+        m, v, comp = lax.cond(warm, warmup, compressed, None)
+        update = m / (jnp.sqrt(v) + self.eps)
+        if self.weight_decay:
+            update = update + self.weight_decay * params
+        update, coeff = self._segment_scale(params, update, state["coeff"],
+                                            warm)
+        new_params = params - lr * update
+        return new_params, {"m": m, "v": v, "coeff": coeff, "comp": comp,
+                            "step": step}

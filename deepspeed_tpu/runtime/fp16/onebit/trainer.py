@@ -1,0 +1,154 @@
+"""OneBitTrainer — a complete data-parallel training program around a
+1-bit optimizer.
+
+The engine's GSPMD train step lets XLA insert gradient collectives from
+shardings; 1-bit optimizers must REPLACE that collective with their
+compressed exchange, so this trainer builds the step explicitly:
+``shard_map`` over the DP axis, per-device local gradients, compressed
+momentum sync inside the optimizer (the reference reaches the same
+structure through torch DDP-bypass + custom allreduce in
+runtime/fp16/onebit/*).
+
+ALL optimizer state and the parameters are stored per-device — global
+arrays stacked (W, ...) and sharded over the DP axis — because 1-bit
+training state is genuinely per-device: error-feedback residuals always
+differ, and 0/1 Adam's local steps let params/momentum drift between sync
+points (re-converging exactly at each sync). Per-device memory equals the
+replicated layout's, and nothing pretends divergent buffers are equal.
+
+Pure-DP by design (tp/pipe/seq = 1), like the reference's 1-bit
+optimizers (incompatible with MoE/PP there too).
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ....utils import groups
+from ...comm.compressed import CompressionState
+
+
+def _flatten_info(params):
+    leaves, treedef = jax.tree.flatten(params)
+    shapes = [l.shape for l in leaves]
+    sizes = [int(np.prod(s)) for s in shapes]
+    offsets = np.cumsum([0] + sizes)
+    return treedef, shapes, sizes, offsets
+
+
+class OneBitTrainer:
+    """``t = OneBitTrainer(loss_fn, params, optimizer); t.step(batch)``.
+
+    loss_fn(params, batch) -> scalar (pure jnp). params: pytree. The
+    optimizer is OneBitAdam / ZeroOneAdam / OneBitLamb. Batches shard over
+    the 'data' axis.
+    """
+
+    def __init__(self, loss_fn, params, optimizer, topology=None,
+                 axis_name="data"):
+        self.topology = topology or groups.get_topology()
+        self.mesh = self.topology.mesh
+        if (self.topology.get_model_parallel_world_size() != 1
+                or self.topology.get_pipe_parallel_world_size() != 1
+                or self.topology.get_sequence_parallel_world_size() != 1
+                or self.topology.get_expert_parallel_world_size() != 1
+                or self.mesh.shape["data_outer"] != 1):
+            raise ValueError("1-bit optimizers support pure (flat) data "
+                             "parallelism only (like the reference)")
+        self.axis = axis_name
+        self.world = self.mesh.shape[self.axis]
+        self.loss_fn = loss_fn
+        self.optimizer = optimizer
+
+        treedef, shapes, sizes, offsets = _flatten_info(params)
+        self._treedef, self._shapes = treedef, shapes
+        self._sizes, self._offsets = sizes, offsets
+        n = int(offsets[-1])
+        self._n_pad = -(-n // (8 * self.world)) * (8 * self.world)
+        self._n = n
+
+        # give LAMB its per-tensor segments in the flat vector
+        if getattr(optimizer, "segments", None) == []:
+            optimizer.segments = [(int(offsets[i]), int(offsets[i + 1]))
+                                  for i in range(len(sizes))]
+
+        W = self.world
+        shard = NamedSharding(self.mesh, P(self.axis))
+        with jax.set_mesh(self.mesh):
+            flat = self._flatten(params)
+            # every device starts from the same values; rows may diverge
+            # later (by design, see module docstring)
+            self.flat_params = jax.device_put(
+                jnp.broadcast_to(flat, (W,) + flat.shape), shard)
+            state = optimizer.init(self._n_pad, W, with_comp=False)
+            state["comp"] = CompressionState(
+                worker_error=jnp.zeros((self._n_pad,), jnp.float32),
+                server_error=jnp.zeros((self._n_pad // W,), jnp.float32))
+            self.opt_state = jax.tree.map(
+                lambda x: jax.device_put(
+                    jnp.broadcast_to(x, (W,) + x.shape), shard), state)
+        self._step_jit = None
+
+    # ---------------------------------------------------------- flat utils
+    def _flatten(self, params):
+        leaves = jax.tree.leaves(params)
+        flat = jnp.concatenate(
+            [l.reshape(-1).astype(jnp.float32) for l in leaves])
+        return jnp.pad(flat, (0, self._n_pad - self._n))
+
+    def _unflatten(self, flat):
+        leaves = [flat[int(self._offsets[i]):int(self._offsets[i + 1])]
+                  .reshape(self._shapes[i]) for i in range(len(self._sizes))]
+        return jax.tree.unflatten(self._treedef, leaves)
+
+    @property
+    def params(self):
+        """Device 0's view (identical across devices at sync points)."""
+        return self._unflatten(self.flat_params[0])
+
+    def _build(self):
+        opt = self.optimizer
+        axis = self.axis
+        loss_fn = self.loss_fn
+        unflatten = self._unflatten
+
+        def body(flat_params, opt_state, batch, lr):
+            # all state arrives stacked (1, ...): this device's row
+            fp = flat_params[0]
+            state = jax.tree.map(lambda x: x[0], opt_state)
+
+            loss, local_grad = jax.value_and_grad(
+                lambda f: loss_fn(unflatten(f), batch))(fp)
+            new_fp, new_state = opt.update(local_grad, state, fp, lr=lr,
+                                           axis_name=axis)
+            loss = jax.lax.pmean(loss, axis)
+            return (new_fp[None], jax.tree.map(lambda x: x[None], new_state),
+                    loss)
+
+        state_specs = jax.tree.map(lambda _: P(self.axis), self.opt_state)
+
+        def step(flat_params, opt_state, batch, lr):
+            return shard_map(
+                body, mesh=self.mesh,
+                in_specs=(P(self.axis), state_specs,
+                          jax.tree.map(lambda _: P(self.axis), batch),
+                          P()),
+                out_specs=(P(self.axis), state_specs, P()),
+                check_vma=False)(flat_params, opt_state, batch, lr)
+
+        return jax.jit(step, donate_argnums=(0, 1))
+
+    def step(self, batch, lr=None):
+        """One optimizer step on a global batch (leading dim divisible by
+        the DP world size). Returns the scalar loss."""
+        if self._step_jit is None:
+            self._step_jit = self._build()
+        lr = jnp.asarray(self.optimizer.lr if lr is None else lr,
+                         jnp.float32)
+        batch = jax.tree.map(jnp.asarray, batch)
+        with jax.set_mesh(self.mesh):
+            self.flat_params, self.opt_state, loss = self._step_jit(
+                self.flat_params, self.opt_state, batch, lr)
+        return float(loss)

@@ -1,0 +1,116 @@
+"""0/1 Adam.
+
+Counterpart of reference ``runtime/fp16/onebit/zoadam.py:359 ZeroOneAdam``
+(0/1 Adam paper): no dense warmup — made stable by (a) VARIANCE FREEZING:
+v updates on an exponentially-thinning schedule until ``var_freeze_step``
+then stays fixed, and (b) LOCAL STEPS: after the freeze, devices apply
+purely local updates for k steps (k doubling up to
+``2**local_step_clipper``), accumulating them in a comm buffer; at each
+sync step the local updates are ROLLED BACK and replaced by the
+compressed-allreduced average (reference zoadam.py:243-257: p -= buffer;
+allreduce(buffer); exp_avg = buffer/lrs; p += buffer/denom), so replicas
+re-converge exactly at every sync point.
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+from ...comm.compressed import CompressionState, compressed_allreduce
+
+
+class ZeroOneAdam:
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, var_freeze_step=50,
+                 var_update_scaler=4, local_step_scaler=100,
+                 local_step_clipper=8):
+        self.lr = lr
+        self.betas = betas
+        self.eps = eps
+        self.weight_decay = weight_decay
+        self.var_freeze_step = var_freeze_step
+        self.var_update_scaler = var_update_scaler
+        self.local_step_scaler = local_step_scaler
+        self.local_step_clipper = local_step_clipper
+
+    def init(self, n, world, with_comp=True):
+        state = {"m": jnp.zeros((n,), jnp.float32),
+                 "v": jnp.zeros((n,), jnp.float32),
+                 # accumulated local updates since last sync (= sum of
+                 # -lr * update), and the lr mass behind them
+                 "buf": jnp.zeros((n,), jnp.float32),
+                 "lrs": jnp.zeros((), jnp.float32),
+                 "step": jnp.zeros((), jnp.int32)}
+        if with_comp:
+            state["comp"] = CompressionState.zeros(n, world)
+        return state
+
+    def _sync_due(self, step):
+        """After var freeze, sync every k steps; k doubles every
+        ``local_step_scaler`` steps, clipped to 2**local_step_clipper."""
+        past = jnp.maximum(step - self.var_freeze_step, 0)
+        k = jnp.minimum(past // self.local_step_scaler,
+                        self.local_step_clipper)
+        interval = 2 ** k
+        return (past % interval) == 0
+
+    def _var_update_due(self, step):
+        """Variance updates thin out exponentially before the freeze
+        (reference var_update_scaler policy)."""
+        k = step // self.var_update_scaler
+        interval = jnp.minimum(2 ** jnp.minimum(k, 16), 1 << 16)
+        return (step % interval) == 0
+
+    def update(self, local_grad, state, params, lr=None, axis_name="data"):
+        b1, b2 = self.betas
+        lr = self.lr if lr is None else lr
+        step = state["step"] + 1
+        W = lax.axis_size(axis_name)
+        frozen = step > self.var_freeze_step
+
+        def pre_freeze(_):
+            """Exact sync every step; v on its thinning schedule."""
+            g = lax.psum(local_grad, axis_name) / W
+            m = b1 * state["m"] + (1 - b1) * g
+            v_new = b2 * state["v"] + (1 - b2) * jnp.square(g)
+            v = jnp.where(self._var_update_due(step), v_new, state["v"])
+            denom = jnp.sqrt(v) + self.eps
+            upd = m / denom
+            if self.weight_decay:
+                upd = upd + self.weight_decay * params
+            p = params - lr * upd
+            return (p, m, v, state["buf"], state["lrs"], state["comp"])
+
+        def post_freeze(_):
+            """Local step + rollback/sync on schedule."""
+            m_local = b1 * state["m"] + (1 - b1) * local_grad
+            denom = jnp.sqrt(state["v"]) + self.eps
+            upd = m_local / denom
+            if self.weight_decay:
+                upd = upd + self.weight_decay * params
+            delta = -lr * upd
+            p = params + delta
+            buf = state["buf"] + delta
+            lrs = state["lrs"] + lr
+
+            def sync(args):
+                p, buf, lrs, m = args
+                p = p - buf                      # roll local updates back
+                mom_sum, comp = compressed_allreduce(
+                    buf * denom, state["comp"], axis_name)
+                m_new = -mom_sum / jnp.maximum(lrs, 1e-12)
+                p = p + mom_sum / denom          # averaged replacement
+                return (p, m_new, jnp.zeros_like(buf),
+                        jnp.zeros_like(lrs), comp)
+
+            def local(args):
+                p, buf, lrs, m = args
+                return (p, m, buf, lrs, state["comp"])
+
+            p, m, buf, lrs, comp = lax.cond(
+                self._sync_due(step), sync, local, (p, buf, lrs, m_local))
+            return (p, m, state["v"], buf, lrs, comp)
+
+        p, m, v, buf, lrs, comp = lax.cond(frozen, post_freeze, pre_freeze,
+                                           None)
+        return p, {"m": m, "v": v, "buf": buf, "lrs": lrs, "comp": comp,
+                   "step": step}

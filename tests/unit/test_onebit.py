@@ -1,0 +1,178 @@
+"""1-bit optimizer tests (reference tests/unit/runtime/half_precision/
+onebit/test_onebit.py): compressed allreduce correctness + error feedback,
+warmup-equals-dense-Adam, end-to-end convergence of all three optimizers.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+import pytest
+
+from deepspeed_tpu.runtime.comm.compressed import (
+    CompressionState, compressed_allreduce, pack_signs, unpack_signs)
+from deepspeed_tpu.runtime.fp16.onebit import (OneBitAdam, OneBitLamb,
+                                               OneBitTrainer, ZeroOneAdam)
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.groups import TopologyConfig
+
+
+def _mesh():
+    groups.reset()
+    return groups.initialize(TopologyConfig()).mesh
+
+
+class TestPackUnpack:
+    def test_roundtrip(self):
+        x = np.random.RandomState(0).randn(128).astype(np.float32)
+        packed = pack_signs(jnp.asarray(x))
+        assert packed.shape == (16,) and packed.dtype == jnp.uint8
+        signs = np.asarray(unpack_signs(packed, 128))
+        np.testing.assert_array_equal(signs, np.where(x >= 0, 1.0, -1.0))
+
+
+def _run_compressed(mesh, x, state, n_iters=1):
+    """x: (W, N) per-device values. Returns (out (W, N), final state)."""
+
+    def body(xs, we, se):
+        st = CompressionState(worker_error=we[0], server_error=se[0])
+        out, st = compressed_allreduce(xs.reshape(-1), st, "data")
+        return out[None], st.worker_error[None], st.server_error[None]
+
+    f = jax.jit(lambda x, w, s: shard_map(
+        body, mesh=mesh, in_specs=(P("data"), P("data"), P("data")),
+        out_specs=(P("data"), P("data"), P("data")), check_vma=False)(
+            x, w, s))
+    w, s = state
+    for _ in range(n_iters):
+        out, w, s = f(x, w, s)
+    return np.asarray(out), (w, s)
+
+
+class TestCompressedAllreduce:
+    def test_single_call_approximates_mean(self):
+        mesh = _mesh()
+        W, N = 8, 1024
+        x = np.random.RandomState(1).randn(W, N).astype(np.float32)
+        w0 = jnp.zeros((W, N)); s0 = jnp.zeros((W, N // 8))
+        out, _ = _run_compressed(mesh, x, (w0, s0))
+        mean = x.mean(0)
+        # every device gets the SAME result
+        for d in range(1, W):
+            np.testing.assert_array_equal(out[0], out[d])
+        # sign-compressed: coarse, but correlated with the true mean
+        corr = np.corrcoef(out[0], mean)[0, 1]
+        assert corr > 0.5, corr
+
+    def test_error_feedback_accumulates(self):
+        """Summing T compressed allreduces of the same value converges to
+        T * mean — the error-feedback guarantee (residuals re-enter)."""
+        mesh = _mesh()
+        W, N = 8, 512
+        x = np.random.RandomState(2).randn(W, N).astype(np.float32)
+        mean = x.mean(0)
+        w = jnp.zeros((W, N)); s = jnp.zeros((W, N // 8))
+        acc = np.zeros(N)
+        rels = {}
+        for t in range(1, 61):
+            out, (w, s) = _run_compressed(mesh, x, (w, s))
+            acc += out[0]
+            if t in (10, 60):
+                rels[t] = (np.linalg.norm(acc / t - mean)
+                           / np.linalg.norm(mean))
+        # residuals re-enter, so the running average keeps improving
+        # (without error feedback it plateaus at the one-shot error)
+        assert rels[60] < 0.6 * rels[10], rels
+        assert rels[60] < 0.15, rels
+
+
+def _quadratic_problem(n=256, m=512, seed=0):
+    rs = np.random.RandomState(seed)
+    A = rs.randn(m, n).astype(np.float32) / np.sqrt(n)
+    target = rs.randn(n).astype(np.float32)
+    y = A @ target
+    params = {"w": jnp.zeros((n,), jnp.float32)}
+
+    def loss_fn(params, batch):
+        pred = batch["A"] @ params["w"]
+        return jnp.mean((pred - batch["y"]) ** 2)
+
+    return params, loss_fn, {"A": A, "y": y}
+
+
+class TestOneBitAdamWarmup:
+    def test_warmup_matches_dense_adam(self):
+        """During freeze_step warmup the update must equal plain Adam on
+        the allreduced gradient."""
+        mesh = _mesh()
+        groups.reset()
+        topo = groups.initialize(TopologyConfig())
+        params, loss_fn, data = _quadratic_problem()
+        opt = OneBitAdam(lr=1e-2, freeze_step=10**9)  # never compress
+        tr = OneBitTrainer(loss_fn, params, opt, topology=topo)
+        losses = [tr.step(data) for _ in range(5)]
+
+        # dense reference: full-batch Adam on the same problem. The
+        # reference's 1-bit Adam applies NO bias correction in its update
+        # (onebit/adam.py:194 update = exp_avg/(sqrt+eps)), so compare
+        # against uncorrected Adam.
+        from deepspeed_tpu.ops.optimizers import FusedAdam
+        dense = FusedAdam(lr=1e-2, bias_correction=False)
+        p = {"w": jnp.zeros_like(params["w"])}
+        st = dense.init(p)
+        ref_losses = []
+        for _ in range(5):
+            l, g = jax.value_and_grad(lambda p: loss_fn(p, data))(p)
+            ref_losses.append(float(l))
+            p, st = dense.update(g, st, p)
+        np.testing.assert_allclose(losses, ref_losses, rtol=1e-4)
+
+    def test_compression_stage_converges(self):
+        groups.reset()
+        topo = groups.initialize(TopologyConfig())
+        params, loss_fn, data = _quadratic_problem()
+        opt = OneBitAdam(lr=1e-2, freeze_step=10)
+        tr = OneBitTrainer(loss_fn, params, opt, topology=topo)
+        losses = [tr.step(data) for _ in range(60)]
+        assert losses[-1] < 0.25 * losses[0], (losses[0], losses[-1])
+        # compression really active: error buffers non-zero
+        we = np.asarray(tr.opt_state["comp"].worker_error)
+        assert np.abs(we).max() > 0
+
+
+class TestZeroOneAdam:
+    def test_converges_without_warmup(self):
+        groups.reset()
+        topo = groups.initialize(TopologyConfig())
+        params, loss_fn, data = _quadratic_problem(seed=3)
+        opt = ZeroOneAdam(lr=1e-2, var_freeze_step=20,
+                          local_step_scaler=10)
+        tr = OneBitTrainer(loss_fn, params, opt, topology=topo)
+        losses = [tr.step(data) for _ in range(60)]
+        assert losses[-1] < 0.25 * losses[0], (losses[0], losses[-1])
+
+
+class TestOneBitLamb:
+    def test_converges_and_freezes_coeff(self):
+        groups.reset()
+        topo = groups.initialize(TopologyConfig())
+        params, loss_fn, data = _quadratic_problem(seed=4)
+        opt = OneBitLamb(lr=3e-3, freeze_step=15)
+        tr = OneBitTrainer(loss_fn, params, opt, topology=topo)
+        losses = [tr.step(data) for _ in range(20)]
+        coeff_at_freeze = np.asarray(tr.opt_state["coeff"]).copy()
+        for _ in range(10):
+            tr.step(data)
+        np.testing.assert_array_equal(
+            coeff_at_freeze, np.asarray(tr.opt_state["coeff"]))
+        assert losses[-1] < losses[0]
+
+
+class TestTrainerValidation:
+    def test_rejects_model_parallel_topology(self):
+        groups.reset()
+        topo = groups.initialize(TopologyConfig(tensor_parallel_size=2))
+        params, loss_fn, _ = _quadratic_problem()
+        with pytest.raises(ValueError, match="data parallelism only"):
+            OneBitTrainer(loss_fn, params, OneBitAdam(), topology=topo)
