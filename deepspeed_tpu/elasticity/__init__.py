@@ -1,0 +1,3 @@
+from .elasticity import (compute_elastic_config, get_compatible_chips_v01,
+                         get_compatible_chips_v02, ElasticityError,
+                         ElasticityConfig, ElasticityIncompatibleWorldSize)

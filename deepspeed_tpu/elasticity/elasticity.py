@@ -1,0 +1,167 @@
+"""Elastic training configuration.
+
+Counterpart of reference ``elasticity/elasticity.py``
+(``_get_compatible_gpus_v01:83``, ``v02:126``,
+``compute_elastic_config:233``): given the set of acceptable micro-batch
+sizes and a max acceptable global batch, compute the global batch size
+compatible with the largest set of chip counts, so training can restart at
+a different pod size without changing the effective batch (the reference's
+enforced-immutability contract). Pure arithmetic — ports semantically.
+
+v0.2 adds slice granularity (``chips_per_slice``, the analogue of
+num_gpus_per_node) and model-parallel divisibility.
+"""
+
+import math
+from dataclasses import dataclass, field
+
+import numpy as np
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+@dataclass
+class ElasticityConfig:
+    """reference elasticity/config.py ElasticityConfig."""
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: list = field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    prefer_larger_batch: bool = True
+    ignore_non_elastic_batch_info: bool = False
+    # v0.2 knobs (reference num_gpus_per_node / model_parallel_size)
+    num_gpus_per_node: int = 1
+    model_parallel_size: int = 1
+
+    @classmethod
+    def from_dict(cls, d):
+        known = set(cls.__dataclass_fields__)
+        return cls(**{k: v for k, v in (d or {}).items() if k in known})
+
+
+def _candidate_batch_sizes(base_list, max_batch):
+    candidates = set()
+    for base in base_list:
+        if base <= 0 or base > max_batch:
+            continue
+        candidates.add((max_batch // base) * base)
+    return sorted(candidates)
+
+
+def _valid_chip_counts(batch_size, micro_batches, min_chips, max_chips):
+    """Chip counts n where batch_size == micro * grad_accum * n for some
+    acceptable micro batch (reference get_valid_gpus)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        total_steps = batch_size // mb  # micro-steps across chips
+        for n in range(min_chips, min(max_chips, total_steps) + 1):
+            if total_steps % n == 0:
+                valid.add(n)
+    return sorted(valid)
+
+
+def get_compatible_chips_v01(micro_batches, max_acceptable_batch_size,
+                             min_chips=None, max_chips=None,
+                             prefer_larger=True):
+    """reference _get_compatible_gpus_v01: candidate batches from each
+    micro batch and their LCM; pick the one compatible with the most chip
+    counts (ties: larger/smaller batch per ``prefer_larger``)."""
+    min_chips = min_chips or 1
+    max_chips = max_chips or (max_acceptable_batch_size
+                              // min(micro_batches))
+    if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
+        raise ElasticityError(
+            "all micro batches must be <= max_acceptable_batch_size "
+            f"{max_acceptable_batch_size}")
+    lcm = int(np.lcm.reduce(micro_batches))
+    base_list = list(micro_batches) + [lcm]
+    best = (None, [])
+    for cand in _candidate_batch_sizes(base_list,
+                                       max_acceptable_batch_size):
+        valid = _valid_chip_counts(cand, micro_batches, min_chips,
+                                   max_chips)
+        better = len(valid) > len(best[1])
+        tie = len(valid) == len(best[1]) and best[0] is not None
+        if better or (tie and ((cand > best[0]) == prefer_larger)):
+            best = (cand, valid)
+    return best
+
+
+def get_compatible_chips_v02(micro_batches, max_acceptable_batch_size,
+                             current_num_chips, min_chips=None,
+                             max_chips=None, prefer_larger=True,
+                             chips_per_slice=1, model_parallel_size=1):
+    """reference _get_compatible_gpus_v02: v0.1 math over DP-equivalent
+    chips, then rescale by model parallelism and keep only counts that are
+    whole slices."""
+    if model_parallel_size > 1:
+        group_size = chips_per_slice * model_parallel_size
+        if current_num_chips % group_size != 0:
+            raise ElasticityIncompatibleWorldSize(
+                f"world size {current_num_chips} not divisible by "
+                f"chips_per_slice*mp = {group_size}")
+        dp_budget = max(1, current_num_chips // model_parallel_size)
+        batch, valid_dp = get_compatible_chips_v01(
+            micro_batches, max_acceptable_batch_size,
+            min_chips=min_chips, max_chips=dp_budget,
+            prefer_larger=prefer_larger)
+        valid = [v * model_parallel_size for v in valid_dp]
+    else:
+        batch, valid = get_compatible_chips_v01(
+            micro_batches, max_acceptable_batch_size,
+            min_chips=min_chips, max_chips=max_chips,
+            prefer_larger=prefer_larger)
+    valid = [v for v in valid
+             if v % chips_per_slice == 0 or v < chips_per_slice]
+    return batch, valid
+
+
+def compute_elastic_config(ds_config, target_version=0.2, world_size=0,
+                           return_microbatch=False):
+    """reference compute_elastic_config:233 — resolve (final batch,
+    valid chip counts[, micro batch for this world size]) from the
+    'elasticity' block of a config dict."""
+    if "elasticity" not in ds_config:
+        raise ElasticityError("no 'elasticity' block in config")
+    cfg = ElasticityConfig.from_dict(ds_config["elasticity"])
+    if not cfg.enabled:
+        raise ElasticityError("elasticity.enabled is false")
+    if float(cfg.version) >= 0.2:
+        final_batch, valid = get_compatible_chips_v02(
+            cfg.micro_batch_sizes, cfg.max_train_batch_size,
+            current_num_chips=world_size or cfg.min_gpus,
+            min_chips=cfg.min_gpus, max_chips=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch,
+            chips_per_slice=cfg.num_gpus_per_node,
+            model_parallel_size=cfg.model_parallel_size)
+    else:
+        final_batch, valid = get_compatible_chips_v01(
+            cfg.micro_batch_sizes, cfg.max_train_batch_size,
+            min_chips=cfg.min_gpus, max_chips=cfg.max_gpus,
+            prefer_larger=cfg.prefer_larger_batch)
+    if world_size > 0 and world_size not in valid:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in valid set {valid} for batch "
+            f"{final_batch}")
+    if not return_microbatch:
+        return final_batch, valid
+    # largest acceptable micro batch that divides this world's share
+    micro = None
+    if world_size > 0:
+        per_chip = final_batch // world_size
+        for mb in sorted(cfg.micro_batch_sizes, reverse=True):
+            if per_chip % mb == 0:
+                micro = mb
+                break
+    return final_batch, valid, micro

@@ -70,9 +70,15 @@ class OneBitTrainer:
         self._n = n
 
         # give LAMB its per-tensor segments in the flat vector
-        if getattr(optimizer, "segments", None) == []:
+        segs = getattr(optimizer, "segments", None)
+        if segs == []:
             optimizer.segments = [(int(offsets[i]), int(offsets[i + 1]))
                                   for i in range(len(sizes))]
+        elif segs and int(segs[-1][1]) > n:
+            raise ValueError(
+                f"optimizer.segments end at {segs[-1][1]} but this model "
+                f"flattens to {n} params — optimizer instances cannot be "
+                "reused across models")
 
         W = self.world
         shard = NamedSharding(self.mesh, P(self.axis))

@@ -95,6 +95,10 @@ class ZeroOneAdam:
             def sync(args):
                 p, buf, lrs, m = args
                 p = p - buf                      # roll local updates back
+                # NOTE: buf includes the decoupled weight-decay term, so
+                # the rebuilt momentum absorbs wd*p*denom — this matches
+                # the reference exactly (zoadam.py:242 accumulates the
+                # full update incl. wd; :257 rebuilds exp_avg from it).
                 mom_sum, comp = compressed_allreduce(
                     buf * denom, state["comp"], axis_name)
                 m_new = -mom_sum / jnp.maximum(lrs, 1e-12)

@@ -1,0 +1,114 @@
+"""Elasticity + autotuning tests (reference tests/unit/elasticity/,
+tests/unit/autotuning/)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.elasticity import (compute_elastic_config,
+                                      get_compatible_chips_v01,
+                                      get_compatible_chips_v02,
+                                      ElasticityError,
+                                      ElasticityIncompatibleWorldSize)
+
+
+class TestElasticityV01:
+    def test_basic_candidates(self):
+        batch, valid = get_compatible_chips_v01(
+            micro_batches=[2, 4], max_acceptable_batch_size=16)
+        # 16 is compatible with many chip counts for mb in {2,4}
+        assert batch == 16
+        assert 1 in valid and 2 in valid and 4 in valid and 8 in valid
+
+    def test_batch_divisible_constraint(self):
+        batch, valid = get_compatible_chips_v01(
+            micro_batches=[3], max_acceptable_batch_size=10)
+        assert batch == 9
+        assert valid == [1, 3]
+
+    def test_micro_batch_too_big_raises(self):
+        with pytest.raises(ElasticityError):
+            get_compatible_chips_v01([32], max_acceptable_batch_size=16)
+
+    def test_min_max_chips_window(self):
+        batch, valid = get_compatible_chips_v01(
+            [2, 4], 16, min_chips=2, max_chips=4)
+        assert all(2 <= v <= 4 for v in valid)
+
+
+class TestElasticityV02:
+    def test_model_parallel_scaling(self):
+        batch, valid = get_compatible_chips_v02(
+            [2, 4], 16, current_num_chips=8, model_parallel_size=2,
+            chips_per_slice=1)
+        # chip counts are DP counts scaled by mp=2 -> all even
+        assert all(v % 2 == 0 for v in valid)
+
+    def test_bad_world_size_raises(self):
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            get_compatible_chips_v02([2], 8, current_num_chips=3,
+                                     model_parallel_size=2,
+                                     chips_per_slice=2)
+
+
+class TestComputeElasticConfig:
+    CFG = {"elasticity": {"enabled": True, "max_train_batch_size": 32,
+                          "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                          "max_gpus": 16, "version": 0.1}}
+
+    def test_resolves(self):
+        batch, valid = compute_elastic_config(self.CFG)
+        assert batch == 32 and 8 in valid
+
+    def test_world_size_check(self):
+        batch, valid, micro = compute_elastic_config(
+            self.CFG, world_size=8, return_microbatch=True)
+        assert batch % 8 == 0
+        assert micro in (2, 4)
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(self.CFG, world_size=7)
+
+    def test_disabled_raises(self):
+        with pytest.raises(ElasticityError):
+            compute_elastic_config({"elasticity": {"enabled": False}})
+        with pytest.raises(ElasticityError):
+            compute_elastic_config({})
+
+
+class TestAutotuner:
+    def test_grid_and_random_tuners(self):
+        from deepspeed_tpu.autotuning import GridSearchTuner, RandomTuner
+        space = {"zero_stage": [0, 2], "micro_batch": [1, 2]}
+        grid = list(GridSearchTuner(space))
+        assert len(grid) == 4
+        rnd = list(RandomTuner(space, seed=1, max_trials=3))
+        assert len(rnd) == 3
+        assert all(e in grid for e in rnd)
+
+    def test_tune_picks_working_config(self, tmp_path):
+        from deepspeed_tpu.autotuning import Autotuner
+        from deepspeed_tpu.models import GPT2, GPT2Config
+        cfg = GPT2Config(n_layer=1, n_head=2, d_model=32, max_seq_len=32,
+                         vocab_size=64, remat=False, dtype="float32")
+        tuner = Autotuner(
+            GPT2(cfg),
+            base_config={"optimizer": {"type": "AdamW",
+                                       "params": {"lr": 1e-3}}},
+            steps=2, warmup=1, results_dir=str(tmp_path))
+        best_config, results = tuner.tune(
+            space={"zero_stage": [0, 1], "micro_batch": [1, 2]})
+        assert len(results) == 4
+        ok = [r for r in results if not r["error"]]
+        assert ok, results
+        best = max(ok, key=lambda r: r["samples_per_sec"])
+        assert best_config["zero_optimization"]["stage"] == \
+            best["zero_stage"]
+        saved = json.loads((tmp_path / "best_config.json").read_text())
+        assert saved["result"]["samples_per_sec"] > 0
+
+    def test_memory_estimates_ordered(self):
+        from deepspeed_tpu.autotuning import ModelInfo
+        mi = ModelInfo(num_params=1_000_000)
+        ests = [mi.memory_per_chip(s, dp_world=8) for s in (0, 1, 2, 3)]
+        assert ests[0] > ests[1] > ests[2] > ests[3]
