@@ -82,15 +82,19 @@ class Autotuner:
             0, vocab, (batch_size, seq)).astype(np.int32)}
 
     def _exp_config(self, exp):
-        """Experiment dict -> full engine config. zero_stage merges into
-        the user's zero_optimization block (preserving its sub-options);
-        any OTHER search-space key is written into the config verbatim, so
+        """Experiment dict -> full engine config. zero_stage (if tuned)
+        merges into the user's zero_optimization block (preserving its
+        sub-options); micro_batch (if tuned) sets the micro batch; any
+        OTHER search-space key is written into the config verbatim, so
         user axes like gradient_accumulation_steps really vary."""
         config = dict(self.base_config)
-        config["zero_optimization"] = {
-            **config.get("zero_optimization", {}),
-            "stage": exp["zero_stage"]}
-        config["train_micro_batch_size_per_gpu"] = exp["micro_batch"]
+        if "zero_stage" in exp:
+            config["zero_optimization"] = {
+                **config.get("zero_optimization", {}),
+                "stage": exp["zero_stage"]}
+        if "micro_batch" in exp:
+            config["train_micro_batch_size_per_gpu"] = exp["micro_batch"]
+        config.setdefault("train_micro_batch_size_per_gpu", 1)
         for k, v in exp.items():
             if k not in ("zero_stage", "micro_batch"):
                 config[k] = v
@@ -104,9 +108,9 @@ class Autotuner:
         import deepspeed_tpu
         from ..utils import groups
         groups.reset()
-        config = self._exp_config(exp)
         result = dict(exp)
         try:
+            config = self._exp_config(exp)
             engine, _, _, _ = deepspeed_tpu.initialize(
                 model=self.model, config=config)
             bsz = engine.config.train_batch_size
@@ -146,10 +150,8 @@ class Autotuner:
             raise RuntimeError("autotuning: every experiment failed; see "
                                "results")
         best = max(ok, key=lambda r: r["samples_per_sec"])
-        exp_keys = set(space)
         best_config = self._exp_config(
-            {k: v for k, v in best.items() if k in exp_keys
-             or k in ("zero_stage", "micro_batch")})
+            {k: v for k, v in best.items() if k in set(space)})
         self._write_results(best_config, best)
         return best_config, self.results
 

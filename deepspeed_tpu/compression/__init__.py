@@ -1,0 +1,3 @@
+from .compress import init_compression, redundancy_clean, CompressionManager
+from .config import get_compression_config
+from . import ops

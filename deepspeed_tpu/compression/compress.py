@@ -1,0 +1,190 @@
+"""Compression manager — init_compression / redundancy_clean.
+
+Counterpart of reference ``compression/compress.py``
+(``init_compression:100`` swaps nn.Modules for ``LinearLayer_Compress``;
+``redundancy_clean:148`` physically rewrites pruned modules). Functional
+redesign: models are param pytrees, so compression is a PARAM TRANSFORM —
+``manager.transform(params, step)`` returns the forward-visible params
+(fake-quantized / masked through straight-through estimators) and
+``manager.wrap(model)`` returns a model whose loss/apply transform params
+first, so the engine trains masters while forward sees compressed values
+(the same QAT structure the reference builds with autograd functions).
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import logger
+from . import ops
+from .config import get_compression_config
+
+
+def _path_str(path):
+    return "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                    for p in path)
+
+
+def _match(path, patterns):
+    """Patterns are real regexes fullmatched against the path ('.*'
+    matches everything; a bare '*' is accepted as that glob-ism)."""
+    return any(re.fullmatch(".*" if pat == "*" else pat, path)
+               for pat in patterns)
+
+
+class CompressionManager:
+    def __init__(self, config, example_params=None):
+        self.techniques = get_compression_config(config)
+        # plan: path -> list of (technique, params dict); built lazily
+        self._plan = None
+        self._masks = {}
+        if example_params is not None:
+            self.build_plan(example_params)
+
+    # ---------------------------------------------------------------- plan
+    def build_plan(self, params):
+        plan = {}
+        for path, leaf in jax.tree.leaves_with_path(params):
+            if getattr(leaf, "ndim", 0) < 2:
+                continue  # reference compresses Linear/Embedding weights
+            p = _path_str(path)
+            for tech, cfg in self.techniques.items():
+                for group in cfg["groups"]:
+                    if _match(p, group["modules"]):
+                        plan.setdefault(p, []).append(
+                            (tech, {**cfg["shared"], **group["params"]}))
+        self._plan = plan
+        if plan:
+            logger.info(f"compression plan covers {len(plan)} tensors: "
+                        f"{sorted(plan)[:4]}...")
+        return plan
+
+    @property
+    def plan(self):
+        return self._plan or {}
+
+    def _offset_ok(self, shared, step):
+        """Python-int/None step -> bool; traced step -> traced bool (the
+        caller selects with jnp.where so the gate works inside jit)."""
+        if step is None:
+            return True
+        offset = shared.get("schedule_offset", 0)
+        if isinstance(step, jax.Array):
+            return step >= offset
+        return step >= offset
+
+    @staticmethod
+    def _gated(ok, transformed, original):
+        if ok is True:
+            return transformed
+        if ok is False:
+            return original
+        return jnp.where(ok, transformed, original)  # traced gate
+
+    # ----------------------------------------------------------- transform
+    def transform(self, params, step=None):
+        """Forward-visible params: quantization/pruning applied via STE.
+        ``step`` gates schedule_offset (None = always on)."""
+        if self._plan is None:
+            self.build_plan(params)
+
+        def visit(path, leaf):
+            p = _path_str(path)
+            for tech, cfg in self._plan.get(p, []):
+                ok = self._offset_ok(cfg, step)
+                if ok is False:
+                    continue
+                if tech == "weight_quantization":
+                    new = ops.quantize_weight(
+                        leaf, bits=cfg.get("target_bits", 8),
+                        symmetric=cfg.get("quantization_type",
+                                          "symmetric") == "symmetric",
+                        group_size=cfg.get("quantize_groups", 0))
+                elif tech == "sparse_pruning":
+                    new = ops.apply_mask(leaf, self._mask(
+                        p, "sparse", leaf, lambda: ops.sparse_mask(
+                            leaf, 1.0 - cfg.get("dense_ratio", 0.5))))
+                elif tech == "row_pruning":
+                    new = ops.apply_mask(leaf, self._mask(
+                        p, "row", leaf, lambda: ops.row_mask(
+                            leaf, 1.0 - cfg.get("dense_ratio", 0.5))))
+                elif tech == "head_pruning":
+                    new = ops.apply_mask(leaf, self._mask(
+                        p, "head", leaf, lambda: ops.head_mask(
+                            leaf, 1.0 - cfg.get("dense_ratio", 0.5),
+                            num_heads=cfg["num_heads"])))
+                else:
+                    continue
+                leaf = self._gated(ok, new, leaf)
+            return leaf
+
+        return jax.tree.map_with_path(visit, params)
+
+    def _mask(self, path, kind, leaf, maker):
+        """Concrete params (manager built with example_params, or eager
+        use): the mask is computed ONCE and frozen, like the reference.
+        Traced params (transform running inside a jitted train step): the
+        mask is recomputed from the live masters each step — iterative
+        magnitude pruning — and is NEVER cached, because caching a tracer
+        would leak it into later retraces."""
+        key = (path, kind)
+        if key in self._masks:
+            return self._masks[key]
+        m = jax.lax.stop_gradient(maker())
+        if not isinstance(leaf, jax.core.Tracer):
+            self._masks[key] = m
+        return m
+
+    def quantize_activations(self, x):
+        cfg = self.techniques.get("activation_quantization")
+        if not cfg:
+            return x
+        shared = cfg["shared"]
+        bits = (cfg["groups"][0]["params"].get("bits", 8)
+                if cfg["groups"] else 8)
+        return ops.quantize_activation(
+            x, bits=bits,
+            symmetric=shared.get("quantization_type",
+                                 "symmetric") == "symmetric")
+
+    # ---------------------------------------------------------------- wrap
+    def wrap(self, model):
+        """Model proxy whose loss()/apply() see transformed params."""
+        return _CompressedModel(model, self)
+
+
+class _CompressedModel:
+    """``step=`` is accepted by loss() so the engine threads the traced
+    global step through to schedule_offset gating (engine._model_loss
+    passes it to any model whose loss signature has a ``step`` param)."""
+
+    def __init__(self, model, manager):
+        self._model = model
+        self._manager = manager
+
+    def __getattr__(self, name):
+        return getattr(self._model, name)
+
+    def loss(self, params, batch, step=None, **kw):
+        return self._model.loss(
+            self._manager.transform(params, step=step), batch, **kw)
+
+    def apply(self, params, *args, step=None, **kw):
+        return self._model.apply(
+            self._manager.transform(params, step=step), *args, **kw)
+
+
+def init_compression(model, ds_config, example_params=None, mpu=None):
+    """reference compress.py:100 init_compression — returns
+    (wrapped_model, manager)."""
+    manager = CompressionManager(ds_config, example_params=example_params)
+    return manager.wrap(model), manager
+
+
+def redundancy_clean(params, manager):
+    """reference compress.py:148 — bake the compression in: returns params
+    with masks permanently applied and quantization materialized (no STE),
+    ready for export/inference."""
+    out = manager.transform(params)
+    return jax.tree.map(jax.lax.stop_gradient, out)

@@ -1,0 +1,49 @@
+"""Compression config parsing (reference compression/config.py +
+constants.py, condensed to the knobs the functional ops support).
+
+Layout (mirrors the reference's ``compression_training`` block):
+
+    "compression_training": {
+      "weight_quantization": {
+        "shared_parameters": {"enabled": true, "quantizer_kernel": false,
+          "schedule_offset": 0, "quantize_groups": 1,
+          "quantization_type": "symmetric"},
+        "different_groups": {
+          "wq1": {"params": {"target_bits": 8},
+                   "modules": ["blocks/wqkv", "blocks/w.*"]}}},
+      "activation_quantization": {...},
+      "sparse_pruning":   {... "params": {"dense_ratio": 0.5}},
+      "row_pruning":      {...},
+      "head_pruning":     {... "params": {"dense_ratio": 0.5,
+                                           "num_heads": 12}}
+    }
+
+``modules`` are REGEX patterns matched against '/'-joined param-tree
+paths (the functional analogue of module names).
+"""
+
+COMPRESSION_TRAINING = "compression_training"
+
+TECHNIQUES = ("weight_quantization", "activation_quantization",
+              "sparse_pruning", "row_pruning", "head_pruning")
+
+
+def get_compression_config(ds_config):
+    """-> {technique: {"shared": {...}, "groups": [ {name, params,
+    modules} ]}} for enabled techniques."""
+    block = (ds_config or {}).get(COMPRESSION_TRAINING, {})
+    out = {}
+    for tech in TECHNIQUES:
+        sub = block.get(tech)
+        if not sub:
+            continue
+        shared = dict(sub.get("shared_parameters", {}))
+        if not shared.get("enabled", False):
+            continue
+        groups = []
+        for name, g in sub.get("different_groups", {}).items():
+            groups.append({"name": name,
+                           "params": dict(g.get("params", {})),
+                           "modules": list(g.get("modules", ["*"]))})
+        out[tech] = {"shared": shared, "groups": groups}
+    return out

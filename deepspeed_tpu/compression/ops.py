@@ -1,0 +1,114 @@
+"""Compression primitives: fake quantization and pruning masks.
+
+Counterpart of reference ``compression/basic_layer.py`` (the compute inside
+``LinearLayer_Compress:121`` / ``Embedding_Compress``) and
+``compression/utils.py``. Functional: each op maps (param, step) -> param
+with the compression applied through a straight-through estimator (STE) —
+forward sees the quantized/pruned value, backward passes gradients to the
+full-precision master (exactly what the reference's autograd functions do).
+"""
+
+import jax
+import jax.numpy as jnp
+
+
+def _ste(x, transformed):
+    """Straight-through: forward = transformed, grad flows to x."""
+    return x + jax.lax.stop_gradient(transformed - x)
+
+
+# ------------------------------------------------------------ quantization
+def quantize_weight(w, bits=8, symmetric=True, group_size=0):
+    """Fake-quantize to ``bits`` with per-tensor (group_size=0) or
+    per-group absmax/minmax scaling (reference quantize_weights,
+    basic_layer.py qat path)."""
+    orig_shape = w.shape
+    wf = w.astype(jnp.float32)
+    if group_size and w.size % group_size == 0:
+        wf = wf.reshape(-1, group_size)
+        axis, keep = 1, True
+    else:
+        wf = wf.reshape(1, -1)
+        axis, keep = 1, True
+    levels = 2 ** (bits - 1) - 1
+    if symmetric:
+        scale = jnp.max(jnp.abs(wf), axis=axis, keepdims=keep) / levels
+        scale = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(wf / scale), -levels - 1, levels) * scale
+    else:
+        lo = jnp.min(wf, axis=axis, keepdims=keep)
+        hi = jnp.max(wf, axis=axis, keepdims=keep)
+        span = jnp.maximum(hi - lo, 1e-8)
+        steps = 2 ** bits - 1
+        q = jnp.round((wf - lo) / span * steps) / steps * span + lo
+    q = q.reshape(orig_shape).astype(w.dtype)
+    return _ste(w, q)
+
+
+def quantize_activation(x, bits=8, symmetric=True):
+    """Dynamic per-tensor activation fake-quant (reference
+    activation_quantization)."""
+    levels = 2 ** (bits - 1) - 1 if symmetric else 2 ** bits - 1
+    xf = x.astype(jnp.float32)
+    if symmetric:
+        scale = jnp.max(jnp.abs(xf)) / levels
+        scale = jnp.where(scale > 0, scale, 1.0)
+        q = jnp.clip(jnp.round(xf / scale), -levels - 1, levels) * scale
+    else:
+        lo, hi = jnp.min(xf), jnp.max(xf)
+        span = jnp.maximum(hi - lo, 1e-8)
+        q = jnp.round((xf - lo) / span * levels) / levels * span + lo
+    return _ste(x, q.astype(x.dtype))
+
+
+# ----------------------------------------------------------------- pruning
+def sparse_mask(w, ratio):
+    """Unstructured magnitude mask: zero the smallest ``ratio`` fraction
+    (reference sparse_pruning, method 'l1')."""
+    k = int(round(w.size * (1.0 - ratio)))
+    flat = jnp.abs(w.reshape(-1))
+    if k <= 0:
+        return jnp.zeros_like(w, dtype=bool)
+    thresh = jnp.sort(flat)[-k]
+    return (jnp.abs(w) >= thresh)
+
+
+def row_mask(w, ratio, axis=0):
+    """Structured mask zeroing the lowest-L1 rows along ``axis``
+    (reference row_pruning)."""
+    other = tuple(i for i in range(w.ndim) if i != axis)
+    norms = jnp.sum(jnp.abs(w), axis=other)
+    n = norms.shape[0]
+    keep = max(1, int(round(n * (1.0 - ratio))))
+    thresh = jnp.sort(norms)[-keep]
+    mask1d = norms >= thresh
+    shape = [1] * w.ndim
+    shape[axis] = n
+    return jnp.broadcast_to(mask1d.reshape(shape), w.shape)
+
+
+def head_mask(w, ratio, num_heads, head_axis=-1):
+    """Zero whole attention heads by L1 norm: w's ``head_axis`` dim is
+    split into ``num_heads`` groups (reference head_pruning on the
+    attention output projection)."""
+    ax = head_axis % w.ndim
+    d = w.shape[ax]
+    assert d % num_heads == 0, (d, num_heads)
+    hd = d // num_heads
+    moved = jnp.moveaxis(w, ax, 0).reshape(num_heads, hd, -1)
+    norms = jnp.sum(jnp.abs(moved), axis=(1, 2))
+    keep = max(1, int(round(num_heads * (1.0 - ratio))))
+    thresh = jnp.sort(norms)[-keep]
+    mask_h = norms >= thresh                       # (H,)
+    mask = jnp.broadcast_to(mask_h[:, None, None], moved.shape)
+    mask = mask.reshape(num_heads * hd, -1)
+    mask = jnp.moveaxis(mask.reshape((d,) + tuple(
+        s for i, s in enumerate(jnp.moveaxis(w, ax, 0).shape) if i > 0)),
+        0, ax)
+    return mask
+
+
+def apply_mask(w, mask):
+    """STE-masked weight: forward zeroed, grads still reach the master
+    (reference keeps the mask fixed and multiplies in forward)."""
+    return _ste(w, w * mask.astype(w.dtype))

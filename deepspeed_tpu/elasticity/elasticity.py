@@ -111,12 +111,15 @@ def get_compatible_chips_v02(micro_batches, max_acceptable_batch_size,
             raise ElasticityIncompatibleWorldSize(
                 f"world size {current_num_chips} not divisible by "
                 f"chips_per_slice*mp = {group_size}")
-        dp_budget = max(1, current_num_chips // model_parallel_size)
+        # chip bounds rescale to DP-replica units under model parallelism
+        mp = model_parallel_size
+        min_dp = -(-(min_chips or 1) // mp)
+        max_dp = (max_chips // mp) if max_chips else None
         batch, valid_dp = get_compatible_chips_v01(
             micro_batches, max_acceptable_batch_size,
-            min_chips=min_chips, max_chips=dp_budget,
+            min_chips=min_dp, max_chips=max_dp,
             prefer_larger=prefer_larger)
-        valid = [v * model_parallel_size for v in valid_dp]
+        valid = [v * mp for v in valid_dp]
     else:
         batch, valid = get_compatible_chips_v01(
             micro_batches, max_acceptable_batch_size,
@@ -156,12 +159,15 @@ def compute_elastic_config(ds_config, target_version=0.2, world_size=0,
             f"{final_batch}")
     if not return_microbatch:
         return final_batch, valid
-    # largest acceptable micro batch that divides this world's share
+    # largest acceptable micro batch that divides a DP replica's share
+    # (the batch splits over DP replicas, not over model-parallel chips)
     micro = None
     if world_size > 0:
-        per_chip = final_batch // world_size
+        mp = cfg.model_parallel_size if float(cfg.version) >= 0.2 else 1
+        dp = max(1, world_size // mp)
+        per_replica = final_batch // dp
         for mb in sorted(cfg.micro_batch_sizes, reverse=True):
-            if per_chip % mb == 0:
+            if per_replica % mb == 0:
                 micro = mb
                 break
     return final_batch, valid, micro

@@ -221,10 +221,21 @@ class DeepSpeedEngine:
         return out
 
     # -------------------------------------------------------------- programs
-    def _model_loss(self, params, batch, rng):
+    def _loss_accepts_step(self):
+        import inspect
+        try:
+            return "step" in inspect.signature(self.model.loss).parameters
+        except (TypeError, ValueError):
+            return False
+
+    def _model_loss(self, params, batch, rng, step=None):
         kwargs = {}
         if self.topology.get_sequence_parallel_world_size() > 1:
             kwargs["seq_sharded"] = True
+        # schedule-aware models (e.g. compression wrappers) take the
+        # traced global step for schedule_offset gating
+        if step is not None and self._loss_accepts_step():
+            kwargs["step"] = step
         return self.model.loss(params, batch, rng=rng, train=True, **kwargs)
 
     def _build_programs(self):
@@ -238,9 +249,11 @@ class DeepSpeedEngine:
         use_master = self.use_master
         constrain = jax.lax.with_sharding_constraint
 
-        def micro_loss_and_grads(params, micro_batch, rng, scale):
+        def micro_loss_and_grads(params, micro_batch, rng, scale,
+                                 step=None):
             def scaled(p):
-                return self._model_loss(p, micro_batch, rng) * scale
+                return self._model_loss(p, micro_batch, rng,
+                                        step=step) * scale
             loss_scaled, grads = jax.value_and_grad(scaled)(params)
             # accumulate/reduce in fp32 (reference grad_accum_dtype default)
             grads = _tree_cast(grads, jnp.float32)
@@ -291,7 +304,8 @@ class DeepSpeedEngine:
             def body(carry, micro):
                 acc, rng, i = carry
                 loss, grads = micro_loss_and_grads(
-                    state["params"], micro, jax.random.fold_in(rng, i), scale)
+                    state["params"], micro, jax.random.fold_in(rng, i),
+                    scale, step=state["step"])
                 grads = jax.tree.map(lambda g, s: constrain(g, s),
                                      grads, grad_specs)
                 acc = jax.tree.map(lambda a, g: a + g / gas, acc, grads)
@@ -315,7 +329,7 @@ class DeepSpeedEngine:
             scale = state["scale"]["scale"]
             rng = jax.random.fold_in(state["rng"], micro_idx)
             loss, grads = micro_loss_and_grads(state["params"], batch, rng,
-                                               scale)
+                                               scale, step=state["step"])
             grads = jax.tree.map(lambda g, s: constrain(g, s), grads,
                                  grad_specs)
             return loss, grads

@@ -74,7 +74,7 @@ class OneBitTrainer:
         if segs == []:
             optimizer.segments = [(int(offsets[i]), int(offsets[i + 1]))
                                   for i in range(len(sizes))]
-        elif segs and int(segs[-1][1]) > n:
+        elif segs and int(segs[-1][1]) != n:
             raise ValueError(
                 f"optimizer.segments end at {segs[-1][1]} but this model "
                 f"flattens to {n} params — optimizer instances cannot be "

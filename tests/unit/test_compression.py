@@ -1,0 +1,148 @@
+"""Compression tests (reference tests/unit/compression/test_compression.py
+scaled to the functional design)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.compression import (CompressionManager, init_compression,
+                                       redundancy_clean, ops)
+from deepspeed_tpu.models import GPT2, GPT2Config
+
+
+TINY = GPT2Config(n_layer=2, n_head=4, d_model=32, max_seq_len=32,
+                  vocab_size=64, remat=False, dtype="float32")
+
+
+class TestOps:
+    def test_quantize_weight_levels(self):
+        w = jnp.asarray(np.random.RandomState(0).randn(64, 64),
+                        jnp.float32)
+        q = ops.quantize_weight(w, bits=4)
+        # 4-bit symmetric: at most 16 distinct values per tensor
+        assert len(np.unique(np.asarray(q))) <= 16
+        # 8-bit is closer to the original than 4-bit
+        e8 = np.abs(np.asarray(ops.quantize_weight(w, bits=8)) - w).mean()
+        e4 = np.abs(np.asarray(q) - w).mean()
+        assert e8 < e4
+
+    def test_quantize_ste_gradient(self):
+        """Backward must be identity (straight-through)."""
+        w = jnp.asarray(np.random.RandomState(1).randn(32, 32), jnp.float32)
+        g = jax.grad(lambda w: jnp.sum(ops.quantize_weight(w, bits=4)))(w)
+        np.testing.assert_allclose(np.asarray(g), 1.0)
+
+    def test_sparse_mask_ratio(self):
+        w = jnp.asarray(np.random.RandomState(2).randn(40, 40), jnp.float32)
+        m = ops.sparse_mask(w, ratio=0.75)
+        assert abs(np.asarray(m).mean() - 0.25) < 0.01
+        # keeps the largest magnitudes
+        kept = np.abs(np.asarray(w))[np.asarray(m)]
+        dropped = np.abs(np.asarray(w))[~np.asarray(m)]
+        assert kept.min() >= dropped.max() - 1e-6
+
+    def test_row_mask_structure(self):
+        w = jnp.asarray(np.random.RandomState(3).randn(16, 8), jnp.float32)
+        m = np.asarray(ops.row_mask(w, ratio=0.5, axis=0))
+        rows = m.all(axis=1) | (~m).any(axis=1)
+        assert rows.all()                       # each row all-true or all-false
+        assert m.all(axis=1).sum() == 8         # half the rows kept
+
+    def test_head_mask_structure(self):
+        w = jnp.asarray(np.random.RandomState(4).randn(24, 12), jnp.float32)
+        m = np.asarray(ops.head_mask(w, ratio=0.5, num_heads=4,
+                                     head_axis=0))
+        # 4 heads of 6 rows: exactly 2 heads survive, whole
+        per_head = m.reshape(4, 6, 12)
+        head_on = per_head.all(axis=(1, 2))
+        head_off = (~per_head).all(axis=(1, 2))
+        assert (head_on | head_off).all() and head_on.sum() == 2
+
+    def test_quantize_activation(self):
+        x = jnp.asarray(np.random.RandomState(5).randn(128), jnp.float32)
+        q = ops.quantize_activation(x, bits=8)
+        assert np.abs(np.asarray(q) - np.asarray(x)).max() < 0.05
+
+
+CONFIG = {
+    "compression_training": {
+        "weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "quantization_type": "symmetric"},
+            "different_groups": {
+                "wq": {"params": {"target_bits": 8},
+                       "modules": ["blocks/wqkv", "blocks/wup"]}}},
+        "sparse_pruning": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0},
+            "different_groups": {
+                "sp": {"params": {"dense_ratio": 0.5},
+                       "modules": ["blocks/wdown"]}}},
+    }
+}
+
+
+class TestManager:
+    def test_plan_matches_patterns(self):
+        model = GPT2(TINY)
+        params = model.init(jax.random.key(0))
+        mgr = CompressionManager(CONFIG, example_params=params)
+        assert "blocks/wqkv" in mgr.plan
+        assert "blocks/wup" in mgr.plan
+        assert "blocks/wdown" in mgr.plan
+        assert "wte" not in mgr.plan
+
+    def test_transform_applies(self):
+        model = GPT2(TINY)
+        params = model.init(jax.random.key(0))
+        mgr = CompressionManager(CONFIG, example_params=params)
+        out = mgr.transform(params)
+        # wdown: half zeroed
+        frac = (np.asarray(out["blocks"]["wdown"]) == 0).mean()
+        assert abs(frac - 0.5) < 0.02
+        # untouched tensors identical
+        np.testing.assert_array_equal(np.asarray(out["wte"]),
+                                      np.asarray(params["wte"]))
+
+    def test_schedule_offset_gates(self):
+        cfg = {"compression_training": {"weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 10},
+            "different_groups": {"g": {"params": {"target_bits": 4},
+                                       "modules": ["blocks/wqkv"]}}}}}
+        model = GPT2(TINY)
+        params = model.init(jax.random.key(0))
+        mgr = CompressionManager(cfg, example_params=params)
+        before = mgr.transform(params, step=5)
+        np.testing.assert_array_equal(
+            np.asarray(before["blocks"]["wqkv"]),
+            np.asarray(params["blocks"]["wqkv"]))
+        after = mgr.transform(params, step=10)
+        assert not np.array_equal(np.asarray(after["blocks"]["wqkv"]),
+                                  np.asarray(params["blocks"]["wqkv"]))
+
+    def test_wrapped_model_trains(self):
+        from deepspeed_tpu.utils import groups
+        groups.reset()
+        model, mgr = init_compression(GPT2(TINY), CONFIG)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                    "steps_per_print": 0})
+        data = np.random.RandomState(0).randint(
+            0, 64, (engine.config.train_batch_size, 32)).astype(np.int32)
+        losses = [float(engine.train_batch({"input_ids": data}))
+                  for _ in range(6)]
+        assert losses[-1] < losses[0]
+        # masters stay dense (masked only in forward)
+        master_wdown = np.asarray(
+            jax.device_get(engine.state["master"]["blocks"]["wdown"]))
+        assert (master_wdown == 0).mean() < 0.1
+
+    def test_redundancy_clean_bakes(self):
+        model = GPT2(TINY)
+        params = model.init(jax.random.key(0))
+        mgr = CompressionManager(CONFIG, example_params=params)
+        cleaned = redundancy_clean(params, mgr)
+        assert (np.asarray(cleaned["blocks"]["wdown"]) == 0).mean() > 0.4

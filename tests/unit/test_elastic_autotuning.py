@@ -112,3 +112,58 @@ class TestAutotuner:
         mi = ModelInfo(num_params=1_000_000)
         ests = [mi.memory_per_chip(s, dp_world=8) for s in (0, 1, 2, 3)]
         assert ests[0] > ests[1] > ests[2] > ests[3]
+
+
+class TestElasticityV02Fixes:
+    def test_scale_up_beyond_current_world(self):
+        from deepspeed_tpu.elasticity import get_compatible_chips_v02
+        batch, valid = get_compatible_chips_v02(
+            [2, 4], 64, current_num_chips=8, max_chips=64,
+            model_parallel_size=2)
+        assert 16 in valid and 32 in valid  # scale-up allowed
+
+    def test_micro_batch_uses_dp_share(self):
+        cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 16,
+                              "micro_batch_sizes": [4], "min_gpus": 1,
+                              "max_gpus": 64, "version": 0.2,
+                              "model_parallel_size": 2}}
+        batch, valid, micro = compute_elastic_config(
+            cfg, world_size=8, return_microbatch=True)
+        assert micro == 4  # dp=4 replicas, 16/4=4 per replica
+
+    def test_min_chips_rescaled_by_mp(self):
+        from deepspeed_tpu.elasticity import get_compatible_chips_v02
+        batch, valid = get_compatible_chips_v02(
+            [2, 4], 16, current_num_chips=16, min_chips=4,
+            model_parallel_size=2)
+        assert 4 in valid  # 4 chips = dp 2, satisfies min_gpus=4
+
+
+class TestAutotunerCustomSpace:
+    def test_user_axis_only_space(self, tmp_path):
+        from deepspeed_tpu.autotuning import Autotuner
+        from deepspeed_tpu.models import GPT2, GPT2Config
+        cfg = GPT2Config(n_layer=1, n_head=2, d_model=32, max_seq_len=32,
+                         vocab_size=64, remat=False, dtype="float32")
+        tuner = Autotuner(
+            GPT2(cfg),
+            base_config={"optimizer": {"type": "AdamW",
+                                       "params": {"lr": 1e-3}},
+                         "train_micro_batch_size_per_gpu": 1},
+            steps=1, warmup=1, results_dir=str(tmp_path))
+        best_config, results = tuner.tune(
+            space={"gradient_accumulation_steps": [1, 2]})
+        assert len(results) == 2
+        assert all(not r["error"] for r in results), results
+        assert best_config["gradient_accumulation_steps"] in (1, 2)
+
+    def test_zero_suboptions_preserved(self):
+        from deepspeed_tpu.autotuning import Autotuner
+        from deepspeed_tpu.models import GPT2, GPT2Config
+        cfg = GPT2Config(n_layer=1, n_head=2, d_model=32, max_seq_len=32,
+                         vocab_size=64)
+        t = Autotuner(GPT2(cfg), base_config={
+            "zero_optimization": {"stage": 1, "overlap_comm": False}})
+        c = t._exp_config({"zero_stage": 2, "micro_batch": 2})
+        assert c["zero_optimization"] == {"stage": 2,
+                                          "overlap_comm": False}
