@@ -65,14 +65,11 @@ class CompressionManager:
         return self._plan or {}
 
     def _offset_ok(self, shared, step):
-        """Python-int/None step -> bool; traced step -> traced bool (the
-        caller selects with jnp.where so the gate works inside jit)."""
+        """None -> True; python int -> bool; traced step -> traced bool
+        (the caller selects with jnp.where so the gate works in jit)."""
         if step is None:
             return True
-        offset = shared.get("schedule_offset", 0)
-        if isinstance(step, jax.Array):
-            return step >= offset
-        return step >= offset
+        return step >= shared.get("schedule_offset", 0)
 
     @staticmethod
     def _gated(ok, transformed, original):
@@ -96,11 +93,17 @@ class CompressionManager:
                 if ok is False:
                     continue
                 if tech == "weight_quantization":
+                    # quantize_groups is a group COUNT (reference
+                    # semantics): 1 group = per-tensor scaling
+                    n_groups = int(cfg.get("quantize_groups", 1))
+                    gsize = (leaf.size // n_groups
+                             if n_groups > 1 and leaf.size % n_groups == 0
+                             else 0)
                     new = ops.quantize_weight(
                         leaf, bits=cfg.get("target_bits", 8),
                         symmetric=cfg.get("quantization_type",
                                           "symmetric") == "symmetric",
-                        group_size=cfg.get("quantize_groups", 0))
+                        group_size=gsize)
                 elif tech == "sparse_pruning":
                     new = ops.apply_mask(leaf, self._mask(
                         p, "sparse", leaf, lambda: ops.sparse_mask(

@@ -1,0 +1,174 @@
+// Async file I/O thread pool.
+//
+// Counterpart of the reference's csrc/aio/ (deepspeed_aio_common.cpp libaio
+// submit/poll loop + deepspeed_aio_thread.cpp pool + py_ds_aio.cpp
+// binding): a pool of worker threads doing chunked pread/pwrite with
+// optional fsync, addressed through a C ABI for ctypes (no pybind11).
+// Plain p{read,write} instead of io_submit: TPU-host swap traffic is
+// sequential bulk I/O where a thread pool saturates NVMe just as well,
+// with no O_DIRECT alignment constraints on the caller's buffers.
+//
+// Request lifecycle: submit -> int64 id; wait(id) joins that request and
+// returns its status (0 ok, -errno on failure). The caller must keep the
+// buffer alive until wait() returns (the python binding pins it).
+
+#include <cerrno>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+struct Task {
+  int64_t id;
+  bool is_write;
+  std::string path;
+  void *buf;
+  int64_t nbytes;
+  int do_fsync;
+};
+
+struct Pool {
+  std::vector<std::thread> workers;
+  std::deque<Task> queue;
+  std::map<int64_t, int> done; // id -> status (0 / -errno)
+  std::mutex mu;
+  std::condition_variable cv_task;
+  std::condition_variable cv_done;
+  int64_t next_id = 1;
+  int64_t block_size;
+  bool stop = false;
+
+  explicit Pool(int threads, int64_t block) : block_size(block) {
+    for (int i = 0; i < threads; ++i)
+      workers.emplace_back([this] { run(); });
+  }
+
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> l(mu);
+      stop = true;
+    }
+    cv_task.notify_all();
+    for (auto &w : workers)
+      w.join();
+  }
+
+  int execute(const Task &t) {
+    int flags = t.is_write ? (O_WRONLY | O_CREAT | O_TRUNC) : O_RDONLY;
+    int fd = ::open(t.path.c_str(), flags, 0644);
+    if (fd < 0)
+      return -errno;
+    int status = 0;
+    int64_t off = 0;
+    char *p = static_cast<char *>(t.buf);
+    while (off < t.nbytes) {
+      int64_t chunk = t.nbytes - off;
+      if (block_size > 0 && chunk > block_size)
+        chunk = block_size;
+      ssize_t n = t.is_write ? ::pwrite(fd, p + off, chunk, off)
+                             : ::pread(fd, p + off, chunk, off);
+      if (n < 0) {
+        if (errno == EINTR)
+          continue;
+        status = -errno;
+        break;
+      }
+      if (n == 0) { // short file on read
+        status = -EIO;
+        break;
+      }
+      off += n;
+    }
+    if (status == 0 && t.is_write && t.do_fsync)
+      if (::fsync(fd) != 0)
+        status = -errno;
+    ::close(fd);
+    return status;
+  }
+
+  void run() {
+    for (;;) {
+      Task t;
+      {
+        std::unique_lock<std::mutex> l(mu);
+        cv_task.wait(l, [this] { return stop || !queue.empty(); });
+        if (stop && queue.empty())
+          return;
+        t = queue.front();
+        queue.pop_front();
+      }
+      int status = execute(t);
+      {
+        std::lock_guard<std::mutex> l(mu);
+        done[t.id] = status;
+      }
+      cv_done.notify_all();
+    }
+  }
+
+  int64_t submit(bool is_write, const char *path, void *buf, int64_t nbytes,
+                 int do_fsync) {
+    std::lock_guard<std::mutex> l(mu);
+    int64_t id = next_id++;
+    queue.push_back(Task{id, is_write, path, buf, nbytes, do_fsync});
+    cv_task.notify_one();
+    return id;
+  }
+
+  int wait(int64_t id) {
+    std::unique_lock<std::mutex> l(mu);
+    cv_done.wait(l, [this, id] { return done.count(id) > 0; });
+    int status = done[id];
+    done.erase(id);
+    return status;
+  }
+};
+
+} // namespace
+
+extern "C" {
+
+void *aio_create(int threads, int64_t block_size) {
+  if (threads < 1)
+    threads = 1;
+  return new Pool(threads, block_size);
+}
+
+void aio_destroy(void *h) { delete static_cast<Pool *>(h); }
+
+int64_t aio_submit_pwrite(void *h, const char *path, const void *buf,
+                          int64_t nbytes, int do_fsync) {
+  return static_cast<Pool *>(h)->submit(
+      true, path, const_cast<void *>(buf), nbytes, do_fsync);
+}
+
+int64_t aio_submit_pread(void *h, const char *path, void *buf,
+                         int64_t nbytes) {
+  return static_cast<Pool *>(h)->submit(false, path, buf, nbytes, 0);
+}
+
+int aio_wait(void *h, int64_t id) { return static_cast<Pool *>(h)->wait(id); }
+
+// blocking helpers (reference sync_pread/sync_pwrite)
+int aio_pwrite(void *h, const char *path, const void *buf, int64_t nbytes,
+               int do_fsync) {
+  Pool *p = static_cast<Pool *>(h);
+  return p->wait(p->submit(true, path, const_cast<void *>(buf), nbytes,
+                           do_fsync));
+}
+
+int aio_pread(void *h, const char *path, void *buf, int64_t nbytes) {
+  Pool *p = static_cast<Pool *>(h);
+  return p->wait(p->submit(false, path, buf, nbytes, 0));
+}
+
+} // extern "C"

@@ -78,8 +78,10 @@ def get_compatible_chips_v01(micro_batches, max_acceptable_batch_size,
     micro batch and their LCM; pick the one compatible with the most chip
     counts (ties: larger/smaller batch per ``prefer_larger``)."""
     min_chips = min_chips or 1
-    max_chips = max_chips or (max_acceptable_batch_size
-                              // min(micro_batches))
+    if max_chips is None:
+        max_chips = max_acceptable_batch_size // min(micro_batches)
+    # max_chips == 0 is a REAL bound (e.g. max_gpus < model_parallel_size
+    # rescaled to DP units) and yields an empty valid set, not the default
     if not all(mb <= max_acceptable_batch_size for mb in micro_batches):
         raise ElasticityError(
             "all micro batches must be <= max_acceptable_batch_size "
@@ -114,7 +116,7 @@ def get_compatible_chips_v02(micro_batches, max_acceptable_batch_size,
         # chip bounds rescale to DP-replica units under model parallelism
         mp = model_parallel_size
         min_dp = -(-(min_chips or 1) // mp)
-        max_dp = (max_chips // mp) if max_chips else None
+        max_dp = (max_chips // mp) if max_chips is not None else None
         batch, valid_dp = get_compatible_chips_v01(
             micro_batches, max_acceptable_batch_size,
             min_chips=min_dp, max_chips=max_dp,

@@ -76,6 +76,14 @@ class CkptWriterBuilder(OpBuilder):
         return ["ckpt_writer.cpp"]
 
 
+class AsyncIOBuilder(OpBuilder):
+    """reference op_builder/async_io.py AsyncIOBuilder (csrc/aio/)."""
+    NAME = "async_io"
+
+    def sources(self):
+        return ["aio.cpp"]
+
+
 class _PallasBuilder(OpBuilder):
     """Pallas kernels: load() imports the python module."""
     MODULE = None
@@ -104,8 +112,9 @@ class QuantizerBuilder(_PallasBuilder):
 
 
 BUILDERS = {
-    b.NAME: b for b in (CkptWriterBuilder, FlashAttnBuilder,
-                        FusedAdamBuilder, QuantizerBuilder)
+    b.NAME: b for b in (CkptWriterBuilder, AsyncIOBuilder,
+                        FlashAttnBuilder, FusedAdamBuilder,
+                        QuantizerBuilder)
 }
 
 

@@ -1,0 +1,117 @@
+"""ctypes binding for the C++ async-IO pool (csrc/aio.cpp).
+
+Counterpart of reference ``csrc/aio/py_lib/py_ds_aio.cpp`` binding the
+``aio_handle``: sync_pread / sync_pwrite / async_pread / async_pwrite /
+wait — the op behind NVMe parameter/optimizer swapping
+(op_builder/async_io.py AsyncIOBuilder).
+"""
+
+import ctypes
+import os
+
+import numpy as np
+
+
+class AsyncIOHandle:
+    """``aio_handle(block_size, queue_depth, single_submit,
+    overlap_events, num_threads)`` signature kept for parity; queue_depth/
+    single_submit/overlap_events are libaio tuning knobs with no analogue
+    in the pread/pwrite pool and are accepted unused."""
+
+    def __init__(self, block_size=1 << 20, queue_depth=32,
+                 single_submit=False, overlap_events=False, num_threads=4):
+        from ...op_builder.builder import create_op_builder
+        self._lib = create_op_builder("async_io").load()
+        self._lib.aio_create.restype = ctypes.c_void_p
+        self._lib.aio_create.argtypes = [ctypes.c_int, ctypes.c_int64]
+        self._lib.aio_destroy.argtypes = [ctypes.c_void_p]
+        for name, res in (("aio_submit_pwrite", ctypes.c_int64),
+                          ("aio_submit_pread", ctypes.c_int64),
+                          ("aio_pwrite", ctypes.c_int),
+                          ("aio_pread", ctypes.c_int)):
+            fn = getattr(self._lib, name)
+            fn.restype = res
+        self._lib.aio_wait.restype = ctypes.c_int
+        self._lib.aio_wait.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        self._h = self._lib.aio_create(int(num_threads), int(block_size))
+        self.block_size = block_size
+        self.num_threads = num_threads
+        self._inflight = {}   # req id -> buffer keepalive
+
+    # -------------------------------------------------------------- helpers
+    @staticmethod
+    def _buf(arr, writable):
+        arr = np.ascontiguousarray(arr) if not writable else arr
+        if writable:
+            assert isinstance(arr, np.ndarray) and arr.flags.c_contiguous \
+                and arr.flags.writeable, "read target must be a writable " \
+                "contiguous numpy array"
+        ptr = arr.ctypes.data_as(ctypes.c_void_p) if isinstance(
+            arr, np.ndarray) else ctypes.cast(arr, ctypes.c_void_p)
+        return arr, ptr, arr.nbytes
+
+    @staticmethod
+    def _check(status, path):
+        if status != 0:
+            raise OSError(-status, os.strerror(-status), str(path))
+
+    # ------------------------------------------------------------ sync API
+    def sync_pwrite(self, buffer, filename, fsync=False):
+        buffer, ptr, nbytes = self._buf(buffer, writable=False)
+        self._check(self._lib.aio_pwrite(
+            ctypes.c_void_p(self._h), str(filename).encode(), ptr,
+            ctypes.c_int64(nbytes), 1 if fsync else 0), filename)
+        return nbytes
+
+    def sync_pread(self, buffer, filename):
+        buffer, ptr, nbytes = self._buf(buffer, writable=True)
+        self._check(self._lib.aio_pread(
+            ctypes.c_void_p(self._h), str(filename).encode(), ptr,
+            ctypes.c_int64(nbytes)), filename)
+        return nbytes
+
+    # ----------------------------------------------------------- async API
+    def async_pwrite(self, buffer, filename, fsync=False):
+        buffer, ptr, nbytes = self._buf(buffer, writable=False)
+        req = self._lib.aio_submit_pwrite(
+            ctypes.c_void_p(self._h), str(filename).encode(), ptr,
+            ctypes.c_int64(nbytes), 1 if fsync else 0)
+        self._inflight[req] = (buffer, filename)
+        return req
+
+    def async_pread(self, buffer, filename):
+        buffer, ptr, nbytes = self._buf(buffer, writable=True)
+        req = self._lib.aio_submit_pread(
+            ctypes.c_void_p(self._h), str(filename).encode(), ptr,
+            ctypes.c_int64(nbytes))
+        self._inflight[req] = (buffer, filename)
+        return req
+
+    def wait(self, req=None):
+        """Wait one request (or all inflight). Returns completed count.
+        Waiting an unknown/already-waited id raises (the C++ pool would
+        otherwise block forever on an id it has no record of)."""
+        if req is not None and req not in self._inflight:
+            raise KeyError(f"aio request {req} is not in flight "
+                           "(already waited or never issued)")
+        reqs = [req] if req is not None else list(self._inflight)
+        n = 0
+        for r in reqs:
+            status = self._lib.aio_wait(ctypes.c_void_p(self._h),
+                                        ctypes.c_int64(r))
+            _, path = self._inflight.pop(r)
+            self._check(status, path)
+            n += 1
+        return n
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self.wait()
+            self._lib.aio_destroy(ctypes.c_void_p(self._h))
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass

@@ -1,0 +1,1 @@
+from .swapper import AsyncTensorSwapper, OptimizerStateSwapper

@@ -1,0 +1,176 @@
+"""Host<->disk tensor swapping over the async-IO pool.
+
+Counterpart of reference ``runtime/swap_tensor/`` (AsyncTensorSwapper in
+async_swapper.py, partitioned_optimizer_swapper.py /
+partitioned_param_swapper.py backed by the AIO op): spill tensors that
+don't fit to NVMe and bring them back on demand, overlapping the file IO
+with compute. On TPU the swap targets HOST staging (device arrays are
+fetched with ``jax.device_get`` first — the VELOC-style D2H hop), so this
+layer serves optimizer-state offload, parameter banks for serving, and
+checkpoint staging.
+"""
+
+import json
+import os
+
+import numpy as np
+import jax
+
+
+class AsyncTensorSwapper:
+    """swap_out(key, array) -> async file write; swap_in(key) -> array.
+    ``wait()`` drains writes; reads are synchronous (the caller needs the
+    data) unless ``async_=True`` (then ``wait_in(key)`` finalizes)."""
+
+    def __init__(self, swap_dir, num_threads=4, block_size=1 << 20,
+                 fsync=False):
+        from ...ops.native.aio import AsyncIOHandle
+        self.dir = swap_dir
+        os.makedirs(swap_dir, exist_ok=True)
+        self.aio = AsyncIOHandle(block_size=block_size,
+                                 num_threads=num_threads)
+        self.fsync = fsync
+        self._meta = {}      # key -> (shape, dtype str)
+        self._out_reqs = {}  # key -> req id
+        self._in_reqs = {}   # key -> (req id, buffer)
+
+    def _path(self, key):
+        safe = str(key).replace("/", "%2F")
+        return os.path.join(self.dir, f"{safe}.bin")
+
+    # ---------------------------------------------------------------- out
+    def swap_out(self, key, array, blocking=False):
+        """array: numpy or jax array (device arrays are fetched to host
+        first). The host buffer is pinned by the aio handle until wait.
+        A still-inflight write to the same key is drained first (two
+        O_TRUNC writers on one path would interleave)."""
+        self.wait(key)
+        arr = np.ascontiguousarray(jax.device_get(array))
+        self._meta[key] = (arr.shape, str(arr.dtype))
+        if blocking:
+            self.aio.sync_pwrite(arr, self._path(key), fsync=self.fsync)
+        else:
+            self._out_reqs[key] = self.aio.async_pwrite(
+                arr, self._path(key), fsync=self.fsync)
+        return key
+
+    def wait(self, key=None):
+        """Drain pending swap-outs (one key or all)."""
+        keys = [key] if key is not None else list(self._out_reqs)
+        for k in keys:
+            req = self._out_reqs.pop(k, None)
+            if req is not None:
+                self.aio.wait(req)
+        return True
+
+    # ----------------------------------------------------------------- in
+    def swap_in(self, key, async_=False):
+        shape, dtype = self._meta[key]
+        buf = np.empty(shape, np.dtype(dtype))
+        self.wait(key)  # a pending write to the same key must land first
+        if async_:
+            self._in_reqs[key] = (self.aio.async_pread(
+                buf, self._path(key)), buf)
+            return None
+        self.aio.sync_pread(buf, self._path(key))
+        return buf
+
+    def wait_in(self, key):
+        req, buf = self._in_reqs.pop(key)
+        self.aio.wait(req)
+        return buf
+
+    def keys(self):
+        return list(self._meta)
+
+    def remove(self, key):
+        self.wait(key)
+        self._meta.pop(key, None)
+        try:
+            os.unlink(self._path(key))
+        except FileNotFoundError:
+            pass
+
+    def close(self):
+        self.wait()
+        self.aio.close()
+
+
+def _skeleton(tree, metas):
+    """Pytree (dict/list/tuple of arrays) -> JSON-able skeleton whose
+    leaves are {"__leaf__": i}; metas collects (shape, dtype) per leaf in
+    traversal order. Supports the containers json can round-trip."""
+    if isinstance(tree, dict):
+        return {k: _skeleton(tree[k], metas) for k in sorted(tree)}
+    if isinstance(tree, (list, tuple)):
+        return [_skeleton(v, metas) for v in tree]
+    arr = np.asarray(tree)
+    metas.append((list(arr.shape), str(arr.dtype)))
+    return {"__leaf__": len(metas) - 1}
+
+
+def _from_skeleton(skel, leaves):
+    if isinstance(skel, dict):
+        if "__leaf__" in skel:
+            return leaves[skel["__leaf__"]]
+        return {k: _from_skeleton(v, leaves) for k, v in skel.items()}
+    return [_from_skeleton(v, leaves) for v in skel]
+
+
+class OptimizerStateSwapper:
+    """Swap whole optimizer-state pytrees (reference
+    partitioned_optimizer_swapper.py role): ``swap_out_tree(key, tree)``
+    writes every leaf (async) + a json manifest carrying the tree
+    skeleton and per-leaf shape/dtype, so ``swap_in_tree`` restores in a
+    FRESH process (crash/restart is the point of offload). Trees must be
+    dict/list/tuple containers (json-representable); tuples come back as
+    lists."""
+
+    def __init__(self, swap_dir, **kw):
+        self.swapper = AsyncTensorSwapper(swap_dir, **kw)
+        self.dir = swap_dir
+
+    def _manifest(self, key):
+        return os.path.join(self.dir, f"{key}.manifest.json")
+
+    def swap_out_tree(self, key, tree, blocking=False):
+        tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
+        metas = []
+        skel = _skeleton(tree, metas)
+        leaves = []
+        _collect_leaves(tree, leaves)
+        names = [f"{key}.{i}" for i in range(len(leaves))]
+        for name, leaf in zip(names, leaves):
+            self.swapper.swap_out(name, leaf, blocking=blocking)
+        with open(self._manifest(key), "w") as f:
+            json.dump({"names": names, "skeleton": skel, "metas": metas},
+                      f)
+        return key
+
+    def swap_in_tree(self, key):
+        with open(self._manifest(key)) as f:
+            m = json.load(f)
+        leaves = []
+        for name, (shape, dtype) in zip(m["names"], m["metas"]):
+            # restore swapper metadata for fresh processes
+            self.swapper._meta[name] = (tuple(shape), dtype)
+            leaves.append(self.swapper.swap_in(name))
+        return _from_skeleton(m["skeleton"], leaves)
+
+    def wait(self):
+        return self.swapper.wait()
+
+    def close(self):
+        self.swapper.close()
+
+
+def _collect_leaves(tree, out):
+    """Leaf order matching _skeleton (sorted dict keys)."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            _collect_leaves(tree[k], out)
+    elif isinstance(tree, (list, tuple)):
+        for v in tree:
+            _collect_leaves(v, out)
+    else:
+        out.append(np.asarray(tree))

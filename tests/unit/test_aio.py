@@ -1,0 +1,146 @@
+"""AIO op + tensor swapping tests (reference tests/unit/ops/aio/
+test_aio.py + runtime/swap_tensor coverage)."""
+
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.ops.native.aio import AsyncIOHandle
+from deepspeed_tpu.runtime.swap_tensor import (AsyncTensorSwapper,
+                                               OptimizerStateSwapper)
+
+
+@pytest.fixture(scope="module")
+def aio():
+    h = AsyncIOHandle(num_threads=2, block_size=1 << 16)
+    yield h
+    h.close()
+
+
+class TestAIOHandle:
+    def test_sync_roundtrip(self, aio, tmp_path):
+        data = np.random.RandomState(0).bytes(300_000)
+        arr = np.frombuffer(data, np.uint8)
+        path = tmp_path / "sync.bin"
+        n = aio.sync_pwrite(arr, path)
+        assert n == 300_000 and path.stat().st_size == 300_000
+        out = np.empty_like(arr)
+        aio.sync_pread(out, path)
+        np.testing.assert_array_equal(out, arr)
+
+    def test_async_roundtrip_many(self, aio, tmp_path):
+        arrays = [np.random.RandomState(i).randn(1000 + i).astype(np.float32)
+                  for i in range(8)]
+        reqs = [aio.async_pwrite(a, tmp_path / f"f{i}.bin", fsync=False)
+                for i, a in enumerate(arrays)]
+        assert aio.wait() == 8
+        outs = [np.empty_like(a) for a in arrays]
+        reqs = [aio.async_pread(o, tmp_path / f"f{i}.bin")
+                for i, o in enumerate(outs)]
+        for r in reqs:
+            aio.wait(r)
+        for a, o in zip(arrays, outs):
+            np.testing.assert_array_equal(a, o)
+
+    def test_missing_file_raises(self, aio, tmp_path):
+        out = np.empty(16, np.uint8)
+        with pytest.raises(OSError):
+            aio.sync_pread(out, tmp_path / "absent.bin")
+
+    def test_chunked_write_exceeds_block(self, aio, tmp_path):
+        # block_size 64KiB; write 1MiB -> 16 chunks
+        arr = np.random.RandomState(1).randn(131072).astype(np.float64)
+        aio.sync_pwrite(arr, tmp_path / "big.bin")
+        out = np.empty_like(arr)
+        aio.sync_pread(out, tmp_path / "big.bin")
+        np.testing.assert_array_equal(arr, out)
+
+
+class TestTensorSwapper:
+    def test_swap_roundtrip_numpy_and_jax(self, tmp_path):
+        sw = AsyncTensorSwapper(str(tmp_path / "swap"), num_threads=2)
+        a = np.random.RandomState(0).randn(64, 32).astype(np.float32)
+        b = jnp.arange(100, dtype=jnp.int32)
+        sw.swap_out("a", a)
+        sw.swap_out("b", b)
+        sw.wait()
+        np.testing.assert_array_equal(sw.swap_in("a"), a)
+        np.testing.assert_array_equal(sw.swap_in("b"), np.asarray(b))
+        sw.close()
+
+    def test_swap_in_waits_pending_write(self, tmp_path):
+        sw = AsyncTensorSwapper(str(tmp_path / "swap2"), num_threads=1)
+        a = np.random.RandomState(1).randn(200_000).astype(np.float32)
+        sw.swap_out("x", a)             # async
+        out = sw.swap_in("x")           # must see the full write
+        np.testing.assert_array_equal(out, a)
+        sw.close()
+
+    def test_async_read(self, tmp_path):
+        sw = AsyncTensorSwapper(str(tmp_path / "swap3"))
+        a = np.arange(5000, dtype=np.float32)
+        sw.swap_out("k", a, blocking=True)
+        assert sw.swap_in("k", async_=True) is None
+        out = sw.wait_in("k")
+        np.testing.assert_array_equal(out, a)
+        sw.close()
+
+    def test_remove(self, tmp_path):
+        sw = AsyncTensorSwapper(str(tmp_path / "swap4"))
+        sw.swap_out("gone", np.ones(10), blocking=True)
+        sw.remove("gone")
+        assert sw.keys() == []
+        sw.close()
+
+
+class TestOptimizerStateSwapper:
+    def test_tree_roundtrip(self, tmp_path):
+        osw = OptimizerStateSwapper(str(tmp_path / "opt"))
+        tree = {"m": {"w": np.random.RandomState(0).randn(32, 16)
+                      .astype(np.float32),
+                      "b": np.zeros(16, np.float32)},
+                "v": {"w": np.ones((32, 16), np.float32),
+                      "b": np.full(16, 2.0, np.float32)}}
+        osw.swap_out_tree("rank0", tree)
+        osw.wait()
+        back = osw.swap_in_tree("rank0")
+        jax.tree.map(np.testing.assert_array_equal, back, tree)
+        osw.close()
+
+
+class TestFixes:
+    def test_double_wait_raises(self, aio, tmp_path):
+        a = np.ones(64, np.float32)
+        r = aio.async_pwrite(a, tmp_path / "dw.bin")
+        aio.wait(r)
+        with pytest.raises(KeyError):
+            aio.wait(r)
+
+    def test_same_key_overwrite_serializes(self, tmp_path):
+        sw = AsyncTensorSwapper(str(tmp_path / "ser"), num_threads=4)
+        a = np.zeros(500_000, np.float32)
+        b = np.ones(500_000, np.float32)
+        sw.swap_out("k", a)           # async
+        sw.swap_out("k", b)           # must drain the first write
+        out = sw.swap_in("k")
+        np.testing.assert_array_equal(out, b)
+        sw.close()
+
+    def test_tree_restore_in_fresh_swapper(self, tmp_path):
+        d = str(tmp_path / "fresh")
+        osw = OptimizerStateSwapper(d)
+        tree = {"m": [np.arange(10, dtype=np.float32),
+                      np.ones((4, 4), np.int32)],
+                "step": np.asarray(7, np.int64)}
+        osw.swap_out_tree("r0", tree, blocking=True)
+        osw.close()
+        # brand-new process simulation: new swapper over the same dir
+        osw2 = OptimizerStateSwapper(d)
+        back = osw2.swap_in_tree("r0")
+        np.testing.assert_array_equal(back["m"][0], tree["m"][0])
+        np.testing.assert_array_equal(back["m"][1], tree["m"][1])
+        assert back["step"] == 7
+        osw2.close()

@@ -146,3 +146,23 @@ class TestManager:
         mgr = CompressionManager(CONFIG, example_params=params)
         cleaned = redundancy_clean(params, mgr)
         assert (np.asarray(cleaned["blocks"]["wdown"]) == 0).mean() > 0.4
+
+
+class TestQuantizeGroupsSemantics:
+    def test_group_count_semantics(self):
+        """quantize_groups=1 (the default) must be per-tensor quantization,
+        NOT a per-element no-op."""
+        import jax
+        from deepspeed_tpu.compression import CompressionManager
+        cfg = {"compression_training": {"weight_quantization": {
+            "shared_parameters": {"enabled": True, "schedule_offset": 0,
+                                  "quantize_groups": 1},
+            "different_groups": {"g": {"params": {"target_bits": 4},
+                                       "modules": ["blocks/wqkv"]}}}}}
+        model = GPT2(TINY)
+        params = model.init(jax.random.key(0))
+        mgr = CompressionManager(cfg, example_params=params)
+        out = mgr.transform(params)
+        q = np.asarray(out["blocks"]["wqkv"])
+        assert not np.array_equal(q, np.asarray(params["blocks"]["wqkv"]))
+        assert len(np.unique(q)) <= 16
