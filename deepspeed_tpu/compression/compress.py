@@ -96,6 +96,11 @@ class CompressionManager:
                     # quantize_groups is a group COUNT (reference
                     # semantics): 1 group = per-tensor scaling
                     n_groups = int(cfg.get("quantize_groups", 1))
+                    if n_groups > 1 and leaf.size % n_groups != 0:
+                        logger.warning(
+                            f"quantize_groups={n_groups} does not divide "
+                            f"{p} (size {leaf.size}); falling back to "
+                            "per-tensor scaling")
                     gsize = (leaf.size // n_groups
                              if n_groups > 1 and leaf.size % n_groups == 0
                              else 0)

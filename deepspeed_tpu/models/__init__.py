@@ -2,3 +2,5 @@ from .gpt2 import (GPT2, GPT2Config, PRESETS, GPT2_TINY, GPT2_125M,
                    GPT2_350M, GPT2_1_3B)
 from .gpt2_moe import GPT2MoE, GPT2MoEConfig
 from .gpt2_pipe import GPT2Pipe
+from .llama import (Llama, LlamaConfig, LLAMA_PRESETS, LLAMA_TINY,
+                    LLAMA2_7B, MISTRAL_7B)

@@ -1,0 +1,421 @@
+"""Llama model family — RoPE + RMSNorm + SwiGLU + grouped-query attention.
+
+Counterpart of the reference's llama support (inference
+model_implementations/llama2, module_inject/containers/llama*.py,
+csrc rms_norm/apply_rotary_pos_emb kernels) — here a first-class
+trainable+servable model with the same functional surface as GPT2
+(models/gpt2.py): ``init/loss/apply/partition_specs`` for the training
+engine, ``init_cache/cache_specs/apply_cached`` for the v1 inference
+engine, ``init_paged_cache/paged_cache_specs/apply_paged_*`` for the v2
+serving engine. Same TPU-first choices: stacked layers under ``lax.scan``,
+declarative Megatron TP on the 'tensor' axis, fp32 norms/logits.
+
+GQA: ``n_kv_heads <= n_head`` — KV caches store only KV heads (the
+serving memory win), queries repeat KV groups at attention time.
+"""
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..utils.groups import BATCH_AXES
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab_size: int = 32000
+    max_seq_len: int = 2048
+    n_layer: int = 16
+    n_head: int = 16
+    n_kv_heads: int = 16
+    d_model: int = 1024
+    d_ff: int = 0               # 0 = round(8/3 * d_model) to multiple of 128
+    rope_theta: float = 10000.0
+    rms_eps: float = 1e-5
+    dtype: str = "bfloat16"
+    remat: bool = True
+    remat_policy: str = "nothing_saveable"
+    tie_embeddings: bool = False
+
+    @property
+    def d_head(self):
+        return self.d_model // self.n_head
+
+    @property
+    def ffn_dim(self):
+        if self.d_ff:
+            return self.d_ff
+        return ((int(8 * self.d_model / 3) + 127) // 128) * 128
+
+    def num_params(self):
+        D, F, V = self.d_model, self.ffn_dim, self.vocab_size
+        kvd = self.n_kv_heads * self.d_head
+        block = (2 * D                      # rms scales
+                 + D * D + 2 * D * kvd + D * D   # q, k, v, o
+                 + 3 * D * F)               # gate, up, down
+        head = 0 if self.tie_embeddings else V * D
+        return V * D + self.n_layer * block + D + head
+
+    def flops_per_token(self):
+        n = self.num_params() - self.vocab_size * self.d_model
+        return 6 * n + 12 * self.n_layer * self.d_model * self.max_seq_len
+
+
+LLAMA_TINY = LlamaConfig(n_layer=2, n_head=4, n_kv_heads=2, d_model=128,
+                         max_seq_len=128, vocab_size=512, remat=False)
+LLAMA2_7B = LlamaConfig(n_layer=32, n_head=32, n_kv_heads=32, d_model=4096,
+                        max_seq_len=4096, vocab_size=32000)
+MISTRAL_7B = LlamaConfig(n_layer=32, n_head=32, n_kv_heads=8, d_model=4096,
+                         d_ff=14336, max_seq_len=8192, vocab_size=32000)
+
+LLAMA_PRESETS = {"tiny": LLAMA_TINY, "llama2-7b": LLAMA2_7B,
+                 "mistral-7b": MISTRAL_7B}
+
+
+def _rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps)
+            * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _rope(x, pos, theta):
+    """x: (..., T, H, hd) with positions pos (..., T) -> rotated."""
+    hd = x.shape[-1]
+    half = hd // 2
+    freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
+    angles = (pos.astype(jnp.float32)[..., None, None]
+              * freqs[None, None, :])                  # (..., T, 1, half)
+    cos, sin = jnp.cos(angles), jnp.sin(angles)
+    x1, x2 = x[..., :half], x[..., half:]
+    out = jnp.concatenate(
+        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return out.astype(x.dtype)
+
+
+def _repeat_kv(k, n_rep):
+    """(B, T, KVH, hd) -> (B, T, KVH*n_rep, hd)."""
+    if n_rep == 1:
+        return k
+    B, T, KVH, hd = k.shape
+    return jnp.repeat(k, n_rep, axis=2)
+
+
+class Llama:
+    """Params layout (block tensors stacked on n_layer):
+      wte (V,D) | norm_f (D,) | lm_head (V,D) unless tied
+      blocks: rms1 (L,D), wq (L,D,D), wk (L,D,KVD), wv (L,D,KVD),
+              wo (L,D,D), rms2 (L,D), wgate (L,D,F), wup (L,D,F),
+              wdown (L,F,D)
+    """
+
+    moe_loss_coeff = 0.0
+
+    def __init__(self, config: LlamaConfig):
+        self.config = config
+
+    # ------------------------------------------------------------------ init
+    def init(self, rng):
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        L, D, F, V = cfg.n_layer, cfg.d_model, cfg.ffn_dim, cfg.vocab_size
+        kvd = cfg.n_kv_heads * cfg.d_head
+        k = iter(jax.random.split(rng, 12))
+        std = 0.02
+        res_std = std / math.sqrt(2 * L)
+
+        def nrm(key, shape, s=std):
+            return (jax.random.normal(key, shape, jnp.float32) * s).astype(dt)
+
+        params = {
+            "wte": nrm(next(k), (V, D)),
+            "norm_f": jnp.ones((D,), dt),
+            "blocks": {
+                "rms1": jnp.ones((L, D), dt),
+                "wq": nrm(next(k), (L, D, D)),
+                "wk": nrm(next(k), (L, D, kvd)),
+                "wv": nrm(next(k), (L, D, kvd)),
+                "wo": nrm(next(k), (L, D, D), res_std),
+                "rms2": jnp.ones((L, D), dt),
+                "wgate": nrm(next(k), (L, D, F)),
+                "wup": nrm(next(k), (L, D, F)),
+                "wdown": nrm(next(k), (L, F, D), res_std),
+            },
+        }
+        if not cfg.tie_embeddings:
+            params["lm_head"] = nrm(next(k), (V, D))
+        return params
+
+    # -------------------------------------------------------------- sharding
+    def partition_specs(self, topology=None):
+        """Column-parallel: wq/wk/wv/wgate/wup (out dim on 'tensor');
+        row-parallel: wo/wdown (in dim). Embeddings/norms replicated."""
+        specs = {
+            "wte": P(),
+            "norm_f": P(),
+            "blocks": {
+                "rms1": P(None, None),
+                "wq": P(None, None, "tensor"),
+                "wk": P(None, None, "tensor"),
+                "wv": P(None, None, "tensor"),
+                "wo": P(None, "tensor", None),
+                "rms2": P(None, None),
+                "wgate": P(None, None, "tensor"),
+                "wup": P(None, None, "tensor"),
+                "wdown": P(None, "tensor", None),
+            },
+        }
+        if not self.config.tie_embeddings:
+            specs["lm_head"] = P()
+        return specs
+
+    # --------------------------------------------------------------- forward
+    def _constrain_fn(self):
+        mesh = jax.sharding.get_abstract_mesh()
+        from jax.sharding import AxisType
+        if mesh.empty or not any(t == AxisType.Auto for t in
+                                 mesh.axis_types):
+            return lambda x, spec: x
+        return lax.with_sharding_constraint
+
+    def head(self, params, x):
+        x = _rms_norm(x, params["norm_f"], self.config.rms_eps)
+        w = params["wte"] if self.config.tie_embeddings else \
+            params["lm_head"]
+        return jnp.einsum("btd,vd->btv", x, w,
+                          preferred_element_type=jnp.float32)
+
+    def _attn_proj(self, x, layer):
+        cfg = self.config
+        B, T = x.shape[0], x.shape[1]
+        H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
+        h = _rms_norm(x, layer["rms1"], cfg.rms_eps)
+        q = (h @ layer["wq"]).reshape(B, T, H, hd)
+        kk = (h @ layer["wk"]).reshape(B, T, KVH, hd)
+        v = (h @ layer["wv"]).reshape(B, T, KVH, hd)
+        return q, kk, v
+
+    def _mlp(self, x, layer):
+        cfg = self.config
+        h = _rms_norm(x, layer["rms2"], cfg.rms_eps)
+        gate = jax.nn.silu(h @ layer["wgate"])
+        return (gate * (h @ layer["wup"])) @ layer["wdown"]
+
+    def block_forward(self, x, layer, pos, *, causal, constrain, act_spec):
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        B, T = x.shape[0], x.shape[1]
+        H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
+        q, kk, v = self._attn_proj(x, layer)
+        q = _rope(q, pos, cfg.rope_theta)
+        kk = _rope(kk, pos, cfg.rope_theta)
+        head_spec = P(BATCH_AXES, None, "tensor", None)
+        q = constrain(q, head_spec)
+        kk = constrain(kk, head_spec)
+        v = constrain(v, head_spec)
+        kk = _repeat_kv(kk, H // KVH)
+        v = _repeat_kv(v, H // KVH)
+        scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                            preferred_element_type=jnp.float32)
+        scores = scores / math.sqrt(hd)
+        scores = jnp.where(causal[None, None], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+        attn = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T,
+                                                               H * hd)
+        x = x + constrain(attn, act_spec) @ layer["wo"]
+        x = constrain(x, act_spec)
+        x = x + self._mlp(x, layer)
+        return constrain(x, act_spec)
+
+    def apply(self, params, input_ids, *, rng=None, train=False,
+              seq_sharded=False):
+        cfg = self.config
+        T = input_ids.shape[1]
+        constrain = self._constrain_fn()
+        act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
+        x = params["wte"][input_ids].astype(jnp.dtype(cfg.dtype))
+        x = constrain(x, act_spec)
+        pos = jnp.broadcast_to(jnp.arange(T)[None, :], input_ids.shape)
+        causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+
+        def block(x, layer):
+            return self.block_forward(x, layer, pos, causal=causal,
+                                      constrain=constrain,
+                                      act_spec=act_spec)
+
+        block_fn = block
+        if cfg.remat:
+            policy = getattr(jax.checkpoint_policies, cfg.remat_policy,
+                             None)
+            block_fn = jax.checkpoint(block, policy=policy)
+
+        x, _ = lax.scan(lambda c, l: (block_fn(c, l), None), x,
+                        params["blocks"])
+        return self.head(params, x)
+
+    def apply_with_aux(self, params, input_ids, **kw):
+        return self.apply(params, input_ids, **kw), jnp.zeros((),
+                                                              jnp.float32)
+
+    def loss(self, params, batch, *, rng=None, train=True,
+             seq_sharded=False):
+        ids = batch["input_ids"]
+        logits = self.apply(params, ids, rng=rng, train=train,
+                            seq_sharded=seq_sharded)
+        targets = ids[:, 1:]
+        logits = logits[:, :-1]
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, targets[..., None],
+                                   axis=-1)[..., 0]
+        return jnp.mean(logz - gold)
+
+    # ------------------------------------------------- v1 KV-cache decoding
+    def init_cache(self, batch_size, max_len, dtype=None):
+        cfg = self.config
+        dt = jnp.dtype(dtype) if dtype is not None else jnp.dtype(cfg.dtype)
+        shape = (cfg.n_layer, batch_size, max_len, cfg.n_kv_heads,
+                 cfg.d_head)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def cache_specs(self, batch_axes=BATCH_AXES):
+        spec = P(None, batch_axes, None, "tensor", None)
+        return {"k": spec, "v": spec}
+
+    def apply_cached(self, params, input_ids, pos_ids, cache, slot,
+                     valid_mask, last_token_only=False):
+        """Same contract as GPT2.apply_cached; KV cache stores KV heads
+        only (GQA)."""
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        B, T = input_ids.shape
+        H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
+        x = params["wte"][input_ids].astype(dt)
+        Tmax = cache["k"].shape[2]
+
+        def body(carry, xs):
+            layer, kc, vc = xs
+            x = carry
+            q, kk, v = self._attn_proj(x, layer)
+            q = _rope(q, pos_ids, cfg.rope_theta)
+            kk = _rope(kk, pos_ids, cfg.rope_theta)
+            kc = lax.dynamic_update_slice(kc, kk.astype(kc.dtype),
+                                          (0, slot, 0, 0))
+            vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype),
+                                          (0, slot, 0, 0))
+            ku = _repeat_kv(kc, H // KVH)
+            vu = _repeat_kv(vc, H // KVH)
+            scores = jnp.einsum("bthd,bshd->bhts", q, ku,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            s_idx = jnp.arange(Tmax)[None, None, None, :]
+            q_idx = (slot + jnp.arange(T))[None, None, :, None]
+            mask = (s_idx <= q_idx) & valid_mask[:, None, None, :]
+            scores = jnp.where(mask, scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
+            x = x + attn.reshape(B, T, H * hd) @ layer["wo"]
+            x = x + self._mlp(x, layer)
+            return x, (kc, vc)
+
+        x, (kc, vc) = lax.scan(body, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+        if last_token_only:
+            x = x[:, -1:]
+        return self.head(params, x), {"k": kc, "v": vc}
+
+    # ------------------------------------------------- v2 paged decoding
+    def init_paged_cache(self, num_blocks, block_size, dtype=None):
+        cfg = self.config
+        dt = jnp.dtype(dtype) if dtype is not None else jnp.dtype(cfg.dtype)
+        shape = (cfg.n_layer, num_blocks, block_size, cfg.n_kv_heads,
+                 cfg.d_head)
+        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+
+    def paged_cache_specs(self):
+        spec = P(None, None, None, "tensor", None)
+        return {"k": spec, "v": spec}
+
+    def apply_paged_prefill(self, params, input_ids, cache, token_blocks,
+                            token_offsets, length):
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        T = input_ids.shape[1]
+        H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
+        x = params["wte"][input_ids].astype(dt)
+        pos = jnp.arange(T)[None, :]
+        valid = (jnp.arange(T) < length)
+        mask = jnp.tril(jnp.ones((T, T), jnp.bool_)) & valid[None, :]
+
+        def body(carry, xs):
+            layer, kc, vc = xs
+            x = carry
+            q, kk, v = self._attn_proj(x, layer)
+            q = _rope(q, pos, cfg.rope_theta)
+            kk = _rope(kk, pos, cfg.rope_theta)
+            kc = kc.at[token_blocks, token_offsets].set(
+                kk[0].astype(kc.dtype))
+            vc = vc.at[token_blocks, token_offsets].set(
+                v[0].astype(vc.dtype))
+            ku = _repeat_kv(kk, H // KVH)
+            vu = _repeat_kv(v, H // KVH)
+            scores = jnp.einsum("bthd,bshd->bhts", q, ku,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
+            x = x + attn.reshape(1, T, H * hd) @ layer["wo"]
+            x = x + self._mlp(x, layer)
+            return x, (kc, vc)
+
+        x, (kc, vc) = lax.scan(body, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+        last = jnp.take_along_axis(
+            x, jnp.maximum(length - 1, 0)[None, None, None], axis=1)
+        return self.head(params, last)[:, 0], {"k": kc, "v": vc}
+
+    def apply_paged_decode(self, params, tokens, lengths, cache,
+                           block_tables):
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        B = tokens.shape[0]
+        H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
+        BS = cache["k"].shape[2]
+        MB = block_tables.shape[1]
+        S = MB * BS
+        pos = jnp.minimum(lengths, cfg.max_seq_len - 1)
+        x = params["wte"][tokens[:, None]].astype(dt)
+        dst_block = jnp.take_along_axis(
+            block_tables, (lengths // BS)[:, None], axis=1)[:, 0]
+        dst_off = lengths % BS
+        attn_mask = jnp.arange(S)[None, :] <= lengths[:, None]
+
+        def body(carry, xs):
+            layer, kc, vc = xs
+            x = carry
+            q, kk, v = self._attn_proj(x, layer)       # (B, 1, ., hd)
+            q = _rope(q, pos[:, None], cfg.rope_theta)
+            kk = _rope(kk, pos[:, None], cfg.rope_theta)
+            kc = kc.at[dst_block, dst_off].set(kk[:, 0].astype(kc.dtype))
+            vc = vc.at[dst_block, dst_off].set(v[:, 0].astype(vc.dtype))
+            gk = kc[block_tables].reshape(B, S, KVH, hd)
+            gv = vc[block_tables].reshape(B, S, KVH, hd)
+            gk = _repeat_kv(gk, H // KVH)
+            gv = _repeat_kv(gv, H // KVH)
+            scores = jnp.einsum("bhd,bshd->bhs", q[:, 0], gk,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            scores = jnp.where(attn_mask[:, None, :], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhs,bshd->bhd", probs, gv)
+            x = x + attn.reshape(B, 1, H * hd) @ layer["wo"]
+            x = x + self._mlp(x, layer)
+            return x, (kc, vc)
+
+        x, (kc, vc) = lax.scan(body, x,
+                               (params["blocks"], cache["k"], cache["v"]))
+        return self.head(params, x)[:, 0], {"k": kc, "v": vc}

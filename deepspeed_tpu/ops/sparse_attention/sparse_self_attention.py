@@ -1,0 +1,68 @@
+"""Block-sparse attention op.
+
+Counterpart of reference ``ops/sparse_attention/`` (Triton blocksparse
+matmul/softmax + ``sparse_self_attention.py``). TPU realization: the
+block LAYOUT becomes a block-resolution mask expanded inside the
+attention computation — XLA fuses the mask into the softmax so masked
+blocks contribute no probability mass; numerics match the reference's
+blocksparse kernels exactly (same masked-softmax semantics). A Pallas
+kernel that skips masked blocks at the MXU level (splash-attention style)
+is the optimization path; the op's contract and layouts are what parity
+requires.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def _expand_layout(layout, block, T):
+    """(H, n, n) block layout -> (H, T, T) element mask."""
+    n = T // block
+    lay = jnp.asarray(layout[:, :n, :n])
+    return jnp.repeat(jnp.repeat(lay, block, axis=1), block, axis=2)
+
+
+def sparse_attention(q, k, v, layout, block, causal=False, scale=None):
+    """q/k/v: (B, T, H, hd); layout: (H, T//block, T//block) bool.
+    Returns (B, T, H, hd)."""
+    B, T, H, hd = q.shape
+    scale = scale or 1.0 / math.sqrt(hd)
+    mask = _expand_layout(layout, block, T)            # (H, T, T)
+    if causal:
+        mask = mask & jnp.tril(jnp.ones((T, T), jnp.bool_))[None]
+    scores = jnp.einsum("bthd,bshd->bhts", q, k,
+                        preferred_element_type=jnp.float32) * scale
+    scores = jnp.where(mask[None], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # fully-masked rows (possible in exotic layouts) -> zero output
+    any_allowed = jnp.any(mask, axis=-1)               # (H, T)
+    probs = jnp.where(any_allowed[None, :, :, None], probs, 0.0)
+    probs = probs.astype(v.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v)
+
+
+class SparseSelfAttention:
+    """reference ops/sparse_attention/sparse_self_attention.py: module
+    bundling a SparsityConfig with the op; layout built per seq len and
+    cached."""
+
+    def __init__(self, sparsity_config, causal=True):
+        self.config = sparsity_config
+        self.causal = causal
+        self._layouts = {}
+
+    def layout(self, seq_len):
+        if seq_len not in self._layouts:
+            self._layouts[seq_len] = self.config.make_layout(seq_len)
+        return self._layouts[seq_len]
+
+    def __call__(self, q, k, v):
+        T = q.shape[1]
+        return sparse_attention(q, k, v, self.layout(T),
+                                self.config.block, causal=self.causal)
+
+    def density(self, seq_len):
+        lay = self.layout(seq_len)
+        return float(lay.mean())

@@ -1,0 +1,65 @@
+"""Hybrid engine — RLHF train/generate flip.
+
+Counterpart of reference ``runtime/hybrid_engine.py:32
+DeepSpeedHybridEngine``: one engine that trains (ZeRO-partitioned) and
+generates (inference-optimized) with the SAME weights — the RLHF actor
+loop. The reference re-shards ZeRO-3 params and swaps in inference
+kernels per phase; here the flip is a jitted cast/reshard of the current
+bf16 params into the inference engine's shardings (device-to-device,
+XLA-planned) and the generation path is the v1 KV-cache engine.
+"""
+
+import jax
+
+from ..inference.engine import InferenceEngine
+from ..utils.logging import log_dist
+from .engine import DeepSpeedEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    """DeepSpeedEngine + ``generate()`` (reference exposes the HF
+    generate surface the same way)."""
+
+    def __init__(self, *args, inference_config=None, **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inference_config = dict(inference_config or {})
+        self._inf_engine = None
+        self._inf_params_step = -1
+        log_dist("hybrid engine: training + in-loop generation", ranks=[0])
+
+    def _refresh_inference_engine(self):
+        if self._inf_engine is None:
+            cfg = {"dtype": str(self.param_dtype.__name__
+                                if hasattr(self.param_dtype, "__name__")
+                                else self.param_dtype)}
+            cfg.update(self._inference_config)
+            self._inf_engine = InferenceEngine(
+                self.model, config=cfg, params=self.state["params"],
+                topology=self.topology)
+            self._inf_params_step = self.global_step
+        elif self._inf_params_step != self.global_step:
+            # flip: reshard current training params into the inference
+            # shardings (no-op placement change when they already match).
+            # The caster is jitted ONCE — a fresh lambda per refresh would
+            # recompile every RLHF iteration.
+            if not hasattr(self, "_cast_jit"):
+                self._cast_jit = jax.jit(
+                    lambda p: jax.tree.map(
+                        lambda x: x.astype(self._inf_engine.dtype), p),
+                    out_shardings=self._inf_engine.param_shardings)
+            with jax.set_mesh(self.mesh):
+                self._inf_engine.params = self._cast_jit(
+                    self.state["params"])
+            self._inf_params_step = self.global_step
+
+    def generate(self, input_ids, **kwargs):
+        """Generate with the CURRENT training weights (the RLHF
+        experience-collection phase)."""
+        self._refresh_inference_engine()
+        return self._inf_engine.generate(input_ids, **kwargs)
+
+    def eval(self):
+        return self
+
+    def train(self):
+        return self

@@ -1,0 +1,31 @@
+"""Progressive layer dropping.
+
+Counterpart of reference ``runtime/progressive_layer_drop.py``
+(ProgressiveLayerDrop): theta(t) = (1 - theta_min) * gamma-decay + theta_min
+keep probability, consumed by models that drop transformer blocks
+stochastically during training (the PLD paper's schedule, verbatim math).
+"""
+
+import numpy as np
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta=0.5, gamma=0.001):
+        self.theta = theta
+        self.gamma = gamma
+        self.current_theta = 1.0
+
+    def get_state(self):
+        return {"progressive_layer_drop": True,
+                "pld_theta": self.get_theta()}
+
+    def get_theta(self):
+        return self.current_theta
+
+    def update_state(self, global_step):
+        def _prob(x, g, t):
+            return (1.0 - t) * np.exp(-g * x) + t
+
+        self.current_theta = float(_prob(global_step, self.gamma,
+                                         self.theta))
+        return self.current_theta

@@ -1,0 +1,61 @@
+"""MoQ (Mixture of Quantization) schedule.
+
+Counterpart of reference ``runtime/quantize.py`` (Quantizer driving
+quantize-aware training with a decreasing bit width, optionally modulated
+by Hessian eigenvalues): tracks the current target bits from
+``start_bits`` down to ``target_bits`` every ``quantize_period`` steps
+(doubling periods, reference semantics), and exposes ``quantize(tree)``
+applying symmetric fake quantization at the current precision via the
+compression ops.
+"""
+
+import jax
+
+from ..compression import ops as cops
+
+
+class Quantizer:
+    def __init__(self, q_target_bits=8, q_start_bits=16, q_period=100,
+                 q_rounding="nearest", use_quantizer_kernel=False,
+                 eigenvalue_enabled=False, layer_keys=None):
+        self.target_bits = q_target_bits
+        self.start_bits = q_start_bits
+        self.period = q_period
+        self.rounding = q_rounding
+        self.eigenvalue_enabled = eigenvalue_enabled
+        self.layer_keys = layer_keys or []
+        self.current_bits = q_start_bits
+        self._next_change = q_period
+
+    def update(self, global_step, eigenvalues=None):
+        """Advance the schedule; with eigenvalues (dict from
+        runtime/eigenvalue.py) sharp (high-curvature) layers keep high
+        precision LONGER — the reference stretches the period by
+        ``1 + floor(eigenvalue * 4)`` (quantize.py:70)."""
+        period = self.period
+        if self.eigenvalue_enabled and eigenvalues:
+            mean_eig = sum(eigenvalues.values()) / len(eigenvalues)
+            if mean_eig > 0:
+                period = int(self.period * (1 + int(mean_eig * 4)))
+        if (global_step >= self._next_change
+                and self.current_bits > self.target_bits):
+            self.current_bits -= 1
+            self._next_change = global_step + period * 2 ** (
+                self.start_bits - self.current_bits)
+        return self.current_bits
+
+    def quantize(self, tree, bits=None):
+        bits = bits or self.current_bits
+        if bits >= 16:
+            return tree
+        return jax.tree.map(
+            lambda x: cops.quantize_weight(x, bits=bits)
+            if getattr(x, "ndim", 0) >= 2 else x, tree)
+
+    def state_dict(self):
+        return {"current_bits": self.current_bits,
+                "next_change": self._next_change}
+
+    def load_state_dict(self, sd):
+        self.current_bits = sd["current_bits"]
+        self._next_change = sd["next_change"]

@@ -142,9 +142,18 @@ class OptimizerStateSwapper:
         names = [f"{key}.{i}" for i in range(len(leaves))]
         for name, leaf in zip(names, leaves):
             self.swapper.swap_out(name, leaf, blocking=blocking)
-        with open(self._manifest(key), "w") as f:
+        # the manifest is the durability marker: it must land only after
+        # every leaf write did, else a crash between them restores torn or
+        # stale leaves with no error
+        for name in names:
+            self.swapper.wait(name)
+        tmp = self._manifest(key) + ".tmp"
+        with open(tmp, "w") as f:
             json.dump({"names": names, "skeleton": skel, "metas": metas},
                       f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._manifest(key))
         return key
 
     def swap_in_tree(self, key):

@@ -1,0 +1,79 @@
+"""Meta-device model materialization.
+
+Counterpart of reference ``utils/init_on_device.py OnDevice`` (construct a
+model on the 'meta' device: shapes without storage). The jax-native form:
+``abstract_init`` evaluates a model's init under ``jax.eval_shape`` —
+zero FLOPs, zero memory — yielding the ShapeDtypeStruct tree that sharding
+plans and checkpoint loaders consume; ``materialize`` then creates the
+real (optionally sharded) params.
+"""
+
+import jax
+
+
+class OnDevice:
+    """``with OnDevice(model, device='meta'): params = model.init(rng)``
+    — inside the context, the listed models' ``init`` really runs through
+    ``jax.eval_shape`` (zero FLOPs/memory, ShapeDtypeStruct leaves);
+    restored on exit. The reference patches nn.Module.__init__ globally;
+    here interception is per-model because models are plain objects."""
+
+    _active = False
+
+    def __init__(self, *models, dtype=None, device="meta", enabled=True):
+        self.models = models
+        self.dtype = dtype
+        self.device = device
+        self.enabled = enabled and device == "meta"
+        self._saved = []
+
+    def __enter__(self):
+        OnDevice._active = self.enabled
+        if self.enabled:
+            for m in self.models:
+                orig = m.init
+                self._saved.append((m, orig))
+
+                def abstract(rng, _orig=orig):
+                    out = jax.eval_shape(_orig, rng)
+                    if self.dtype is not None:
+                        out = jax.tree.map(
+                            lambda s: jax.ShapeDtypeStruct(s.shape,
+                                                           self.dtype),
+                            out)
+                    return out
+
+                m.init = abstract
+        return self
+
+    def __exit__(self, *exc):
+        OnDevice._active = False
+        for m, orig in self._saved:
+            m.init = orig
+        self._saved = []
+        return False
+
+    @classmethod
+    def is_active(cls):
+        return cls._active
+
+
+def abstract_init(model, rng=None):
+    """ShapeDtypeStruct pytree of ``model.init`` without running it."""
+    if rng is None:
+        rng = jax.random.key(0)
+    return jax.eval_shape(model.init, rng)
+
+
+def materialize(model, rng, shardings=None, dtype=None):
+    """Real params, created directly into ``shardings`` (no full-size
+    host copy — the zero.Init property)."""
+    def init(r):
+        p = model.init(r)
+        if dtype is not None:
+            p = jax.tree.map(lambda x: x.astype(dtype), p)
+        return p
+
+    if shardings is None:
+        return jax.jit(init)(rng)
+    return jax.jit(init, out_shardings=shardings)(rng)

@@ -1,0 +1,219 @@
+"""Sparse attention, eigenvalue, PLD, MoQ, OnDevice, hybrid engine tests
+(reference tests/unit/ops/sparse_attention + runtime misc coverage)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2, GPT2Config
+from deepspeed_tpu.ops.sparse_attention import (
+    BigBirdSparsityConfig, BSLongformerSparsityConfig, DenseSparsityConfig,
+    FixedSparsityConfig, SparseSelfAttention, sparse_attention)
+from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+from deepspeed_tpu.runtime.quantize import Quantizer
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.init_on_device import (OnDevice, abstract_init,
+                                                materialize)
+
+
+class TestSparsityConfigs:
+    def test_dense_layout_full(self):
+        lay = DenseSparsityConfig(num_heads=2, block=16).make_layout(64)
+        assert lay.shape == (2, 4, 4) and lay.all()
+
+    def test_fixed_local_window(self):
+        cfg = FixedSparsityConfig(num_heads=1, block=16, num_local_blocks=2,
+                                  num_global_blocks=1,
+                                  attention="unidirectional")
+        lay = cfg.make_layout(128)  # 8 blocks
+        # block 3 (window 1): local blocks 2..3, global = last of window 0
+        assert lay[0, 3, 2] and lay[0, 3, 3]
+        assert lay[0, 3, 1]          # global: last block of window 0
+        assert not lay[0, 3, 4]      # causal: no future
+        assert not lay[0, 3, 0]
+
+    def test_bigbird_window_and_global(self):
+        cfg = BigBirdSparsityConfig(num_heads=1, block=16,
+                                    num_sliding_window_blocks=3,
+                                    num_global_blocks=1,
+                                    num_random_blocks=1)
+        lay = cfg.make_layout(128)
+        assert lay[0, 0, :].all() and lay[0, :, 0].all()  # global
+        for q in range(1, 7):
+            assert lay[0, q, q] and lay[0, q, q - 1]       # window
+
+    def test_longformer_global_indices(self):
+        cfg = BSLongformerSparsityConfig(num_heads=1, block=16,
+                                         global_block_indices=(2,))
+        lay = cfg.make_layout(128)
+        assert lay[0, 2, :].all() and lay[0, :, 2].all()
+
+    def test_indivisible_raises(self):
+        with pytest.raises(ValueError):
+            FixedSparsityConfig(num_heads=1, block=16).make_layout(100)
+
+
+class TestSparseAttention:
+    def test_dense_layout_matches_full_attention(self):
+        B, T, H, hd = 2, 64, 2, 16
+        rs = np.random.RandomState(0)
+        q, k, v = (jnp.asarray(rs.randn(B, T, H, hd), jnp.float32)
+                   for _ in range(3))
+        lay = DenseSparsityConfig(num_heads=H, block=16).make_layout(T)
+        out = sparse_attention(q, k, v, lay, 16, causal=True)
+        # reference: plain causal attention
+        scores = jnp.einsum("bthd,bshd->bhts", q, k) / np.sqrt(hd)
+        causal = jnp.tril(jnp.ones((T, T), bool))
+        probs = jax.nn.softmax(jnp.where(causal[None, None], scores,
+                                         -1e30), axis=-1)
+        ref = jnp.einsum("bhts,bshd->bthd", probs, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_blocked_mask_zeroes_blocked_scores(self):
+        """Tokens must not attend outside their allowed blocks."""
+        B, T, H, hd = 1, 64, 1, 8
+        rs = np.random.RandomState(1)
+        q = jnp.asarray(rs.randn(B, T, H, hd), jnp.float32)
+        k, v = q, jnp.asarray(rs.randn(B, T, H, hd), jnp.float32)
+        # only diagonal blocks allowed
+        lay = np.eye(4, dtype=bool)[None]
+        out = sparse_attention(q, k, v, lay, 16)
+        # per-block attention computed separately must match
+        for blk in range(4):
+            sl = slice(blk * 16, (blk + 1) * 16)
+            sub = sparse_attention(q[:, sl], k[:, sl], v[:, sl],
+                                   np.ones((1, 1, 1), bool), 16)
+            np.testing.assert_allclose(np.asarray(out[:, sl]),
+                                       np.asarray(sub), rtol=1e-5,
+                                       atol=1e-5)
+
+    def test_module_density(self):
+        att = SparseSelfAttention(FixedSparsityConfig(
+            num_heads=2, block=16, num_local_blocks=2,
+            attention="unidirectional"), causal=True)
+        assert att.density(128) < 0.6
+        q = jnp.ones((1, 128, 2, 8), jnp.float32)
+        out = att(q, q, q)
+        assert out.shape == (1, 128, 2, 8)
+
+
+class TestEigenvalue:
+    def test_quadratic_exact(self):
+        """For loss = 0.5 x^T A x the dominant eigenvalue is max |eig A|."""
+        rs = np.random.RandomState(0)
+        M = rs.randn(8, 8)
+        A = (M + M.T) / 2
+        true = np.abs(np.linalg.eigvalsh(A)).max()
+
+        def loss(params, batch):
+            x = params["x"]
+            return 0.5 * x @ jnp.asarray(A) @ x
+
+        eig, vec = Eigenvalue(max_iter=200, tol=1e-5).compute_eigenvalue(
+            loss, {"x": jnp.asarray(rs.randn(8), jnp.float32)}, None)
+        assert abs(eig - true) / true < 0.05
+
+    def test_model_eigenvalue_positive(self):
+        cfg = GPT2Config(n_layer=1, n_head=2, d_model=16, max_seq_len=16,
+                         vocab_size=32, remat=False, dtype="float32")
+        model = GPT2(cfg)
+        params = model.init(jax.random.key(0))
+        batch = {"input_ids": np.zeros((2, 16), np.int32)}
+        eig, _ = Eigenvalue(max_iter=20, tol=1e-2).compute_eigenvalue(
+            lambda p, b: model.loss(p, b, train=False), params, batch)
+        assert eig > 0
+
+
+class TestPLDAndMoQ:
+    def test_pld_schedule_decays_to_theta(self):
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert pld.update_state(0) == 1.0
+        mid = pld.update_state(100)
+        assert 0.5 < mid < 1.0
+        assert abs(pld.update_state(10**6) - 0.5) < 1e-6
+        assert pld.get_state()["progressive_layer_drop"]
+
+    def test_moq_bit_schedule(self):
+        q = Quantizer(q_target_bits=8, q_start_bits=12, q_period=10)
+        bits = [q.update(s) for s in range(0, 500, 10)]
+        assert bits[0] == 12
+        assert min(bits) == 8
+        assert sorted(bits, reverse=True) == bits  # monotone decreasing
+
+    def test_moq_quantize_tree(self):
+        q = Quantizer(q_target_bits=4, q_start_bits=4, q_period=1)
+        q.current_bits = 4
+        tree = {"w": jnp.asarray(np.random.RandomState(0).randn(32, 32),
+                                 jnp.float32),
+                "b": jnp.ones((32,), jnp.float32)}
+        out = q.quantize(tree)
+        assert len(np.unique(np.asarray(out["w"]))) <= 16
+        np.testing.assert_array_equal(np.asarray(out["b"]),
+                                      np.asarray(tree["b"]))  # 1-D skipped
+
+
+class TestOnDevice:
+    def test_abstract_init_no_memory(self):
+        cfg = GPT2Config(n_layer=2, n_head=2, d_model=32, max_seq_len=32,
+                         vocab_size=64)
+        abstract = abstract_init(GPT2(cfg))
+        leaf = jax.tree.leaves(abstract)[0]
+        assert isinstance(leaf, jax.ShapeDtypeStruct)
+
+    def test_materialize_matches_init(self):
+        cfg = GPT2Config(n_layer=1, n_head=2, d_model=16, max_seq_len=16,
+                         vocab_size=32, dtype="float32")
+        model = GPT2(cfg)
+        a = materialize(model, jax.random.key(0))
+        b = model.init(jax.random.key(0))
+        jax.tree.map(
+            lambda x, y: np.testing.assert_allclose(
+                np.asarray(x), np.asarray(y), rtol=1e-6, atol=1e-7),
+            a, b)
+
+    def test_context_intercepts_init(self):
+        cfg = GPT2Config(n_layer=1, n_head=2, d_model=16, max_seq_len=16,
+                         vocab_size=32)
+        model = GPT2(cfg)
+        assert not OnDevice.is_active()
+        with OnDevice(model, device="meta"):
+            assert OnDevice.is_active()
+            abstract = model.init(jax.random.key(0))
+            assert all(isinstance(l, jax.ShapeDtypeStruct)
+                       for l in jax.tree.leaves(abstract))
+        assert not OnDevice.is_active()
+        real = model.init(jax.random.key(0))  # restored
+        assert not isinstance(jax.tree.leaves(real)[0],
+                              jax.ShapeDtypeStruct)
+
+
+class TestHybridEngine:
+    def test_train_and_generate_share_weights(self):
+        from deepspeed_tpu.runtime.hybrid_engine import DeepSpeedHybridEngine
+        groups.reset()
+        cfg = GPT2Config(n_layer=2, n_head=2, d_model=32, max_seq_len=64,
+                         vocab_size=64, remat=False, dtype="float32")
+        engine = DeepSpeedHybridEngine(
+            model=GPT2(cfg),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 5e-3}},
+                    "steps_per_print": 0},
+            inference_config={"prompt_bucket": 16, "dtype": "float32"})
+        data = (np.arange(engine.config.train_batch_size * 32)
+                .reshape(-1, 32) % 64).astype(np.int32)
+        out_before = engine.generate(data[:1, :8], max_new_tokens=4,
+                                     temperature=0.0)
+        for _ in range(10):
+            engine.train_batch({"input_ids": data})
+        out_after = engine.generate(data[:1, :8], max_new_tokens=4,
+                                    temperature=0.0)
+        # training a memorizable ramp changes the generation
+        ids = data[0, :8]
+        # after training on the ramp, generation continues it
+        expect = (np.arange(8, 12)) % 64
+        assert (out_after[0] == expect).sum() >= 3, (out_after, expect)
+        assert not np.array_equal(out_before, out_after)
