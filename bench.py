@@ -28,15 +28,23 @@ from deepspeed_tpu.utils import groups
 def main():
     preset = os.environ.get("BENCH_PRESET", "350M")
     seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
-    micro = int(os.environ.get("BENCH_MICRO_BS", "8"))
+    micro = int(os.environ.get("BENCH_MICRO_BS", "24"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
 
     cfg = PRESETS[preset]
-    if seq_len != cfg.max_seq_len:
-        from dataclasses import replace
-        cfg = replace(cfg, max_seq_len=seq_len)
+    from dataclasses import replace
+    # tuned v5e config: pallas flash attention with a full-KV inner loop
+    # + per-layer remat (~2x over the dense-attention baseline). Chunked
+    # cross entropy (BENCH_LOSS_CHUNK=256) trades ~2% speed for the
+    # (B,T,V) fp32 logits never materializing — needed for larger micro
+    # batches / vocabs; bs=24 fits dense, so default off.
+    flash = os.environ.get("BENCH_FLASH", "1") == "1"
+    cfg = replace(cfg, max_seq_len=seq_len,
+                  use_flash_attention=flash,
+                  flash_block_q=512, flash_block_k=1024,
+                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")))
     model = GPT2(cfg)
 
     n_dev = len(jax.devices())

@@ -1,0 +1,3 @@
+from .universal import (consolidate_to_fp32, load_consolidated,
+                        ds_to_universal, load_universal_param,
+                        inspect_checkpoint)

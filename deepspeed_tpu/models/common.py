@@ -1,0 +1,59 @@
+"""Helpers shared by the model zoo (GPT2, Llama, ...)."""
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def constrain_fn():
+    """Sharding constraints are advisory: no-ops without an active mesh
+    (single-device tests / eager use) and under fully-manual meshes
+    (inside shard_map, e.g. the 1-bit trainer), GSPMD directives
+    otherwise."""
+    mesh = jax.sharding.get_abstract_mesh()
+    from jax.sharding import AxisType
+    if mesh.empty or not any(t == AxisType.Auto for t in mesh.axis_types):
+        return lambda x, spec: x
+    return lax.with_sharding_constraint
+
+
+def next_token_xent(logits, ids):
+    """Mean next-token cross entropy from dense (B, T, V) fp32 logits."""
+    targets = ids[:, 1:]
+    logits = logits[:, :-1]
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    gold = jnp.take_along_axis(logits, targets[..., None], axis=-1)[..., 0]
+    return jnp.mean(logz - gold)
+
+
+def chunked_softmax_xent(head_fn, params, hidden, targets, chunk):
+    """Mean next-token CE over (B, T, D) hidden states computed ``chunk``
+    tokens at a time: ``head_fn(params, x_chunk)`` produces fp32 logits
+    for just that chunk and remat recomputes them in backward, so peak
+    logits memory is (B, chunk, V) instead of (B, T, V). Any T: the
+    sequence is zero-padded to a chunk multiple and padded positions are
+    masked out of the sum. Exact same value as the dense computation."""
+    B, T, D = hidden.shape
+    n = -(-T // chunk)
+    pad = n * chunk - T
+    if pad:
+        hidden = jnp.pad(hidden, ((0, 0), (0, pad), (0, 0)))
+        targets = jnp.pad(targets, ((0, 0), (0, pad)))
+    valid = (jnp.arange(n * chunk) < T).reshape(n, 1, chunk)  # (n, 1, c)
+    xs = hidden.reshape(B, n, chunk, D).swapaxes(0, 1)      # (n, B, c, D)
+    ts = targets.reshape(B, n, chunk).swapaxes(0, 1)
+
+    @jax.checkpoint
+    def chunk_loss(x, t, m):
+        logits = head_fn(params, x)                         # (B, c, V) f32
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, t[..., None], axis=-1)[..., 0]
+        return jnp.sum(jnp.where(m, logz - gold, 0.0))
+
+    def body(acc, xtm):
+        x, t, m = xtm
+        return acc + chunk_loss(x, t, m), None
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32),
+                        (xs, ts, valid))
+    return total / (B * T)

@@ -33,6 +33,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..utils.groups import BATCH_AXES
+from .common import chunked_softmax_xent, constrain_fn, next_token_xent
 
 
 @dataclass(frozen=True)
@@ -47,6 +48,8 @@ class GPT2Config:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     use_flash_attention: bool = False  # pallas kernel (TPU only)
+    flash_block_q: int = 128           # pallas attention tile sizes
+    flash_block_k: int = 128
     # 'dense': GSPMD Ulysses resharding (all_to_all pair) when seq-sharded.
     # 'ring': ring/context-parallel attention (sequence/ring.py) — KV blocks
     #         rotate over the 'seq' axis; no head-count constraint.
@@ -54,6 +57,10 @@ class GPT2Config:
     # pipeline parallelism (GPT2Pipe): microbatches in flight; 0 = auto
     # (2x the pipe axis size, amortizing the fill/drain bubble)
     pipe_microbatches: int = 0
+    # chunked cross entropy: unembed+CE computed per loss_chunk tokens
+    # under remat so the full (B, T, V) fp32 logits never materialize
+    # (0 = off). Big-vocab memory saver; exact same loss value.
+    loss_chunk: int = 0
 
     @property
     def d_head(self):
@@ -180,9 +187,11 @@ class GPT2:
         return logits
 
     def apply_with_aux(self, params, input_ids, *, rng=None, train=False,
-                       seq_sharded=False):
+                       seq_sharded=False, return_hidden=False):
         """Return (logits (B, T, V) fp32, summed aux loss) — aux is the MoE
-        load-balance loss (0 for dense models).
+        load-balance loss (0 for dense models). ``return_hidden`` skips the
+        unembed and returns the (B, T, D) hidden states instead (the
+        chunked-loss path).
 
         ``seq_sharded``: inputs/activations carry T on the 'seq' mesh axis
         (Ulysses). Attention re-constrains heads onto 'seq' so XLA emits the
@@ -218,19 +227,12 @@ class GPT2:
             return x, aux
 
         x, auxs = lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+        if return_hidden:
+            return x, jnp.sum(auxs)
         return self.head(params, x), jnp.sum(auxs)
 
     def _constrain_fn(self):
-        """Sharding constraints are advisory: no-ops without an active mesh
-        (single-device tests / eager use) and under fully-manual meshes
-        (inside shard_map, e.g. the 1-bit trainer), GSPMD directives
-        otherwise."""
-        mesh = jax.sharding.get_abstract_mesh()
-        from jax.sharding import AxisType
-        if mesh.empty or not any(t == AxisType.Auto for t in
-                                 mesh.axis_types):
-            return lambda x, spec: x
-        return lax.with_sharding_constraint
+        return constrain_fn()
 
     def embed(self, params, input_ids, *, rng, train, constrain, act_spec):
         """Token + position embedding (B, T) -> (B, T, D); validates the
@@ -285,7 +287,9 @@ class GPT2:
             q = constrain(q, head_spec)
             kk = constrain(kk, head_spec)
             v = constrain(v, head_spec)
-            attn = flash_attention(q, kk, v, causal=True).astype(dt)
+            attn = flash_attention(q, kk, v, causal=True,
+                                   block_q=cfg.flash_block_q,
+                                   block_k=cfg.flash_block_k).astype(dt)
         else:
             if seq_sharded:
                 # Ulysses: heads onto 'seq', sequence gathered
@@ -542,14 +546,23 @@ class GPT2:
     def loss(self, params, batch, *, rng=None, train=True, seq_sharded=False):
         """Next-token cross entropy. batch: {"input_ids": (B, T) int32}."""
         ids = batch["input_ids"]
+        cfg = self.config
+        T = ids.shape[1]
+        chunk = cfg.loss_chunk
+        if chunk and T - 1 > chunk and not seq_sharded:
+            # chunked CE: never materialize the full (B, T, V) fp32 logits
+            # (3.3 GB at B=16, T=1024, V=50k) — unembed + CE per sequence
+            # chunk under remat, recomputed in backward
+            x, aux = self.apply_with_aux(params, ids, rng=rng, train=train,
+                                         seq_sharded=seq_sharded,
+                                         return_hidden=True)
+            return chunked_softmax_xent(
+                self.head, params, x[:, :-1], ids[:, 1:], chunk) \
+                + self.moe_loss_coeff * aux
         logits, aux = self.apply_with_aux(params, ids, rng=rng, train=train,
                                           seq_sharded=seq_sharded)
-        targets = ids[:, 1:]
-        logits = logits[:, :-1]
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, targets[..., None],
-                                   axis=-1)[..., 0]
-        return jnp.mean(logz - gold) + self.moe_loss_coeff * aux
+        return next_token_xent(logits, ids) + self.moe_loss_coeff * aux
+
 
 
 def _layernorm(x, scale, bias, eps=1e-5):

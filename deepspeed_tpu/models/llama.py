@@ -23,6 +23,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..utils.groups import BATCH_AXES
+from .common import chunked_softmax_xent, constrain_fn, next_token_xent
 
 
 @dataclass(frozen=True)
@@ -40,6 +41,11 @@ class LlamaConfig:
     remat: bool = True
     remat_policy: str = "nothing_saveable"
     tie_embeddings: bool = False
+    # chunked cross entropy (see gpt2.GPT2Config.loss_chunk); 0 = off
+    loss_chunk: int = 0
+    use_flash_attention: bool = False  # pallas kernel (TPU)
+    flash_block_q: int = 512
+    flash_block_k: int = 1024
 
     @property
     def d_head(self):
@@ -99,10 +105,7 @@ def _rope(x, pos, theta):
 
 def _repeat_kv(k, n_rep):
     """(B, T, KVH, hd) -> (B, T, KVH*n_rep, hd)."""
-    if n_rep == 1:
-        return k
-    B, T, KVH, hd = k.shape
-    return jnp.repeat(k, n_rep, axis=2)
+    return k if n_rep == 1 else jnp.repeat(k, n_rep, axis=2)
 
 
 class Llama:
@@ -175,12 +178,7 @@ class Llama:
 
     # --------------------------------------------------------------- forward
     def _constrain_fn(self):
-        mesh = jax.sharding.get_abstract_mesh()
-        from jax.sharding import AxisType
-        if mesh.empty or not any(t == AxisType.Auto for t in
-                                 mesh.axis_types):
-            return lambda x, spec: x
-        return lax.with_sharding_constraint
+        return constrain_fn()
 
     def head(self, params, x):
         x = _rms_norm(x, params["norm_f"], self.config.rms_eps)
@@ -219,20 +217,27 @@ class Llama:
         v = constrain(v, head_spec)
         kk = _repeat_kv(kk, H // KVH)
         v = _repeat_kv(v, H // KVH)
-        scores = jnp.einsum("bthd,bshd->bhts", q, kk,
-                            preferred_element_type=jnp.float32)
-        scores = scores / math.sqrt(hd)
-        scores = jnp.where(causal[None, None], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-        attn = jnp.einsum("bhts,bshd->bthd", probs, v).reshape(B, T,
-                                                               H * hd)
+        if cfg.use_flash_attention:
+            from ..ops.pallas.flash_attention import flash_attention
+            attn = flash_attention(q, kk, v, causal=True,
+                                   block_q=cfg.flash_block_q,
+                                   block_k=cfg.flash_block_k).astype(dt)
+            attn = attn.reshape(B, T, H * hd)
+        else:
+            scores = jnp.einsum("bthd,bshd->bhts", q, kk,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            scores = jnp.where(causal[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhts,bshd->bthd", probs,
+                              v).reshape(B, T, H * hd)
         x = x + constrain(attn, act_spec) @ layer["wo"]
         x = constrain(x, act_spec)
         x = x + self._mlp(x, layer)
         return constrain(x, act_spec)
 
     def apply(self, params, input_ids, *, rng=None, train=False,
-              seq_sharded=False):
+              seq_sharded=False, return_hidden=False):
         cfg = self.config
         T = input_ids.shape[1]
         constrain = self._constrain_fn()
@@ -255,6 +260,8 @@ class Llama:
 
         x, _ = lax.scan(lambda c, l: (block_fn(c, l), None), x,
                         params["blocks"])
+        if return_hidden:
+            return x
         return self.head(params, x)
 
     def apply_with_aux(self, params, input_ids, **kw):
@@ -264,14 +271,16 @@ class Llama:
     def loss(self, params, batch, *, rng=None, train=True,
              seq_sharded=False):
         ids = batch["input_ids"]
+        T = ids.shape[1]
+        chunk = self.config.loss_chunk
+        if chunk and T - 1 > chunk and not seq_sharded:
+            x = self.apply(params, ids, rng=rng, train=train,
+                           seq_sharded=seq_sharded, return_hidden=True)
+            return chunked_softmax_xent(self.head, params, x[:, :-1],
+                                        ids[:, 1:], chunk)
         logits = self.apply(params, ids, rng=rng, train=train,
                             seq_sharded=seq_sharded)
-        targets = ids[:, 1:]
-        logits = logits[:, :-1]
-        logz = jax.nn.logsumexp(logits, axis=-1)
-        gold = jnp.take_along_axis(logits, targets[..., None],
-                                   axis=-1)[..., 0]
-        return jnp.mean(logz - gold)
+        return next_token_xent(logits, ids)
 
     # ------------------------------------------------- v1 KV-cache decoding
     def init_cache(self, batch_size, max_len, dtype=None):

@@ -1,0 +1,1 @@
+from .auto_tp import AutoTP, autotp_partition_specs

@@ -1,0 +1,97 @@
+"""AutoTP — policy-free tensor-parallel sharding by name heuristics.
+
+Counterpart of reference ``module_inject/auto_tp.py:188 AutoTP`` (and
+``tp_shard.py``): models without a hand-written policy get Megatron-style
+TP from MODULE-NAME heuristics. Here modules are param-tree paths: the
+same name tables decide column-parallel (output dim on 'tensor'),
+row-parallel (input dim), or replicated, with shape-divisibility guards.
+In-repo models override this with exact ``partition_specs``; AutoTP is
+the fallback for imported/converted param trees (e.g. HF weight dumps).
+"""
+
+import re
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+# name fragments -> parallel style (reference auto_tp.py maintains the
+# same kind of allow/deny lists)
+COLUMN_PATTERNS = ("wq", "wk", "wv", "wqkv", "q_proj", "k_proj", "v_proj",
+                   "query", "key", "value", "qkv", "wup", "up_proj",
+                   "wgate", "gate_proj", "fc1", "w1", "w3", "intermediate",
+                   "dense_h_to_4h")
+ROW_PATTERNS = ("wo", "o_proj", "out_proj", "wdown", "down_proj", "fc2",
+                "w2", "dense_4h_to_h", "attention.dense", "self_output")
+REPLICATED_PATTERNS = ("embed", "wte", "wpe", "norm", "ln", "rms", "bias",
+                       "lm_head", "scale")
+
+
+def _leaf_name(path):
+    parts = [str(getattr(p, "key", getattr(p, "idx", p))) for p in path]
+    return "/".join(parts), parts[-1] if parts else ""
+
+
+def _style_for(name):
+    low = name.lower()
+    for pat in REPLICATED_PATTERNS:
+        if pat in low:
+            return "replicate"
+    for pat in ROW_PATTERNS:
+        if re.search(rf"(^|[._/]){re.escape(pat)}($|[._/])", low) \
+                or low.endswith(pat):
+            return "row"
+    for pat in COLUMN_PATTERNS:
+        if re.search(rf"(^|[._/]){re.escape(pat)}($|[._/])", low) \
+                or low.endswith(pat):
+            return "column"
+    return "replicate"
+
+
+def autotp_partition_specs(params, tp_size, axis_name="tensor"):
+    """Param pytree -> PartitionSpec pytree. Column-parallel shards the
+    LAST dim, row-parallel the SECOND-TO-LAST (matrices may carry leading
+    stacked-layer dims); anything indivisible or unmatched replicates."""
+
+    def visit(path, leaf):
+        ndim = getattr(leaf, "ndim", 0)
+        shape = getattr(leaf, "shape", ())
+        full, last = _leaf_name(path)
+        if ndim < 2 or tp_size <= 1:
+            return P()
+        style = _style_for(full)
+        spec = [None] * ndim
+        if style == "column" and shape[-1] % tp_size == 0:
+            spec[-1] = axis_name
+        elif style == "row" and shape[-2] % tp_size == 0:
+            spec[-2] = axis_name
+        return P(*spec)
+
+    return jax.tree.map_with_path(visit, params)
+
+
+class AutoTP:
+    """reference AutoTP class surface: ``AutoTP(model_or_params).
+    partition_specs(topology)`` so an arbitrary param tree can drive the
+    training engine / inference engines like a zoo model."""
+
+    def __init__(self, params):
+        self.params = params
+
+    def partition_specs(self, topology=None):
+        tp = (topology.get_model_parallel_world_size()
+              if topology is not None else 1)
+        return autotp_partition_specs(self.params, tp)
+
+    def report(self, topology=None):
+        """{path: style} summary (debugging, reference prints the same)."""
+        specs = self.partition_specs(topology)
+        out = {}
+        for path, spec in jax.tree.leaves_with_path(
+                specs, is_leaf=lambda x: isinstance(x, P)):
+            full, _ = _leaf_name(path)
+            if any(e is not None for e in spec):
+                idx = [i for i, e in enumerate(spec) if e is not None][0]
+                out[full] = ("column" if idx == len(spec) - 1 else "row")
+            else:
+                out[full] = "replicate"
+        return out

@@ -96,7 +96,11 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
     l = jnp.zeros((bq,), jnp.float32)
     acc, m, l = jax.lax.fori_loop(0, kmax, body, (acc, m, l))
     o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
-    lse_ref[0] = m + jnp.log(l)
+    # lse carries a 128-wide lane dim (value replicated across lanes):
+    # per-row scalars are not tileable on TPU, so like the official TPU
+    # flash kernel we store (.., bq, 128) blocks
+    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, None],
+                                  (bq, lse_ref.shape[-1]))
 
 
 def _fwd(q, k, v, scale, causal, bq, bk, t_real, interpret):
@@ -113,11 +117,11 @@ def _fwd(q, k, v, scale, causal, bq, bk, t_real, interpret):
         ],
         out_specs=[
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, 128), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             _sds((BH, T, d), q.dtype, q),
-            _sds((BH, T), jnp.float32, q),
+            _sds((BH, T, 128), jnp.float32, q),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -130,8 +134,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
     qi = pl.program_id(1)
     q = q_ref[0].astype(jnp.float32) * scale
     do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0]
-    delta = delta_ref[0]
+    lse = lse_ref[0][:, 0]
+    delta = delta_ref[0][:, 0]
     T = k_ref.shape[1]
     nk = T // bk
     kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
@@ -168,8 +172,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk, dv = carry
         q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
         do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq)]
-        delta = delta_ref[0, pl.ds(i * bq, bq)]
+        lse = lse_ref[0, pl.ds(i * bq, bq), :][:, 0]
+        delta = delta_ref[0, pl.ds(i * bq, bq), :][:, 0]
         s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                 preferred_element_type=jnp.float32)
         s = _mask_scores(s, i * bq, ki * bk, bq, bk, causal, t_real, T)
@@ -196,6 +200,7 @@ def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, t_real, interpret):
     BH, T, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                # (BH, T)
+    delta = jnp.broadcast_to(delta[..., None], lse.shape)   # lane dim
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real),
@@ -205,8 +210,8 @@ def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, t_real, interpret):
             pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
-            pl.BlockSpec((1, bq), lambda b, i: (b, i)),
+            pl.BlockSpec((1, bq, 128), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 128), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
         out_shape=_sds((BH, T, d), q.dtype, q),
@@ -221,8 +226,8 @@ def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, t_real, interpret):
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
-            pl.BlockSpec((1, T), lambda b, j: (b, 0)),
+            pl.BlockSpec((1, T, 128), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, T, 128), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
@@ -274,17 +279,21 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
     if interpret is None:
         interpret = _interpret_default()
     bq, bk, T_pad = _block_sizes(T, block_q, block_k)
+    # TPU tiling wants the lane (last) dim in 128s: zero-pad small head
+    # dims (zero columns add 0 to scores and produce zero output columns,
+    # and zero cotangent columns backward — exact)
+    d_pad = _round_up(d, 128)
 
     def fold(x):
         x = x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
-        if T_pad != T:
-            x = jnp.pad(x, ((0, 0), (0, T_pad - T), (0, 0)))
+        if T_pad != T or d_pad != d:
+            x = jnp.pad(x, ((0, 0), (0, T_pad - T), (0, d_pad - d)))
         return x
 
     o = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal),
                bq, bk, T, bool(interpret))
-    if T_pad != T:
-        o = o[:, :T]
+    if T_pad != T or d_pad != d:
+        o = o[:, :T, :d]
     return o.reshape(B, H, T, d).transpose(0, 2, 1, 3)
 
 

@@ -60,23 +60,29 @@ class FixedSparsityConfig(SparsityConfig):
     def make_layout(self, seq_len):
         layout, n = self.setup_layout(seq_len)
         L, G = self.num_local_blocks, self.num_global_blocks
-        for h in range(self.num_heads):
+        heads = (self.num_heads if self.different_layout_per_head else 1)
+        # global block indices: the last G blocks of every window
+        gidx = [b for w0 in range(0, n, L)
+                for b in range(max(w0 + L - G, w0), min(w0 + L, n))]
+        for h in range(heads):
             for q in range(n):
                 w = q // L
                 # local window
                 start = w * L
                 end = min(start + L, n)
                 layout[h, q, start:end] = True
-                # global: last G blocks of every previous window
-                for pw in range(w):
-                    ps = pw * L
-                    pe = min(ps + L, n)
-                    layout[h, q, max(pe - G, ps):pe] = True
-                if self.attention == "bidirectional" \
-                        and self.horizontal_global_attention:
-                    # global rows attend everywhere
-                    gs = max(end - G, start)
-                    layout[h, gs:end, :] = True
+                if self.attention == "unidirectional":
+                    # global: last G blocks of every previous window
+                    for pw in range(w):
+                        ps = pw * L
+                        pe = min(ps + L, n)
+                        layout[h, q, max(pe - G, ps):pe] = True
+            if self.attention == "bidirectional":
+                # every query sees every global block (reference sets the
+                # global columns for ALL rows)
+                layout[h][:, gidx] = True
+                if self.horizontal_global_attention:
+                    layout[h][gidx, :] = True
         if self.attention == "unidirectional":
             tril = np.tril(np.ones((n, n), dtype=bool))
             layout &= tril[None]
@@ -101,7 +107,8 @@ class BigBirdSparsityConfig(SparsityConfig):
         layout, n = self.setup_layout(seq_len)
         rs = np.random.RandomState(self.seed)
         W = self.num_sliding_window_blocks
-        for h in range(self.num_heads):
+        heads = (self.num_heads if self.different_layout_per_head else 1)
+        for h in range(heads):
             for q in range(n):
                 lo = max(0, q - W // 2)
                 layout[h, q, lo:min(n, q + W // 2 + 1)] = True
@@ -139,7 +146,8 @@ class BSLongformerSparsityConfig(SparsityConfig):
     def make_layout(self, seq_len):
         layout, n = self.setup_layout(seq_len)
         W = self.num_sliding_window_blocks
-        for h in range(self.num_heads):
+        heads = (self.num_heads if self.different_layout_per_head else 1)
+        for h in range(heads):
             for q in range(n):
                 lo = max(0, q - W // 2)
                 layout[h, q, lo:min(n, q + W // 2 + 1)] = True

@@ -144,6 +144,13 @@ ENGINES = {
     "async": AsyncCheckpointEngine,
     "native": NativeCheckpointEngine,
     "none": NoneCheckpointEngine,
+    # reference-fork config names (engine.py:931-963 selection) map onto
+    # the equivalent TPU engines: torch -> sync; veloc/datastates (C++
+    # pinned-cache writer pipelines) -> native; torch_sn_async -> async
+    "torch": SyncCheckpointEngine,
+    "veloc": NativeCheckpointEngine,
+    "datastates": NativeCheckpointEngine,
+    "torch_sn_async": AsyncCheckpointEngine,
 }
 
 

@@ -127,6 +127,9 @@ class OptimizerStateSwapper:
     lists."""
 
     def __init__(self, swap_dir, **kw):
+        # the durable manifest certifies leaf data: leaves must reach the
+        # platter, so fsync defaults ON here (unlike the raw swapper)
+        kw.setdefault("fsync", True)
         self.swapper = AsyncTensorSwapper(swap_dir, **kw)
         self.dir = swap_dir
 
@@ -134,6 +137,10 @@ class OptimizerStateSwapper:
         return os.path.join(self.dir, f"{key}.manifest.json")
 
     def swap_out_tree(self, key, tree, blocking=False):
+        """blocking=False overlaps the NVMe writes with caller compute;
+        the durable manifest is deferred until ``wait()`` (or the next
+        swap_in of the key), so it always lands AFTER its leaf data —
+        a crash before wait() leaves the previous manifest intact."""
         tree = jax.tree.map(lambda x: np.asarray(jax.device_get(x)), tree)
         metas = []
         skel = _skeleton(tree, metas)
@@ -142,21 +149,29 @@ class OptimizerStateSwapper:
         names = [f"{key}.{i}" for i in range(len(leaves))]
         for name, leaf in zip(names, leaves):
             self.swapper.swap_out(name, leaf, blocking=blocking)
-        # the manifest is the durability marker: it must land only after
-        # every leaf write did, else a crash between them restores torn or
-        # stale leaves with no error
-        for name in names:
+        self._pending = getattr(self, "_pending", {})
+        self._pending[key] = {"names": names, "skeleton": skel,
+                              "metas": metas}
+        if blocking:
+            self._finalize(key)
+        return key
+
+    def _finalize(self, key):
+        m = self._pending.pop(key, None)
+        if m is None:
+            return
+        for name in m["names"]:
             self.swapper.wait(name)
         tmp = self._manifest(key) + ".tmp"
         with open(tmp, "w") as f:
-            json.dump({"names": names, "skeleton": skel, "metas": metas},
-                      f)
+            json.dump(m, f)
             f.flush()
             os.fsync(f.fileno())
         os.replace(tmp, self._manifest(key))
-        return key
 
     def swap_in_tree(self, key):
+        if key in getattr(self, "_pending", {}):
+            self._finalize(key)
         with open(self._manifest(key)) as f:
             m = json.load(f)
         leaves = []
@@ -167,9 +182,12 @@ class OptimizerStateSwapper:
         return _from_skeleton(m["skeleton"], leaves)
 
     def wait(self):
+        for key in list(getattr(self, "_pending", {})):
+            self._finalize(key)
         return self.swapper.wait()
 
     def close(self):
+        self.wait()
         self.swapper.close()
 
 
