@@ -1,0 +1,95 @@
+"""Collective-communication microbenchmark.
+
+Counterpart of reference ``bin/ds_bench`` + ``benchmarks/communication``
+(all_reduce/all_gather/all_to_all sweeps): times each collective over the
+current mesh's data axes across a size sweep and prints algorithmic
+bandwidth. Run on any topology:
+
+    python benchmarks/comm_bench.py [--sizes-mb 1 16 64] [--trials 10]
+
+On a single chip the numbers are loopback; on a pod they measure ICI/DCN.
+"""
+
+import argparse
+import time
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu import comm as dist
+from deepspeed_tpu.utils import groups
+
+
+def _timeit(fn, x, trials):
+    out = fn(x)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        out = fn(x)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / trials
+
+
+def bench(sizes_mb, trials=10, axis="data"):
+    topo = groups.get_topology()
+    mesh = topo.mesh
+    W = mesh.shape[axis]
+    results = []
+
+    def make(op_name, body, out_specs):
+        return op_name, jax.jit(lambda x: shard_map(
+            body, mesh=mesh, in_specs=P(axis),
+            out_specs=out_specs, check_vma=False)(x))
+
+    ops = [
+        make("all_reduce", lambda x: dist.all_reduce(x, axis), P(axis)),
+        make("all_gather",
+             lambda x: dist.all_gather(x, axis), P(None, axis)),
+        make("reduce_scatter",
+             lambda x: dist.reduce_scatter(x.reshape(W, -1), axis),
+             P(axis)),
+        make("all_to_all",
+             lambda x: dist.all_to_all(x.reshape(W, -1), axis, 0, 0),
+             P(axis)),
+        make("quantized_reduce_scatter",
+             lambda x: dist.quantized_reduce_scatter(x.reshape(-1), axis),
+             P(axis)),
+    ]
+    for mb in sizes_mb:
+        n = int(mb * 1e6 / 4)
+        n = max(W * 2048, n // (W * 2048) * (W * 2048))
+        x = jnp.asarray(np.random.RandomState(0).randn(W, n // W),
+                        jnp.float32)
+        for name, fn in ops:
+            try:
+                dt = _timeit(fn, x, trials)
+                # algorithmic bandwidth: bytes moved per rank ~ 2(W-1)/W
+                # x payload for ring allreduce; report payload/s (simple,
+                # comparable across ops like the reference does)
+                gbps = x.nbytes / dt / 1e9
+                results.append((name, mb, dt * 1e3, gbps))
+                print(f"{name:28s} {mb:6.1f}MB  {dt * 1e3:8.3f}ms "
+                      f"{gbps:8.2f} GB/s")
+            except Exception as e:  # noqa: BLE001
+                print(f"{name:28s} {mb:6.1f}MB  FAIL {e}")
+    return results
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--sizes-mb", type=float, nargs="+",
+                    default=[1, 16, 64])
+    ap.add_argument("--trials", type=int, default=10)
+    ap.add_argument("--axis", default="data")
+    args = ap.parse_args()
+    dist.init_distributed()
+    groups.initialize()
+    print(f"mesh: {dict(groups.get_mesh().shape)}")
+    bench(args.sizes_mb, args.trials, args.axis)
+
+
+if __name__ == "__main__":
+    main()

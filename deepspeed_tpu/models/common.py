@@ -17,6 +17,15 @@ def constrain_fn():
     return lax.with_sharding_constraint
 
 
+def resolve_remat_policy(name):
+    """Model remat_policy name -> jax.checkpoint policy. 'save_attn'
+    keeps tensors tagged checkpoint_name('attn_out') (the attention
+    outputs) and recomputes the rest."""
+    if name == "save_attn":
+        return jax.checkpoint_policies.save_only_these_names("attn_out")
+    return getattr(jax.checkpoint_policies, name, None)
+
+
 def next_token_xent(logits, ids):
     """Mean next-token cross entropy from dense (B, T, V) fp32 logits."""
     targets = ids[:, 1:]

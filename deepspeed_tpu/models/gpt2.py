@@ -33,7 +33,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..utils.groups import BATCH_AXES
-from .common import chunked_softmax_xent, constrain_fn, next_token_xent
+from .common import (chunked_softmax_xent, constrain_fn, next_token_xent,
+                     resolve_remat_policy)
 
 
 @dataclass(frozen=True)
@@ -61,6 +62,9 @@ class GPT2Config:
     # under remat so the full (B, T, V) fp32 logits never materialize
     # (0 = off). Big-vocab memory saver; exact same loss value.
     loss_chunk: int = 0
+    # lax.scan unroll over layers (1 = compact single-block program;
+    # higher trades compile time/code size for cross-layer overlap)
+    scan_unroll: int = 1
 
     @property
     def d_head(self):
@@ -215,8 +219,8 @@ class GPT2:
 
         block_fn = block
         if cfg.remat:
-            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
-            block_fn = jax.checkpoint(block, policy=policy)
+            block_fn = jax.checkpoint(
+                block, policy=resolve_remat_policy(cfg.remat_policy))
 
         layer_rngs = jax.random.split(
             rng if rng is not None else jax.random.key(0), cfg.n_layer)
@@ -226,7 +230,8 @@ class GPT2:
             x, aux = block_fn(carry, layer, lrng)
             return x, aux
 
-        x, auxs = lax.scan(scan_body, x, (params["blocks"], layer_rngs))
+        x, auxs = lax.scan(scan_body, x, (params["blocks"], layer_rngs),
+                           unroll=cfg.scan_unroll)
         if return_hidden:
             return x, jnp.sum(auxs)
         return self.head(params, x), jnp.sum(auxs)
@@ -290,6 +295,10 @@ class GPT2:
             attn = flash_attention(q, kk, v, causal=True,
                                    block_q=cfg.flash_block_q,
                                    block_k=cfg.flash_block_k).astype(dt)
+            # named so remat policies can keep it (skip recomputing the
+            # whole attention in backward): remat_policy='save_attn'
+            from jax.ad_checkpoint import checkpoint_name
+            attn = checkpoint_name(attn, "attn_out")
         else:
             if seq_sharded:
                 # Ulysses: heads onto 'seq', sequence gathered
@@ -306,6 +315,8 @@ class GPT2:
             scores = jnp.where(causal[None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs, v)
+            from jax.ad_checkpoint import checkpoint_name
+            attn = checkpoint_name(attn, "attn_out")
         attn = attn.reshape(B, T, H * hd)
         attn = constrain(attn, act_spec)
         x = x + attn @ layer["wo"] + layer["bo"]

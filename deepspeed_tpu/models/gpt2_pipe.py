@@ -56,12 +56,13 @@ class GPT2Pipe(GPT2):
         return mesh.shape["pipe"]
 
     def apply_with_aux(self, params, input_ids, *, rng=None, train=False,
-                       seq_sharded=False):
+                       seq_sharded=False, return_hidden=False):
         S = self._pipe_size()
         if S == 1:
             return super().apply_with_aux(params, input_ids, rng=rng,
                                           train=train,
-                                          seq_sharded=seq_sharded)
+                                          seq_sharded=seq_sharded,
+                                          return_hidden=return_hidden)
         cfg = self.config
         if cfg.attention_backend == "ring":
             raise NotImplementedError(
@@ -98,8 +99,9 @@ class GPT2Pipe(GPT2):
             return y
 
         if cfg.remat:
-            policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
-            block_fn = jax.checkpoint(block_fn, policy=policy)
+            from .common import resolve_remat_policy
+            block_fn = jax.checkpoint(
+                block_fn, policy=resolve_remat_policy(cfg.remat_policy))
 
         layer_rngs = jax.random.split(
             rng if rng is not None else jax.random.key(0), cfg.n_layer)
@@ -112,4 +114,6 @@ class GPT2Pipe(GPT2):
         x = constrain(x, act_spec)
 
         # --- head (outside the pipe) ---
+        if return_hidden:
+            return x, jnp.zeros((), jnp.float32)
         return self.head(params, x), jnp.zeros((), jnp.float32)

@@ -32,7 +32,8 @@ def _leaf_name(path):
 
 
 def _style_for(name):
-    low = name.lower()
+    # paths are '/'-joined; reference pattern tables use '.' — normalize
+    low = name.lower().replace("/", ".")
     for pat in REPLICATED_PATTERNS:
         if pat in low:
             return "replicate"

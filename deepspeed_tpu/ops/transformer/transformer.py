@@ -1,0 +1,140 @@
+"""Standalone fused transformer encoder layer.
+
+Counterpart of reference ``ops/transformer/transformer.py:296
+DeepSpeedTransformerLayer`` (+ DeepSpeedTransformerConfig) backed by
+``csrc/transformer/`` — the fused BERT-style encoder block with pre/post
+LayerNorm variants. On TPU the fusion is XLA's job (plus the Pallas flash
+kernel for attention); this module delivers the same drop-in surface:
+config-driven, bidirectional (encoder) attention with an optional
+additive mask, returning fp32-normed hidden states.
+
+Functional like the model zoo: ``layer.init(rng) -> params``;
+``layer(params, x, mask=None, rng=None, train=False)``.
+"""
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclass
+class DeepSpeedTransformerConfig:
+    """Reference config fields (transformer.py:30-120), TPU-relevant
+    subset; the CUDA-workflow knobs (stream injection, fp16 flags,
+    stochastic_mode) have no analogue and are accepted for parity."""
+    batch_size: int = -1               # informational (shapes are dynamic)
+    hidden_size: int = 768
+    intermediate_size: int = 0         # 0 = 4 * hidden
+    heads: int = 12
+    attn_dropout_ratio: float = 0.0
+    hidden_dropout_ratio: float = 0.0
+    num_hidden_layers: int = 1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = True
+    normalize_invertible: bool = False   # accepted, no-op (remat instead)
+    gelu_checkpoint: bool = False        # accepted, no-op
+    stochastic_mode: bool = False        # accepted, no-op
+    use_flash_attention: bool = False
+    dtype: str = "float32"
+
+    @property
+    def d_ff(self):
+        return self.intermediate_size or 4 * self.hidden_size
+
+
+def _ln(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale + bias).astype(x.dtype)
+
+
+def _dropout(x, rate, rng):
+    if not rate or rng is None:
+        return x
+    keep = jax.random.bernoulli(rng, 1.0 - rate, x.shape)
+    return jnp.where(keep, x / (1.0 - rate), 0.0).astype(x.dtype)
+
+
+class DeepSpeedTransformerLayer:
+    def __init__(self, config: DeepSpeedTransformerConfig):
+        self.config = config
+
+    def init(self, rng):
+        cfg = self.config
+        D, F = cfg.hidden_size, cfg.d_ff
+        dt = jnp.dtype(cfg.dtype)
+        ks = iter(jax.random.split(rng, 8))
+        s = cfg.initializer_range
+
+        def nrm(shape):
+            return (jax.random.normal(next(ks), shape, jnp.float32)
+                    * s).astype(dt)
+
+        return {
+            "ln1_scale": jnp.ones((D,), jnp.float32),
+            "ln1_bias": jnp.zeros((D,), jnp.float32),
+            "wqkv": nrm((D, 3 * D)), "bqkv": jnp.zeros((3 * D,), dt),
+            "wo": nrm((D, D)), "bo": jnp.zeros((D,), dt),
+            "ln2_scale": jnp.ones((D,), jnp.float32),
+            "ln2_bias": jnp.zeros((D,), jnp.float32),
+            "wi": nrm((D, F)), "bi": jnp.zeros((F,), dt),
+            "wout": nrm((F, D)), "bout": jnp.zeros((D,), dt),
+        }
+
+    def __call__(self, params, x, mask=None, rng=None, train=False):
+        """x: (B, T, D); mask: optional (B, T) validity or (B, 1, T, T)
+        additive fp32 mask (BERT-style)."""
+        cfg = self.config
+        D, H = cfg.hidden_size, cfg.heads
+        hd = D // H
+        B, T = x.shape[0], x.shape[1]
+        eps = cfg.layer_norm_eps
+        r_attn = r_hidden = r_mlp = None
+        if train and rng is not None:
+            r_attn, r_hidden, r_mlp = jax.random.split(rng, 3)
+
+        h = _ln(x, params["ln1_scale"], params["ln1_bias"], eps) \
+            if cfg.pre_layer_norm else x
+        qkv = (h @ params["wqkv"] + params["bqkv"]).reshape(B, T, 3, H, hd)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        attn_drop = cfg.attn_dropout_ratio if train else 0.0
+        # flash path has no probability-dropout hook: fall back to dense
+        # whenever attn dropout must actually apply (never drop silently)
+        if cfg.use_flash_attention and mask is None and not attn_drop:
+            from ..pallas.flash_attention import flash_attention
+            attn = flash_attention(q, k, v, causal=False).astype(x.dtype)
+        else:
+            scores = jnp.einsum("bthd,bshd->bhts", q, k,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            if mask is not None:
+                if mask.ndim == 2:       # (B, T) validity -> additive
+                    add = jnp.where(mask[:, None, None, :], 0.0, -1e30)
+                else:
+                    add = mask
+                scores = scores + add
+            probs = jax.nn.softmax(scores, axis=-1)
+            probs = _dropout(probs.astype(x.dtype), attn_drop, r_attn)
+            attn = jnp.einsum("bhts,bshd->bthd", probs, v)
+        attn = attn.reshape(B, T, D) @ params["wo"] + params["bo"]
+        attn = _dropout(attn, cfg.hidden_dropout_ratio if train else 0.0,
+                        r_hidden)
+        x = x + attn
+        if not cfg.pre_layer_norm:
+            x = _ln(x, params["ln1_scale"], params["ln1_bias"], eps)
+
+        h = _ln(x, params["ln2_scale"], params["ln2_bias"], eps) \
+            if cfg.pre_layer_norm else x
+        inter = jax.nn.gelu(h @ params["wi"] + params["bi"])
+        out = inter @ params["wout"] + params["bout"]
+        out = _dropout(out, cfg.hidden_dropout_ratio if train else 0.0,
+                       r_mlp)
+        x = x + out
+        if not cfg.pre_layer_norm:
+            x = _ln(x, params["ln2_scale"], params["ln2_bias"], eps)
+        return x

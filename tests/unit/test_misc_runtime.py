@@ -217,3 +217,42 @@ class TestHybridEngine:
         expect = (np.arange(8, 12)) % 64
         assert (out_after[0] == expect).sum() >= 3, (out_after, expect)
         assert not np.array_equal(out_before, out_after)
+
+
+class TestDeepSpeedTransformerLayer:
+    def _layer(self, **kw):
+        from deepspeed_tpu.ops.transformer import (
+            DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
+        cfg = DeepSpeedTransformerConfig(hidden_size=32, heads=4, **kw)
+        layer = DeepSpeedTransformerLayer(cfg)
+        params = layer.init(jax.random.key(0))
+        return layer, params
+
+    def test_forward_shapes_pre_and_post_ln(self):
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 16, 32),
+                        jnp.float32)
+        for pre in (True, False):
+            layer, params = self._layer(pre_layer_norm=pre)
+            out = layer(params, x)
+            assert out.shape == x.shape
+            assert np.isfinite(np.asarray(out)).all()
+
+    def test_mask_blocks_attention(self):
+        """Padding positions must not affect valid positions' outputs."""
+        layer, params = self._layer()
+        rs = np.random.RandomState(1)
+        x = jnp.asarray(rs.randn(1, 8, 32), jnp.float32)
+        mask = jnp.asarray([[1, 1, 1, 1, 0, 0, 0, 0]], bool)
+        out_a = layer(params, x, mask=mask)
+        x_b = x.at[:, 4:].set(jnp.asarray(rs.randn(1, 4, 32)))
+        out_b = layer(params, x_b, mask=mask)
+        np.testing.assert_allclose(np.asarray(out_a[:, :4]),
+                                   np.asarray(out_b[:, :4]), rtol=1e-5,
+                                   atol=1e-5)
+
+    def test_differentiable(self):
+        layer, params = self._layer()
+        x = jnp.asarray(np.random.RandomState(2).randn(1, 8, 32),
+                        jnp.float32)
+        g = jax.grad(lambda p: jnp.sum(layer(p, x) ** 2))(params)
+        assert float(jnp.abs(g["wqkv"]).max()) > 0
