@@ -13,6 +13,11 @@ On a single chip the numbers are loopback; on a pod they measure ICI/DCN.
 import argparse
 import time
 
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 import numpy as np
 import jax
 import jax.numpy as jnp

@@ -151,6 +151,7 @@ ENGINES = {
     "veloc": NativeCheckpointEngine,
     "datastates": NativeCheckpointEngine,
     "torch_sn_async": AsyncCheckpointEngine,
+    "nebula": AsyncCheckpointEngine,   # Azure tiered async -> async
 }
 
 
