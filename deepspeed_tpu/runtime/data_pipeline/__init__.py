@@ -1,3 +1,5 @@
 from .curriculum_scheduler import CurriculumScheduler
 from .data_sampler import DeepSpeedDataSampler
 from .random_ltd import RandomLTDScheduler, token_drop
+from .indexed_dataset import (IndexedDatasetBuilder,
+                              MMapIndexedDataset, FixedSeqDataset)

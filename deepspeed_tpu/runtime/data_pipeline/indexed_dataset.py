@@ -1,0 +1,122 @@
+"""Memory-mapped indexed dataset.
+
+Counterpart of reference ``runtime/data_pipeline/data_sampling/
+indexed_dataset.py:619`` (the Megatron MMapIndexedDataset family): token
+sequences stored as one flat binary file plus an index of (offset, length)
+per document, read zero-copy through numpy memmap — the layout that lets
+a multi-TB corpus feed the sampler without loading anything up front.
+
+Format (little-endian):
+  data.bin  — concatenated token arrays (one dtype for the whole file)
+  data.idx  — json header line (magic, dtype, count) then
+              int64 lengths[count]; offsets are derived (cumsum) on load
+"""
+
+import json
+import os
+
+import numpy as np
+
+_MAGIC = "DSTPU_IDX_V1"
+
+
+class IndexedDatasetBuilder:
+    """Stream documents in, then ``finalize()``:
+
+        b = IndexedDatasetBuilder("corpus", dtype=np.uint16)
+        for doc in docs: b.add_item(tokens)
+        b.finalize()
+    """
+
+    def __init__(self, path_prefix, dtype=np.int32):
+        self.prefix = path_prefix
+        self.dtype = np.dtype(dtype)
+        os.makedirs(os.path.dirname(os.path.abspath(path_prefix)),
+                    exist_ok=True)
+        self._data = open(path_prefix + ".bin", "wb")
+        self._lengths = []
+
+    def add_item(self, tokens):
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._data.write(arr.tobytes())
+        self._lengths.append(len(arr))
+
+    def finalize(self):
+        self._data.close()
+        lengths = np.asarray(self._lengths, np.int64)
+        with open(self.prefix + ".idx", "wb") as f:
+            header = {"magic": _MAGIC, "dtype": self.dtype.name,
+                      "count": len(lengths)}
+            f.write((json.dumps(header) + "\n").encode())
+            f.write(lengths.tobytes())
+        return len(lengths)
+
+
+class MMapIndexedDataset:
+    """Zero-copy document access: ``ds[i] -> np array`` (a view into the
+    mapped file; copy before mutating)."""
+
+    def __init__(self, path_prefix):
+        with open(path_prefix + ".idx", "rb") as f:
+            # bounded read + tolerant decode: a foreign/corrupt binary
+            # index must fail the MAGIC check, not raise UnicodeDecodeError
+            # or slurp a multi-GB file looking for a newline
+            first = f.readline(4096).decode("utf-8", errors="replace")
+            try:
+                header = json.loads(first)
+            except json.JSONDecodeError:
+                header = {}
+            if header.get("magic") != _MAGIC:
+                raise ValueError(f"{path_prefix}.idx: bad magic")
+            count = header["count"]
+            self.dtype = np.dtype(header["dtype"])
+            raw = np.frombuffer(f.read(), dtype=np.int64)
+        self.lengths = raw[:count]
+        self.offsets = np.concatenate(
+            [[0], np.cumsum(self.lengths)[:-1]]).astype(np.int64) \
+            if count else np.zeros((0,), np.int64)
+        self._mmap = np.memmap(path_prefix + ".bin", dtype=self.dtype,
+                               mode="r")
+
+    def __len__(self):
+        return len(self.lengths)
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(len(self)))]
+        if not -len(self) <= i < len(self):
+            raise IndexError(f"document {i} out of range [0, {len(self)})")
+        off, ln = int(self.offsets[i]), int(self.lengths[i])
+        return self._mmap[off:off + ln]
+
+    @property
+    def sizes(self):
+        return self.lengths
+
+    def total_tokens(self):
+        return int(self.lengths.sum())
+
+
+class FixedSeqDataset:
+    """View an indexed dataset as fixed-length training samples (packed
+    contiguously across document boundaries, the GPT pretraining layout):
+    item i = tokens[i*seq_len : (i+1)*seq_len] as an int32 'input_ids'
+    dict, directly consumable by DeepSpeedDataLoader / the engine."""
+
+    def __init__(self, indexed: MMapIndexedDataset, seq_len):
+        self.ds = indexed
+        self.seq_len = seq_len
+        self._n = indexed.total_tokens() // seq_len
+
+    def __len__(self):
+        return self._n
+
+    def __getitem__(self, i):
+        if not -self._n <= i < self._n:
+            # real IndexError so the sequence-iteration protocol (and any
+            # bounds bug) terminates instead of yielding empty arrays
+            raise IndexError(f"sample {i} out of range [0, {self._n})")
+        i %= self._n
+        s = self.seq_len
+        flat = self.ds._mmap[i * s:(i + 1) * s]
+        return {"input_ids": np.asarray(flat, np.int32)}

@@ -271,3 +271,34 @@ class TestRandomLTD:
         assert 16 < mid < 128 and mid % 16 == 0
         assert s.update_seq(10) == 128
         assert s.update_seq(100) == 128
+
+
+class TestIndexedDataset:
+    def test_build_and_mmap_roundtrip(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            IndexedDatasetBuilder, MMapIndexedDataset, FixedSeqDataset)
+        prefix = str(tmp_path / "corpus")
+        docs = [np.arange(n, dtype=np.uint16) for n in (5, 17, 3, 64)]
+        b = IndexedDatasetBuilder(prefix, dtype=np.uint16)
+        for d in docs:
+            b.add_item(d)
+        assert b.finalize() == 4
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 4 and ds.total_tokens() == 89
+        for i, d in enumerate(docs):
+            np.testing.assert_array_equal(np.asarray(ds[i]), d)
+        # packed fixed-seq view feeds the engine directly
+        fixed = FixedSeqDataset(ds, seq_len=16)
+        assert len(fixed) == 5
+        item = fixed[1]
+        assert item["input_ids"].shape == (16,)
+        np.testing.assert_array_equal(
+            item["input_ids"],
+            np.concatenate([d for d in docs])[16:32].astype(np.int32))
+
+    def test_bad_magic_raises(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import MMapIndexedDataset
+        (tmp_path / "x.idx").write_bytes(b'{"magic": "nope"}\n')
+        (tmp_path / "x.bin").write_bytes(b"")
+        with pytest.raises(ValueError, match="bad magic"):
+            MMapIndexedDataset(str(tmp_path / "x"))
