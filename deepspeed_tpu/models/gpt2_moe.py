@@ -28,6 +28,10 @@ class GPT2MoEConfig(GPT2Config):
     noisy_gate_policy: str = None        # None | 'RSample' | 'Jitter'
     moe_loss_coeff: float = 0.01
     moe_drop_tokens: bool = True
+    # 'dense' = GShard capacity dispatch (EP-shaped); 'ragged' = dropless
+    # grouped GEMM (lax.ragged_dot) for DP/TP meshes
+    moe_backend: str = "dense"
+
 
     def num_params(self):
         dense = super().num_params()
@@ -49,7 +53,7 @@ class GPT2MoE(GPT2):
             min_capacity=config.min_capacity,
             noisy_gate_policy=config.noisy_gate_policy,
             drop_tokens=config.moe_drop_tokens,
-            dtype=jnp.dtype(config.dtype))
+            dtype=jnp.dtype(config.dtype), backend=config.moe_backend)
 
     def init(self, rng):
         import math

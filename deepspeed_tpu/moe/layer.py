@@ -10,7 +10,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .sharded_moe import TopKGate, moe_layer
+from .sharded_moe import TopKGate, moe_layer, moe_layer_ragged
 
 
 class MoE:
@@ -18,13 +18,31 @@ class MoE:
                  k=1, capacity_factor=1.0, eval_capacity_factor=1.0,
                  min_capacity=4, noisy_gate_policy=None, drop_tokens=True,
                  top2_2nd_expert_sampling=True, activation=jax.nn.gelu,
-                 dtype=jnp.bfloat16):
+                 dtype=jnp.bfloat16, backend="dense"):
+        """backend: 'dense' = GShard static-capacity dispatch (the
+        SPMD/EP-shaped path); 'ragged' = dropless grouped GEMM via
+        lax.ragged_dot (megablox / reference cutlass moe_gemm) — use
+        under DP/TP where experts are not expert-parallel-sharded."""
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
         self.num_experts = num_experts
-        self.gate = TopKGate(k, capacity_factor, eval_capacity_factor,
-                             min_capacity, noisy_gate_policy, drop_tokens,
-                             top2_2nd_expert_sampling)
+        self.k = k
+        self.backend = backend
+        if backend == "ragged":
+            # dropless routing has no capacity knobs (vacuous) but noisy
+            # gating would be silently ignored — reject, don't lie
+            if noisy_gate_policy is not None:
+                raise ValueError(
+                    "backend='ragged' uses deterministic top-k routing; "
+                    f"noisy_gate_policy={noisy_gate_policy!r} is not "
+                    "supported (use backend='dense')")
+            if k < 1:
+                raise ValueError("k must be >= 1")
+            self.gate = None
+        else:
+            self.gate = TopKGate(k, capacity_factor, eval_capacity_factor,
+                                 min_capacity, noisy_gate_policy,
+                                 drop_tokens, top2_2nd_expert_sampling)
         self.activation = activation
         self.dtype = dtype
 
@@ -62,6 +80,11 @@ class MoE:
         }
 
     def apply(self, params, x, *, rng=None, train=True, seq_sharded=False):
+        if self.backend == "ragged":
+            return moe_layer_ragged(
+                x, params["gate_w"], params["wi"], params["bi"],
+                params["wo"], params["bo"], k=self.k,
+                activation=self.activation, seq_sharded=seq_sharded)
         return moe_layer(x, params["gate_w"], params["wi"], params["bi"],
                          params["wo"], params["bo"], self.gate, rng=rng,
                          train=train, activation=self.activation,

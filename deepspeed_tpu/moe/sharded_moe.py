@@ -172,6 +172,70 @@ class TopKGate:
             self.top2_2nd_expert_sampling and train and rng is not None)
 
 
+def topk_routing(logits, k=1):
+    """Capacity-free top-k routing: (weights (S, k), experts (S, k) int32,
+    aux load-balance loss, counts (E,)). The aux term is the GShard/Switch
+    loss — E * mean(router_prob_per_expert * first_choice_frac) — while
+    ``counts`` reports ALL k dispatches per expert (the dense paths'
+    exp_counts semantics)."""
+    S, E = logits.shape
+    probs = jax.nn.softmax(logits, axis=-1)
+    weights, experts = jax.lax.top_k(probs, k)
+    if k > 1:
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+    first = jnp.sum(jax.nn.one_hot(experts[:, 0], E), axis=0)
+    l_aux = E * jnp.sum(jnp.mean(probs, axis=0) * first / S)
+    counts = jnp.sum(jax.nn.one_hot(experts, E), axis=(0, 1))
+    return weights, experts.astype(jnp.int32), l_aux, counts
+
+
+def moe_layer_ragged(tokens, gate_w, wi, bi, wo, bo, k=1, *,
+                     activation=jax.nn.gelu, seq_sharded=False):
+    """DROPLESS MoE via grouped GEMM (``lax.ragged_dot``) — the
+    megablox pattern and the counterpart of the reference's CUTLASS
+    ``moe_gemm`` (inference/v2/kernels/cutlass_ops): tokens sort by
+    assigned expert, each expert multiplies exactly its contiguous group
+    (no capacity padding, no dropped tokens), results unsort back.
+
+    Single-shard expert compute: use under DP/TP (experts replicated or
+    TP-sharded); under expert-parallel meshes the static-capacity dense
+    dispatch in ``moe_layer`` is the SPMD-shaped path.
+    Returns (y, l_aux, exp_counts) like ``moe_layer``.
+    """
+    orig_shape = tokens.shape
+    M = orig_shape[-1]
+    x = tokens.reshape(-1, M)
+    S = x.shape[0]
+    E = gate_w.shape[-1]
+
+    logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+    weights, experts, l_aux, _ = topk_routing(logits, k)
+
+    # replicate tokens k times, sort by expert for contiguous groups
+    flat_exp = experts.reshape(-1)                      # (S*k,)
+    flat_w = weights.reshape(-1).astype(tokens.dtype)
+    x_rep = jnp.repeat(x, k, axis=0)                    # (S*k, M)
+    order = jnp.argsort(flat_exp)
+    xs = x_rep[order]
+    exp_sorted = flat_exp[order]
+    group_sizes = jnp.bincount(flat_exp, length=E).astype(jnp.int32)
+
+    exp_counts = group_sizes
+    h = jax.lax.ragged_dot(xs, wi, group_sizes)         # (S*k, F)
+    h = activation(h + bi[exp_sorted])
+    out = jax.lax.ragged_dot(h, wo, group_sizes)        # (S*k, M)
+    out = out + bo[exp_sorted]
+
+    # unsort and weighted-combine the k expert outputs per token
+    unsorted = jnp.zeros_like(out).at[order].set(out)
+    y = jnp.sum((unsorted * flat_w[:, None]).reshape(S, k, M), axis=1)
+    y = y.astype(tokens.dtype).reshape(orig_shape)
+    y = _constrain(
+        y, P(BATCH_AXES, "seq" if seq_sharded else None, None)
+        if len(orig_shape) == 3 else P(BATCH_AXES, None))
+    return y, l_aux, exp_counts
+
+
 def moe_layer(tokens, gate_w, wi, bi, wo, bo, gate: TopKGate, *, rng=None,
               train=True, activation=jax.nn.gelu, seq_sharded=False):
     """Full MoE layer over flattened tokens.

@@ -71,12 +71,21 @@ class MMapIndexedDataset:
             count = header["count"]
             self.dtype = np.dtype(header["dtype"])
             raw = np.frombuffer(f.read(), dtype=np.int64)
+        if len(raw) < count:
+            raise ValueError(
+                f"{path_prefix}.idx truncated: header says {count} "
+                f"documents, index holds {len(raw)}")
         self.lengths = raw[:count]
         self.offsets = np.concatenate(
             [[0], np.cumsum(self.lengths)[:-1]]).astype(np.int64) \
             if count else np.zeros((0,), np.int64)
         self._mmap = np.memmap(path_prefix + ".bin", dtype=self.dtype,
                                mode="r")
+        total = int(self.lengths.sum())
+        if len(self._mmap) != total:
+            raise ValueError(
+                f"{path_prefix}.bin holds {len(self._mmap)} tokens but the "
+                f"index expects {total} (truncated or mismatched corpus)")
 
     def __len__(self):
         return len(self.lengths)

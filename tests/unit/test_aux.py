@@ -302,3 +302,16 @@ class TestIndexedDataset:
         (tmp_path / "x.bin").write_bytes(b"")
         with pytest.raises(ValueError, match="bad magic"):
             MMapIndexedDataset(str(tmp_path / "x"))
+
+    def test_truncated_corpus_raises(self, tmp_path):
+        from deepspeed_tpu.runtime.data_pipeline import (
+            IndexedDatasetBuilder, MMapIndexedDataset)
+        prefix = str(tmp_path / "t")
+        b = IndexedDatasetBuilder(prefix, dtype=np.uint16)
+        b.add_item(np.arange(100, dtype=np.uint16))
+        b.finalize()
+        # truncate the data file
+        with open(prefix + ".bin", "r+b") as f:
+            f.truncate(50)
+        with pytest.raises(ValueError, match="truncated or mismatched"):
+            MMapIndexedDataset(prefix)

@@ -182,3 +182,79 @@ class TestGPT2MoEEngine:
         mwi = engine.state["master"]["blocks"]["moe"]["wi"]
         flat = jax.tree.leaves(tuple(mwi.sharding.spec))
         assert "data" in flat and "expert" in flat
+
+
+class TestRaggedMoE:
+    def _params_and_x(self, k=1):
+        from deepspeed_tpu.moe.layer import MoE
+        moe = MoE(hidden_size=32, ffn_hidden_size=64, num_experts=4, k=k,
+                  capacity_factor=8.0, eval_capacity_factor=8.0,
+                  dtype=jnp.float32, backend="ragged")
+        params = moe.init(jax.random.key(0))
+        x = jnp.asarray(np.random.RandomState(0).randn(16, 32), jnp.float32)
+        return moe, params, x
+
+    def test_matches_dense_when_no_drops(self):
+        """With capacity large enough that nothing drops, dropless ragged
+        and dense dispatch compute the same function (top-1, eval mode)."""
+        from deepspeed_tpu.moe.layer import MoE
+        moe_r, params, x = self._params_and_x(k=1)
+        moe_d = MoE(hidden_size=32, ffn_hidden_size=64, num_experts=4, k=1,
+                    capacity_factor=8.0, eval_capacity_factor=8.0,
+                    dtype=jnp.float32, backend="dense")
+        y_r, aux_r, _ = moe_r.apply(params, x, train=False)
+        y_d, aux_d, _ = moe_d.apply(params, x, train=False)
+        np.testing.assert_allclose(np.asarray(y_r), np.asarray(y_d),
+                                   rtol=2e-4, atol=2e-4)
+
+    def test_dropless_property(self):
+        """Every token gets expert output even when dense capacity would
+        drop (all tokens routed to one expert, capacity tiny)."""
+        from deepspeed_tpu.moe.sharded_moe import moe_layer_ragged
+        rs = np.random.RandomState(1)
+        M, F, E, S = 16, 32, 4, 12
+        gate_w = np.zeros((M, E), np.float32)
+        gate_w[:, 2] = 1.0  # all tokens -> expert 2
+        wi = jnp.asarray(rs.randn(E, M, F), jnp.float32)
+        bi = jnp.zeros((E, F), jnp.float32)
+        wo = jnp.asarray(rs.randn(E, F, M), jnp.float32)
+        bo = jnp.zeros((E, M), jnp.float32)
+        x = jnp.asarray(np.abs(rs.randn(S, M)) + 0.5, jnp.float32)
+        y, aux, counts = moe_layer_ragged(x, jnp.asarray(gate_w), wi, bi,
+                                          wo, bo, k=1)
+        assert int(np.asarray(counts)[2]) == S
+        # no token got zeroed (dense with capacity 4 would drop 8 of 12)
+        norms = np.linalg.norm(np.asarray(y), axis=-1)
+        assert (norms > 1e-3).all()
+
+    def test_top2_ragged(self):
+        moe, params, x = self._params_and_x(k=2)
+        y, aux, counts = moe.apply(params, x, train=False)
+        assert y.shape == x.shape
+        assert float(aux) > 0
+        assert np.isfinite(np.asarray(y)).all()
+
+    def test_grad_flows(self):
+        moe, params, x = self._params_and_x()
+        g = jax.grad(lambda p: jnp.sum(
+            moe.apply(p, x, train=False)[0] ** 2))(params)
+        assert float(jnp.abs(g["wi"]).max()) > 0
+
+
+class TestRaggedMoEValidation:
+    def test_noisy_gate_rejected(self):
+        from deepspeed_tpu.moe.layer import MoE
+        with pytest.raises(ValueError, match="ragged"):
+            MoE(hidden_size=8, num_experts=2, backend="ragged",
+                noisy_gate_policy="RSample")
+
+    def test_k4_allowed_ragged(self):
+        from deepspeed_tpu.moe.layer import MoE
+        moe = MoE(hidden_size=16, ffn_hidden_size=32, num_experts=8, k=4,
+                  dtype=jnp.float32, backend="ragged")
+        params = moe.init(jax.random.key(0))
+        x = jnp.asarray(np.random.RandomState(0).randn(6, 16), jnp.float32)
+        y, aux, counts = moe.apply(params, x, train=False)
+        assert y.shape == x.shape
+        # counts reflect ALL k dispatches
+        assert int(np.asarray(counts).sum()) == 6 * 4
