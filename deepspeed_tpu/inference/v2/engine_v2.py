@@ -29,7 +29,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ...utils import groups
 from ...utils.groups import TopologyConfig
 from ...utils.logging import log_dist
-from ..engine import _sample
 from ..utils import shard_params
 from .ragged import DSStateManager
 
@@ -54,6 +53,8 @@ class _Request:
     prompt: np.ndarray
     max_new_tokens: int
     eos_token_id: int = -1
+    temperature: float = 0.0
+    top_k: int = 0
 
 
 class InferenceEngineV2:
@@ -112,8 +113,10 @@ class InferenceEngineV2:
             ranks=[0])
 
     # ------------------------------------------------------------- requests
-    def put(self, prompt, max_new_tokens=32, eos_token_id=-1, uid=None):
-        """Queue a generation request. Returns its uid."""
+    def put(self, prompt, max_new_tokens=32, eos_token_id=-1, uid=None,
+            temperature=None, top_k=None):
+        """Queue a generation request (sampling params per request, like
+        FastGen; None = the engine-config defaults). Returns its uid."""
         if uid is None:
             uid = self._uid_next
             self._uid_next += 1
@@ -131,8 +134,11 @@ class InferenceEngineV2:
                 f"request needs {mgr.blocks_needed(total)} KV blocks but "
                 f"the pool only has {mgr.allocator.total_blocks}; raise "
                 "num_kv_blocks")
-        self._pending.append(_Request(uid, prompt, max_new_tokens,
-                                      eos_token_id))
+        self._pending.append(_Request(
+            uid, prompt, max_new_tokens, eos_token_id,
+            temperature=(self.config.temperature if temperature is None
+                         else float(temperature)),
+            top_k=(self.config.top_k if top_k is None else int(top_k))))
         return uid
 
     def is_done(self, uid):
@@ -160,25 +166,46 @@ class InferenceEngineV2:
         return bool(self._pending) or self.state_mgr.n_active > 0
 
     # ------------------------------------------------------------- programs
-    def _sample_logits(self, logits, rng):
-        # shared with the v1 engine; v2 config has no top_p knob
-        return _sample(logits, rng, self.config.temperature,
-                       self.config.top_k, 1.0)
+    @staticmethod
+    def _sample_per_slot(logits, rng, temps, top_ks, all_greedy=False):
+        """Vectorized per-request sampling (FastGen carries sampling
+        params per sequence): logits (B, V), temps (B,) f32 (0 = greedy),
+        top_ks (B,) int32 (0 = off). Traced — one program serves any mix
+        of greedy and sampled requests."""
+        B, V = logits.shape
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        if all_greedy:
+            # static fast path: no full-vocab sort/categorical in the
+            # compiled program when every live request is greedy
+            return greedy
+        lt = logits / jnp.maximum(temps, 1e-6)[:, None]
+        # per-row top-k: mask everything below each row's k-th largest
+        sorted_desc = -jnp.sort(-lt, axis=-1)
+        kth_idx = jnp.clip(top_ks - 1, 0, V - 1)[:, None]
+        kth_val = jnp.take_along_axis(sorted_desc, kth_idx, axis=1)
+        masked = jnp.where((top_ks[:, None] > 0) & (lt < kth_val),
+                           -1e30, lt)
+        sampled = jax.random.categorical(rng, masked, axis=-1).astype(
+            jnp.int32)
+        return jnp.where(temps > 0, sampled, greedy)
 
     def _get_prefill(self):
         # one jit object; jax specializes per T_pad bucket shape itself
         if self._prefill_jit is None:
             model = self.model
 
-            def prefill(params, cache, ids, tb, to, length, rng):
+            def prefill(params, cache, ids, tb, to, length, rng, temp,
+                        top_k, all_greedy):
                 logits, cache = model.apply_paged_prefill(
                     params, ids, cache, tb, to, length)
-                return self._sample_logits(logits, rng), cache
+                tok = self._sample_per_slot(logits, rng, temp, top_k,
+                                            all_greedy)
+                return tok, cache
 
             self._prefill_jit = jax.jit(
-                prefill, donate_argnums=(1,),
+                prefill, donate_argnums=(1,), static_argnums=(9,),
                 in_shardings=(self.param_shardings, self._cache_sh,
-                              None, None, None, None, None),
+                              None, None, None, None, None, None, None),
                 out_shardings=(None, self._cache_sh))
         return self._prefill_jit
 
@@ -186,15 +213,18 @@ class InferenceEngineV2:
         if self._decode_jit is None:
             model = self.model
 
-            def decode(params, cache, tokens, lengths, tables, rng):
+            def decode(params, cache, tokens, lengths, tables, rng,
+                       temps, top_ks, all_greedy):
                 logits, cache = model.apply_paged_decode(
                     params, tokens, lengths, cache, tables)
-                return self._sample_logits(logits, rng), cache
+                tok = self._sample_per_slot(logits, rng, temps, top_ks,
+                                            all_greedy)
+                return tok, cache
 
             self._decode_jit = jax.jit(
-                decode, donate_argnums=(1,),
+                decode, donate_argnums=(1,), static_argnums=(8,),
                 in_shardings=(self.param_shardings, self._cache_sh,
-                              None, None, None, None),
+                              None, None, None, None, None, None),
                 out_shardings=(None, self._cache_sh))
         return self._decode_jit
 
@@ -208,7 +238,9 @@ class InferenceEngineV2:
                 break
             self._pending.popleft()
             slot, seq = mgr.admit(req.uid, req.prompt, req.max_new_tokens,
-                                  req.eos_token_id)
+                                  req.eos_token_id,
+                                  temperature=req.temperature,
+                                  top_k=req.top_k)
             T = len(req.prompt)
             T_pad = -(-max(T, 1) // bucket) * bucket
             ids = np.zeros((1, T_pad), np.int32)
@@ -219,8 +251,11 @@ class InferenceEngineV2:
             self._rng, sub = jax.random.split(self._rng)
             fn = self._get_prefill()
             with jax.set_mesh(self.mesh):
-                tok, self.cache = fn(self.params, self.cache, ids, tb, to,
-                                     np.int32(T), sub)
+                tok, self.cache = fn(
+                    self.params, self.cache, ids, tb, to, np.int32(T), sub,
+                    np.asarray([seq.temperature], np.float32),
+                    np.asarray([seq.top_k], np.int32),
+                    seq.temperature == 0.0)
             self._post_token(seq, int(np.asarray(tok)[0]))
 
     def _post_token(self, seq, token):
@@ -245,7 +280,9 @@ class InferenceEngineV2:
         with jax.set_mesh(self.mesh):
             toks, self.cache = fn(self.params, self.cache,
                                   batch.tokens, batch.lengths,
-                                  batch.block_tables, sub)
+                                  batch.block_tables, sub,
+                                  batch.temps, batch.top_ks,
+                                  not bool(batch.temps.any()))
         toks = np.asarray(toks)
         out = []
         slots = list(mgr._slots)  # snapshot: retire mutates

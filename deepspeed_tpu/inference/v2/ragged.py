@@ -24,6 +24,8 @@ class DSSequenceDescriptor:
     prompt: np.ndarray                    # (T,) int32
     max_new_tokens: int
     eos_token_id: int = -1
+    temperature: float = 0.0              # per-request sampling params
+    top_k: int = 0                        # (FastGen per-request config)
     blocks: list = field(default_factory=list)
     generated: list = field(default_factory=list)
     done: bool = False
@@ -43,6 +45,8 @@ class RaggedBatchWrapper:
     lengths: np.ndarray       # (B,) int32 — tokens already in cache
     block_tables: np.ndarray  # (B, MB) int32 — scratch-0 padded
     active: np.ndarray        # (B,) bool
+    temps: np.ndarray = None  # (B,) f32 — per-slot temperature (0=greedy)
+    top_ks: np.ndarray = None  # (B,) int32 — per-slot top-k (0=off)
 
 
 class DSStateManager:
@@ -78,7 +82,8 @@ class DSStateManager:
         return (self.free_slot() is not None
                 and self.allocator.free_blocks >= self.blocks_needed(total))
 
-    def admit(self, uid, prompt, max_new_tokens, eos_token_id=-1):
+    def admit(self, uid, prompt, max_new_tokens, eos_token_id=-1,
+              temperature=0.0, top_k=0):
         """Allocate blocks for the full prompt+generation budget and bind
         the sequence to a batch slot. Returns (slot, descriptor)."""
         slot = self.free_slot()
@@ -91,7 +96,8 @@ class DSStateManager:
                              f"KV capacity {cap}")
         seq = DSSequenceDescriptor(uid=uid, prompt=prompt,
                                    max_new_tokens=max_new_tokens,
-                                   eos_token_id=eos_token_id)
+                                   eos_token_id=eos_token_id,
+                                   temperature=temperature, top_k=top_k)
         seq.blocks = self.allocator.allocate(self.blocks_needed(total))
         self._seqs[uid] = seq
         self._slots[slot] = uid
@@ -131,11 +137,15 @@ class DSStateManager:
         lengths = np.zeros((B,), np.int32)
         tables = np.zeros((B, MB), np.int32)   # scratch
         active = np.zeros((B,), bool)
+        temps = np.zeros((B,), np.float32)
+        top_ks = np.zeros((B,), np.int32)
         for slot, uid in enumerate(self._slots):
             if uid is None:
                 continue
             seq = self._seqs[uid]
             active[slot] = True
+            temps[slot] = seq.temperature
+            top_ks[slot] = seq.top_k
             # input token = last generated (prefill produced the first);
             # it is not yet in the cache, so its write position is
             # seen_tokens - 1
@@ -144,4 +154,5 @@ class DSStateManager:
             nb = len(seq.blocks)
             tables[slot, :nb] = seq.blocks
         return RaggedBatchWrapper(tokens=tokens, lengths=lengths,
-                                  block_tables=tables, active=active)
+                                  block_tables=tables, active=active,
+                                  temps=temps, top_ks=top_ks)

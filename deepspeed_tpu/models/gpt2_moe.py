@@ -80,6 +80,8 @@ class GPT2MoE(GPT2):
 
     def _requires_train_rng(self):
         cfg = self.config
+        if self.moe.gate is None:  # ragged backend: deterministic routing
+            return super()._requires_train_rng()
         return (super()._requires_train_rng()
                 or cfg.noisy_gate_policy is not None
                 or (cfg.moe_top_k == 2

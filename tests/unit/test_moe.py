@@ -258,3 +258,26 @@ class TestRaggedMoEValidation:
         assert y.shape == x.shape
         # counts reflect ALL k dispatches
         assert int(np.asarray(counts).sum()) == 6 * 4
+
+
+class TestGPT2MoERagged:
+    def test_ragged_backend_trains_top2(self):
+        from deepspeed_tpu.models import GPT2MoE, GPT2MoEConfig
+        from deepspeed_tpu.utils import groups
+        groups.reset()
+        cfg = GPT2MoEConfig(n_layer=2, n_head=2, d_model=32, max_seq_len=32,
+                            vocab_size=64, num_experts=4, moe_top_k=2,
+                            moe_backend="ragged", remat=False,
+                            dtype="float32")
+        model = GPT2MoE(cfg)
+        assert not model._requires_train_rng()  # deterministic routing
+        import deepspeed_tpu
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 2e-3}},
+                    "steps_per_print": 0})
+        data = np.zeros((engine.config.train_batch_size, 16), np.int32)
+        l0 = float(engine.train_batch({"input_ids": data}))
+        l1 = float(engine.train_batch({"input_ids": data}))
+        assert l1 < l0
