@@ -23,55 +23,11 @@
 #include <unistd.h>
 #include <vector>
 
+#include "pool.h"
+
 namespace {
+using WriterPool = dstpu::WorkerPool;
 
-struct WriterPool {
-  explicit WriterPool(int n_threads) : stop_(false) {
-    if (n_threads < 1) n_threads = 1;
-    for (int i = 0; i < n_threads; ++i)
-      workers_.emplace_back([this] { this->run(); });
-  }
-
-  ~WriterPool() {
-    {
-      std::unique_lock<std::mutex> lk(mu_);
-      stop_ = true;
-    }
-    cv_.notify_all();
-    for (auto& t : workers_) t.join();
-  }
-
-  void submit(std::function<void()> fn) {
-    {
-      std::unique_lock<std::mutex> lk(mu_);
-      q_.push(std::move(fn));
-    }
-    cv_.notify_one();
-  }
-
-  void run() {
-    for (;;) {
-      std::function<void()> fn;
-      {
-        std::unique_lock<std::mutex> lk(mu_);
-        cv_.wait(lk, [this] { return stop_ || !q_.empty(); });
-        if (stop_ && q_.empty()) return;
-        fn = std::move(q_.front());
-        q_.pop();
-      }
-      fn();
-    }
-  }
-
-  int n_threads() const { return static_cast<int>(workers_.size()); }
-
- private:
-  std::vector<std::thread> workers_;
-  std::queue<std::function<void()>> q_;
-  std::mutex mu_;
-  std::condition_variable cv_;
-  bool stop_;
-};
 
 int pwrite_full(int fd, const char* buf, int64_t count, int64_t offset) {
   while (count > 0) {

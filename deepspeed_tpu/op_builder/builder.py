@@ -20,6 +20,18 @@ from ..utils.logging import logger
 
 _CSRC = os.path.join(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))), "csrc")
+def _host_isa_tag():
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return line.strip()
+    except OSError:
+        pass
+    import platform
+    return platform.processor() or platform.machine()
+
+
 _DEFAULT_BUILD_DIR = os.environ.get(
     "DSTPU_BUILD_DIR",
     os.path.join(os.path.expanduser("~"), ".cache", "deepspeed_tpu", "build"))
@@ -49,8 +61,20 @@ class OpBuilder:
         for s in self.absolute_sources():
             with open(s, "rb") as f:
                 h.update(f.read())
+        for s in self.header_deps():
+            if os.path.exists(s):
+                with open(s, "rb") as f:
+                    h.update(f.read())
         h.update(" ".join(self.cxx_args()).encode())
+        if "-march=native" in self.cxx_args():
+            # ISA-specific builds must not be served to other hosts from a
+            # shared cache (NFS homes under the ssh/pdsh launcher)
+            h.update(_host_isa_tag().encode())
         return h.hexdigest()[:16]
+
+    def header_deps(self):
+        """Headers whose changes must invalidate the cache."""
+        return [os.path.join(_CSRC, "pool.h")]
 
     def load(self):
         """Compile (if needed) and return the loaded ctypes CDLL."""
@@ -84,6 +108,19 @@ class AsyncIOBuilder(OpBuilder):
         return ["aio.cpp"]
 
 
+class CPUAdamBuilder(OpBuilder):
+    """reference op_builder/cpu_adam.py (csrc/adam/ SIMD kernels)."""
+    NAME = "cpu_adam"
+
+    def sources(self):
+        return ["cpu_adam.cpp"]
+
+    def cxx_args(self):
+        # -march=native for auto-vectorization; NOT -ffast-math — Inf/NaN
+        # grads must propagate so overflow checks downstream see them
+        return super().cxx_args() + ["-march=native", "-fno-math-errno"]
+
+
 class _PallasBuilder(OpBuilder):
     """Pallas kernels: load() imports the python module."""
     MODULE = None
@@ -112,7 +149,7 @@ class QuantizerBuilder(_PallasBuilder):
 
 
 BUILDERS = {
-    b.NAME: b for b in (CkptWriterBuilder, AsyncIOBuilder,
+    b.NAME: b for b in (CkptWriterBuilder, AsyncIOBuilder, CPUAdamBuilder,
                         FlashAttnBuilder, FusedAdamBuilder,
                         QuantizerBuilder)
 }

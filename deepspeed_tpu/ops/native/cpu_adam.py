@@ -1,0 +1,92 @@
+"""ctypes binding for the host-side C++ Adam (csrc/cpu_adam.cpp).
+
+Counterpart of reference ``deepspeed/ops/adam/cpu_adam.py:13
+DeepSpeedCPUAdam`` (backed by csrc/adam/cpu_adam_impl.cpp SIMD kernels):
+steps fp32 optimizer state living in HOST RAM — the ZeRO-Offload
+pattern where the device computes grads and the CPU owns the update.
+Pairs with runtime/swap_tensor for NVMe-backed state.
+"""
+
+import ctypes
+
+import numpy as np
+
+
+class DeepSpeedCPUAdam:
+    """Flat-tensor API: state tensors are caller-owned numpy fp32 arrays
+    updated IN PLACE (like the reference updates torch CPU tensors).
+
+        opt = DeepSpeedCPUAdam(lr=1e-3)
+        st = opt.create_state(n)                # {'m','v'} fp32
+        opt.step(params, grads, st)             # params updated in place
+    """
+
+    def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
+                 weight_decay=0.0, adamw_mode=True, bias_correction=True,
+                 num_threads=4):
+        from ...op_builder.builder import create_op_builder
+        self._lib = create_op_builder("cpu_adam").load()
+        self._lib.cpu_adam_create.restype = ctypes.c_void_p
+        self._lib.cpu_adam_create.argtypes = [
+            ctypes.c_float, ctypes.c_float, ctypes.c_float, ctypes.c_float,
+            ctypes.c_float, ctypes.c_int, ctypes.c_int, ctypes.c_int]
+        self._lib.cpu_adam_destroy.argtypes = [ctypes.c_void_p]
+        self._lib.cpu_adam_set_lr.argtypes = [ctypes.c_void_p,
+                                              ctypes.c_float]
+        self._lib.cpu_adam_step.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
+            ctypes.c_int64, ctypes.c_int]
+        self._h = self._lib.cpu_adam_create(
+            lr, betas[0], betas[1], eps, weight_decay,
+            1 if adamw_mode else 0, 1 if bias_correction else 0,
+            num_threads)
+        self.lr = lr
+
+    def set_lr(self, lr):
+        self.lr = lr
+        self._lib.cpu_adam_set_lr(ctypes.c_void_p(self._h), float(lr))
+
+    @staticmethod
+    def create_state(n):
+        return {"m": np.zeros(n, np.float32), "v": np.zeros(n, np.float32)}
+
+    @staticmethod
+    def _ptr(a):
+        return a.ctypes.data_as(ctypes.c_void_p)
+
+    def step(self, params, grads, state, increment_step=True):
+        """params: fp32 contiguous numpy (updated in place); grads: fp32
+        or bfloat16 numpy of the same length."""
+        assert params.dtype == np.float32 and params.flags.c_contiguous
+        assert params.flags.writeable
+        n = params.size
+        grads = np.ascontiguousarray(grads)
+        if grads.dtype == np.float32:
+            is_bf16 = 0
+        else:
+            # ml_dtypes bfloat16 (2-byte) -> reinterpret as uint16
+            assert grads.dtype.itemsize == 2, (
+                f"grads must be fp32 or bf16, got {grads.dtype}")
+            grads = grads.view(np.uint16)
+            is_bf16 = 1
+        assert grads.size == n and state["m"].size == n \
+            and state["v"].size == n, "state/grads size mismatch"
+        assert state["m"].dtype == np.float32 \
+            and state["v"].dtype == np.float32
+        self._lib.cpu_adam_step(
+            ctypes.c_void_p(self._h), self._ptr(params),
+            self._ptr(state["m"]), self._ptr(state["v"]), self._ptr(grads),
+            is_bf16, ctypes.c_int64(n), 1 if increment_step else 0)
+        return params
+
+    def close(self):
+        if getattr(self, "_h", None):
+            self._lib.cpu_adam_destroy(ctypes.c_void_p(self._h))
+            self._h = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 - interpreter teardown
+            pass
