@@ -4,26 +4,19 @@ The reference simulates multi-node as multi-process single-host with a real
 NCCL/GLOO backend (tests/unit/common.py:105 DistributedExec). The TPU-native
 equivalent: a *virtual 8-device CPU mesh* via
 ``--xla_force_host_platform_device_count`` so every collective XLA emits is
-real (ring algorithms on host), just not timed. Must be set before jax
-imports anything.
+real (ring algorithms on host), just not timed. The provisioning recipe is
+shared with the driver gate (``__graft_entry__._provision``) so the test mesh
+and the gate mesh can't diverge.
 """
 
 import os
+import sys
 
-# Overwrite (the ambient env may pin JAX_PLATFORMS to the real TPU tunnel);
-# unit tests always run on the virtual CPU mesh. jax may already be imported
-# at interpreter startup with config captured from env, so set both the env
-# vars and the live config.
-os.environ["JAX_PLATFORMS"] = "cpu"
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-import jax  # noqa: E402
+from __graft_entry__ import _provision  # noqa: E402
 
-jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+_provision(8)
 
 import pytest  # noqa: E402
 
