@@ -43,7 +43,14 @@ def main():
     flash = os.environ.get("BENCH_FLASH", "1") == "1"
     cfg = replace(cfg, max_seq_len=seq_len,
                   use_flash_attention=flash,
-                  flash_block_q=512, flash_block_k=1024,
+                  flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", "512")),
+                  flash_block_k=int(os.environ.get("BENCH_FLASH_BK", "512")),
+                  flash_block_h=int(os.environ.get("BENCH_FLASH_BH", "2")),
+                  remat=os.environ.get("BENCH_REMAT", "1") == "1",
+                  # save_mid measured best (benchmarks/PERF_NOTES.md)
+                  remat_policy=os.environ.get("BENCH_REMAT_POLICY",
+                                              "save_mid"),
+                  scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")),
                   loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")))
     model = GPT2(cfg)
 

@@ -51,6 +51,7 @@ class GPT2Config:
     use_flash_attention: bool = False  # pallas kernel (TPU only)
     flash_block_q: int = 128           # pallas attention tile sizes
     flash_block_k: int = 128
+    flash_block_h: int = 2             # (batch*head) instances per grid step
     # 'dense': GSPMD Ulysses resharding (all_to_all pair) when seq-sharded.
     # 'ring': ring/context-parallel attention (sequence/ring.py) — KV blocks
     #         rotate over the 'seq' axis; no head-count constraint.
@@ -218,7 +219,28 @@ class GPT2:
                                       seq_sharded=seq_sharded, train=train)
 
         block_fn = block
-        if cfg.remat:
+        if cfg.remat and cfg.remat_policy == "split_attn":
+            # jax NEVER stores custom_vjp residuals across a checkpoint
+            # inside scan — a whole-block remat re-runs the flash forward
+            # kernel in backward. Splitting the remat boundary keeps
+            # attention OUTSIDE any checkpoint: its residuals (q, k, v, o,
+            # lse) become ordinary scan residuals (saved), while the
+            # cheap-to-recompute pre (ln1+qkv) and post (wo/ln2/MLP)
+            # segments remat. Backward then runs zero extra flash kernels
+            # and recomputes only matmul-light segments.
+            def split_block(x, layer, lrng):
+                pre = jax.checkpoint(partial(
+                    self.block_qkv, constrain=constrain, act_spec=act_spec))
+                q, kk, v = pre(x, layer)
+                attn = self.block_attn(q, kk, v, causal=causal,
+                                       constrain=constrain,
+                                       seq_sharded=seq_sharded)
+                post = jax.checkpoint(partial(
+                    self.block_post, constrain=constrain, act_spec=act_spec,
+                    seq_sharded=seq_sharded, train=train))
+                return post(x, attn, layer, lrng)
+            block_fn = split_block
+        elif cfg.remat:
             block_fn = jax.checkpoint(
                 block, policy=resolve_remat_policy(cfg.remat_policy))
 
@@ -263,20 +285,22 @@ class GPT2:
         return jnp.einsum("btd,vd->btv", x, params["wte"],
                           preferred_element_type=jnp.float32)
 
-    def block_forward(self, x, layer, lrng, *, causal, constrain, act_spec,
-                      seq_sharded, train):
-        """One transformer block: (B, T, D) -> (B, T, D), plus aux loss.
-        Shared by the dense scan path and the pipelined executor
-        (models/gpt2_pipe.py)."""
+    def block_qkv(self, x, layer, *, constrain, act_spec):
+        """ln1 + qkv projection: (B, T, D) -> q, k, v each (B, T, H, hd).
+        Cheap to recompute in backward (one matmul whose output no grad
+        rule needs — only ln1_out is, and that's VPU work)."""
         cfg = self.config
-        dt = _dtype(cfg)
         B, T = x.shape[0], x.shape[1]
         H, hd = cfg.n_head, cfg.d_head
-
         h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
         qkv = h @ layer["wqkv"] + layer["bqkv"]
         qkv = qkv.reshape(B, T, 3, H, hd)
-        q, kk, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+    def block_attn(self, q, kk, v, *, causal, constrain, seq_sharded):
+        """Attention backend dispatch: (B, T, H, hd) x3 -> (B, T, H, hd)."""
+        cfg = self.config
+        dt = _dtype(cfg)
         if (seq_sharded and cfg.attention_backend == "ring"
                 and not jax.sharding.get_abstract_mesh().empty):
             # context parallel: KV rotates the 'seq' ring (ppermute)
@@ -294,9 +318,8 @@ class GPT2:
             v = constrain(v, head_spec)
             attn = flash_attention(q, kk, v, causal=True,
                                    block_q=cfg.flash_block_q,
-                                   block_k=cfg.flash_block_k).astype(dt)
-            # named so remat policies can keep it (skip recomputing the
-            # whole attention in backward): remat_policy='save_attn'
+                                   block_k=cfg.flash_block_k,
+                                   block_h=cfg.flash_block_h).astype(dt)
             from jax.ad_checkpoint import checkpoint_name
             attn = checkpoint_name(attn, "attn_out")
         else:
@@ -311,16 +334,28 @@ class GPT2:
 
             scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                                 preferred_element_type=jnp.float32)
-            scores = scores / math.sqrt(hd)
+            scores = scores / math.sqrt(self.config.d_head)
             scores = jnp.where(causal[None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs, v)
             from jax.ad_checkpoint import checkpoint_name
             attn = checkpoint_name(attn, "attn_out")
-        attn = attn.reshape(B, T, H * hd)
+        return attn
+
+    def block_post(self, x, attn, layer, lrng, *, constrain, act_spec,
+                   seq_sharded, train):
+        """Output projection residual + ln2 + MLP residual."""
+        cfg = self.config
+        B, T = x.shape[0], x.shape[1]
+        attn = attn.reshape(B, T, cfg.n_head * cfg.d_head)
         attn = constrain(attn, act_spec)
         x = x + attn @ layer["wo"] + layer["bo"]
         x = constrain(x, act_spec)
+        from jax.ad_checkpoint import checkpoint_name
+        # named so remat policies can keep the post-attention residual
+        # stream (remat_policy='save_mid'/'save_mid_up'): backward then
+        # recomputes only ln2 + the MLP instead of the attention half too
+        x = checkpoint_name(x, "attn_mid")
 
         h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
         mlp_out, aux = self._mlp(h, layer, lrng, train=train,
@@ -330,6 +365,19 @@ class GPT2:
         x = constrain(x, act_spec)
         return x, aux
 
+    def block_forward(self, x, layer, lrng, *, causal, constrain, act_spec,
+                      seq_sharded, train):
+        """One transformer block: (B, T, D) -> (B, T, D), plus aux loss.
+        Shared by the dense scan path and the pipelined executor
+        (models/gpt2_pipe.py)."""
+        q, kk, v = self.block_qkv(x, layer, constrain=constrain,
+                                  act_spec=act_spec)
+        attn = self.block_attn(q, kk, v, causal=causal, constrain=constrain,
+                               seq_sharded=seq_sharded)
+        return self.block_post(x, attn, layer, lrng, constrain=constrain,
+                               act_spec=act_spec, seq_sharded=seq_sharded,
+                               train=train)
+
     def _requires_train_rng(self):
         """True when a training forward is stochastic (overridden by
         GPT2MoE for noisy gating / top-2 sampling)."""
@@ -338,7 +386,11 @@ class GPT2:
     def _mlp(self, h, layer, rng, *, train, seq_sharded, constrain):
         """Dense MLP; overridden by GPT2MoE with an expert-parallel MoE.
         Returns (output, aux_loss)."""
-        up = jax.nn.gelu(h @ layer["wup"] + layer["bup"])
+        from jax.ad_checkpoint import checkpoint_name
+        # named pre-activation: saving it skips the wup matmul recompute in
+        # backward (gelu' needs this tensor; gelu_out is one VPU op away)
+        u = checkpoint_name(h @ layer["wup"] + layer["bup"], "mlp_up")
+        up = jax.nn.gelu(u)
         up = constrain(up, P(BATCH_AXES, "seq" if seq_sharded else None,
                              "tensor"))
         return (up @ layer["wdown"] + layer["bdown"],

@@ -91,17 +91,39 @@ class GPT2Pipe(GPT2):
         # --- pipelined blocks ---
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
 
-        def block_fn(x, layer_and_rng):
-            layer, lrng = layer_and_rng
-            y, _aux = self.block_forward(
-                x, layer, lrng, causal=causal, constrain=constrain,
-                act_spec=act_spec, seq_sharded=seq_sharded, train=train)
-            return y
+        if cfg.remat and cfg.remat_policy == "split_attn":
+            # same split-boundary structure as GPT2.apply_with_aux: the
+            # pre (ln1+qkv) and post (wo/ln2/MLP) segments remat, the
+            # attention custom_vjp sits OUTSIDE any checkpoint so its
+            # forward kernel is never re-run in backward
+            from functools import partial
 
-        if cfg.remat:
-            from .common import resolve_remat_policy
-            block_fn = jax.checkpoint(
-                block_fn, policy=resolve_remat_policy(cfg.remat_policy))
+            def block_fn(x, layer_and_rng):
+                layer, lrng = layer_and_rng
+                pre = jax.checkpoint(partial(
+                    self.block_qkv, constrain=constrain, act_spec=act_spec))
+                q, kk, v = pre(x, layer)
+                attn = self.block_attn(q, kk, v, causal=causal,
+                                       constrain=constrain,
+                                       seq_sharded=seq_sharded)
+                post = jax.checkpoint(partial(
+                    self.block_post, constrain=constrain,
+                    act_spec=act_spec, seq_sharded=seq_sharded,
+                    train=train))
+                y, _aux = post(x, attn, layer, lrng)
+                return y
+        else:
+            def block_fn(x, layer_and_rng):
+                layer, lrng = layer_and_rng
+                y, _aux = self.block_forward(
+                    x, layer, lrng, causal=causal, constrain=constrain,
+                    act_spec=act_spec, seq_sharded=seq_sharded, train=train)
+                return y
+
+            if cfg.remat:
+                from .common import resolve_remat_policy
+                block_fn = jax.checkpoint(
+                    block_fn, policy=resolve_remat_policy(cfg.remat_policy))
 
         layer_rngs = jax.random.split(
             rng if rng is not None else jax.random.key(0), cfg.n_layer)

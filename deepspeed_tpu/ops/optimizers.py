@@ -9,8 +9,9 @@ On TPU the multi-tensor-apply trick is unnecessary: updates are elementwise
 jnp expressions over the (sharded) param pytree, XLA fuses each leaf's
 update chain into one kernel, and sharded leaves update shard-locally —
 which *is* the ZeRO partitioned-optimizer behavior when the engine shards
-master params/optimizer state over the DP axis. A Pallas fused path exists
-for the flat-buffer hot case (ops/pallas/fused_adam.py).
+master params/optimizer state over the DP axis. (A separate Pallas kernel
+would buy nothing here: the update is bandwidth-bound and XLA already
+emits one fused read-modify-write pass per leaf.)
 
 Protocol (self-contained; optax-style but torch-free):
     opt.init(params)                      -> state pytree

@@ -9,12 +9,16 @@ motivation here: the online-softmax streaming form never materializes
 scores, so HBM traffic drops from O(T^2) to O(T * d) per head and the MXU
 stays busy on the two matmuls.
 
-Layout: (batch, seq, heads, head_dim) at the API (the model's layout);
-kernels run per (batch*head) on (seq, head_dim) slabs, grid over query
-blocks. K/V for one head live in VMEM whole (T*d*2B at bf16 — up to ~32k
-tokens at d=128 inside the 16 MB budget); the backward recomputes
-attention probabilities from the saved logsumexp instead of storing them
-(the standard flash backward).
+Layout: (batch, seq, heads, head_dim) at the API (the model's layout).
+Kernels process a GROUP of ``block_h`` (batch*head) instances per grid step
+as batched dots — at GPT-2 head dims (64..128) a single head's (bq, d) x
+(d, bk) dot is far too little work per grid step, and the sequential TPU
+grid makes per-step overhead (DMA issue, semaphores) the bottleneck;
+batching heads amortizes it. The MXU path keeps q/k/v/p in bf16 with fp32
+accumulation (fp32 dot inputs run the MXU at 1/8 rate); softmax
+bookkeeping stays fp32 on the VPU. The backward recomputes attention
+probabilities from the saved logsumexp instead of storing them (the
+standard flash backward).
 
 Off-TPU (unit tests / dryrun) the kernels run in Pallas interpreter mode.
 """
@@ -46,14 +50,17 @@ def _block_sizes(T, block_q, block_k):
 
 NEG_INF = -1e30
 
+# batched dot helpers: x (G, a, c) contract c against y's dim, batch over G
+_DN_QK = (((2,), (2,)), ((0,), (0,)))    # (G,bq,d) x (G,bk,d) -> (G,bq,bk)
+_DN_PV = (((2,), (1,)), ((0,), (0,)))    # (G,bq,bk) x (G,bk,d) -> (G,bq,d)
+_DN_T = (((1,), (1,)), ((0,), (0,)))     # (G,bq,bk) x (G,bq,d) -> (G,bk,d)
 
-# ------------------------------------------------------------------ forward
-def _mask_scores(s, qi_start, kj_start, bq, bk, causal, t_real, T):
-    """Apply causal and/or padded-key masking to a (bq, bk) score block.
-    ``t_real < T`` means the sequence was padded; padded keys must never
-    contribute. Static no-op when neither mask applies."""
+
+def _mask_block(qi_start, kj_start, bq, bk, causal, t_real, T):
+    """(bq, bk) boolean mask for causal and/or padded-key masking; None
+    when neither applies (static no-op)."""
     if not causal and t_real >= T:
-        return s
+        return None
     qpos = qi_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = kj_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     ok = None
@@ -62,62 +69,84 @@ def _mask_scores(s, qi_start, kj_start, bq, bk, causal, t_real, T):
     if t_real < T:
         valid = kpos < t_real
         ok = valid if ok is None else jnp.logical_and(ok, valid)
-    return jnp.where(ok, s, NEG_INF)
+    return ok
 
 
+def _apply_mask(s, ok):
+    """s: (G, bq, bk); ok: (bq, bk) or None."""
+    if ok is None:
+        return s
+    return jnp.where(ok[None], s, NEG_INF)
+
+
+# ------------------------------------------------------------------ forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
                 causal, t_real):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale              # (bq, d)
+    q = q_ref[...]                                        # (G, bq, d) bf16
+    G = q.shape[0]
     T = k_ref.shape[1]
     nk = T // bk
-    # causal: query block qi attends k blocks 0..ceil((qi+1)*bq / bk)-1
+    # causal: query block qi attends k blocks 0..ceil((qi+1)*bq / bk)-1.
+    # Blocks fully below the diagonal skip mask generation entirely (the
+    # iota/compare/select per element is real VPU cost in a VPU-bound
+    # kernel); only the straddling blocks mask. With padded keys
+    # (t_real < T) every block takes the masked path.
     kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
+    kfull = (qi * bq) // bk if (causal and t_real >= T) else (
+        nk if (not causal and t_real >= T) else 0)
 
-    def body(j, carry):
-        acc, m, l = carry
-        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = _mask_scores(s, qi * bq, j * bk, bq, bk, causal, t_real, T)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[:, None])
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1)
-        acc = acc * alpha[:, None] + jax.lax.dot_general(
-            p, vb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
-        return acc, m_new, l
+    def make_body(masked):
+        def body(j, carry):
+            acc, m, l = carry
+            kb = k_ref[:, pl.ds(j * bk, bk), :]
+            vb = v_ref[:, pl.ds(j * bk, bk), :]
+            s = jax.lax.dot_general(q, kb, _DN_QK,
+                                    preferred_element_type=jnp.float32)
+            if scale != 1.0:
+                s = s * scale
+            if masked:
+                s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
+                                               causal, t_real, T))
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[..., None] + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, _DN_PV,
+                preferred_element_type=jnp.float32)
+            return acc, m_new, l
+        return body
 
     d = q_ref.shape[-1]
-    acc = jnp.zeros((bq, d), jnp.float32)
-    m = jnp.full((bq,), NEG_INF, jnp.float32)
-    l = jnp.zeros((bq,), jnp.float32)
-    acc, m, l = jax.lax.fori_loop(0, kmax, body, (acc, m, l))
-    o_ref[0] = (acc / l[:, None]).astype(o_ref.dtype)
+    acc = jnp.zeros((G, bq, d), jnp.float32)
+    m = jnp.full((G, bq), NEG_INF, jnp.float32)
+    l = jnp.zeros((G, bq), jnp.float32)
+    carry = jax.lax.fori_loop(0, kfull, make_body(False), (acc, m, l))
+    acc, m, l = jax.lax.fori_loop(kfull, kmax, make_body(True), carry)
+    o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
     # lse carries a 128-wide lane dim (value replicated across lanes):
     # per-row scalars are not tileable on TPU, so like the official TPU
     # flash kernel we store (.., bq, 128) blocks
-    lse_ref[0] = jnp.broadcast_to((m + jnp.log(l))[:, None],
-                                  (bq, lse_ref.shape[-1]))
+    lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[..., None],
+                                    (G, bq, lse_ref.shape[-1]))
 
 
-def _fwd(q, k, v, scale, causal, bq, bk, t_real, interpret):
+def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
     BH, T, d = q.shape
-    grid = (BH, T // bq)
+    grid = (BH // bh, T // bq)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, 128), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             _sds((BH, T, d), q.dtype, q),
@@ -132,106 +161,139 @@ def _fwd(q, k, v, scale, causal, bq, bk, t_real, interpret):
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
                    *, bq, bk, scale, causal, t_real):
     qi = pl.program_id(1)
-    q = q_ref[0].astype(jnp.float32) * scale
-    do = do_ref[0].astype(jnp.float32)
-    lse = lse_ref[0][:, 0]
-    delta = delta_ref[0][:, 0]
+    q = q_ref[...]                                          # (G, bq, d) bf16
+    G = q.shape[0]
+    do = do_ref[...]
+    lse = lse_ref[...][..., 0]                              # (G, bq)
+    delta = delta_ref[...][..., 0]
     T = k_ref.shape[1]
     nk = T // bk
     kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
+    kfull = (qi * bq) // bk if (causal and t_real >= T) else (
+        nk if (not causal and t_real >= T) else 0)
 
-    def body(j, dq):
-        kb = k_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        vb = v_ref[0, pl.ds(j * bk, bk), :].astype(jnp.float32)
-        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = _mask_scores(s, qi * bq, j * bk, bq, bk, causal, t_real, T)
-        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
-        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])                      # (bq, bk)
-        return dq + jax.lax.dot_general(
-            ds, kb, (((1,), (0,)), ((), ())),
-            preferred_element_type=jnp.float32)
+    def make_body(masked):
+        def body(j, dq):
+            kb = k_ref[:, pl.ds(j * bk, bk), :]
+            vb = v_ref[:, pl.ds(j * bk, bk), :]
+            s = jax.lax.dot_general(q, kb, _DN_QK,
+                                    preferred_element_type=jnp.float32)
+            if scale != 1.0:
+                s = s * scale
+            if masked:
+                s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
+                                               causal, t_real, T))
+            p = jnp.exp(s - lse[..., None])                 # (G, bq, bk) f32
+            dp = jax.lax.dot_general(do, vb, _DN_QK,
+                                     preferred_element_type=jnp.float32)
+            ds = p * (dp - delta[..., None])
+            return dq + jax.lax.dot_general(
+                ds.astype(kb.dtype), kb, _DN_PV,
+                preferred_element_type=jnp.float32)
+        return body
 
     d = q_ref.shape[-1]
-    dq = jax.lax.fori_loop(0, kmax, body, jnp.zeros((bq, d), jnp.float32))
-    dq_ref[0] = (dq * scale).astype(dq_ref.dtype)
+    dq = jax.lax.fori_loop(0, kfull, make_body(False),
+                           jnp.zeros((G, bq, d), jnp.float32))
+    dq = jax.lax.fori_loop(kfull, kmax, make_body(True), dq)
+    if scale != 1.0:
+        dq = dq * scale
+    dq_ref[...] = dq.astype(dq_ref.dtype)
 
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, *, bq, bk, scale, causal, t_real):
     ki = pl.program_id(1)
-    kb = k_ref[0].astype(jnp.float32)                       # (bk, d)
-    vb = v_ref[0].astype(jnp.float32)
+    kb = k_ref[...]                                         # (G, bk, d) bf16
+    G = kb.shape[0]
+    vb = v_ref[...]
     T = q_ref.shape[1]
     nq = T // bq
     qmin = (ki * bk) // bq if causal else 0
+    # q blocks straddling the diagonal need the causal mask; blocks fully
+    # below it don't. With padded keys every block masks.
+    qfull = pl.cdiv((ki + 1) * bk, bq) if (causal and t_real >= T) else (
+        qmin if t_real >= T else nq)
 
-    def body(i, carry):
-        dk, dv = carry
-        q = q_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32) * scale
-        do = do_ref[0, pl.ds(i * bq, bq), :].astype(jnp.float32)
-        lse = lse_ref[0, pl.ds(i * bq, bq), :][:, 0]
-        delta = delta_ref[0, pl.ds(i * bq, bq), :][:, 0]
-        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
-                                preferred_element_type=jnp.float32)
-        s = _mask_scores(s, i * bq, ki * bk, bq, bk, causal, t_real, T)
-        p = jnp.exp(s - lse[:, None])                       # (bq, bk)
-        dv = dv + jax.lax.dot_general(p, do, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
-                                 preferred_element_type=jnp.float32)
-        ds = p * (dp - delta[:, None])
-        dk = dk + jax.lax.dot_general(ds, q, (((0,), (0,)), ((), ())),
-                                      preferred_element_type=jnp.float32)
-        return dk, dv
+    def make_body(masked):
+        def body(i, carry):
+            dk, dv = carry
+            q = q_ref[:, pl.ds(i * bq, bq), :]
+            do = do_ref[:, pl.ds(i * bq, bq), :]
+            lse = lse_ref[:, pl.ds(i * bq, bq), :][..., 0]  # (G, bq)
+            delta = delta_ref[:, pl.ds(i * bq, bq), :][..., 0]
+            s = jax.lax.dot_general(q, kb, _DN_QK,
+                                    preferred_element_type=jnp.float32)
+            if scale != 1.0:
+                s = s * scale
+            if masked:
+                s = _apply_mask(s, _mask_block(i * bq, ki * bk, bq, bk,
+                                               causal, t_real, T))
+            p = jnp.exp(s - lse[..., None])                 # (G, bq, bk) f32
+            pb = p.astype(do.dtype)
+            dv = dv + jax.lax.dot_general(pb, do, _DN_T,
+                                          preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, vb, _DN_QK,
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[..., None])).astype(q.dtype)
+            dk = dk + jax.lax.dot_general(ds, q, _DN_T,
+                                          preferred_element_type=jnp.float32)
+            return dk, dv
+        return body
 
     d = q_ref.shape[-1]
-    dk = jnp.zeros((bk, d), jnp.float32)
-    dv = jnp.zeros((bk, d), jnp.float32)
-    dk, dv = jax.lax.fori_loop(qmin, nq, body, (dk, dv))
-    # dk accumulated against scaled q: scale folded in already via q*scale
-    dk_ref[0] = dk.astype(dk_ref.dtype)
-    dv_ref[0] = dv.astype(dv_ref.dtype)
+    dk = jnp.zeros((G, bk, d), jnp.float32)
+    dv = jnp.zeros((G, bk, d), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qmin, qfull, make_body(True), (dk, dv))
+    dk, dv = jax.lax.fori_loop(qfull, nq, make_body(False), (dk, dv))
+    # ds was computed from unscaled-q dots (scale applied to s post-dot),
+    # so dk needs the scale factor once here
+    if scale != 1.0:
+        dk = dk * scale
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, t_real, interpret):
+def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, bh, t_real, interpret,
+         dlse=None):
     BH, T, d = q.shape
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                # (BH, T)
+    if dlse is not None:
+        # lse cotangent folds into delta (see _flash_bwd)
+        delta = delta - dlse[..., 0].astype(jnp.float32)
     delta = jnp.broadcast_to(delta[..., None], lse.shape)   # lane dim
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real),
-        grid=(BH, T // bq),
+        grid=(BH // bh, T // bq),
         in_specs=[
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, T, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, bq, 128), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, 128), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, 128), lambda b, i: (b, i, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
         out_shape=_sds((BH, T, d), q.dtype, q),
         interpret=interpret,
     )(q, k, v, do, lse, delta)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real),
-        grid=(BH, T // bk),
+        grid=(BH // bh, T // bk),
         in_specs=[
-            pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, T, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, T, 128), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((1, T, 128), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, T, 128), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, T, 128), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
-            pl.BlockSpec((1, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
             _sds((BH, T, d), q.dtype, q),
@@ -243,35 +305,51 @@ def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, t_real, interpret):
 
 
 # --------------------------------------------------------------- public API
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
-def _flash(q, k, v, scale, causal, bq, bk, t_real, interpret):
-    o, _ = _fwd(q, k, v, scale, causal, bq, bk, t_real, interpret)
-    return o
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+def _flash(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
+    o, lse = _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
+    return o, lse[..., :1]
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk, t_real, interpret):
-    o, lse = _fwd(q, k, v, scale, causal, bq, bk, t_real, interpret)
-    return o, (q, k, v, o, lse)
+def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
+    o, lse = _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
+    lse_t = lse[..., :1]                                    # (BH, T, 1)
+    return (o, lse_t), (q, k, v, o, lse_t)
 
 
-def _flash_bwd(scale, causal, bq, bk, t_real, interpret, res, do):
-    q, k, v, o, lse = res
-    return _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, t_real,
-                interpret)
+def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, res, cts):
+    do, dlse = cts
+    q, k, v, o, lse_t = res
+    lse = jnp.broadcast_to(lse_t, lse_t.shape[:2] + (128,))
+    # lse is a real (differentiable) output: d lse_i / d s_ij = p_ij, so a
+    # cotangent on lse enters the shared ds = p * (dp - delta) term as
+    # ds += p * dlse — i.e. exactly a shift of delta by -dlse. Folding it
+    # there costs zero extra kernel work.
+    return _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, bh, t_real,
+                interpret, dlse=dlse)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
-                    block_k=128, interpret=None):
-    """Fused attention over (batch, seq, heads, head_dim) inputs.
+def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
+                             block_q=128, block_k=128, block_h=2,
+                             interpret=None):
+    """Fused attention over (batch, seq, heads, head_dim) inputs, returning
+    ``(o, lse)`` where lse is the per-query logsumexp, (B, H, T) fp32.
 
     Equivalent math to softmax(scale * q k^T + causal_mask) v with fp32
     accumulation, O(T) memory. Differentiable (custom flash backward).
     Sequences that don't divide the block sizes are zero-padded and the
     padded keys masked in-kernel (slicing the output transposes to
-    zero-padded cotangents, so the backward stays correct).
+    zero-padded cotangents, so the backward stays correct). ``block_h``
+    (b, h) instances are processed per grid step (clamped to a divisor
+    of batch*heads).
+
+    lse is exposed (rather than kept as a hidden vjp residual) so callers
+    under ``jax.checkpoint`` can tag o/lse/q/k/v with ``checkpoint_name``
+    and a save-policy can keep exactly the flash residuals — making the
+    backward reuse them instead of recomputing the forward kernel.
     """
     B, T, H, d = q.shape
     if scale is None:
@@ -279,10 +357,14 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
     if interpret is None:
         interpret = _interpret_default()
     bq, bk, T_pad = _block_sizes(T, block_q, block_k)
-    # TPU tiling wants the lane (last) dim in 128s: zero-pad small head
-    # dims (zero columns add 0 to scores and produce zero output columns,
-    # and zero cotangent columns backward — exact)
-    d_pad = _round_up(d, 128)
+    bh = max(1, min(block_h, B * H))
+    while (B * H) % bh:
+        bh -= 1
+    # TPU tiling wants the lane (last) dim in 64/128 units: zero-pad other
+    # head dims (zero columns add 0 to scores and produce zero output
+    # columns, and zero cotangent columns backward — exact). d=64 is kept
+    # native: the smaller DMA footprint beats the MXU's preference for 128.
+    d_pad = d if d in (64, 128) else _round_up(d, 128)
 
     def fold(x):
         x = x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
@@ -290,11 +372,27 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
             x = jnp.pad(x, ((0, 0), (0, T_pad - T), (0, d_pad - d)))
         return x
 
-    o = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal),
-               bq, bk, T, bool(interpret))
+    # fold the softmax scale into q OUTSIDE the kernel (and the custom_vjp,
+    # so autodiff chains dq): one (BH, T, d) multiply instead of a
+    # per-score-element multiply inside a VPU-bound kernel
+    q = q * jnp.asarray(scale, q.dtype)
+    o, lse = _flash(fold(q), fold(k), fold(v), 1.0, bool(causal),
+                    bq, bk, bh, T, bool(interpret))
     if T_pad != T or d_pad != d:
         o = o[:, :T, :d]
-    return o.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+        lse = lse[:, :T]
+    o = o.reshape(B, H, T, d).transpose(0, 2, 1, 3)
+    return o, lse[..., 0].reshape(B, H, T)
+
+
+def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
+                    block_k=128, block_h=2, interpret=None):
+    """Fused attention over (batch, seq, heads, head_dim); see
+    :func:`flash_attention_with_lse` (this drops the lse output)."""
+    o, _ = flash_attention_with_lse(
+        q, k, v, causal=causal, scale=scale, block_q=block_q,
+        block_k=block_k, block_h=block_h, interpret=interpret)
+    return o
 
 
 def attention_reference(q, k, v, *, causal=True, scale=None):

@@ -71,16 +71,19 @@ def spmd_pipeline(block_fn, layers, x_mb, *, pipe_axis="pipe",
     if M is None:
         raise ValueError("x_mb must have a leading microbatch dim")
 
-    # Activations cross the shard_map boundary in f32: the transpose of a
-    # replicated input is a psum over 'pipe', and XLA-CPU check-fails
-    # promoting partial-manual sub-f32 all-reduces (f32 is also the safe
-    # accumulation dtype for the cotangent sum).
+    # XLA-CPU (the virtual test mesh) check-fails promoting partial-manual
+    # sub-f32 all-reduces, so THERE activations cross the shard_map
+    # boundary in f32. On TPU bf16 ppermute/psum are legal and halve the
+    # boundary bytes — the workaround is scoped to the CPU interpreter.
+    f32_boundary = jax.default_backend() == "cpu"
+
     def _is_lowp(x):
         return (jnp.issubdtype(x.dtype, jnp.floating)
                 and jnp.finfo(x.dtype).bits < 32)
     in_dtypes = jax.tree.map(lambda x: x.dtype, x_mb)
-    x_mb = jax.tree.map(
-        lambda x: x.astype(jnp.float32) if _is_lowp(x) else x, x_mb)
+    if f32_boundary:
+        x_mb = jax.tree.map(
+            lambda x: x.astype(jnp.float32) if _is_lowp(x) else x, x_mb)
 
     def stage_fn(layers_local, x_local):
         sid = lax.axis_index(pipe_axis)
@@ -92,9 +95,13 @@ def spmd_pipeline(block_fn, layers, x_mb, *, pipe_axis="pipe",
             return y
 
         def varying_zeros(x):
-            # pcast in f32, cast after: the transpose of pcast(to='varying')
-            # is a psum over 'pipe', and it must not be sub-f32 (same
-            # XLA-CPU promotion check-fail as the output broadcast below)
+            # CPU: pcast in f32, cast after — the transpose of
+            # pcast(to='varying') is a psum over 'pipe', and XLA-CPU
+            # check-fails promoting a sub-f32 partial-manual all-reduce.
+            # TPU: pcast in the native dtype (bf16 collectives are legal).
+            if not f32_boundary:
+                return lax.pcast(jnp.zeros(x.shape, x.dtype), (pipe_axis,),
+                                 to="varying")
             z = lax.pcast(jnp.zeros(x.shape, jnp.float32), (pipe_axis,),
                           to="varying")
             return z.astype(x.dtype)
@@ -137,12 +144,12 @@ def spmd_pipeline(block_fn, layers, x_mb, *, pipe_axis="pipe",
                                    jnp.arange(M + S - 1))
 
         # non-last stages hold zeros: psum broadcasts the result pipe-wide.
-        # Sub-f32 floats go through f32 (XLA-CPU check-fails promoting a
-        # partial-manual bf16 all-reduce; f32 is also the safe accumulation
-        # dtype on TPU and this is one collective of activations).
+        # On the CPU test mesh sub-f32 floats go through f32 (XLA-CPU
+        # check-fails promoting a partial-manual bf16 all-reduce); on TPU
+        # the psum runs in the native dtype — half the boundary bytes.
         def bcast(o):
-            if jnp.issubdtype(o.dtype, jnp.floating) and \
-                    jnp.finfo(o.dtype).bits < 32:
+            if f32_boundary and jnp.issubdtype(o.dtype, jnp.floating) \
+                    and jnp.finfo(o.dtype).bits < 32:
                 return lax.psum(o.astype(jnp.float32),
                                 pipe_axis).astype(o.dtype)
             return lax.psum(o, pipe_axis)

@@ -109,7 +109,6 @@ class ThroughputTimer:
     """Tokens/samples-per-second accounting (reference utils/timer.py:198)."""
 
     def __init__(self, batch_size, start_step=2, steps_per_output=50, monitor_memory=False):
-        self.start_time = 0
         self.started = False
         self.batch_size = max(1, batch_size)
         self.start_step = start_step
@@ -117,8 +116,16 @@ class ThroughputTimer:
         self.micro_step_count = 0
         self.global_step_count = 0
         self.total_elapsed_time = 0
-        self.step_elapsed_time = 0
+        self.total_timed_steps = 0
         self.steps_per_output = steps_per_output
+        # Async-dispatch-honest accounting: a hard device sync every step
+        # would serialize host prep with device compute (the overlap IS the
+        # TPU performance story), so time is measured over report WINDOWS:
+        # one sync when the window opens, one when it closes; everything
+        # in between stays pipelined. Per-step times inside a window are
+        # not individually observable — only window averages are reported.
+        self._window_start = None
+        self._window_steps = 0
 
     def update_epoch_count(self):
         self.epoch_count += 1
@@ -126,9 +133,26 @@ class ThroughputTimer:
 
     def start(self):
         self.started = True
-        if self.global_step_count >= self.start_step:
+        if (self.global_step_count >= self.start_step
+                and self._window_start is None):
             _device_sync()
-            self.start_time = time.time()
+            self._window_start = time.time()
+            self._window_steps = 0
+
+    def _close_window(self, sync_arrays=None):
+        """Sync the device and fold the open window into the running
+        totals. Returns the window's (duration, steps) or None."""
+        if self._window_start is None or self._window_steps == 0:
+            return None
+        _device_sync(sync_arrays)
+        now = time.time()
+        window = now - self._window_start
+        steps = self._window_steps
+        self.total_elapsed_time += window
+        self.total_timed_steps += steps
+        self._window_start = now
+        self._window_steps = 0
+        return window, steps
 
     def stop(self, global_step=False, report_speed=True, sync_arrays=None):
         if not self.started:
@@ -137,24 +161,25 @@ class ThroughputTimer:
         self.micro_step_count += 1
         if global_step:
             self.global_step_count += 1
-        if self.start_time > 0:
-            _device_sync(sync_arrays)
-            duration = time.time() - self.start_time
-            self.total_elapsed_time += duration
-            self.step_elapsed_time += duration
-            if global_step:
-                if (report_speed and self.steps_per_output
-                        and self.global_step_count % self.steps_per_output == 0):
-                    log_dist(
-                        f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
-                        f"global_step={self.global_step_count}, "
-                        f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.6g}, "
-                        f"CurrSamplesPerSec={self.batch_size / self.step_elapsed_time:.6g}",
-                        ranks=[0])
-                self.step_elapsed_time = 0
+            if self._window_start is not None:
+                self._window_steps += 1
+                if (self.steps_per_output and self.global_step_count
+                        % self.steps_per_output == 0):
+                    closed = self._close_window(sync_arrays)
+                    if report_speed and closed and closed[0] > 0:
+                        window, steps = closed
+                        log_dist(
+                            f"epoch={self.epoch_count}/micro_step={self.micro_step_count}/"
+                            f"global_step={self.global_step_count}, "
+                            f"RunningAvgSamplesPerSec={self.avg_samples_per_sec():.6g}, "
+                            f"CurrSamplesPerSec={self.batch_size * steps / window:.6g}",
+                            ranks=[0])
 
     def avg_samples_per_sec(self):
-        if self.global_step_count > self.start_step and self.total_elapsed_time > 0:
-            samples = self.batch_size * (self.global_step_count - self.start_step)
+        # close any open window first (with steps_per_output=0 nothing
+        # else ever folds time in, and the sync makes the answer honest)
+        self._close_window()
+        if self.total_timed_steps > 0 and self.total_elapsed_time > 0:
+            samples = self.batch_size * self.total_timed_steps
             return samples / self.total_elapsed_time
         return float("-inf")

@@ -102,6 +102,37 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4)
 
+    def test_lse_grad_matches_dense(self):
+        """lse is a real differentiable output (z-loss style consumers):
+        its cotangent folds into the shared delta term."""
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            flash_attention_with_lse)
+        import math
+        q, k, v = self._qkv(T=64)
+
+        def loss_f(q, k, v):
+            o, lse = flash_attention_with_lse(q, k, v, block_q=32,
+                                              block_k=32)
+            return jnp.sum(o ** 2) + jnp.sum(lse ** 2)
+
+        def loss_r(q, k, v):
+            d = q.shape[-1]
+            s = jnp.einsum("bthd,bshd->bhts", q, k,
+                           preferred_element_type=jnp.float32)
+            s = s / math.sqrt(d)
+            mask = jnp.tril(jnp.ones(s.shape[-2:], bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+            lse = jax.nn.logsumexp(s, axis=-1)          # (B, H, T)
+            p = jnp.exp(s - lse[..., None])
+            o = jnp.einsum("bhts,bshd->bthd", p.astype(q.dtype), v)
+            return jnp.sum(o ** 2) + jnp.sum(lse ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-3)
+
     def test_in_model(self):
         """GPT2(use_flash_attention=True) is loss- and grad-identical to
         the dense model."""
