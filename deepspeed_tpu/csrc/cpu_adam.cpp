@@ -82,6 +82,15 @@ void cpu_adam_destroy(void *h) {
   delete s;
 }
 
+int64_t cpu_adam_get_step(void *h) {
+  return static_cast<AdamState *>(h)->step;
+}
+
+// checkpoint restore: resume bias correction at the saved step count
+void cpu_adam_set_step(void *h, int64_t step) {
+  static_cast<AdamState *>(h)->step = step;
+}
+
 void cpu_adam_set_lr(void *h, float lr) {
   static_cast<AdamState *>(h)->lr = lr;
 }

@@ -33,6 +33,10 @@ class DeepSpeedCPUAdam:
         self._lib.cpu_adam_destroy.argtypes = [ctypes.c_void_p]
         self._lib.cpu_adam_set_lr.argtypes = [ctypes.c_void_p,
                                               ctypes.c_float]
+        self._lib.cpu_adam_get_step.restype = ctypes.c_int64
+        self._lib.cpu_adam_get_step.argtypes = [ctypes.c_void_p]
+        self._lib.cpu_adam_set_step.argtypes = [ctypes.c_void_p,
+                                                ctypes.c_int64]
         self._lib.cpu_adam_step.argtypes = [
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_void_p,
             ctypes.c_void_p, ctypes.c_void_p, ctypes.c_int,
@@ -46,6 +50,13 @@ class DeepSpeedCPUAdam:
     def set_lr(self, lr):
         self.lr = lr
         self._lib.cpu_adam_set_lr(ctypes.c_void_p(self._h), float(lr))
+
+    def get_step(self):
+        return int(self._lib.cpu_adam_get_step(ctypes.c_void_p(self._h)))
+
+    def set_step(self, step):
+        """Checkpoint restore: resume bias correction at the saved count."""
+        self._lib.cpu_adam_set_step(ctypes.c_void_p(self._h), int(step))
 
     @staticmethod
     def create_state(n):

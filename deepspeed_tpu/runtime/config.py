@@ -9,7 +9,7 @@ checkpoint-engine selection keys (reference runtime/config.py:909-926).
 """
 
 import json
-from dataclasses import dataclass, field, asdict
+from dataclasses import dataclass, field, fields, asdict
 
 from . import constants as C
 from ..utils.logging import logger
@@ -35,6 +35,40 @@ class BF16Config:
 
 
 @dataclass
+class OffloadConfig:
+    """Reference zero/offload_config.py DeepSpeedZeroOffloadOptimizerConfig /
+    ...ParamConfig: where the offloaded state lives."""
+    device: str = "none"              # none | cpu | nvme
+    nvme_path: str = "/tmp/dstpu_swap"
+    pin_memory: bool = True           # accepted for compatibility
+    buffer_count: int = 4             # accepted for compatibility
+
+    @classmethod
+    def normalize(cls, val):
+        """Accept bool (true -> cpu), reference-style dict, or None."""
+        if isinstance(val, cls):
+            return val
+        if val is None or val is False:
+            return cls()
+        if val is True:
+            return cls(device="cpu")
+        if isinstance(val, dict):
+            known = {f.name for f in fields(cls)}
+            out = cls(**{k: v for k, v in val.items() if k in known})
+            out.device = str(out.device).lower()
+            if out.device not in ("none", "cpu", "nvme"):
+                raise DeepSpeedConfigError(
+                    f"offload device must be none|cpu|nvme, got "
+                    f"{out.device!r}")
+            return out
+        raise DeepSpeedConfigError(f"bad offload config: {val!r}")
+
+    @property
+    def enabled(self):
+        return self.device != "none"
+
+
+@dataclass
 class ZeroConfig:
     """Mirrors reference zero/config.py:82 DeepSpeedZeroConfig knobs that are
     meaningful under XLA. Bucket sizes/overlap are accepted for config
@@ -51,14 +85,17 @@ class ZeroConfig:
     param_persistence_threshold: int = int(1e5)
     model_persistence_threshold: int = int(1e10)
     max_live_parameters: int = int(1e9)
-    offload_optimizer: bool = False
-    offload_param: bool = False
+    offload_optimizer: object = False   # bool | dict -> OffloadConfig
+    offload_param: object = False       # bool | dict -> OffloadConfig
     zero_quantized_weights: bool = False
     zero_quantized_gradients: bool = False
     hpz_partition_size: int = 1
     mics_shard_size: int = -1
 
     def __post_init__(self):
+        self.offload_optimizer = OffloadConfig.normalize(
+            self.offload_optimizer)
+        self.offload_param = OffloadConfig.normalize(self.offload_param)
         if self.stage not in (0, 1, 2, 3):
             raise DeepSpeedConfigError(f"invalid ZeRO stage {self.stage}")
         mics = self.mics_shard_size not in (-1, 0)

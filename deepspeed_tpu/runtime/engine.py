@@ -160,20 +160,58 @@ class DeepSpeedEngine:
 
         self.use_master = self.param_dtype != jnp.float32
 
+        # ZeRO-Offload (reference stage_1_and_2.py:1181 CPU-offload grads +
+        # cpu_adam, stage3.py:584 NVMe tensor swapping): master + Adam
+        # moments leave the device entirely — the host optimizer owns them
+        # and the device state holds ONLY bf16 params.
+        self.offload_opt_cfg = self.config.zero.offload_optimizer
+        self.offload_param_cfg = self.config.zero.offload_param
+        self.offload_enabled = (self.offload_opt_cfg.enabled
+                                or self.offload_param_cfg.enabled)
+        self.host_optimizer = None
+        if self.offload_enabled and jax.process_count() > 1:
+            raise NotImplementedError(
+                "ZeRO-Offload currently supports single-process meshes "
+                "(each multi-host process would need its addressable "
+                "master shard stepped host-side)")
+
         with jax.set_mesh(self.mesh):
-            params = jax.jit(
-                lambda r: _tree_cast(self.model.init(r), self.param_dtype),
-                out_shardings=param_sh)(rng)
-            if self.use_master:
-                master = jax.jit(lambda p: _tree_cast(p, jnp.float32),
-                                 out_shardings=master_sh)(params)
+            if self.offload_enabled:
+                # fp32 init materialized once, fetched to host, then freed:
+                # the device never holds master/opt state after init
+                master_dev = jax.jit(
+                    lambda r: _tree_cast(self.model.init(r), jnp.float32),
+                    out_shardings=master_sh)(rng)
+                params = jax.jit(
+                    lambda m: _tree_cast(m, self.param_dtype),
+                    out_shardings=param_sh)(master_dev)
+                host_master = jax.device_get(master_dev)
+                del master_dev
+                from .zero.offload import HostOffloadOptimizer
+                self.host_optimizer = HostOffloadOptimizer(
+                    host_master, self.config.optimizer,
+                    self.offload_opt_cfg, self.offload_param_cfg)
+                del host_master
+                master = None
+                opt_state = None
+                opt_sh = None
             else:
-                # fp32 training: master IS params (sharded per master plan
-                # from stage>=1; the update allgathers into param specs)
-                master = jax.jit(lambda p: p, out_shardings=master_sh)(params)
-            opt_sh = self._opt_state_shardings(master)
-            opt_state = jax.jit(self.optimizer.init,
-                                out_shardings=opt_sh)(master)
+                params = jax.jit(
+                    lambda r: _tree_cast(self.model.init(r),
+                                         self.param_dtype),
+                    out_shardings=param_sh)(rng)
+                if self.use_master:
+                    master = jax.jit(lambda p: _tree_cast(p, jnp.float32),
+                                     out_shardings=master_sh)(params)
+                else:
+                    # fp32 training: master IS params (sharded per master
+                    # plan from stage>=1; the update allgathers into param
+                    # specs)
+                    master = jax.jit(lambda p: p,
+                                     out_shardings=master_sh)(params)
+                opt_sh = self._opt_state_shardings(master)
+                opt_state = jax.jit(self.optimizer.init,
+                                    out_shardings=opt_sh)(master)
         self.opt_shardings = opt_sh
 
         scale_state = jax.device_put(
@@ -195,7 +233,9 @@ class DeepSpeedEngine:
                                   NamedSharding(self.mesh, P())),
         }
         self.state_shardings = {
-            "params": param_sh, "master": master_sh, "opt": opt_sh,
+            "params": param_sh,
+            "master": None if self.offload_enabled else master_sh,
+            "opt": opt_sh,
             "scale": jax.tree.map(
                 lambda _: NamedSharding(self.mesh, P()), scale_state),
             "step": NamedSharding(self.mesh, P()),
@@ -259,21 +299,26 @@ class DeepSpeedEngine:
             grads = _tree_cast(grads, jnp.float32)
             return loss_scaled / scale, grads
 
-        def apply_update(state, grads, lr):
-            """grads: fp32 tree, already averaged over GAS; scale included."""
-            scale = state["scale"]["scale"]
+        def unscale_clip_grads(grads, scale):
+            """Shared unscale + overflow check + global-norm clip — ONE
+            definition so the fused, offload, and staged paths cannot
+            drift. Returns (grads, finite, gnorm); the global norm's
+            cross-shard psum falls out of GSPMD."""
             grads = jax.tree.map(lambda g, s: constrain(g / scale, s),
                                  grads, grad_specs)
             finite = grads_finite(grads)
-            # global grad norm (GSPMD inserts the cross-shard psum)
+            sq = sum(jnp.sum(jnp.square(g))
+                     for g in jax.tree.leaves(grads))
+            gnorm = jnp.sqrt(sq)
             if clip and clip > 0:
-                sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
-                gnorm = jnp.sqrt(sq)
                 coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
                 grads = jax.tree.map(lambda g: g * coef, grads)
-            else:
-                sq = sum(jnp.sum(jnp.square(g)) for g in jax.tree.leaves(grads))
-                gnorm = jnp.sqrt(sq)
+            return grads, finite, gnorm
+
+        def apply_update(state, grads, lr):
+            """grads: fp32 tree, already averaged over GAS; scale included."""
+            scale = state["scale"]["scale"]
+            grads, finite, gnorm = unscale_clip_grads(grads, scale)
             new_master, new_opt = opt.update(grads, state["opt"],
                                              state["master"], lr=lr)
             # skip-on-overflow: keep old state where not finite
@@ -300,6 +345,20 @@ class DeepSpeedEngine:
         def train_step(state, batch, lr):
             """batch leaves: (gas, per_step_batch, ...)"""
             scale = state["scale"]["scale"]
+
+            if gas == 1:
+                # no accumulation buffer: skip the zeros-init + add round
+                # trip through HBM (O(model size) fp32 traffic per step)
+                micro = jax.tree.map(lambda x: x[0], batch)
+                loss, grads = micro_loss_and_grads(
+                    state["params"], micro,
+                    jax.random.fold_in(state["rng"], 0), scale,
+                    step=state["step"])
+                grads = jax.tree.map(lambda g, s: constrain(g, s),
+                                     grads, grad_specs)
+                new_state, metrics = apply_update(state, grads, lr)
+                metrics["loss"] = loss
+                return new_state, metrics
 
             def body(carry, micro):
                 acc, rng, i = carry
@@ -337,9 +396,76 @@ class DeepSpeedEngine:
         def acc_add(acc, grads):
             return jax.tree.map(lambda a, g: a + g / gas, acc, grads)
 
+        def grad_step(state, batch):
+            """ZeRO-Offload device half: loss + clipped, UNSCALED fp32
+            grads + overflow flag. The update happens on the host
+            (zero/offload.py HostOffloadOptimizer)."""
+            scale = state["scale"]["scale"]
+
+            def micro(carry, micro_batch):
+                acc, rng, i = carry
+                loss, grads = micro_loss_and_grads(
+                    state["params"], micro_batch,
+                    jax.random.fold_in(rng, i), scale, step=state["step"])
+                grads = jax.tree.map(lambda g, s: constrain(g, s),
+                                     grads, grad_specs)
+                acc = jax.tree.map(lambda a, g: a + g / gas, acc, grads)
+                return (acc, rng, i + 1), loss
+
+            if gas == 1:
+                first = jax.tree.map(lambda x: x[0], batch)
+                loss, grads = micro_loss_and_grads(
+                    state["params"], first,
+                    jax.random.fold_in(state["rng"], 0), scale,
+                    step=state["step"])
+                losses = loss
+            else:
+                zeros = jax.tree.map(
+                    lambda s: jnp.zeros(s.shape, jnp.float32),
+                    jax.eval_shape(lambda p: _tree_cast(p, jnp.float32),
+                                   state["params"]))
+                zeros = jax.tree.map(lambda g, s: constrain(g, s),
+                                     zeros, grad_specs)
+                (grads, _, _), losses = jax.lax.scan(
+                    micro, (zeros, state["rng"], 0), batch)
+            grads, finite, gnorm = unscale_clip_grads(grads, scale)
+            metrics = {"loss": jnp.mean(losses), "grad_norm": gnorm,
+                       "overflow": ~finite, "loss_scale": scale}
+            return grads, metrics
+
+        def offload_finalize(state, overflow):
+            """Counter/scale half of the step (device-side, tiny)."""
+            new_state = dict(state)
+            new_state.update(
+                scale=scaler.update(state["scale"], overflow),
+                step=state["step"] + 1,
+                skipped=state["skipped"]
+                + jnp.where(overflow, 1, 0).astype(jnp.int32),
+                rng=jax.random.fold_in(state["rng"], 0))
+            return new_state
+
+        def finish_grads(grads, scale):
+            """Staged-API ZeRO-Offload: unscale/clip the accumulated grads
+            on device before the host update."""
+            grads, finite, gnorm = unscale_clip_grads(grads, scale)
+            return grads, {"grad_norm": gnorm, "overflow": ~finite,
+                           "loss_scale": scale}
+
         st_sh = lambda: self.state_shardings
         with jax.set_mesh(self.mesh):
-            self._train_step_jit = jax.jit(
+            if self.offload_enabled:
+                self._grad_step_jit = jax.jit(
+                    grad_step, in_shardings=(st_sh(), None),
+                    out_shardings=(self.grad_shardings, None))
+                self._offload_finalize_jit = jax.jit(
+                    offload_finalize, donate_argnums=(0,),
+                    in_shardings=(st_sh(), None),
+                    out_shardings=st_sh())
+                self._finish_grads_jit = jax.jit(
+                    finish_grads, donate_argnums=(0,),
+                    in_shardings=(self.grad_shardings, None),
+                    out_shardings=(self.grad_shardings, None))
+            self._train_step_jit = None if self.offload_enabled else jax.jit(
                 train_step, donate_argnums=(0,),
                 in_shardings=(st_sh(), None, None),
                 out_shardings=(st_sh(), None))
@@ -365,7 +491,14 @@ class DeepSpeedEngine:
         if self.lr_scheduler is not None:
             return jnp.asarray(self.lr_scheduler(self.global_step),
                                jnp.float32)
-        return jnp.asarray(self.optimizer.lr, jnp.float32)
+        # constant lr: reuse one device scalar (a fresh host->device
+        # transfer per step adds real latency through remote transports);
+        # invalidated if the user mutates optimizer.lr mid-training
+        cached = getattr(self, "_lr_cache", None)
+        if cached is None or cached[0] != self.optimizer.lr:
+            self._lr_cache = (self.optimizer.lr,
+                              jnp.asarray(self.optimizer.lr, jnp.float32))
+        return self._lr_cache[1]
 
     def _add_gas_dim(self, x):
         """(train_batch_size, ...) -> (gas, train_batch_size//gas, ...)."""
@@ -404,8 +537,12 @@ class DeepSpeedEngine:
         batch = jax.tree.map(self._add_gas_dim, batch)
         batch = self._shard_batch(batch, with_gas_dim=True)
         with jax.set_mesh(self.mesh):
-            self.state, metrics = self._train_step_jit(
-                self.state, batch, self._current_lr())
+            if self.offload_enabled:
+                grads, metrics = self._grad_step_jit(self.state, batch)
+                metrics = self._host_optimizer_step(grads, metrics)
+            else:
+                self.state, metrics = self._train_step_jit(
+                    self.state, batch, self._current_lr())
         self.global_step += 1
         self.micro_steps += gas
         if self.lr_scheduler is not None:
@@ -414,6 +551,34 @@ class DeepSpeedEngine:
                              sync_arrays=metrics["loss"])
         self._maybe_print(metrics)
         return metrics["loss"]
+
+    def _host_optimizer_step(self, grads, metrics):
+        """ZeRO-Offload host half: pull grads, CPU-Adam the host master,
+        push refreshed bf16 params leaf-by-leaf (reference
+        stage_1_and_2.py:1745 step with cpu_offload; the leafwise push
+        overlaps the next leaf's NVMe reads)."""
+        overflow = bool(np.asarray(metrics["overflow"]))
+        if not overflow:
+            host_grads = jax.device_get(grads)
+            del grads
+            lr = float(np.asarray(self._current_lr()))
+            np_dtype = np.dtype(self.param_dtype)
+            shardings_flat = jax.tree.leaves(self.param_shardings)
+            leaves_out = []
+
+            def on_leaf(path, w_flat, shape):
+                arr = w_flat.reshape(shape)
+                if arr.dtype != np_dtype:
+                    arr = arr.astype(np_dtype)
+                leaves_out.append(
+                    jax.device_put(arr, shardings_flat[len(leaves_out)]))
+
+            self.host_optimizer.step(host_grads, lr, on_leaf)
+            self.state["params"] = jax.tree.unflatten(
+                jax.tree.structure(self.state["params"]), leaves_out)
+        self.state = self._offload_finalize_jit(
+            self.state, jnp.asarray(overflow))
+        return metrics
 
     # ------------------------------------------- staged fwd/bwd/step (parity)
     def forward(self, batch):
@@ -453,14 +618,25 @@ class DeepSpeedEngine:
             return
         assert self._acc_grads is not None, "step() before forward()"
         with jax.set_mesh(self.mesh):
-            self.state, metrics = self._apply_update_jit(
-                self.state, self._acc_grads, self._current_lr())
+            if self.offload_enabled:
+                metrics = self._staged_offload_step()
+            else:
+                self.state, metrics = self._apply_update_jit(
+                    self.state, self._acc_grads, self._current_lr())
         self._acc_grads = None
         self.global_step += 1
         if self.lr_scheduler is not None:
             self.lr_scheduler.step()
         self._maybe_print(metrics)
         return metrics
+
+    def _staged_offload_step(self):
+        """Staged-API ZeRO-Offload: unscale/clip the accumulated grads on
+        device (prebuilt program), then run the host update."""
+        grads, metrics = self._finish_grads_jit(
+            self._acc_grads, self.state["scale"]["scale"])
+        metrics["loss"] = self._pending_loss
+        return self._host_optimizer_step(grads, metrics)
 
     # ------------------------------------------------------------------ misc
     def _write_monitor_events(self, metrics):
@@ -526,8 +702,17 @@ class DeepSpeedEngine:
 
     # ------------------------------------------------------------ checkpoint
     def _ckpt_tree(self):
-        """Device state staged for saving: fp32 master + optimizer + scale +
-        counters. bf16 params are re-derived on load (cast of master)."""
+        """State staged for saving: fp32 master + optimizer + scale +
+        counters. bf16 params are re-derived on load (cast of master).
+        Under ZeRO-Offload the master/opt live on the host (read back from
+        NVMe when tiered)."""
+        if self.offload_enabled:
+            return {"master": self.host_optimizer.master_tree(),
+                    "opt": self.host_optimizer.state_tree(),
+                    "scale": self.state["scale"],
+                    "step": self.state["step"],
+                    "skipped": self.state["skipped"],
+                    "rng_data": jax.random.key_data(self.state["rng"])}
         return {"master": self.state["master"], "opt": self.state["opt"],
                 "scale": self.state["scale"], "step": self.state["step"],
                 "skipped": self.state["skipped"],
@@ -603,16 +788,26 @@ class DeepSpeedEngine:
 
         master = tree["master"]
         with jax.set_mesh(self.mesh):
-            new_master = jax.device_put(master, self.master_shardings)
-            new_params = jax.jit(
-                lambda m: _tree_cast(m, self.param_dtype),
-                out_shardings=self.param_shardings)(new_master)
             state = dict(self.state)
-            state["master"] = new_master
-            state["params"] = new_params
-            if load_optimizer_states:
-                state["opt"] = jax.device_put(tree["opt"],
-                                              self.opt_shardings)
+            if self.offload_enabled:
+                self.host_optimizer.load_master_tree(master)
+                if load_optimizer_states:
+                    self.host_optimizer.load_state_tree(tree["opt"])
+                np_dtype = np.dtype(self.param_dtype)
+                state["params"] = jax.tree.map(
+                    lambda m, s: jax.device_put(
+                        np.asarray(m, np.float32).astype(np_dtype), s),
+                    master, self.param_shardings)
+            else:
+                new_master = jax.device_put(master, self.master_shardings)
+                new_params = jax.jit(
+                    lambda m: _tree_cast(m, self.param_dtype),
+                    out_shardings=self.param_shardings)(new_master)
+                state["master"] = new_master
+                state["params"] = new_params
+                if load_optimizer_states:
+                    state["opt"] = jax.device_put(tree["opt"],
+                                                  self.opt_shardings)
             state["scale"] = jax.device_put(tree["scale"],
                                             self.state_shardings["scale"])
             state["step"] = jax.device_put(

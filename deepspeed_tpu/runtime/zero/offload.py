@@ -1,0 +1,254 @@
+"""ZeRO-Offload: optimizer state in host RAM (optionally tiered to NVMe),
+stepped by the native C++ CPU Adam.
+
+Counterpart of the reference CPU-offload paths:
+  * ``runtime/zero/stage_1_and_2.py:1181`` (async_accumulate_grad_in_cpu_
+    via_gpu) + ``ops/adam/cpu_adam.py:13 DeepSpeedCPUAdam`` — device
+    computes grads, host owns fp32 master + Adam moments and steps them.
+  * ``runtime/zero/stage3.py:584`` (_configure_tensor_swapping) — optimizer
+    and param state tiered to NVMe through the AIO pool
+    (partitioned/pipelined_optimizer_swapper, partitioned_param_swapper).
+
+TPU-first shape of the same capability: the jitted device program computes
+loss + clipped, unscaled fp32 grads and an overflow flag; grads land on the
+host (the D2H hop the reference does with cudaMemcpyAsync), the C++ worker
+pool (csrc/cpu_adam.cpp) steps each leaf in place, and the refreshed bf16
+params are pushed back to the device sharding leaf-by-leaf. Device memory
+holds ONLY bf16 params (+ transient grads): the 12 bytes/param of
+master+m+v move to host RAM. With ``offload_optimizer.device='nvme'`` the
+m/v moments stream from disk (leaf i+1 prefetching under leaf i's CPU
+step — the reference's pipelined_optimizer_swapper); with
+``offload_param.device='nvme'`` the fp32 master streams too, so host RAM
+holds one leaf's state at a time. The bf16 working params stay
+device-resident: under XLA the per-layer gather the reference does for
+NVMe params IS the ZeRO-3 scan-dim sharding, not a host round trip.
+"""
+
+import numpy as np
+import jax
+
+from ...utils.logging import log_dist
+
+
+def _leaf_paths(tree, prefix=()):
+    """Yield (path_tuple, leaf) pairs in deterministic (sorted-key) order
+    (matches jax.tree.map's dict ordering)."""
+    if isinstance(tree, dict):
+        for k in sorted(tree):
+            yield from _leaf_paths(tree[k], prefix + (str(k),))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            yield from _leaf_paths(v, prefix + (str(i),))
+    else:
+        yield prefix, tree
+
+
+def _get_path(tree, path):
+    for p in path:
+        tree = tree[int(p)] if isinstance(tree, (list, tuple)) else tree[p]
+    return tree
+
+
+class HostOffloadOptimizer:
+    """Owns the fp32 master params + Adam moments off-device and applies
+    the update with the native CPU Adam worker pool.
+
+    step(host_grads, lr, on_leaf) walks the leaves; ``on_leaf(path,
+    master_leaf)`` fires after each leaf's update so the caller can push
+    the refreshed (bf16) leaf back to the device while the next leaf's
+    NVMe reads are in flight."""
+
+    def __init__(self, master_tree, opt_config, offload_opt_cfg,
+                 offload_param_cfg=None, num_threads=8):
+        from ...ops.native.cpu_adam import DeepSpeedCPUAdam
+        params = dict(opt_config.params) if opt_config is not None else {}
+        betas = tuple(params.get("betas", (0.9, 0.999)))
+        typ = (opt_config.type if opt_config is not None else "AdamW").lower()
+        if typ not in ("adam", "adamw", "fusedadam"):
+            raise ValueError(
+                f"offload_optimizer supports Adam/AdamW (got '{typ}') — the "
+                "native CPU kernel is Adam-family (reference "
+                "DeepSpeedCPUAdam)")
+        adamw = typ == "adamw" or bool(params.get("adam_w_mode", True))
+        self.adam = DeepSpeedCPUAdam(
+            lr=float(params.get("lr", 1e-3)), betas=betas,
+            eps=float(params.get("eps", 1e-8)),
+            weight_decay=float(params.get("weight_decay", 0.0)),
+            adamw_mode=adamw,
+            bias_correction=bool(params.get("bias_correction", True)),
+            num_threads=num_threads)
+        self.state_nvme = offload_opt_cfg.device == "nvme"
+        self.master_nvme = (offload_param_cfg is not None
+                            and offload_param_cfg.device == "nvme")
+        self._swapper = None
+        if self.state_nvme or self.master_nvme:
+            from ..swap_tensor.swapper import AsyncTensorSwapper
+            path = (offload_opt_cfg.nvme_path if self.state_nvme
+                    else offload_param_cfg.nvme_path)
+            self._swapper = AsyncTensorSwapper(path)
+
+        # copy=True: device_get hands back non-writeable views, and the
+        # CPU Adam updates in place
+        host = jax.tree.map(
+            lambda x: np.array(x, np.float32, copy=True, order="C"),
+            master_tree)
+        self._shapes = {p: l.shape for p, l in _leaf_paths(host)}
+        self._paths = list(self._shapes)
+        n_total = sum(int(np.prod(s)) for s in self._shapes.values())
+
+        if self.master_nvme:
+            for path, leaf in _leaf_paths(host):
+                self._swapper.swap_out(self._key(path, "w"), leaf.reshape(-1))
+            self._swapper.wait()
+            self.master = None
+        else:
+            self.master = host
+
+        if self.state_nvme:
+            # moments start as zeros on disk; streamed every step after
+            for path, shape in self._shapes.items():
+                z = np.zeros(int(np.prod(shape)), np.float32)
+                self._swapper.swap_out(self._key(path, "m"), z)
+                self._swapper.swap_out(self._key(path, "v"), z)
+            self._swapper.wait()
+            self.state = None
+        else:
+            self.state = {
+                path: {"m": np.zeros(int(np.prod(shape)), np.float32),
+                       "v": np.zeros(int(np.prod(shape)), np.float32)}
+                for path, shape in self._shapes.items()}
+        log_dist(
+            f"offload_optimizer: host CPU Adam over {n_total / 1e6:.1f}M "
+            f"params (moments: {'nvme' if self.state_nvme else 'host RAM'}, "
+            f"master: {'nvme' if self.master_nvme else 'host RAM'})",
+            ranks=[0])
+
+    @staticmethod
+    def _key(path, which):
+        return "/".join(path) + "." + which
+
+    # ------------------------------------------------------------- stepping
+    def _prefetch(self, path):
+        if self.state_nvme:
+            self._swapper.swap_in(self._key(path, "m"), async_=True)
+            self._swapper.swap_in(self._key(path, "v"), async_=True)
+        if self.master_nvme:
+            self._swapper.swap_in(self._key(path, "w"), async_=True)
+
+    def step(self, host_grads, lr, on_leaf=None):
+        """host_grads: pytree of np arrays (fp32 or bf16) matching the
+        master structure. Applies Adam in place; calls ``on_leaf(path,
+        master_flat, shape)`` after each leaf. Returns the master tree
+        (None when the master is NVMe-tiered — consume leaves via
+        on_leaf)."""
+        self.adam.set_lr(float(lr))
+        sw = self._swapper
+        if sw is not None:
+            self._prefetch(self._paths[0])
+        for i, path in enumerate(self._paths):
+            shape = self._shapes[path]
+            if self.state_nvme:
+                st = {"m": sw.wait_in(self._key(path, "m")),
+                      "v": sw.wait_in(self._key(path, "v"))}
+            else:
+                st = self.state[path]
+            if self.master_nvme:
+                w = sw.wait_in(self._key(path, "w"))
+            else:
+                w = _get_path(self.master, path).reshape(-1)
+            if sw is not None and i + 1 < len(self._paths):
+                self._prefetch(self._paths[i + 1])
+            g = np.asarray(_get_path(host_grads, path)).reshape(-1)
+            self.adam.step(w, g, st, increment_step=(i == 0))
+            if on_leaf is not None:
+                on_leaf(path, w, shape)
+            if self.state_nvme:
+                sw.swap_out(self._key(path, "m"), st["m"])
+                sw.swap_out(self._key(path, "v"), st["v"])
+            if self.master_nvme:
+                sw.swap_out(self._key(path, "w"), w)
+        if sw is not None:
+            sw.wait()
+        return self.master
+
+    # --------------------------------------------------------- checkpointing
+    def master_tree(self):
+        """Full fp32 master as a nested tree (reads from NVMe if tiered)."""
+        it = iter(self._paths)
+
+        def take(shape_path):
+            path = next(it)
+            shape = self._shapes[path]
+            if self.master_nvme:
+                flat = self._swapper.swap_in(self._key(path, "w"))
+            else:
+                flat = _get_path(self.master, path).reshape(-1)
+            return flat.reshape(shape).copy()
+        return self._map_structure(take)
+
+    def state_tree(self):
+        """{'step', 'm': tree, 'v': tree} mirroring the master structure —
+        the checkpointable optimizer state (reads back from NVMe when
+        tiered)."""
+        def fetch(which):
+            it = iter(self._paths)
+
+            def take(_):
+                path = next(it)
+                if self.state_nvme:
+                    flat = self._swapper.swap_in(self._key(path, which))
+                else:
+                    flat = self.state[path][which]
+                return flat.reshape(self._shapes[path]).copy()
+            return self._map_structure(take)
+        return {"step": np.int32(self.adam.get_step()),
+                "m": fetch("m"), "v": fetch("v")}
+
+    def load_master_tree(self, tree):
+        for path in self._paths:
+            flat = np.ascontiguousarray(
+                np.asarray(_get_path(tree, path), np.float32).reshape(-1))
+            if self.master_nvme:
+                self._swapper.swap_out(self._key(path, "w"), flat)
+            else:
+                _get_path(self.master, path).reshape(-1)[:] = flat
+        if self.master_nvme:
+            self._swapper.wait()
+
+    def load_state_tree(self, tree):
+        """Inverse of state_tree (call after load_master_tree)."""
+        self.adam.set_step(int(tree.get("step", 0)))
+        for which in ("m", "v"):
+            for path in self._paths:
+                flat = np.ascontiguousarray(np.asarray(
+                    _get_path(tree[which], path), np.float32).reshape(-1))
+                if self.state_nvme:
+                    self._swapper.swap_out(self._key(path, which), flat)
+                else:
+                    self.state[path][which][:] = flat
+        if self.state_nvme:
+            self._swapper.wait()
+
+    def _map_structure(self, take):
+        """Rebuild the nested master structure calling take(path) in
+        _leaf_paths order."""
+        def build(paths, depth):
+            heads = {}
+            for p in paths:
+                heads.setdefault(p[depth], []).append(p)
+            if len(paths) == 1 and len(paths[0]) == depth:
+                return take(paths[0])
+            out = {}
+            for k in sorted(heads):
+                sub = heads[k]
+                if all(len(p) == depth + 1 for p in sub):
+                    out[k] = take(sub[0])
+                else:
+                    out[k] = build(sub, depth + 1)
+            return out
+        return build(self._paths, 0)
+
+    def close(self):
+        self.adam.close()
+        if self._swapper is not None:
+            self._swapper.close()
