@@ -41,6 +41,10 @@ class ElasticityConfig:
     # v0.2 knobs (reference num_gpus_per_node / model_parallel_size)
     num_gpus_per_node: int = 1
     model_parallel_size: int = 1
+    # non-reference escape hatch: admit world sizes smaller than one
+    # slice/node (single-host debugging); the reference accepts whole-node
+    # multiples only
+    allow_partial_slice: bool = False
 
     @classmethod
     def from_dict(cls, d):
@@ -103,10 +107,13 @@ def get_compatible_chips_v01(micro_batches, max_acceptable_batch_size,
 def get_compatible_chips_v02(micro_batches, max_acceptable_batch_size,
                              current_num_chips, min_chips=None,
                              max_chips=None, prefer_larger=True,
-                             chips_per_slice=1, model_parallel_size=1):
+                             chips_per_slice=1, model_parallel_size=1,
+                             allow_partial_slice=False):
     """reference _get_compatible_gpus_v02: v0.1 math over DP-equivalent
     chips, then rescale by model parallelism and keep only counts that are
-    whole slices."""
+    whole slices (``allow_partial_slice`` additionally admits sub-slice
+    worlds for single-host debugging; the reference accepts whole-node
+    multiples only)."""
     if model_parallel_size > 1:
         group_size = chips_per_slice * model_parallel_size
         if current_num_chips % group_size != 0:
@@ -128,7 +135,8 @@ def get_compatible_chips_v02(micro_batches, max_acceptable_batch_size,
             min_chips=min_chips, max_chips=max_chips,
             prefer_larger=prefer_larger)
     valid = [v for v in valid
-             if v % chips_per_slice == 0 or v < chips_per_slice]
+             if v % chips_per_slice == 0
+             or (allow_partial_slice and v < chips_per_slice)]
     return batch, valid
 
 
@@ -149,7 +157,8 @@ def compute_elastic_config(ds_config, target_version=0.2, world_size=0,
             min_chips=cfg.min_gpus, max_chips=cfg.max_gpus,
             prefer_larger=cfg.prefer_larger_batch,
             chips_per_slice=cfg.num_gpus_per_node,
-            model_parallel_size=cfg.model_parallel_size)
+            model_parallel_size=cfg.model_parallel_size,
+            allow_partial_slice=cfg.allow_partial_slice)
     else:
         final_batch, valid = get_compatible_chips_v01(
             cfg.micro_batch_sizes, cfg.max_train_batch_size,

@@ -68,7 +68,13 @@ class InferenceEngine:
             config = DeepSpeedInferenceConfig.from_dict(kwargs)
         self.config = config
         self.model = model
-        self._generate_cache = {}
+        # LRU-bounded: every distinct (shape-bucket, sampling params) tuple
+        # retains a compiled XLA program; long-running servers with varied
+        # requests would otherwise leak memory (v2 passes sampling params
+        # as traced args instead — one program per shape only)
+        from collections import OrderedDict
+        self._generate_cache = OrderedDict()
+        self._generate_cache_max = 32
 
         if topology is None:
             topology = groups.initialize(TopologyConfig(
@@ -205,6 +211,9 @@ class InferenceEngine:
             self._generate_cache[key] = self._build_generate(
                 B, T_pad, max_new_tokens, float(temperature), int(top_k),
                 float(top_p), int(eos_token_id))
+            while len(self._generate_cache) > self._generate_cache_max:
+                self._generate_cache.popitem(last=False)
+        self._generate_cache.move_to_end(key)
         fn = self._generate_cache[key]
 
         if seed is not None:

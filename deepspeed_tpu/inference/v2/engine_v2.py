@@ -158,7 +158,12 @@ class InferenceEngineV2:
             return self._results.pop(uid) if flush else self._results[uid]
         if any(r.uid == uid for r in self._pending):
             return np.zeros((0,), np.int32)  # queued, nothing yet
-        seq = self.state_mgr.get_sequence(uid)
+        try:
+            seq = self.state_mgr.get_sequence(uid)
+        except KeyError:
+            raise KeyError(
+                f"unknown uid {uid} (never submitted, or already fetched "
+                f"with get(flush=True))") from None
         return np.asarray(seq.generated, np.int32)
 
     @property

@@ -87,8 +87,15 @@ class DeepSpeedDataSampler:
             start = offset
             end = min(start + self.global_batch_size, self.total_samples)
             batch = order[start:end]
-            if len(batch) < self.global_batch_size:  # not drop_last: pad by
-                batch = np.resize(batch, self.global_batch_size)  # tiling
+            if len(batch) < self.global_batch_size:
+                # not drop_last: the final partial global batch must still
+                # be SPMD-shaped (every DP rank needs an equal slice), so
+                # it is padded by TILING — the tail samples appear twice
+                # in that step. Metric consumers that must not
+                # double-count the tail should set drop_last=True (the
+                # reference sampler instead yields a short batch, which an
+                # SPMD engine cannot shard).
+                batch = np.resize(batch, self.global_batch_size)
             self.consumed_samples += (end - start)
             per_rank = self.global_batch_size // self.dp_size
             mine = batch[self.dp_rank * per_rank:(self.dp_rank + 1)

@@ -102,8 +102,12 @@ def test_staged_fwd_bwd_step_matches_train_batch():
             loss = e2(micro)
             e2.backward(loss)
             e2.step()
-        l_staged.append(float(np.mean([float(l) for l in [loss]])))
+            micro_losses.append(float(loss))
+        l_staged.append(float(np.mean(micro_losses)))
     assert e2.global_step == 2
+    # the fused path's per-step loss is the mean over its gas micro
+    # losses — the staged path must reproduce it step for step
+    np.testing.assert_allclose(l_staged, l_fused, rtol=2e-5, atol=1e-6)
     # same state evolution => same final eval loss
     probe = _batches(1, 8, seed=99)[0]
     np.testing.assert_allclose(float(e1.eval_loss(probe)),
