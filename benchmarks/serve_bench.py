@@ -1,0 +1,116 @@
+"""Serving benchmark: decode throughput + per-token latency on real TPU.
+
+The reference's FastGen identity is measured serving throughput
+(BASELINE.md rows 3-5: effective throughput under SLA). This bench drives
+the v2 continuous-batching engine end to end — prefill a batch of
+prompts, then timed decode steps over the paged KV cache (the Pallas
+paged-attention kernel) — and prints one JSON line per configuration:
+
+    {"model": ..., "batch": N, "prompt_len": P, "decode_tokens_per_sec":
+     ..., "ms_per_token": ...}
+
+Run on the chip:  python benchmarks/serve_bench.py
+Env: SERVE_MODELS=gpt2-350M,llama-1b  SERVE_BATCHES=1,8
+     SERVE_PROMPT=1024  SERVE_DECODE=128
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax  # noqa: E402
+
+from deepspeed_tpu.inference.v2.engine_v2 import (  # noqa: E402
+    InferenceEngineV2, RaggedInferenceEngineConfig)
+from deepspeed_tpu.models import GPT2, PRESETS  # noqa: E402
+from deepspeed_tpu.models.llama import Llama, LlamaConfig  # noqa: E402
+from deepspeed_tpu.utils import groups  # noqa: E402
+
+
+def build_model(name):
+    if name == "gpt2-350M":
+        from dataclasses import replace
+        return GPT2(replace(PRESETS["350M"], max_seq_len=2048))
+    if name == "llama-1b":
+        return Llama(LlamaConfig(n_layer=16, n_head=16, n_kv_heads=8,
+                                 d_model=2048, d_ff=5632, max_seq_len=2048,
+                                 vocab_size=32000))
+    raise ValueError(name)
+
+
+def bench_one(name, batch, prompt_len, decode_tokens, block_size=128):
+    groups.reset()
+    model = build_model(name)
+    engine = InferenceEngineV2(
+        model,
+        RaggedInferenceEngineConfig(max_batch_size=batch,
+                                    kv_block_size=block_size,
+                                    prompt_bucket=prompt_len))
+    rng = np.random.RandomState(0)
+    V = model.config.vocab_size
+
+    def run(n_decode):
+        for _ in range(batch):
+            engine.put(rng.randint(0, V, (prompt_len,)),
+                       max_new_tokens=n_decode, eos_token_id=-1)
+        # first step admits + prefills; subsequent steps decode
+        while engine.has_work:
+            engine.step()
+        for uid in list(engine._results):
+            engine.get(uid)
+
+    run(4)   # warm both programs (prefill bucket + decode)
+
+    # timed: prefill separately from decode so decode rate is clean
+    t0 = time.perf_counter()
+    for _ in range(batch):
+        engine.put(rng.randint(0, V, (prompt_len,)),
+                   max_new_tokens=decode_tokens, eos_token_id=-1)
+    engine.step()             # admission + prefills + first decode
+    t_prefill = time.perf_counter() - t0
+
+    steps = 0
+    t0 = time.perf_counter()
+    while engine.has_work:
+        engine.step()
+        steps += 1
+    # force completion
+    for uid in list(engine._results):
+        np.asarray(engine.get(uid))
+    t_decode = time.perf_counter() - t0
+
+    total_decoded = batch * decode_tokens
+    out = {
+        "model": name,
+        "batch": batch,
+        "prompt_len": prompt_len,
+        "decode_tokens": decode_tokens,
+        "decode_tokens_per_sec": round(total_decoded / t_decode, 1),
+        # a sequence's own next-token latency: decode wall / its tokens
+        "ms_per_token": round(1e3 * t_decode / decode_tokens, 3),
+        "dispatches": steps,
+        "prefill_s": round(t_prefill, 3),
+        "devices": len(jax.devices()),
+    }
+    print(json.dumps(out))
+    return out
+
+
+def main():
+    models = os.environ.get("SERVE_MODELS", "gpt2-350M,llama-1b").split(",")
+    batches = [int(b) for b in
+               os.environ.get("SERVE_BATCHES", "1,8").split(",")]
+    prompt = int(os.environ.get("SERVE_PROMPT", "1024"))
+    decode = int(os.environ.get("SERVE_DECODE", "128"))
+    for m in models:
+        for b in batches:
+            bench_one(m, b, prompt, decode)
+
+
+if __name__ == "__main__":
+    main()

@@ -47,3 +47,114 @@ class RandomTuner(BaseTuner):
         if self.max_trials:
             exps = exps[:self.max_trials]
         return iter(exps)
+
+
+class CostModel:
+    """Fitted performance model over experiment configs (reference
+    autotuning/tuner/cost_model.py:14 XGBoostCostModel). Torch/xgboost-free
+    realization: one-hot + numeric featurization of config dicts and a
+    ridge-regression fit in closed form (numpy) — enough signal to rank a
+    ZeRO-stage x micro-batch x buckets space, with none of the
+    dependency weight."""
+
+    def __init__(self, ridge=1e-3):
+        self.ridge = ridge
+        self._feat_keys = None
+        self._cat_values = None
+        self._w = None
+
+    def _featurize(self, exp):
+        vec = []
+        for k in self._feat_keys:
+            v = exp.get(k)
+            if k in self._cat_values:              # categorical: one-hot
+                for cv in self._cat_values[k]:
+                    vec.append(1.0 if v == cv else 0.0)
+            else:
+                import math
+                x = float(v)
+                vec.append(math.log1p(abs(x)) * (1 if x >= 0 else -1))
+        vec.append(1.0)                            # bias
+        return vec
+
+    def fit(self, experiments, metrics):
+        """experiments: list of config dicts; metrics: measured values
+        (higher better)."""
+        import numpy as np
+        keys = sorted({k for e in experiments for k in e})
+        self._feat_keys = keys
+        self._cat_values = {}
+        for k in keys:
+            vals = {e.get(k) for e in experiments}
+            if any(not isinstance(v, (int, float, bool)) or
+                   isinstance(v, bool) for v in vals):
+                self._cat_values[k] = sorted(vals, key=repr)
+        X = np.asarray([self._featurize(e) for e in experiments])
+        y = np.asarray(metrics, float)
+        A = X.T @ X + self.ridge * np.eye(X.shape[1])
+        self._w = np.linalg.solve(A, X.T @ y)
+        return self
+
+    def predict(self, experiments):
+        import numpy as np
+        assert self._w is not None, "fit() first"
+        X = np.asarray([self._featurize(e) for e in experiments])
+        return X @ self._w
+
+
+class ModelBasedTuner(BaseTuner):
+    """Sequential model-based search (reference
+    tuner/model_based_tuner.py:19): seed with a few random trials, then
+    alternate fit -> propose the best predicted untried config, with
+    epsilon-greedy exploration. Drive it with::
+
+        tuner = ModelBasedTuner(space)
+        for exp in tuner:
+            tuner.record(exp, measure(exp))
+    """
+
+    def __init__(self, space, seed=0, max_trials=None, warmup_trials=3,
+                 explore_eps=0.15):
+        super().__init__(space, seed)
+        self.max_trials = max_trials or len(self.experiments)
+        self.warmup = warmup_trials
+        self.eps = explore_eps
+        self.rng = random.Random(seed)
+        self.observed = []                # (exp, metric)
+        self.model = CostModel()
+
+    def __len__(self):
+        return min(self.max_trials, len(self.experiments))
+
+    def record(self, exp, metric):
+        self.observed.append((exp, float(metric)))
+
+    def _untried(self):
+        seen = [e for e, _ in self.observed]
+        return [e for e in self.experiments if e not in seen]
+
+    def __iter__(self):
+        count = 0
+        order = list(self.experiments)
+        self.rng.shuffle(order)
+        while count < len(self):
+            untried = self._untried()
+            if not untried:
+                return
+            if len(self.observed) < self.warmup or \
+                    self.rng.random() < self.eps:
+                exp = next(e for e in order if e in untried)
+            else:
+                self.model.fit(*zip(*self.observed))
+                preds = self.model.predict(untried)
+                exp = untried[int(max(range(len(untried)),
+                                      key=lambda i: preds[i]))]
+            count += 1
+            yield exp
+        if len(self.observed) < count:
+            raise RuntimeError(
+                "ModelBasedTuner requires record(exp, metric) after each "
+                "yielded experiment")
+
+    def best(self):
+        return max(self.observed, key=lambda em: em[1])

@@ -30,18 +30,34 @@ from ..runtime.checkpoint_engine import serialization as ser
 
 
 def _resolve(path_or_dir, tag=None):
+    """-> loadable location: a direct .npz file path, or a tag directory
+    (legacy monolithic state.npz or the sharded per-host layout — both
+    handled by serialization.load_state)."""
+    if (os.path.isdir(path_or_dir)
+            and not os.path.exists(os.path.join(path_or_dir, "latest"))
+            and (os.path.exists(os.path.join(path_or_dir, "state.npz"))
+                 or any(f.startswith("shard-")
+                        for f in os.listdir(path_or_dir)))):
+        return path_or_dir  # already a tag dir
     if os.path.isdir(path_or_dir):
         if tag is None:
             with open(os.path.join(path_or_dir, "latest")) as f:
                 tag = f.read().strip()
-        return os.path.join(path_or_dir, tag, "state.npz")
+        return os.path.join(path_or_dir, tag)
     return path_or_dir
+
+
+def _load(path_or_dir, tag=None):
+    loc = _resolve(path_or_dir, tag)
+    if os.path.isdir(loc):
+        return ser.load_state(loc)
+    return ser.load_file(loc)
 
 
 def consolidate_to_fp32(ckpt, output_path, tag=None):
     """reference utils/zero_to_fp32.py: training checkpoint -> standalone
     fp32 weights file (master subtree only). Returns #params written."""
-    flat, header = ser.load_file(_resolve(ckpt, tag))
+    flat, header = _load(ckpt, tag)
     master = {k[len("master/"):]: v for k, v in flat.items()
               if k.startswith("master/")}
     if not master:
@@ -68,7 +84,7 @@ def load_consolidated(path):
 def ds_to_universal(ckpt, out_dir, tag=None):
     """reference checkpoint/ds_to_universal.py: one .npy per logical
     param + index json. Returns the index dict."""
-    flat, header = ser.load_file(_resolve(ckpt, tag))
+    flat, header = _load(ckpt, tag)
     os.makedirs(out_dir, exist_ok=True)
     index = {}
     for key, arr in flat.items():
@@ -98,7 +114,7 @@ def inspect_checkpoint(ckpt, tag=None, file=None):
     bytes."""
     import sys
     f = file or sys.stdout
-    flat, header = ser.load_file(_resolve(ckpt, tag))
+    flat, header = _load(ckpt, tag)
     total = 0
     for key in sorted(flat):
         arr = np.asarray(flat[key])

@@ -1,0 +1,128 @@
+"""Elastic agent: supervise the worker world, restart on membership change.
+
+Counterpart of reference ``elasticity/elastic_agent.py:28 DSElasticAgent``
+(a torch-elastic LocalElasticAgent subclass: rendezvous, health watch,
+restart-on-membership-change) and the ``bin/ds_elastic`` CLI. The TPU
+realization supervises the launcher's worker processes directly:
+jax.distributed worlds cannot survive a member loss (the coordinator and
+every collective assume a fixed world), so the recovery unit is the WHOLE
+world — on any worker failure the agent tears the remaining workers down,
+recomputes the world from the surviving hosts (validated against the
+elastic config's admissible chip counts when one is given), and
+relaunches. Workers resume from the latest checkpoint (the engine's
+durable-`latest` pointer), which is the reference's recovery model too.
+"""
+
+import time
+
+from ..utils.logging import logger
+from .elasticity import compute_elastic_config, ElasticityError
+
+
+class WorldFailure(Exception):
+    """Raised when the world cannot be restarted (too few hosts /
+    restart budget exhausted / inadmissible world size)."""
+
+
+class DSElasticAgent:
+    """Drive ``launch_fn(hosts) -> [(host, subprocess.Popen), ...]``
+    through failures.
+
+    Args:
+      launch_fn: starts one worker per host for the CURRENT world and
+        returns (host, proc) pairs. Each relaunch gets env/rendezvous for
+        the new world size (the launcher rebuilds worker commands).
+      hosts: initial host list.
+      ds_config: optional config dict with an 'elasticity' block — used to
+        validate shrunken world sizes (reference compute_elastic_config).
+      chips_per_host: multiplied into world size for validation.
+      max_restarts: restart budget (reference torch-elastic semantics).
+      min_hosts: refuse to shrink below this.
+      poll_s: liveness poll interval.
+      on_restart(gen, hosts): hook (tests observe membership changes).
+    """
+
+    def __init__(self, launch_fn, hosts, ds_config=None, chips_per_host=1,
+                 max_restarts=10, min_hosts=1, poll_s=0.5,
+                 on_restart=None):
+        self.launch_fn = launch_fn
+        self.hosts = list(hosts)
+        self.ds_config = ds_config
+        self.chips_per_host = chips_per_host
+        self.max_restarts = max_restarts
+        self.min_hosts = min_hosts
+        self.poll_s = poll_s
+        self.on_restart = on_restart
+        self.restart_count = 0
+
+    # ------------------------------------------------------------ internals
+    def _validate_world(self, hosts):
+        if len(hosts) < max(1, self.min_hosts):
+            raise WorldFailure(
+                f"only {len(hosts)} hosts left (< min_hosts="
+                f"{max(1, self.min_hosts)})")
+        if self.ds_config and "elasticity" in self.ds_config:
+            world = len(hosts) * self.chips_per_host
+            try:
+                compute_elastic_config(self.ds_config, world_size=world)
+            except ElasticityError as e:
+                raise WorldFailure(
+                    f"world size {world} not admissible under the elastic "
+                    f"config: {e}") from e
+
+    def _supervise(self, procs):
+        """Block until every worker exits. On the FIRST failure, terminate
+        the rest (a jax.distributed world is all-or-nothing). Returns
+        (ok, failed_hosts)."""
+        live = dict(procs)
+        failed = []
+        while live:
+            for host, p in list(live.items()):
+                rc = p.poll()
+                if rc is None:
+                    continue
+                del live[host]
+                if rc != 0:
+                    logger.warning(
+                        f"elastic agent: worker on {host} exited rc={rc}")
+                    failed.append(host)
+            if failed and live:
+                logger.warning(
+                    f"elastic agent: tearing down {len(live)} surviving "
+                    "workers for world restart")
+                for p in live.values():
+                    p.terminate()
+                deadline = time.time() + 10
+                for p in live.values():
+                    try:
+                        p.wait(timeout=max(0.1, deadline - time.time()))
+                    except Exception:  # noqa: BLE001
+                        p.kill()
+                live.clear()
+            if live:
+                time.sleep(self.poll_s)
+        return (not failed), failed
+
+    # ---------------------------------------------------------------- run
+    def run(self):
+        """Launch and supervise until clean exit. Returns the final host
+        list. Raises WorldFailure when recovery is impossible."""
+        self._validate_world(self.hosts)
+        while True:
+            gen = self.restart_count
+            logger.info(
+                f"elastic agent: launching generation {gen} on "
+                f"{len(self.hosts)} hosts")
+            procs = self.launch_fn(list(self.hosts))
+            ok, failed = self._supervise(procs)
+            if ok:
+                return list(self.hosts)
+            # membership change: drop the failed hosts, restart the rest
+            self.hosts = [h for h in self.hosts if h not in failed]
+            self.restart_count += 1
+            if self.restart_count > self.max_restarts:
+                raise WorldFailure(
+                    f"restart budget exhausted ({self.max_restarts})")
+            self._validate_world(self.hosts)
+            if self.on_restart is not None:
+                self.on_restart(self.restart_count, list(self.hosts))

@@ -231,12 +231,10 @@ class InferenceEngine:
         load falls out of device_put with NamedShardings)."""
         import os
         from ..runtime.checkpoint_engine import serialization as ser
-        from ..runtime.checkpoint_engine.engines import SyncCheckpointEngine
         if tag is None:
             with open(os.path.join(load_dir, "latest")) as f:
                 tag = f.read().strip()
-        path = os.path.join(load_dir, tag, "state.npz")
-        flat, header = SyncCheckpointEngine().load(path)
+        flat, header = ser.load_state(os.path.join(load_dir, tag))
         abstract = jax.eval_shape(self.model.init, jax.random.key(0))
         tree = ser.unflatten_into({"master": abstract}, {
             k: v for k, v in flat.items() if k.startswith("master")

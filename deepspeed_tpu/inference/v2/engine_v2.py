@@ -3,14 +3,17 @@
 Counterpart of reference ``inference/v2/engine_v2.py:30 InferenceEngineV2``
 (FastGen). TPU redesign:
   * The blocked KV cache is ONE device pytree {'k','v'}:
-    (L, num_blocks, block_size, H, hd); per-sequence block tables index it
-    (reference BlockedKVCache, kv_cache.py:40). Heads shard over 'tensor'.
-  * Two compiled programs replace the ragged kernel zoo: a per-bucket
-    prefill (one sequence, causal over its prompt, KV scattered into its
-    blocks) and a fixed-shape decode (whole batch, one token each,
-    block-table gather + masked attention). Fixed shapes mean exactly two
-    XLA compilations per bucket — the CUDA-graph-like property FastGen gets
-    from its kernel design.
+    (L, num_blocks, H_kv, block_size, hd), heads-major; per-sequence block
+    tables index it (reference BlockedKVCache, kv_cache.py:40). Heads
+    shard over 'tensor'.
+  * Two compiled programs replace most of the ragged kernel zoo: a
+    per-bucket prefill (one sequence, causal over its prompt, KV scattered
+    into its blocks) and a fixed-shape decode (whole batch, one token
+    each) whose attention is the Pallas paged kernel
+    (ops/pallas/paged_attention.py) reading K/V straight through the
+    block table — the blocked_flash role. Fixed shapes mean exactly two
+    XLA compilations per bucket — the CUDA-graph-like property FastGen
+    gets from its kernel design.
   * Scheduling (reference DSStateManager + the put/schedule loop in
     mii/ragged batching): admit pending requests while slots+blocks allow,
     prefill them, then batched decode steps; sequences retire on EOS or
@@ -45,6 +48,9 @@ class RaggedInferenceEngineConfig:
     temperature: float = 0.0         # 0 = greedy
     top_k: int = 0
     seed: int = 0
+    # decode steps fused into one device program (host sync + dispatch
+    # amortize over this many tokens; scheduling granularity coarsens)
+    decode_steps_per_dispatch: int = 8
 
 
 @dataclass
@@ -217,14 +223,26 @@ class InferenceEngineV2:
     def _get_decode(self):
         if self._decode_jit is None:
             model = self.model
+            n = max(1, self.config.decode_steps_per_dispatch)
 
             def decode(params, cache, tokens, lengths, tables, rng,
                        temps, top_ks, all_greedy):
-                logits, cache = model.apply_paged_decode(
-                    params, tokens, lengths, cache, tables)
-                tok = self._sample_per_slot(logits, rng, temps, top_ks,
-                                            all_greedy)
-                return tok, cache
+                # n decode steps in ONE program: the sampled token feeds
+                # the next step in-trace, so the host round trip (token
+                # sync + batch re-upload + dispatch latency) amortizes
+                # over n tokens. Unrolled (not lax.scan): the cache pools
+                # must stay per-layer donated buffers updated in place —
+                # carrying them through a scan defensively copies them.
+                all_toks = []
+                for t in range(n):
+                    logits, cache = model.apply_paged_decode(
+                        params, tokens, lengths, cache, tables)
+                    tokens = self._sample_per_slot(
+                        logits, jax.random.fold_in(rng, t), temps,
+                        top_ks, all_greedy)
+                    lengths = lengths + 1
+                    all_toks.append(tokens)
+                return jnp.stack(all_toks), cache
 
             self._decode_jit = jax.jit(
                 decode, donate_argnums=(1,), static_argnums=(8,),
@@ -272,9 +290,17 @@ class InferenceEngineV2:
             self.state_mgr.flush(seq.uid)
 
     def step(self):
-        """One scheduler iteration: admit+prefill pending, then one decode
-        step for every active sequence. Returns list of (uid, token) pairs
-        produced this step."""
+        """One scheduler iteration: admit+prefill pending, then up to
+        ``decode_steps_per_dispatch`` decode steps for every active
+        sequence in one device program. Returns list of (uid, token)
+        pairs produced this step.
+
+        A sequence that hits EOS or its budget mid-dispatch keeps
+        decoding until the dispatch ends (its extra tokens are discarded
+        and its over-writes land in its own tail slots / the scratch
+        block) — the FastGen trade of scheduling granularity for
+        amortized launch overhead.
+        """
         self._admit_pending()
         mgr = self.state_mgr
         if mgr.n_active == 0:
@@ -288,16 +314,19 @@ class InferenceEngineV2:
                                   batch.block_tables, sub,
                                   batch.temps, batch.top_ks,
                                   not bool(batch.temps.any()))
-        toks = np.asarray(toks)
+        toks = np.asarray(toks)                     # (n, B)
         out = []
         slots = list(mgr._slots)  # snapshot: retire mutates
         for slot, uid in enumerate(slots):
             if uid is None or not batch.active[slot]:
                 continue
             seq = mgr.get_sequence(uid)
-            tok = int(toks[slot])
-            self._post_token(seq, tok)
-            out.append((uid, tok))
+            for t in range(toks.shape[0]):
+                if uid in self._results:
+                    break                            # finished mid-dispatch
+                tok = int(toks[t, slot])
+                self._post_token(seq, tok)
+                out.append((uid, tok))
         return out
 
     def generate_all(self, prompts, max_new_tokens=32, eos_token_id=-1):

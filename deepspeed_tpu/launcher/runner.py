@@ -79,7 +79,7 @@ def parse_inclusion_exclusion(resource_pool, include_str="",
 
 
 def build_worker_cmds(hosts, coordinator, script, script_args,
-                      env_passthrough=()):
+                      env_passthrough=(), extra_env=None):
     """One (host, argv, env) per host. env carries the jax.distributed
     rendezvous triplet."""
     cmds = []
@@ -90,6 +90,8 @@ def build_worker_cmds(hosts, coordinator, script, script_args,
             "NUM_PROCESSES": str(n),
             "PROCESS_ID": str(pid),
         }
+        if extra_env:
+            env.update(extra_env)
         for k in env_passthrough:
             if k in os.environ:
                 env[k] = os.environ[k]
@@ -165,6 +167,12 @@ def parse_args(argv=None):
                         choices=["ssh", "pdsh"])
     parser.add_argument("--env", action="append", default=[],
                         help="env var names to pass through to workers")
+    parser.add_argument("--elastic", action="store_true",
+                        help="supervise workers and restart the world on "
+                             "membership change (reference ds_elastic / "
+                             "DSElasticAgent)")
+    parser.add_argument("--max_elastic_restarts", type=int, default=10)
+    parser.add_argument("--min_hosts", type=int, default=1)
     parser.add_argument("script", help="training script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
@@ -192,6 +200,23 @@ def main(argv=None):
               else SSHRunner(args))
     if not runner.available():
         raise SystemExit(f"launcher {args.launcher} not available")
+    if args.elastic:
+        from ..elasticity.elastic_agent import DSElasticAgent
+
+        def launch_fn(world_hosts):
+            coord = f"{args.master_addr or world_hosts[0]}:{args.master_port}"
+            wc = build_worker_cmds(
+                world_hosts, coord, args.script, args.script_args,
+                env_passthrough=tuple(args.env) + (
+                    "PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS"),
+                extra_env={"ELASTIC_GENERATION": str(agent.restart_count)})
+            return list(zip(world_hosts, runner.launch(wc)))
+
+        agent = DSElasticAgent(launch_fn, hosts,
+                               max_restarts=args.max_elastic_restarts,
+                               min_hosts=args.min_hosts)
+        agent.run()
+        return 0
     logger.info(f"launching on {len(hosts)} hosts via {args.launcher}; "
                 f"coordinator {coordinator}")
     procs = runner.launch(cmds)

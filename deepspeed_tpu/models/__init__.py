@@ -4,3 +4,4 @@ from .gpt2_moe import GPT2MoE, GPT2MoEConfig
 from .gpt2_pipe import GPT2Pipe
 from .llama import (Llama, LlamaConfig, LLAMA_PRESETS, LLAMA_TINY,
                     LLAMA2_7B, MISTRAL_7B)
+from .mixtral import Mixtral, MixtralConfig, MIXTRAL_TINY, MIXTRAL_8X7B

@@ -498,16 +498,28 @@ class GPT2:
     #     ragged/kv_cache.py BlockedKVCache; here the cache is a pool of
     #     fixed-size blocks indexed by per-sequence block tables) ---
     def init_paged_cache(self, num_blocks, block_size, dtype=None):
-        """{'k','v'}: (L, num_blocks, block_size, H, hd). Block 0 is the
+        """{'k','v'}: LISTS of per-layer (num_blocks, H, block_size, hd)
+        pools, heads-major (the Pallas paged-decode kernel's (H, BS, hd)
+        block needs no in-VMEM transpose). Separate per-layer buffers —
+        not one stacked (L, ...) array — so each layer's new-token scatter
+        updates its own donated buffer IN PLACE; a stacked array carried
+        through lax.scan gets defensively copied every layer (custom-call
+        operand + carry), ~the whole pool per layer. Block 0 is the
         scratch block (pad/inactive writes land there)."""
         cfg = self.config
         dt = jnp.dtype(dtype) if dtype is not None else _dtype(cfg)
-        shape = (cfg.n_layer, num_blocks, block_size, cfg.n_head, cfg.d_head)
-        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        shape = (num_blocks, cfg.n_head, block_size, cfg.d_head)
+        return {"k": [jnp.zeros(shape, dt) for _ in range(cfg.n_layer)],
+                "v": [jnp.zeros(shape, dt) for _ in range(cfg.n_layer)]}
 
     def paged_cache_specs(self):
-        spec = P(None, None, None, "tensor", None)
-        return {"k": spec, "v": spec}
+        spec = P(None, "tensor", None, None)
+        L = self.config.n_layer
+        return {"k": [spec] * L, "v": [spec] * L}
+
+    def _layer_slice(self, params, i):
+        """Static per-layer view of the stacked block params."""
+        return jax.tree.map(lambda a: a[i], params["blocks"])
 
     def apply_paged_prefill(self, params, input_ids, cache, token_blocks,
                             token_offsets, length):
@@ -528,13 +540,16 @@ class GPT2:
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
         mask = causal & valid[None, :]
 
-        def body(carry, xs):
-            layer, kc0, vc0 = xs
+        ks_out, vs_out = [], []
+        for i in range(cfg.n_layer):
+            layer = self._layer_slice(params, i)
+            kc0, vc0 = cache["k"][i], cache["v"][i]
 
-            def attn_fn(q, kk, v):
-                kc = kc0.at[token_blocks, token_offsets].set(
+            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0):
+                # in-place scatter on this layer's own donated pool buffer
+                kc = kc0.at[token_blocks, :, token_offsets].set(
                     kk[0].astype(kc0.dtype))
-                vc = vc0.at[token_blocks, token_offsets].set(
+                vc = vc0.at[token_blocks, :, token_offsets].set(
                     v[0].astype(vc0.dtype))
                 scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                                     preferred_element_type=jnp.float32)
@@ -543,14 +558,12 @@ class GPT2:
                 probs = jax.nn.softmax(scores, axis=-1).astype(dt)
                 return jnp.einsum("bhts,bshd->bthd", probs, v), (kc, vc)
 
-            x, (kc, vc) = self._block_core(carry, layer, attn_fn)
-            return x, (kc, vc)
-
-        x, (kc, vc) = lax.scan(body, x,
-                               (params["blocks"], cache["k"], cache["v"]))
+            x, (kc, vc) = self._block_core(x, layer, attn_fn)
+            ks_out.append(kc)
+            vs_out.append(vc)
         last = jnp.take_along_axis(
             x, jnp.maximum(length - 1, 0)[None, None, None], axis=1)
-        return self.head(params, last)[:, 0], {"k": kc, "v": vc}
+        return self.head(params, last)[:, 0], {"k": ks_out, "v": vs_out}
 
     def apply_paged_decode(self, params, tokens, lengths, cache,
                            block_tables):
@@ -562,48 +575,41 @@ class GPT2:
         Returns (logits (B, V), cache).
         """
         cfg = self.config
-        dt = _dtype(cfg)
         B = tokens.shape[0]
-        H, hd = cfg.n_head, cfg.d_head
-        BS = cache["k"].shape[2]
-        MB = block_tables.shape[1]
-        S = MB * BS
+        BS = cache["k"][0].shape[2]
 
         pos = jnp.minimum(lengths, cfg.max_seq_len - 1)
         x = (params["wte"][tokens[:, None]]
-             + params["wpe"][pos[:, None]]).astype(dt)
+             + params["wpe"][pos[:, None]]).astype(_dtype(cfg))
         dst_block = jnp.take_along_axis(
             block_tables, (lengths // BS)[:, None], axis=1)[:, 0]
         dst_off = lengths % BS
-        # attend over slots 0..lengths (inclusive of the new token)
-        attn_mask = jnp.arange(S)[None, :] <= lengths[:, None]
 
-        def body(carry, xs):
-            layer, kc0, vc0 = xs
+        ks_out, vs_out = [], []
+        for i in range(cfg.n_layer):
+            layer = self._layer_slice(params, i)
+            kc0, vc0 = cache["k"][i], cache["v"][i]
 
-            def attn_fn(q, kk, v):
-                # q/kk/v: (B, 1, H, hd) — the single new token per slot
-                kc = kc0.at[dst_block, dst_off].set(kk[:, 0].astype(
-                    kc0.dtype))
-                vc = vc0.at[dst_block, dst_off].set(v[:, 0].astype(
-                    vc0.dtype))
-                # gather each slot's blocks: (B, MB, BS, H, hd) -> (B, S, .)
-                gk = kc[block_tables].reshape(B, S, H, hd)
-                gv = vc[block_tables].reshape(B, S, H, hd)
-                scores = jnp.einsum("bhd,bshd->bhs", q[:, 0], gk,
-                                    preferred_element_type=jnp.float32)
-                scores = scores / math.sqrt(hd)
-                scores = jnp.where(attn_mask[:, None, :], scores, -1e30)
-                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-                attn = jnp.einsum("bhs,bshd->bhd", probs, gv)
+            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0):
+                # q/kk/v: (B, 1, H, hd) — the single new token per slot.
+                # In-place write into this layer's donated pool, then the
+                # Pallas paged kernel reads K/V straight through the block
+                # table (no dense gather; reference
+                # inference/v2/kernels/ragged_ops blocked_flash)
+                from ..ops.pallas.paged_attention import (
+                    paged_decode_attention)
+                kc = kc0.at[dst_block, :, dst_off].set(
+                    kk[:, 0].astype(kc0.dtype))
+                vc = vc0.at[dst_block, :, dst_off].set(
+                    v[:, 0].astype(vc0.dtype))
+                attn = paged_decode_attention(
+                    q[:, 0], kc, vc, block_tables, lengths)
                 return attn[:, None], (kc, vc)
 
-            x, (kc, vc) = self._block_core(carry, layer, attn_fn)
-            return x, (kc, vc)
-
-        x, (kc, vc) = lax.scan(body, x,
-                               (params["blocks"], cache["k"], cache["v"]))
-        return self.head(params, x)[:, 0], {"k": kc, "v": vc}
+            x, (kc, vc) = self._block_core(x, layer, attn_fn)
+            ks_out.append(kc)
+            vs_out.append(vc)
+        return self.head(params, x)[:, 0], {"k": ks_out, "v": vs_out}
 
     # --- loss ---
     def loss(self, params, batch, *, rng=None, train=True, seq_sharded=False):

@@ -46,6 +46,16 @@ class LlamaConfig:
     use_flash_attention: bool = False  # pallas kernel (TPU)
     flash_block_q: int = 512
     flash_block_k: int = 1024
+    # architecture knobs covering the reference v2 model families
+    # (model_implementations/{falcon,phi,qwen}): qkv projection bias
+    # (qwen), rotary applied to only a fraction of each head (phi/neox
+    # partial rotary), SwiGLU vs plain-gelu FFN (falcon/phi use gelu-MLP)
+    qkv_bias: bool = False
+    rotary_pct: float = 1.0
+    mlp_gated: bool = True             # False: wup+gelu+wdown only
+    # falcon/phi parallel residual: x + attn(ln1 x) + mlp(ln2 x) instead
+    # of the sequential two-residual block
+    parallel_block: bool = False
 
     @property
     def d_head(self):
@@ -62,7 +72,9 @@ class LlamaConfig:
         kvd = self.n_kv_heads * self.d_head
         block = (2 * D                      # rms scales
                  + D * D + 2 * D * kvd + D * D   # q, k, v, o
-                 + 3 * D * F)               # gate, up, down
+                 + (3 if self.mlp_gated else 2) * D * F)
+        if self.qkv_bias:
+            block += D + 2 * kvd
         head = 0 if self.tie_embeddings else V * D
         return V * D + self.n_layer * block + D + head
 
@@ -144,11 +156,16 @@ class Llama:
                 "wv": nrm(next(k), (L, D, kvd)),
                 "wo": nrm(next(k), (L, D, D), res_std),
                 "rms2": jnp.ones((L, D), dt),
-                "wgate": nrm(next(k), (L, D, F)),
                 "wup": nrm(next(k), (L, D, F)),
                 "wdown": nrm(next(k), (L, F, D), res_std),
             },
         }
+        if cfg.mlp_gated:
+            params["blocks"]["wgate"] = nrm(next(k), (L, D, F))
+        if cfg.qkv_bias:
+            params["blocks"]["bq"] = jnp.zeros((L, D), dt)
+            params["blocks"]["bk"] = jnp.zeros((L, kvd), dt)
+            params["blocks"]["bv"] = jnp.zeros((L, kvd), dt)
         if not cfg.tie_embeddings:
             params["lm_head"] = nrm(next(k), (V, D))
         return params
@@ -167,11 +184,16 @@ class Llama:
                 "wv": P(None, None, "tensor"),
                 "wo": P(None, "tensor", None),
                 "rms2": P(None, None),
-                "wgate": P(None, None, "tensor"),
                 "wup": P(None, None, "tensor"),
                 "wdown": P(None, "tensor", None),
             },
         }
+        if self.config.mlp_gated:
+            specs["blocks"]["wgate"] = P(None, None, "tensor")
+        if self.config.qkv_bias:
+            specs["blocks"]["bq"] = P(None, "tensor")
+            specs["blocks"]["bk"] = P(None, "tensor")
+            specs["blocks"]["bv"] = P(None, "tensor")
         if not self.config.tie_embeddings:
             specs["lm_head"] = P()
         return specs
@@ -192,14 +214,35 @@ class Llama:
         B, T = x.shape[0], x.shape[1]
         H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
         h = _rms_norm(x, layer["rms1"], cfg.rms_eps)
-        q = (h @ layer["wq"]).reshape(B, T, H, hd)
-        kk = (h @ layer["wk"]).reshape(B, T, KVH, hd)
-        v = (h @ layer["wv"]).reshape(B, T, KVH, hd)
-        return q, kk, v
+        q = h @ layer["wq"]
+        kk = h @ layer["wk"]
+        v = h @ layer["wv"]
+        if cfg.qkv_bias:                      # qwen-style attention bias
+            q = q + layer["bq"]
+            kk = kk + layer["bk"]
+            v = v + layer["bv"]
+        return (q.reshape(B, T, H, hd), kk.reshape(B, T, KVH, hd),
+                v.reshape(B, T, KVH, hd))
+
+    def _rope(self, x, pos):
+        """Rotary with optional partial application (phi/neox
+        rotary_pct < 1: only the leading fraction of each head
+        rotates)."""
+        cfg = self.config
+        pct = cfg.rotary_pct
+        if pct >= 1.0:
+            return _rope(x, pos, cfg.rope_theta)
+        hd = x.shape[-1]
+        rot = max(2, int(hd * pct)) // 2 * 2
+        return jnp.concatenate(
+            [_rope(x[..., :rot], pos, cfg.rope_theta), x[..., rot:]],
+            axis=-1)
 
     def _mlp(self, x, layer):
         cfg = self.config
         h = _rms_norm(x, layer["rms2"], cfg.rms_eps)
+        if not cfg.mlp_gated:                 # falcon/phi plain-gelu MLP
+            return jax.nn.gelu(h @ layer["wup"]) @ layer["wdown"]
         gate = jax.nn.silu(h @ layer["wgate"])
         return (gate * (h @ layer["wup"])) @ layer["wdown"]
 
@@ -209,8 +252,8 @@ class Llama:
         B, T = x.shape[0], x.shape[1]
         H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
         q, kk, v = self._attn_proj(x, layer)
-        q = _rope(q, pos, cfg.rope_theta)
-        kk = _rope(kk, pos, cfg.rope_theta)
+        q = self._rope(q, pos)
+        kk = self._rope(kk, pos)
         head_spec = P(BATCH_AXES, None, "tensor", None)
         q = constrain(q, head_spec)
         kk = constrain(kk, head_spec)
@@ -231,9 +274,14 @@ class Llama:
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs,
                               v).reshape(B, T, H * hd)
-        x = x + constrain(attn, act_spec) @ layer["wo"]
-        x = constrain(x, act_spec)
-        x = x + self._mlp(x, layer)
+        attn_out = constrain(attn, act_spec) @ layer["wo"]
+        if cfg.parallel_block:
+            # falcon/phi: attention and MLP branch from the same input
+            x = x + attn_out + self._mlp(x, layer)
+        else:
+            x = x + attn_out
+            x = constrain(x, act_spec)
+            x = x + self._mlp(x, layer)
         return constrain(x, act_spec)
 
     def apply(self, params, input_ids, *, rng=None, train=False,
@@ -326,8 +374,12 @@ class Llama:
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
-            x = x + attn.reshape(B, T, H * hd) @ layer["wo"]
-            x = x + self._mlp(x, layer)
+            attn_out = attn.reshape(B, T, H * hd) @ layer["wo"]
+            if cfg.parallel_block:
+                x = x + attn_out + self._mlp(x, layer)
+            else:
+                x = x + attn_out
+                x = x + self._mlp(x, layer)
             return x, (kc, vc)
 
         x, (kc, vc) = lax.scan(body, x,
@@ -338,15 +390,23 @@ class Llama:
 
     # ------------------------------------------------- v2 paged decoding
     def init_paged_cache(self, num_blocks, block_size, dtype=None):
+        """LISTS of per-layer heads-major pools (NB, KVH, BS, hd) — the
+        layout the Pallas paged-decode kernel consumes without
+        transposes; separate per-layer buffers so the new-token scatter
+        updates each donated pool IN PLACE (see GPT2.init_paged_cache)."""
         cfg = self.config
         dt = jnp.dtype(dtype) if dtype is not None else jnp.dtype(cfg.dtype)
-        shape = (cfg.n_layer, num_blocks, block_size, cfg.n_kv_heads,
-                 cfg.d_head)
-        return {"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+        shape = (num_blocks, cfg.n_kv_heads, block_size, cfg.d_head)
+        return {"k": [jnp.zeros(shape, dt) for _ in range(cfg.n_layer)],
+                "v": [jnp.zeros(shape, dt) for _ in range(cfg.n_layer)]}
 
     def paged_cache_specs(self):
-        spec = P(None, None, None, "tensor", None)
-        return {"k": spec, "v": spec}
+        spec = P(None, "tensor", None, None)
+        L = self.config.n_layer
+        return {"k": [spec] * L, "v": [spec] * L}
+
+    def _layer_slice(self, params, i):
+        return jax.tree.map(lambda a: a[i], params["blocks"])
 
     def apply_paged_prefill(self, params, input_ids, cache, token_blocks,
                             token_offsets, length):
@@ -359,16 +419,18 @@ class Llama:
         valid = (jnp.arange(T) < length)
         mask = jnp.tril(jnp.ones((T, T), jnp.bool_)) & valid[None, :]
 
-        def body(carry, xs):
-            layer, kc, vc = xs
-            x = carry
+        ks_out, vs_out = [], []
+        for i in range(cfg.n_layer):
+            layer = self._layer_slice(params, i)
+            kc0, vc0 = cache["k"][i], cache["v"][i]
             q, kk, v = self._attn_proj(x, layer)
-            q = _rope(q, pos, cfg.rope_theta)
-            kk = _rope(kk, pos, cfg.rope_theta)
-            kc = kc.at[token_blocks, token_offsets].set(
-                kk[0].astype(kc.dtype))
-            vc = vc.at[token_blocks, token_offsets].set(
-                v[0].astype(vc.dtype))
+            q = self._rope(q, pos)
+            kk = self._rope(kk, pos)
+            # in-place scatter on this layer's own donated pool buffer
+            kc = kc0.at[token_blocks, :, token_offsets].set(
+                kk[0].astype(kc0.dtype))
+            vc = vc0.at[token_blocks, :, token_offsets].set(
+                v[0].astype(vc0.dtype))
             ku = _repeat_kv(kk, H // KVH)
             vu = _repeat_kv(v, H // KVH)
             scores = jnp.einsum("bthd,bshd->bhts", q, ku,
@@ -377,54 +439,54 @@ class Llama:
             scores = jnp.where(mask[None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
-            x = x + attn.reshape(1, T, H * hd) @ layer["wo"]
-            x = x + self._mlp(x, layer)
-            return x, (kc, vc)
-
-        x, (kc, vc) = lax.scan(body, x,
-                               (params["blocks"], cache["k"], cache["v"]))
+            attn_out = attn.reshape(1, T, H * hd) @ layer["wo"]
+            if cfg.parallel_block:
+                x = x + attn_out + self._mlp(x, layer)
+            else:
+                x = x + attn_out
+                x = x + self._mlp(x, layer)
+            ks_out.append(kc)
+            vs_out.append(vc)
         last = jnp.take_along_axis(
             x, jnp.maximum(length - 1, 0)[None, None, None], axis=1)
-        return self.head(params, last)[:, 0], {"k": kc, "v": vc}
+        return self.head(params, last)[:, 0], {"k": ks_out, "v": vs_out}
 
     def apply_paged_decode(self, params, tokens, lengths, cache,
                            block_tables):
         cfg = self.config
         dt = jnp.dtype(cfg.dtype)
         B = tokens.shape[0]
-        H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
-        BS = cache["k"].shape[2]
-        MB = block_tables.shape[1]
-        S = MB * BS
+        H, hd = cfg.n_head, cfg.d_head
+        BS = cache["k"][0].shape[2]
         pos = jnp.minimum(lengths, cfg.max_seq_len - 1)
         x = params["wte"][tokens[:, None]].astype(dt)
         dst_block = jnp.take_along_axis(
             block_tables, (lengths // BS)[:, None], axis=1)[:, 0]
         dst_off = lengths % BS
-        attn_mask = jnp.arange(S)[None, :] <= lengths[:, None]
 
-        def body(carry, xs):
-            layer, kc, vc = xs
-            x = carry
+        ks_out, vs_out = [], []
+        for i in range(cfg.n_layer):
+            layer = self._layer_slice(params, i)
+            kc0, vc0 = cache["k"][i], cache["v"][i]
             q, kk, v = self._attn_proj(x, layer)       # (B, 1, ., hd)
-            q = _rope(q, pos[:, None], cfg.rope_theta)
-            kk = _rope(kk, pos[:, None], cfg.rope_theta)
-            kc = kc.at[dst_block, dst_off].set(kk[:, 0].astype(kc.dtype))
-            vc = vc.at[dst_block, dst_off].set(v[:, 0].astype(vc.dtype))
-            gk = kc[block_tables].reshape(B, S, KVH, hd)
-            gv = vc[block_tables].reshape(B, S, KVH, hd)
-            gk = _repeat_kv(gk, H // KVH)
-            gv = _repeat_kv(gv, H // KVH)
-            scores = jnp.einsum("bhd,bshd->bhs", q[:, 0], gk,
-                                preferred_element_type=jnp.float32)
-            scores = scores / math.sqrt(hd)
-            scores = jnp.where(attn_mask[:, None, :], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-            attn = jnp.einsum("bhs,bshd->bhd", probs, gv)
-            x = x + attn.reshape(B, 1, H * hd) @ layer["wo"]
-            x = x + self._mlp(x, layer)
-            return x, (kc, vc)
-
-        x, (kc, vc) = lax.scan(body, x,
-                               (params["blocks"], cache["k"], cache["v"]))
-        return self.head(params, x)[:, 0], {"k": kc, "v": vc}
+            q = self._rope(q, pos[:, None])
+            kk = self._rope(kk, pos[:, None])
+            kc = kc0.at[dst_block, :, dst_off].set(
+                kk[:, 0].astype(kc0.dtype))
+            vc = vc0.at[dst_block, :, dst_off].set(
+                v[:, 0].astype(vc0.dtype))
+            # Pallas paged kernel: GQA-native (no repeat_kv copies), K/V
+            # read straight through the block table (reference
+            # inference/v2/kernels/ragged_ops blocked_flash)
+            from ..ops.pallas.paged_attention import paged_decode_attention
+            attn = paged_decode_attention(q[:, 0], kc, vc, block_tables,
+                                          lengths)
+            attn_out = attn.reshape(B, 1, H * hd) @ layer["wo"]
+            if cfg.parallel_block:
+                x = x + attn_out + self._mlp(x, layer)
+            else:
+                x = x + attn_out
+                x = x + self._mlp(x, layer)
+            ks_out.append(kc)
+            vs_out.append(vc)
+        return self.head(params, x)[:, 0], {"k": ks_out, "v": vs_out}

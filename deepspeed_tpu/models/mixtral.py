@@ -1,0 +1,118 @@
+"""Mixtral-class model: Llama attention (GQA + rope) with a sparse MoE
+SwiGLU FFN per block.
+
+Counterpart of reference ``inference/v2/model_implementations/mixtral``
+(FastGen's Mixtral support over moe_gather/moe_scatter + cutlass
+moe_gemm). Here the expert FFN is the dropless grouped-GEMM pattern
+(``lax.ragged_dot`` — the moe_gemm role): tokens sort by routed expert,
+each expert multiplies exactly its contiguous group, outputs unsort and
+combine by the top-k router weights. The same ``_mlp`` serves training,
+the contiguous-cache decode, and the v2 paged serving path (all inherited
+from Llama — apply_paged_prefill/apply_paged_decode call ``_mlp``
+per layer).
+
+Training note: the router's load-balance aux loss is not threaded through
+Llama's apply (serving-first model); use GPT2MoE for aux-loss-supervised
+MoE training parity tests.
+"""
+
+import math
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .llama import Llama, LlamaConfig, _rms_norm
+
+
+@dataclass(frozen=True)
+class MixtralConfig(LlamaConfig):
+    num_experts: int = 8
+    moe_top_k: int = 2
+
+    def num_params(self):
+        base = super().num_params()
+        # replace the dense SwiGLU (3 * D * F) with E experts + router
+        L, D, F, E = self.n_layer, self.d_model, self.ffn_dim, \
+            self.num_experts
+        return base - L * 3 * D * F + L * (D * E + E * 3 * D * F)
+
+
+MIXTRAL_TINY = MixtralConfig(n_layer=2, n_head=4, n_kv_heads=2, d_model=128,
+                             max_seq_len=128, vocab_size=512, remat=False,
+                             num_experts=4, moe_top_k=2)
+MIXTRAL_8X7B = MixtralConfig(n_layer=32, n_head=32, n_kv_heads=8,
+                             d_model=4096, d_ff=14336, max_seq_len=8192,
+                             vocab_size=32000, num_experts=8, moe_top_k=2)
+
+
+class Mixtral(Llama):
+    """Params: Llama attention tensors; blocks swap wgate/wup/wdown for
+      moe_gate (L,D,E), moe_w1 (L,E,D,F), moe_w3 (L,E,D,F),
+      moe_w2 (L,E,F,D)   (w1=gate, w3=up, w2=down — Mixtral naming)."""
+
+    def init(self, rng):
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        params = super().init(rng)
+        blocks = params["blocks"]
+        for k in ("wgate", "wup", "wdown"):
+            del blocks[k]
+        L, D, F, E = cfg.n_layer, cfg.d_model, cfg.ffn_dim, cfg.num_experts
+        ks = jax.random.split(jax.random.fold_in(rng, 17), 4)
+        std = 0.02
+        res_std = std / math.sqrt(2 * L)
+
+        def nrm(key, shape, s=std):
+            return (jax.random.normal(key, shape, jnp.float32) * s).astype(dt)
+
+        # router stays fp32 (routing is precision-sensitive)
+        blocks["moe_gate"] = (jax.random.normal(
+            ks[0], (L, D, E), jnp.float32) * std)
+        blocks["moe_w1"] = nrm(ks[1], (L, E, D, F))
+        blocks["moe_w3"] = nrm(ks[2], (L, E, D, F))
+        blocks["moe_w2"] = nrm(ks[3], (L, E, F, D), res_std)
+        return params
+
+    def partition_specs(self, topology=None):
+        specs = super().partition_specs(topology)
+        blocks = specs["blocks"]
+        for k in ("wgate", "wup", "wdown"):
+            del blocks[k]
+        blocks["moe_gate"] = P(None, None, None)
+        # experts over 'expert', FFN dim over 'tensor' (EP x TP)
+        blocks["moe_w1"] = P(None, "expert", None, "tensor")
+        blocks["moe_w3"] = P(None, "expert", None, "tensor")
+        blocks["moe_w2"] = P(None, "expert", "tensor", None)
+        return specs
+
+    def _mlp(self, x, layer):
+        """Dropless top-k SwiGLU MoE over the flattened tokens."""
+        cfg = self.config
+        B, T, D = x.shape
+        E, k = cfg.num_experts, cfg.moe_top_k
+        h = _rms_norm(x, layer["rms2"], cfg.rms_eps)
+        xs = h.reshape(-1, D)
+        S = xs.shape[0]
+
+        logits = xs.astype(jnp.float32) @ layer["moe_gate"]
+        probs = jax.nn.softmax(logits, axis=-1)
+        weights, experts = jax.lax.top_k(probs, k)
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+        flat_exp = experts.reshape(-1).astype(jnp.int32)
+        flat_w = weights.reshape(-1).astype(x.dtype)
+        x_rep = jnp.repeat(xs, k, axis=0)
+        order = jnp.argsort(flat_exp, stable=True)
+        xr = x_rep[order]
+        group_sizes = jnp.bincount(flat_exp, length=E).astype(jnp.int32)
+
+        g = lax.ragged_dot(xr, layer["moe_w1"], group_sizes)
+        u = lax.ragged_dot(xr, layer["moe_w3"], group_sizes)
+        o = lax.ragged_dot(jax.nn.silu(g) * u, layer["moe_w2"],
+                           group_sizes)
+        unsorted = jnp.zeros_like(o).at[order].set(o)
+        y = jnp.sum((unsorted * flat_w[:, None]).reshape(S, k, D), axis=1)
+        return y.astype(x.dtype).reshape(B, T, D)

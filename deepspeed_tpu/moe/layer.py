@@ -10,7 +10,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from .sharded_moe import TopKGate, moe_layer, moe_layer_ragged
+from .sharded_moe import (TopKGate, moe_layer, moe_layer_ragged,
+                          moe_layer_ragged_ep)
 
 
 class MoE:
@@ -20,9 +21,11 @@ class MoE:
                  top2_2nd_expert_sampling=True, activation=jax.nn.gelu,
                  dtype=jnp.bfloat16, backend="dense"):
         """backend: 'dense' = GShard static-capacity dispatch (the
-        SPMD/EP-shaped path); 'ragged' = dropless grouped GEMM via
-        lax.ragged_dot (megablox / reference cutlass moe_gemm) — use
-        under DP/TP where experts are not expert-parallel-sharded."""
+        SPMD/EP-shaped path with token dropping at capacity); 'ragged' =
+        DROPLESS grouped GEMM via lax.ragged_dot (megablox / reference
+        cutlass moe_gemm) — under an expert-parallel mesh this routes
+        through moe_layer_ragged_ep (shard_map + all_to_all + per-shard
+        ragged_dot), single-shard otherwise."""
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
         self.num_experts = num_experts
@@ -81,7 +84,7 @@ class MoE:
 
     def apply(self, params, x, *, rng=None, train=True, seq_sharded=False):
         if self.backend == "ragged":
-            return moe_layer_ragged(
+            return moe_layer_ragged_ep(
                 x, params["gate_w"], params["wi"], params["bi"],
                 params["wo"], params["bo"], k=self.k,
                 activation=self.activation, seq_sharded=seq_sharded)

@@ -274,3 +274,115 @@ def moe_layer(tokens, gate_w, wi, bi, wo, bo, gate: TopKGate, *, rng=None,
         P(BATCH_AXES, "seq" if seq_sharded else None, None)
         if len(orig_shape) == 3 else P(BATCH_AXES, None))
     return y, l_aux, exp_counts
+
+
+def moe_layer_ragged_ep(tokens, gate_w, wi, bi, wo, bo, k=1, *,
+                        activation=jax.nn.gelu, expert_axis="expert",
+                        batch_axes=BATCH_AXES, seq_sharded=False):
+    """EXPERT-PARALLEL dropless MoE: shard_map over the expert axis with an
+    explicit all_to_all exchange and per-shard grouped GEMM
+    (``lax.ragged_dot``) — the reference's CUTLASS ``moe_gemm`` composed
+    with its ``_AllToAll`` dispatch (sharded_moe.py:95,505), megablox
+    style, with NO token dropping and NO capacity padding in the FFN.
+
+    tokens: (..., M) with the leading (token) dim sharded over
+    ``batch_axes``; wi/bi/wo/bo carry a leading E dim sharded over
+    ``expert_axis`` (E % ep == 0); gate_w (M, E) replicated.
+
+    Mechanics per expert-shard (manual over the batch axes): route the
+    S_loc local tokens over all E experts; pack tokens destined for each
+    expert shard into a (ep, S_loc*k) transport buffer (worst-case sized:
+    transport pays for exactness — the FFN does not: after the
+    all_to_all, rows sort by LOCAL expert and ``ragged_dot`` multiplies
+    only the valid rows); all_to_all back and weighted-combine. Invalid
+    rows ride with expert id E_loc so they sort last, outside every
+    ragged group; their (undefined) outputs are masked before combine.
+
+    Returns (y, l_aux, exp_counts(E,)) like ``moe_layer``.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty or mesh.shape.get(expert_axis, 1) == 1:
+        return moe_layer_ragged(tokens, gate_w, wi, bi, wo, bo, k=k,
+                                activation=activation,
+                                seq_sharded=seq_sharded)
+    ep = mesh.shape[expert_axis]
+    E = gate_w.shape[-1]
+    assert E % ep == 0, f"experts {E} not divisible by expert axis {ep}"
+    E_loc = E // ep
+    orig_shape = tokens.shape
+    M = orig_shape[-1]
+    manual_axes = tuple(a for a in (batch_axes if isinstance(
+        batch_axes, tuple) else (batch_axes,)) if a in mesh.shape)
+    if expert_axis not in manual_axes:
+        manual_axes = manual_axes + (expert_axis,)
+
+    def shard_fn(x, gate_w, wi, bi, wo, bo):
+        x = x.reshape(-1, M)
+        S_loc = x.shape[0]
+        cap = S_loc * k                                  # exact transport
+        logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+        weights, experts, l_aux, counts = topk_routing(logits, k)
+        # l_aux/counts are per-shard over local tokens: average/sum over
+        # the manual axes to match the global-batch semantics
+        l_aux = lax.pmean(l_aux, manual_axes)
+        counts = lax.psum(counts, manual_axes)
+
+        flat_exp = experts.reshape(-1)                   # (S_loc*k,)
+        flat_w = weights.reshape(-1).astype(tokens.dtype)
+        dest = flat_exp // E_loc                         # target shard
+        local_e = flat_exp % E_loc                       # expert on shard
+        x_rep = jnp.repeat(x, k, axis=0)
+
+        # pack per-destination: stable sort by dest, then position within
+        # the destination bucket = rank among same-dest rows
+        order = jnp.argsort(dest, stable=True)
+        dest_s = dest[order]
+        pos_in_bucket = jnp.arange(cap) - jnp.searchsorted(
+            dest_s, dest_s, side="left")
+        send_x = jnp.zeros((ep, cap, M), x.dtype)
+        send_e = jnp.full((ep, cap), E_loc, jnp.int32)   # E_loc = invalid
+        send_x = send_x.at[dest_s, pos_in_bucket].set(x_rep[order])
+        send_e = send_e.at[dest_s, pos_in_bucket].set(local_e[order])
+
+        # exchange: shard g receives every shard's bucket for g
+        recv_x = lax.all_to_all(send_x, expert_axis, 0, 0, tiled=False)
+        recv_e = lax.all_to_all(send_e, expert_axis, 0, 0, tiled=False)
+        rx = recv_x.reshape(ep * cap, M)
+        re = recv_e.reshape(ep * cap)
+
+        # group by local expert (invalid rows sort last, outside groups)
+        g_order = jnp.argsort(re, stable=True)
+        xs = rx[g_order]
+        es = re[g_order]
+        group_sizes = jnp.bincount(re, length=E_loc).astype(jnp.int32)
+        h = lax.ragged_dot(xs, wi, group_sizes)
+        safe_e = jnp.minimum(es, E_loc - 1)
+        h = activation(h + bi[safe_e])
+        out = lax.ragged_dot(h, wo, group_sizes)
+        out = out + bo[safe_e]
+        out = jnp.where((es < E_loc)[:, None], out, 0.0)
+
+        # unsort, exchange back, unpack to original (S_loc*k) order
+        back = jnp.zeros_like(out).at[g_order].set(out)
+        back = back.reshape(ep, cap, M)
+        ret = lax.all_to_all(back, expert_axis, 0, 0, tiled=False)
+        ret_flat = ret[dest_s, pos_in_bucket]            # sorted order
+        unsorted = jnp.zeros_like(ret_flat).at[order].set(ret_flat)
+        y = jnp.sum(
+            (unsorted * flat_w[:, None]).reshape(S_loc, k, M), axis=1)
+        return y.astype(tokens.dtype), l_aux, counts
+
+    flat = tokens.reshape(-1, M)
+    token_spec = P(tuple(manual_axes))
+    y, l_aux, counts = jax.shard_map(
+        shard_fn,
+        in_specs=(token_spec, P(), P(expert_axis), P(expert_axis),
+                  P(expert_axis), P(expert_axis)),
+        out_specs=(token_spec, P(), P()),
+        axis_names=set(manual_axes), check_vma=False,
+    )(flat, gate_w, wi, bi, wo, bo)
+    y = y.reshape(orig_shape)
+    y = _constrain(
+        y, P(BATCH_AXES, "seq" if seq_sharded else None, None)
+        if len(orig_shape) == 3 else P(BATCH_AXES, None))
+    return y, l_aux, counts

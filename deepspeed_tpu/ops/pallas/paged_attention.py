@@ -1,0 +1,168 @@
+"""Paged (blocked-KV) decode attention as a Pallas TPU kernel.
+
+Counterpart of the reference's FastGen ragged kernels
+(``deepspeed/inference/v2/kernels/ragged_ops/`` — blocked flash over a
+paged KV cache behind ``RaggedBatchWrapper``): one new token per sequence
+slot attends over that sequence's KV blocks, located through a per-slot
+block table.
+
+The jnp fallback path gathers every slot's blocks into a dense
+(B, S, H, d) copy and runs masked-dense attention — O(B * MB * BS) HBM
+traffic in COPIES per layer, then attention over the fully padded length.
+This kernel instead streams each KV block through VMEM exactly once,
+indexed directly by the block table (scalar-prefetch index_map — the block
+id picked per grid step comes from the table in SMEM), with online softmax
+across blocks; blocks past the sequence's length are clamped to the
+scratch block in the index map and fully masked, so padded table tails
+cost no fresh DMA.
+
+GQA is native: q heads fold to (KVH, G, d) and both dots batch over KVH —
+no repeat_kv materialization.
+
+Layout: q (B, H, d); cache (NB, KVH, BS, d) — heads-major so the kernel's
+(KVH, BS, d) block needs no in-VMEM transpose; block_tables (B, MB) int32
+(inactive/overflow entries point at scratch block 0); lengths (B,) int32 =
+the new token's position (the kernel attends cache slots 0..lengths
+inclusive, matching the dense path's semantics).
+"""
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import interpret_default as _interpret_default
+
+NEG_INF = -1e30
+
+
+def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, *, BS, KVH, G, scale):
+    b = pl.program_id(0)
+    j = pl.program_id(1)
+    H = KVH * G
+    d = q_ref.shape[-1]
+    L = len_ref[b]
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    @pl.when(j * BS <= L)
+    def _step():
+        kb = k_ref[0]                                     # (KVH, BS, d)
+        vb = v_ref[0]
+        # q arrives (1, KVH, G, d) — the caller reshaped (B, H, d) to
+        # (B, KVH, G, d) OUTSIDE the kernel (in-kernel singleton reshapes
+        # are unsupported shape casts in Mosaic, and a dot needs a
+        # non-contracting lhs dim, which G provides even when == 1)
+        q = q_ref[0]
+        s = jax.lax.dot_general(
+            q, kb, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale   # (KVH, G, BS)
+        pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (KVH, G, BS), 2)
+        s = jnp.where(pos <= L, s, NEG_INF)
+
+        m_prev = m_ref[..., 0]                            # (KVH, G)
+        l_prev = l_ref[..., 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[..., None])                 # (KVH, G, BS) f32
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)           # (KVH, G, d)
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+        m_ref[...] = jnp.broadcast_to(m_new[..., None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[..., None], l_ref.shape)
+
+    l = jnp.maximum(l_ref[..., 0], 1e-30)                 # (KVH, G)
+    o_ref[0] = (acc_ref[...] / l[..., None]).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_cache, v_cache, block_tables, lengths, *,
+                           scale=None, interpret=None):
+    """One decode step of attention over a paged KV cache.
+
+    q: (B, H, d); k_cache/v_cache: (NB, KVH, BS, d) with H % KVH == 0;
+    block_tables: (B, MB) int32; lengths: (B,) int32 = the new token's
+    position. Returns (B, H, d) in q's dtype. The new token's K/V must
+    already be written to the cache (the callers do the dynamic-slot
+    write first).
+
+    Multi-layer pools: view (L, NB, ...) as (L*NB, ...) (a free reshape)
+    and offset the tables by ``layer * NB`` — a lax.scan over layers then
+    never slices the pool per layer, which would copy ~the whole cache
+    every layer (scan xs/ys cannot alias).
+    """
+    B, H, d = q.shape
+    NB, KVH, BS, _ = k_cache.shape
+    MB = block_tables.shape[1]
+    G = H // KVH
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret_default()
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(B, MB),
+        in_specs=[
+            pl.BlockSpec((1, KVH, G, d),
+                         lambda b, j, tbl, lens: (b, 0, 0, 0)),
+            pl.BlockSpec(
+                (1, KVH, BS, d),
+                lambda b, j, tbl, lens: (
+                    jnp.where(j * BS <= lens[b], tbl[b, j],
+                              tbl[b, 0]), 0, 0, 0)),
+            pl.BlockSpec(
+                (1, KVH, BS, d),
+                lambda b, j, tbl, lens: (
+                    jnp.where(j * BS <= lens[b], tbl[b, j],
+                              tbl[b, 0]), 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, KVH, G, d),
+                               lambda b, j, tbl, lens: (b, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KVH, G, 128), jnp.float32),  # running max
+            pltpu.VMEM((KVH, G, 128), jnp.float32),  # running denom
+            pltpu.VMEM((KVH, G, d), jnp.float32),    # output accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, BS=BS, KVH=KVH, G=G,
+                          scale=float(scale)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, KVH, G, d), q.dtype),
+        interpret=interpret,
+    )(block_tables, lengths, q.reshape(B, KVH, G, d), k_cache, v_cache)
+    return out.reshape(B, H, d)
+
+
+def paged_decode_attention_reference(q, k_cache, v_cache, block_tables,
+                                     lengths, *, scale=None):
+    """Dense gather fallback (the pre-kernel path), for parity tests."""
+    B, H, d = q.shape
+    NB, KVH, BS, _ = k_cache.shape
+    MB = block_tables.shape[1]
+    S = MB * BS
+    G = H // KVH
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    kc = k_cache.transpose(0, 2, 1, 3)                 # (NB, BS, KVH, d)
+    vc = v_cache.transpose(0, 2, 1, 3)
+    gk = kc[block_tables].reshape(B, S, KVH, d)
+    gv = vc[block_tables].reshape(B, S, KVH, d)
+    gk = jnp.repeat(gk, G, axis=2)
+    gv = jnp.repeat(gv, G, axis=2)
+    s = jnp.einsum("bhd,bshd->bhs", q, gk,
+                   preferred_element_type=jnp.float32) * scale
+    mask = jnp.arange(S)[None, :] <= lengths[:, None]
+    s = jnp.where(mask[:, None, :], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhs,bshd->bhd", p, gv)

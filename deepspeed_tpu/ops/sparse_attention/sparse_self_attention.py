@@ -45,13 +45,20 @@ def sparse_attention(q, k, v, layout, block, causal=False, scale=None):
 
 class SparseSelfAttention:
     """reference ops/sparse_attention/sparse_self_attention.py: module
-    bundling a SparsityConfig with the op; layout built per seq len and
-    cached."""
+    bundling a SparsityConfig with the op; layout (and the Pallas
+    kernel's block lists) built per seq len and cached.
 
-    def __init__(self, sparsity_config, causal=True):
+    ``use_kernel=True`` (default) runs the Pallas block-sparse kernel
+    (ops/pallas/block_sparse_attention.py) — compute scales with layout
+    density, the reference's Triton blocksparse property. False falls
+    back to the masked-dense op (the parity reference)."""
+
+    def __init__(self, sparsity_config, causal=True, use_kernel=True):
         self.config = sparsity_config
         self.causal = causal
+        self.use_kernel = use_kernel
         self._layouts = {}
+        self._lists = {}
 
     def layout(self, seq_len):
         if seq_len not in self._layouts:
@@ -60,8 +67,20 @@ class SparseSelfAttention:
 
     def __call__(self, q, k, v):
         T = q.shape[1]
-        return sparse_attention(q, k, v, self.layout(T),
-                                self.config.block, causal=self.causal)
+        lay = self.layout(T)
+        if not self.use_kernel:
+            return sparse_attention(q, k, v, lay, self.config.block,
+                                    causal=self.causal)
+        from ..pallas.block_sparse_attention import (block_sparse_attention,
+                                                     layout_lists)
+        if T not in self._lists:
+            import numpy as np
+            n = T // self.config.block
+            self._lists[T] = layout_lists(np.asarray(lay), self.causal,
+                                          n, n)
+        return block_sparse_attention(q, k, v, lay, self.config.block,
+                                      causal=self.causal,
+                                      lists=self._lists[T])
 
     def density(self, seq_len):
         lay = self.layout(seq_len)

@@ -163,3 +163,120 @@ def _fmt(x, unit):
         if abs(x) >= scale:
             return f"{x / scale:.2f} {pre}{unit}"
     return f"{x:.0f} {unit}"
+
+
+# ----------------------------------------------------- per-module breakdown
+def _dot_flops(eqn):
+    """2 * batch * M * N * K for a dot_general eqn."""
+    import numpy as np
+    lhs, rhs = (v.aval for v in eqn.invars[:2])
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    batch = int(np.prod([lhs.shape[d] for d in lb], dtype=np.int64)) \
+        if lb else 1
+    k = int(np.prod([lhs.shape[d] for d in lc], dtype=np.int64)) if lc else 1
+    m = int(np.prod([lhs.shape[d] for d in range(lhs.ndim)
+                     if d not in tuple(lc) + tuple(lb)], dtype=np.int64))
+    n = int(np.prod([rhs.shape[d] for d in range(rhs.ndim)
+                     if d not in tuple(rc) + tuple(rb)], dtype=np.int64))
+    return 2.0 * batch * m * n * k
+
+
+_ELEMENTWISE = {
+    "add", "sub", "mul", "div", "max", "min", "exp", "log", "tanh",
+    "logistic", "rsqrt", "sqrt", "erf", "pow", "integer_pow", "neg",
+    "select_n", "convert_element_type", "and", "or", "xor", "sign",
+    "abs", "floor", "ceil", "round",
+}
+
+
+def _eqn_flops(eqn):
+    import numpy as np
+    name = eqn.primitive.name
+    if name == "dot_general":
+        return _dot_flops(eqn)
+    if name == "ragged_dot":
+        # grouped GEMM: rows x (per-group N*K summed = total expert mats)
+        lhs, rhs = (v.aval for v in eqn.invars[:2])
+        return 2.0 * lhs.shape[0] * rhs.shape[-2] * rhs.shape[-1]
+    if name in _ELEMENTWISE or name.startswith("reduce_"):
+        out = eqn.outvars[0].aval
+        return float(np.prod(out.shape, dtype=np.int64)) if out.shape \
+            else 1.0
+    return 0.0
+
+
+def _module_of(eqn, code_root):
+    """Attribute an eqn to the innermost model-code frame 'fn:line'."""
+    src = eqn.source_info
+    try:
+        frames = list(src.traceback.frames)
+    except Exception:  # noqa: BLE001
+        return "<unknown>"
+    for fr in frames:
+        fname = getattr(fr, "file_name", "")
+        if code_root in fname:
+            short = fname.split("/")[-1].rsplit(".", 1)[0]
+            return f"{short}.{fr.function_name}"
+    return "<outside-model>"
+
+
+def per_module_flops(fn, *args, code_root="models"):
+    """Walk the jaxpr of ``fn(*args)`` and attribute flops to the model
+    source function that emitted each op (reference
+    print_model_profile's per-module rows, realized as a jaxpr walk:
+    module hooks don't exist under jit, source provenance does).
+
+    Returns {module_name: flops} including scan bodies scaled by trip
+    count. Elementwise ops count 1 flop/element; dots count 2*M*N*K.
+    """
+    jaxpr = jax.make_jaxpr(fn)(*args)
+    groups = {}
+
+    def add(name, fl):
+        groups[name] = groups.get(name, 0.0) + fl
+
+    def walk(jaxpr, scale):
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            sub = None
+            sub_scale = scale
+            if name == "scan":
+                sub = eqn.params["jaxpr"].jaxpr
+                sub_scale = scale * eqn.params["length"]
+            elif name in ("pjit", "closed_call", "core_call",
+                          "remat_call", "checkpoint", "custom_jvp_call",
+                          "custom_vjp_call", "custom_vjp_call_jaxpr"):
+                p = eqn.params
+                j = (p.get("jaxpr") or p.get("call_jaxpr")
+                     or p.get("fun_jaxpr"))
+                if j is not None:
+                    sub = getattr(j, "jaxpr", j)
+            elif name == "while":
+                sub = eqn.params["body_jaxpr"].jaxpr
+                # trip count unknown statically; count one iteration
+            elif name == "cond":
+                for br in eqn.params["branches"]:
+                    walk(br.jaxpr, scale)
+                continue
+            if sub is not None:
+                walk(sub, sub_scale)
+                continue
+            fl = _eqn_flops(eqn)
+            if fl:
+                add(_module_of(eqn, code_root), fl * scale)
+    walk(jaxpr.jaxpr, 1.0)
+    return groups
+
+
+def print_module_profile(fn, *args, code_root="models", file=None):
+    """Reference ``print_model_profile`` analogue: per-module flops table
+    sorted by share."""
+    groups = per_module_flops(fn, *args, code_root=code_root)
+    total = sum(groups.values()) or 1.0
+    lines = [f"{'module':44s} {'GFLOPs':>12s} {'share':>7s}"]
+    for name, fl in sorted(groups.items(), key=lambda kv: -kv[1]):
+        lines.append(f"{name:44s} {fl / 1e9:12.3f} {fl / total:6.1%}")
+    lines.append(f"{'TOTAL':44s} {total / 1e9:12.3f} {1:6.1%}")
+    out = "\n".join(lines)
+    print(out, file=file)
+    return groups

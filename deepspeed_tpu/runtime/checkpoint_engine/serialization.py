@@ -10,6 +10,7 @@ capability the reference needs offline conversion for
 
 import io
 import json
+import os
 
 import numpy as np
 import jax
@@ -72,3 +73,94 @@ def load_file(path):
         flat = {k.replace("%2F", "/"): z[k] for k in z.files
                 if k != "__meta__"}
     return flat, header
+
+
+# --------------------------------------------------------- sharded layout
+# Per-host shard files (reference engine.py:3545 _save_zero_checkpoint
+# writes per-DP-rank partition files for exactly this reason): each process
+# writes ONLY its addressable shards — no process_allgather of the full
+# model state over DCN, no single writer. A chunk file 'shard-{p}.npz'
+# holds this process's chunks keyed '{leafkey}#{i}' plus an index entry
+# per leaf ({global shape, dtype, chunk offsets}); any process count /
+# topology reassembles the global logical tensors on load.
+
+def extract_local_chunks(tree):
+    """-> (chunks dict, index dict, meta dict) for THIS process.
+
+    Device-array leaves contribute their addressable shards with
+    replica_id == 0 (each global shard is written exactly once across the
+    job); host/numpy leaves are single chunks owned by process 0."""
+    import jax as _jax
+    flat, meta = flatten_state(tree)
+    chunks, index = {}, {}
+    pid = _jax.process_index()
+    for key, leaf in flat.items():
+        if isinstance(leaf, _jax.Array):
+            entry = {"shape": list(leaf.shape), "dtype": str(leaf.dtype),
+                     "chunks": []}
+            for i, sh in enumerate(leaf.addressable_shards):
+                if sh.replica_id != 0:
+                    continue
+                data = np.asarray(sh.data)
+                start = [0 if s.start is None else int(s.start)
+                         for s in sh.index]
+                ck = f"{key}#{i}"
+                chunks[ck] = data
+                entry["chunks"].append({"key": ck, "start": start})
+            index[key] = entry
+        else:
+            arr = np.asarray(leaf)
+            entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                     "chunks": []}
+            if pid == 0:
+                ck = f"{key}#0"
+                chunks[ck] = arr
+                entry["chunks"].append(
+                    {"key": ck, "start": [0] * arr.ndim})
+            index[key] = entry
+    return chunks, index, meta
+
+
+def load_sharded(dirpath):
+    """Read every shard-*.npz in ``dirpath`` and reassemble the global
+    logical arrays. -> (flat dict path->array, normalized header)."""
+    import glob
+    files = sorted(glob.glob(os.path.join(dirpath, "shard-*.npz")))
+    if not files:
+        raise FileNotFoundError(f"no shard-*.npz under {dirpath}")
+    merged = {}
+    all_chunks = {}
+    header0 = None
+    for f in files:
+        flat, header = load_file(f)
+        for k, e in (header["extra"].get("index") or {}).items():
+            cur = merged.setdefault(
+                k, {"shape": e["shape"], "dtype": e["dtype"], "chunks": []})
+            cur["chunks"].extend(e["chunks"])
+        all_chunks.update(flat)
+        if os.path.basename(f) == "shard-0.npz":
+            header0 = header
+    header0 = header0 or header
+    out = {}
+    for k, e in merged.items():
+        arr = np.empty(e["shape"], np.dtype(e["dtype"]))
+        for c in e["chunks"]:
+            data = all_chunks[c["key"]]
+            sl = tuple(slice(s, s + n) for s, n in zip(c["start"],
+                                                       data.shape))
+            arr[sl] = data
+        out[k] = arr
+    extra = dict(header0["extra"])
+    meta = extra.pop("__tree_meta__", {})
+    extra.pop("index", None)
+    return out, {"meta": meta, "extra": extra.get("user_extra", extra)}
+
+
+def load_state(tag_dir):
+    """Load a checkpoint tag directory in either layout: legacy monolithic
+    ``state.npz`` (global arrays, one writer) or the sharded per-host
+    layout. -> (flat dict, header with 'meta'/'extra')."""
+    legacy = os.path.join(tag_dir, "state.npz")
+    if os.path.exists(legacy):
+        return load_file(legacy)
+    return load_sharded(tag_dir)

@@ -1,0 +1,130 @@
+"""Offline data analyzer — corpus difficulty metrics for curriculum
+learning.
+
+Counterpart of reference ``runtime/data_pipeline/data_sampling/
+data_analyzer.py:444 DataAnalyzer``: walk a dataset once (optionally in
+parallel workers), score every sample under one or more difficulty
+metrics, and write per-metric index files (sample->score map + the
+sample ids sorted by score, bucketed by distinct score) that curriculum
+sampling consumes at train time — the CurriculumScheduler's difficulty d
+maps to "samples with metric <= d" through these indexes.
+
+Built-in metrics (the reference ships seqlen + vocabularyrarity):
+  * ``seqlen``            — non-pad token count.
+  * ``vocab_rarity``      — mean negative log unigram probability of the
+    sample's tokens under the corpus unigram distribution (two passes:
+    count, then score).
+  * any callable ``fn(sample) -> number``.
+
+Outputs under ``output_dir``:
+  {metric}_sample_to_metric.npy   (float32, one score per sample)
+  {metric}_index_to_sample.npy    (int64 sample ids sorted by score)
+  {metric}_metric_values.npy      (sorted scores, aligned with the above)
+  summary.json                    (per-metric min/max/mean + file map)
+"""
+
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+
+import numpy as np
+
+
+def seqlen_metric(pad_token_id=0):
+    def fn(sample):
+        arr = np.asarray(sample)
+        return int((arr != pad_token_id).sum())
+    fn.requires_counts = False
+    return fn
+
+
+class DataAnalyzer:
+    """``DataAnalyzer(dataset).run(output_dir)``.
+
+    dataset: indexable of token arrays (e.g. MMapIndexedDataset or a list
+    of np arrays). metrics: {name: callable} — defaults to seqlen +
+    vocab_rarity. num_workers: thread fan-out for the scoring pass (the
+    reference shards across processes; scoring is numpy-light so threads
+    suffice here)."""
+
+    def __init__(self, dataset, metrics=None, pad_token_id=0,
+                 num_workers=4):
+        self.dataset = dataset
+        self.pad_token_id = pad_token_id
+        self.num_workers = max(1, num_workers)
+        self.metrics = metrics or {
+            "seqlen": seqlen_metric(pad_token_id),
+            "vocab_rarity": "vocab_rarity",     # built-in two-pass
+        }
+
+    # ------------------------------------------------------------ passes
+    def _unigram_counts(self):
+        counts = {}
+        for i in range(len(self.dataset)):
+            arr = np.asarray(self.dataset[i]).reshape(-1)
+            arr = arr[arr != self.pad_token_id]
+            ids, c = np.unique(arr, return_counts=True)
+            for t, n in zip(ids.tolist(), c.tolist()):
+                counts[t] = counts.get(t, 0) + n
+        total = max(1, sum(counts.values()))
+        return {t: n / total for t, n in counts.items()}
+
+    def _score(self, metric, probs):
+        n = len(self.dataset)
+
+        def one(i):
+            arr = np.asarray(self.dataset[i]).reshape(-1)
+            if metric == "vocab_rarity":
+                toks = arr[arr != self.pad_token_id]
+                if len(toks) == 0:
+                    return 0.0
+                return float(np.mean(
+                    [-np.log(probs.get(int(t), 1e-12)) for t in toks]))
+            return float(metric(arr))
+
+        if self.num_workers == 1:
+            return np.asarray([one(i) for i in range(n)], np.float32)
+        with ThreadPoolExecutor(self.num_workers) as pool:
+            return np.asarray(list(pool.map(one, range(n))), np.float32)
+
+    # --------------------------------------------------------------- run
+    def run(self, output_dir):
+        os.makedirs(output_dir, exist_ok=True)
+        needs_probs = any(m == "vocab_rarity"
+                          for m in self.metrics.values())
+        probs = self._unigram_counts() if needs_probs else None
+        summary = {"num_samples": len(self.dataset), "metrics": {}}
+        for name, metric in self.metrics.items():
+            scores = self._score(metric, probs)
+            order = np.argsort(scores, kind="stable").astype(np.int64)
+            base = os.path.join(output_dir, name)
+            np.save(base + "_sample_to_metric.npy", scores)
+            np.save(base + "_index_to_sample.npy", order)
+            np.save(base + "_metric_values.npy", scores[order])
+            summary["metrics"][name] = {
+                "min": float(scores.min()), "max": float(scores.max()),
+                "mean": float(scores.mean()),
+                "files": {k: f"{name}_{k}.npy" for k in
+                          ("sample_to_metric", "index_to_sample",
+                           "metric_values")},
+            }
+        with open(os.path.join(output_dir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+        return summary
+
+
+class CurriculumIndex:
+    """Train-time consumer: admissible sample ids for a difficulty value
+    (reference data_sampler's curriculum path reads the analyzer's index
+    the same way)."""
+
+    def __init__(self, output_dir, metric):
+        base = os.path.join(output_dir, metric)
+        self.sorted_ids = np.load(base + "_index_to_sample.npy")
+        self.sorted_values = np.load(base + "_metric_values.npy")
+
+    def samples_up_to(self, difficulty):
+        """ids of every sample with metric <= difficulty (sorted easier
+        first)."""
+        hi = int(np.searchsorted(self.sorted_values, difficulty, "right"))
+        return self.sorted_ids[:hi]

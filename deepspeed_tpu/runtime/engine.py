@@ -721,36 +721,42 @@ class DeepSpeedEngine:
     def save_checkpoint(self, save_dir, tag=None, client_state=None,
                         save_latest=True):
         """reference engine.py:3124. Layout:
-        {save_dir}/{tag}/state.npz + {save_dir}/latest (shared FS, like the
-        reference assumes).
+        {save_dir}/{tag}/shard-{process}.npz + {save_dir}/latest (shared
+        FS, like the reference assumes).
 
-        Arrays are saved as GLOBAL logical tensors (shards gathered), so any
-        ZeRO stage / mesh can load any checkpoint — the property the
-        reference needs checkpoint/ds_to_universal.py for. The 'latest'
-        pointer is written by the checkpoint engine only after the bytes are
-        durable, so a crash mid-write can't leave it naming a torn file.
+        Each process writes ONLY its addressable shards (the reference's
+        per-rank _save_zero_checkpoint, engine.py:3545) — no
+        process_allgather of the full model state over DCN, no single
+        writer. The shard files carry a chunk index so ANY ZeRO stage /
+        mesh / process count reassembles the global logical tensors on
+        load — the property the reference needs checkpoint/
+        ds_to_universal.py for. The 'latest' pointer is written by rank
+        0's checkpoint engine only after its bytes are durable, so a crash
+        mid-write can't leave it naming a torn file.
         """
         import os
+        from .checkpoint_engine import serialization as ser
         tag = tag or f"global_step{self.global_step}"
         self.checkpoint_engine.create(tag)
-        # D2H staging (the VELOC _d2h_trf analogue; synchronous,
-        # bandwidth-bound), then the engine writes async if configured.
-        if jax.process_count() > 1:
-            from jax.experimental import multihost_utils
-            host_tree = multihost_utils.process_allgather(self._ckpt_tree())
-        else:
-            host_tree = jax.device_get(self._ckpt_tree())
-        if jax.process_index() != 0:
-            return tag
+        # D2H staging of LOCAL shards only (the VELOC _d2h_trf analogue;
+        # synchronous, bandwidth-bound), then the engine writes async if
+        # configured.
+        chunks, index, meta = ser.extract_local_chunks(self._ckpt_tree())
         extra = {
-            "global_step": self.global_step,
-            "micro_steps": self.micro_steps,
-            "zero_stage": self.zero_stage,
-            "lr_scheduler": (self.lr_scheduler.state_dict()
-                             if self.lr_scheduler is not None else None),
-            "client_state": client_state or {},
+            "index": index,
+            "__tree_meta__": meta,
+            "user_extra": {
+                "global_step": self.global_step,
+                "micro_steps": self.micro_steps,
+                "zero_stage": self.zero_stage,
+                "nprocs": jax.process_count(),
+                "lr_scheduler": (self.lr_scheduler.state_dict()
+                                 if self.lr_scheduler is not None else None),
+                "client_state": client_state or {},
+            },
         }
-        path = os.path.join(save_dir, tag, "state.npz")
+        path = os.path.join(save_dir, tag,
+                            f"shard-{jax.process_index()}.npz")
 
         def mark_latest():
             os.makedirs(save_dir, exist_ok=True)
@@ -760,8 +766,9 @@ class DeepSpeedEngine:
             os.replace(tmp, os.path.join(save_dir, "latest"))
 
         self.checkpoint_engine.save(
-            (host_tree, extra), path,
-            on_durable=mark_latest if save_latest else None)
+            (chunks, extra), path,
+            on_durable=(mark_latest if save_latest
+                        and jax.process_index() == 0 else None))
         self.checkpoint_engine.commit(tag)
         return tag
 
@@ -777,10 +784,15 @@ class DeepSpeedEngine:
                 return None, {}
             with open(latest) as f:
                 tag = f.read().strip()
-        path = os.path.join(load_dir, tag, "state.npz")
-        if not os.path.exists(path):
+        path = os.path.join(load_dir, tag)
+        legacy = os.path.join(path, "state.npz")
+        if os.path.exists(legacy):
+            flat, header = self.checkpoint_engine.load(legacy)
+        elif os.path.isdir(path):
+            self.checkpoint_engine.wait()
+            flat, header = ser.load_sharded(path)
+        else:
             return None, {}
-        flat, header = self.checkpoint_engine.load(path)
         # structural template only — no device transfer
         template = jax.eval_shape(self._ckpt_tree)
         tree = ser.unflatten_into(template, flat, header.get("meta"))

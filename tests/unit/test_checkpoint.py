@@ -137,3 +137,57 @@ def test_native_engine_roundtrip(tmp_path):
     ref = float(e1.eval_loss(_batch(seed=3)))
     got = float(e2.eval_loss(_batch(seed=3)))
     np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+
+def test_sharded_layout_partial_chunks(tmp_path):
+    """The new per-host layout (reference engine.py:3545 per-rank ZeRO
+    partition files): shard files hold only addressable chunks with a
+    reassembly index — a ZeRO-partitioned leaf must appear as MULTIPLE
+    partial chunks, not one gathered tensor."""
+    import os
+    e = _engine(stage=2)
+    e.train_batch(_batch())
+    tag = e.save_checkpoint(str(tmp_path))
+    files = os.listdir(str(tmp_path / tag))
+    assert files == ["shard-0.npz"], files   # one process -> one shard file
+    flat, header = ser.load_file(str(tmp_path / tag / "shard-0.npz"))
+    index = header["extra"]["index"]
+    # master leaves are dp-sharded under ZeRO-2: chunked, offsets > 0 exist
+    key = "master/blocks/wqkv"
+    assert len(index[key]["chunks"]) == 8    # 8-device virtual mesh
+    starts = sorted(tuple(c["start"]) for c in index[key]["chunks"])
+    assert starts[0] != starts[-1]
+    # chunk data are partial slices of the global shape
+    ck = index[key]["chunks"][0]["key"]
+    assert list(flat[ck].shape) != index[key]["shape"]
+    # reassembly reproduces the global logical tensor bit-for-bit
+    global_flat, h2 = ser.load_sharded(str(tmp_path / tag))
+    assert list(global_flat[key].shape) == index[key]["shape"]
+    got = np.sort(np.asarray(
+        jax.device_get(e.state["master"]["blocks"]["wqkv"])).ravel())
+    np.testing.assert_array_equal(
+        np.sort(global_flat[key].ravel()), got)
+
+
+def test_legacy_monolithic_layout_still_loads(tmp_path):
+    """Checkpoints written by the old single-writer layout load through
+    the same path."""
+    import os
+    e = _engine(stage=0)
+    for _ in range(2):
+        e.train_batch(_batch())
+    # write a legacy-format checkpoint by hand
+    tree = jax.device_get(e._ckpt_tree())
+    tagdir = tmp_path / "legacy_tag"
+    os.makedirs(tagdir)
+    ser.save_file(str(tagdir / "state.npz"), tree, extra_meta={
+        "global_step": 2, "micro_steps": 2, "zero_stage": 0,
+        "lr_scheduler": None, "client_state": {"old": True}})
+    with open(tmp_path / "latest", "w") as f:
+        f.write("legacy_tag")
+    e2 = _engine(stage=2)
+    path, client = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and client["old"] is True
+    np.testing.assert_allclose(
+        float(e2.eval_loss(_batch(seed=5))),
+        float(e.eval_loss(_batch(seed=5))), rtol=1e-6)
