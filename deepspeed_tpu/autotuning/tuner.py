@@ -121,6 +121,7 @@ class ModelBasedTuner(BaseTuner):
         self.eps = explore_eps
         self.rng = random.Random(seed)
         self.observed = []                # (exp, metric)
+        self._pending = []                # yielded, not yet recorded
         self.model = CostModel()
 
     def __len__(self):
@@ -128,12 +129,19 @@ class ModelBasedTuner(BaseTuner):
 
     def record(self, exp, metric):
         self.observed.append((exp, float(metric)))
+        if exp in self._pending:
+            self._pending.remove(exp)
 
     def _untried(self):
-        seen = [e for e, _ in self.observed]
+        # exclude BOTH recorded and yielded-but-unrecorded experiments:
+        # otherwise skipping record() hands the same config back forever
+        seen = [e for e, _ in self.observed] + self._pending
         return [e for e in self.experiments if e not in seen]
 
     def __iter__(self):
+        # a fresh iteration may retry configs abandoned (yielded, never
+        # recorded) by a crashed/stopped earlier loop
+        self._pending = []
         count = 0
         order = list(self.experiments)
         self.rng.shuffle(order)
@@ -145,16 +153,17 @@ class ModelBasedTuner(BaseTuner):
                     self.rng.random() < self.eps:
                 exp = next(e for e in order if e in untried)
             else:
+                if not self.observed:
+                    raise RuntimeError(
+                        "ModelBasedTuner with warmup_trials=0 requires "
+                        "record(exp, metric) before model-guided picks")
                 self.model.fit(*zip(*self.observed))
                 preds = self.model.predict(untried)
                 exp = untried[int(max(range(len(untried)),
                                       key=lambda i: preds[i]))]
             count += 1
+            self._pending.append(exp)
             yield exp
-        if len(self.observed) < count:
-            raise RuntimeError(
-                "ModelBasedTuner requires record(exp, metric) after each "
-                "yielded experiment")
 
     def best(self):
         return max(self.observed, key=lambda em: em[1])

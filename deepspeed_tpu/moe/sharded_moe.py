@@ -321,11 +321,23 @@ def moe_layer_ragged_ep(tokens, gate_w, wi, bi, wo, bo, k=1, *,
         S_loc = x.shape[0]
         cap = S_loc * k                                  # exact transport
         logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
-        weights, experts, l_aux, counts = topk_routing(logits, k)
-        # l_aux/counts are per-shard over local tokens: average/sum over
-        # the manual axes to match the global-batch semantics
-        l_aux = lax.pmean(l_aux, manual_axes)
+        weights, experts, _, counts = topk_routing(logits, k)
         counts = lax.psum(counts, manual_axes)
+        # The GShard aux loss is nonlinear in the per-expert statistics,
+        # so psum the raw sums (prob mass + first-choice counts) across
+        # shards FIRST and form the loss once from global-batch values —
+        # a pmean of per-shard losses biases the balance gradient
+        # whenever routing differs across shards.
+        probs = jax.nn.softmax(logits, axis=-1)
+        probsum = lax.psum(jnp.sum(probs, axis=0), manual_axes)
+        first = lax.psum(
+            jnp.sum(jax.nn.one_hot(experts[:, 0], E), axis=0),
+            manual_axes)
+        n_shards = 1
+        for a in manual_axes:
+            n_shards *= mesh.shape[a]
+        S_glob = S_loc * n_shards
+        l_aux = E * jnp.sum((probsum / S_glob) * (first / S_glob))
 
         flat_exp = experts.reshape(-1)                   # (S_loc*k,)
         flat_w = weights.reshape(-1).astype(tokens.dtype)

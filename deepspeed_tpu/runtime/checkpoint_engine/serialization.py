@@ -104,7 +104,10 @@ def extract_local_chunks(tree):
                 data = np.asarray(sh.data)
                 start = [0 if s.start is None else int(s.start)
                          for s in sh.index]
-                ck = f"{key}#{i}"
+                # process-unique chunk key: enumerate() restarts at 0 on
+                # every process, so '{key}#{i}' alone would collide
+                # across shard files in multi-process checkpoints
+                ck = f"{key}#{pid}.{i}"
                 chunks[ck] = data
                 entry["chunks"].append({"key": ck, "start": start})
             index[key] = entry
@@ -113,7 +116,7 @@ def extract_local_chunks(tree):
             entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
                      "chunks": []}
             if pid == 0:
-                ck = f"{key}#0"
+                ck = f"{key}#0.0"
                 chunks[ck] = arr
                 entry["chunks"].append(
                     {"key": ck, "start": [0] * arr.ndim})
@@ -141,14 +144,36 @@ def load_sharded(dirpath):
         if os.path.basename(f) == "shard-0.npz":
             header0 = header
     header0 = header0 or header
+    # Coverage validation: the reassembly buffer is np.empty, so any gap
+    # (missing shard file, partial copy, mismatched process count) would
+    # silently resume training from uninitialized memory. Check the shard
+    # file count against the writer's recorded world size, then require
+    # every leaf's chunks to cover it exactly.
+    nprocs = (header0["extra"].get("user_extra") or {}).get("nprocs")
+    if nprocs is not None and len(files) != nprocs:
+        raise ValueError(
+            f"incomplete checkpoint {dirpath}: found {len(files)} shard "
+            f"files but the writer recorded nprocs={nprocs}")
     out = {}
     for k, e in merged.items():
+        total = int(np.prod(e["shape"], dtype=np.int64))
+        filled = 0
         arr = np.empty(e["shape"], np.dtype(e["dtype"]))
         for c in e["chunks"]:
+            if c["key"] not in all_chunks:
+                raise ValueError(
+                    f"checkpoint {dirpath}: leaf {k} chunk {c['key']} "
+                    f"indexed but absent from every shard file")
             data = all_chunks[c["key"]]
             sl = tuple(slice(s, s + n) for s, n in zip(c["start"],
                                                        data.shape))
             arr[sl] = data
+            filled += data.size
+        if filled != total:
+            raise ValueError(
+                f"checkpoint {dirpath}: leaf {k} covered by "
+                f"{filled}/{total} elements — shard files missing or "
+                f"written by a torn save")
         out[k] = arr
     extra = dict(header0["extra"])
     meta = extra.pop("__tree_meta__", {})

@@ -730,9 +730,13 @@ class DeepSpeedEngine:
         writer. The shard files carry a chunk index so ANY ZeRO stage /
         mesh / process count reassembles the global logical tensors on
         load — the property the reference needs checkpoint/
-        ds_to_universal.py for. The 'latest' pointer is written by rank
-        0's checkpoint engine only after its bytes are durable, so a crash
-        mid-write can't leave it naming a torn file.
+        ds_to_universal.py for. Durable-latest: single-process, the
+        'latest' pointer is written by the checkpoint engine only after
+        the shard's bytes are durable (async overlap preserved);
+        multi-process, every process drains its own writes and a
+        cross-process barrier runs before rank 0 publishes 'latest', so
+        it can never name a checkpoint whose other-rank shards are still
+        in flight.
         """
         import os
         from .checkpoint_engine import serialization as ser
@@ -765,10 +769,25 @@ class DeepSpeedEngine:
                 f.write(tag)
             os.replace(tmp, os.path.join(save_dir, "latest"))
 
-        self.checkpoint_engine.save(
-            (chunks, extra), path,
-            on_durable=(mark_latest if save_latest
-                        and jax.process_index() == 0 else None))
+        rank0 = jax.process_index() == 0
+        if save_latest and jax.process_count() > 1:
+            # 'latest' must only ever name a checkpoint whose EVERY shard
+            # is durable. on_durable fires when THIS process's shard is
+            # down; other ranks may still be writing (especially async) —
+            # so drain local writes, barrier, then let rank 0 publish.
+            self.checkpoint_engine.save((chunks, extra), path)
+            self.checkpoint_engine.wait()
+            from jax.experimental import multihost_utils
+            multihost_utils.sync_global_devices(f"ckpt-durable-{tag}")
+            # a no-op engine (checkpoint=none) writes nothing: publishing
+            # 'latest' would dangle at an empty tag directory
+            if rank0 and os.path.exists(path):
+                mark_latest()
+        else:
+            self.checkpoint_engine.save(
+                (chunks, extra), path,
+                on_durable=(mark_latest if save_latest and rank0
+                            else None))
         self.checkpoint_engine.commit(tag)
         return tag
 

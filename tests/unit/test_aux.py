@@ -350,6 +350,23 @@ class TestModelBasedTuner:
         next(it)  # not recording is fine for warmup picks
         next(it)
 
+    def test_no_duplicate_yields_without_record(self):
+        """Skipping record() must not hand the same config back: yielded-
+        but-unrecorded experiments are excluded from the untried pool."""
+        from deepspeed_tpu.autotuning.tuner import ModelBasedTuner
+        space = {"a": [1, 2, 3], "b": [10, 20]}
+        tuner = ModelBasedTuner(space, max_trials=6, warmup_trials=100)
+        seen = [tuple(sorted(e.items())) for e in tuner]
+        assert len(seen) == len(set(seen)), seen
+
+    def test_model_picks_need_observations(self):
+        from deepspeed_tpu.autotuning.tuner import ModelBasedTuner
+        import pytest
+        tuner = ModelBasedTuner({"a": [1, 2, 3]}, warmup_trials=0,
+                                explore_eps=0.0)
+        with pytest.raises(RuntimeError):
+            next(iter(tuner))
+
 
 class TestPerModuleFlops:
     """reference print_model_profile per-module tree (jaxpr-walk

@@ -169,6 +169,43 @@ def test_sharded_layout_partial_chunks(tmp_path):
         np.sort(global_flat[key].ravel()), got)
 
 
+def test_load_sharded_rejects_missing_shards(tmp_path):
+    """Gaps in the np.empty reassembly buffer must raise, not resume
+    training from uninitialized memory: a checkpoint whose shard files
+    don't cover every leaf (torn save, partial copy, wrong nprocs) is
+    rejected at load."""
+    import os
+    import pytest
+    e = _engine(stage=2)
+    e.train_batch(_batch())
+    tag = e.save_checkpoint(str(tmp_path))
+    shard = tmp_path / tag / "shard-0.npz"
+    # simulate a second writer whose shard never landed: bump the
+    # recorded world size without adding its file
+    flat, header = ser.load_file(str(shard))
+    header["extra"]["user_extra"]["nprocs"] = 2
+    np_arrays = {k.replace("/", "%2F"): v for k, v in flat.items()}
+    import json as _json
+    np_arrays["__meta__"] = np.frombuffer(
+        _json.dumps(header).encode(), dtype=np.uint8)
+    with open(str(shard), "wb") as f:
+        np.savez(f, **np_arrays)
+    with pytest.raises(ValueError, match="nprocs"):
+        ser.load_sharded(str(tmp_path / tag))
+    # and a chunk-coverage gap (shard file deleted outright, single-proc
+    # header) must also raise rather than return np.empty garbage
+    header["extra"]["user_extra"]["nprocs"] = 1
+    some_chunk = next(k for k in list(np_arrays)
+                      if k != "__meta__" and "#" in k)
+    del np_arrays[some_chunk]
+    np_arrays["__meta__"] = np.frombuffer(
+        _json.dumps(header).encode(), dtype=np.uint8)
+    with open(str(shard), "wb") as f:
+        np.savez(f, **np_arrays)
+    with pytest.raises(ValueError, match="chunk|covered"):
+        ser.load_sharded(str(tmp_path / tag))
+
+
 def test_legacy_monolithic_layout_still_loads(tmp_path):
     """Checkpoints written by the old single-writer layout load through
     the same path."""

@@ -303,18 +303,23 @@ class TestRaggedEP:
         gate_w, wi, bi, wo, bo = self._params()
         rng = np.random.RandomState(1)
         x = jnp.asarray(rng.randn(64, 32) * 0.3, jnp.float32)
-        y_ref, _, cnt_ref = moe_layer_ragged(x, gate_w, wi, bi, wo, bo, k=k)
+        y_ref, aux_ref, cnt_ref = moe_layer_ragged(
+            x, gate_w, wi, bi, wo, bo, k=k)
         groups.reset()
         topo = groups.initialize(TopologyConfig(data_parallel_size=2,
                                                 expert_parallel_size=4))
         with jax.set_mesh(topo.mesh):
-            y, _, cnt = jax.jit(
+            y, aux, cnt = jax.jit(
                 lambda *a: __import__("deepspeed_tpu").moe.sharded_moe
                 .moe_layer_ragged_ep(*a, k=k))(x, gate_w, wi, bi, wo, bo)
         np.testing.assert_allclose(np.asarray(y), np.asarray(y_ref),
                                    rtol=2e-4, atol=2e-4)
         np.testing.assert_array_equal(np.asarray(cnt),
                                       np.asarray(cnt_ref))
+        # aux is formed from psum'd GLOBAL statistics, so it must equal
+        # the single-shard loss (not a mean of per-shard losses)
+        np.testing.assert_allclose(np.asarray(aux), np.asarray(aux_ref),
+                                   rtol=1e-5, atol=1e-6)
 
     def test_dropless_vs_dense_dispatch_no_drops(self):
         """With ample capacity the dense dispatch drops nothing; dropless
