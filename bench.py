@@ -43,15 +43,21 @@ def main():
     flash = os.environ.get("BENCH_FLASH", "1") == "1"
     cfg = replace(cfg, max_seq_len=seq_len,
                   use_flash_attention=flash,
-                  flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", "512")),
-                  flash_block_k=int(os.environ.get("BENCH_FLASH_BK", "512")),
-                  flash_block_h=int(os.environ.get("BENCH_FLASH_BH", "2")),
+                  flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", "1024")),
+                  flash_block_k=int(os.environ.get("BENCH_FLASH_BK", "1024")),
+                  flash_block_h=int(os.environ.get("BENCH_FLASH_BH", "1")),
                   remat=os.environ.get("BENCH_REMAT", "1") == "1",
-                  # save_mid measured best (benchmarks/PERF_NOTES.md)
+                  # save_flash measured best (benchmarks/PERF_NOTES.md):
+                  # saved flash o/lse residuals, no fwd re-run in backward
                   remat_policy=os.environ.get("BENCH_REMAT_POLICY",
-                                              "save_mid"),
+                                              "save_flash"),
                   scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")),
-                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "0")))
+                  # fused LN kernel measured slower in-step (see
+                  # GPT2Config.fused_layernorm): off unless forced
+                  fused_layernorm={"0": False, "1": True,
+                                   "auto": "auto"}.get(
+                      os.environ.get("BENCH_FUSED_LN", "0"), False),
+                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "256")))
     model = GPT2(cfg)
 
     n_dev = len(jax.devices())

@@ -66,6 +66,14 @@ class GPT2Config:
     # lax.scan unroll over layers (1 = compact single-block program;
     # higher trades compile time/code size for cross-layer overlap)
     scan_unroll: int = 1
+    # fused one-pass LayerNorm Pallas kernel (ops/pallas/layernorm.py;
+    # reference csrc/transformer/normalize_kernels.cu). Measured SLOWER
+    # than XLA's fused jnp layernorm inside the 350M training step (the
+    # custom-call boundary breaks surrounding elementwise fusions and
+    # pins layouts XLA wants freedom over: 727 -> 785 ms/step), so the
+    # default is off; the kernel stays available for standalone use.
+    # 'auto' = on TPU when d_model is lane-tileable; True forces.
+    fused_layernorm: object = False
 
     @property
     def d_head(self):
@@ -229,15 +237,17 @@ class GPT2:
             # segments remat. Backward then runs zero extra flash kernels
             # and recomputes only matmul-light segments.
             def split_block(x, layer, lrng):
+                hm = cfg.use_flash_attention and not seq_sharded
                 pre = jax.checkpoint(partial(
-                    self.block_qkv, constrain=constrain, act_spec=act_spec))
+                    self.block_qkv, constrain=constrain, act_spec=act_spec,
+                    heads_major=hm))
                 q, kk, v = pre(x, layer)
                 attn = self.block_attn(q, kk, v, causal=causal,
                                        constrain=constrain,
                                        seq_sharded=seq_sharded)
                 post = jax.checkpoint(partial(
                     self.block_post, constrain=constrain, act_spec=act_spec,
-                    seq_sharded=seq_sharded, train=train))
+                    seq_sharded=seq_sharded, train=train, heads_major=hm))
                 return post(x, attn, layer, lrng)
             block_fn = split_block
         elif cfg.remat:
@@ -261,6 +271,18 @@ class GPT2:
     def _constrain_fn(self):
         return constrain_fn()
 
+    def _ln(self, x, scale, bias):
+        """LayerNorm dispatch: fused Pallas kernel (one HBM pass fwd, one
+        bwd, VMEM-accumulated param grads) when enabled, jnp otherwise."""
+        use = self.config.fused_layernorm
+        if use == "auto":
+            use = (jax.default_backend() == "tpu"
+                   and x.shape[-1] % 128 == 0)
+        if use:
+            from ..ops.pallas.layernorm import fused_layernorm
+            return fused_layernorm(x, scale, bias)
+        return _layernorm(x, scale, bias)
+
     def embed(self, params, input_ids, *, rng, train, constrain, act_spec):
         """Token + position embedding (B, T) -> (B, T, D); validates the
         train rng. Shared by the dense and pipelined paths."""
@@ -281,18 +303,30 @@ class GPT2:
 
     def head(self, params, x):
         """Final LN + tied-embedding unembed: (B, T, D) -> fp32 logits."""
-        x = _layernorm(x, params["lnf_scale"], params["lnf_bias"])
+        x = self._ln(x, params["lnf_scale"], params["lnf_bias"])
         return jnp.einsum("btd,vd->btv", x, params["wte"],
                           preferred_element_type=jnp.float32)
 
-    def block_qkv(self, x, layer, *, constrain, act_spec):
-        """ln1 + qkv projection: (B, T, D) -> q, k, v each (B, T, H, hd).
-        Cheap to recompute in backward (one matmul whose output no grad
-        rule needs — only ln1_out is, and that's VPU work)."""
+    def block_qkv(self, x, layer, *, constrain, act_spec,
+                  heads_major=False):
+        """ln1 + qkv projection: (B, T, D) -> q, k, v each (B, T, H, hd)
+        — or (B, H, T, hd) when ``heads_major`` (the flash kernel's
+        native layout: the einsum emits (…, T, hd)-minor tiles directly,
+        so no transpose copy exists between the projection and the
+        kernel, and no T-minor layout pressure warps the surrounding
+        matmuls). Cheap to recompute in backward (one matmul whose
+        output no grad rule needs — only ln1_out is, and that's VPU
+        work)."""
         cfg = self.config
         B, T = x.shape[0], x.shape[1]
         H, hd = cfg.n_head, cfg.d_head
-        h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
+        h = self._ln(x, layer["ln1_scale"], layer["ln1_bias"])
+        if heads_major:
+            w = layer["wqkv"].reshape(x.shape[-1], 3, H, hd)
+            b = layer["bqkv"].reshape(3, H, hd)
+            qkv = jnp.einsum("btd,dshe->sbhte", h, w) \
+                + b[:, None, :, None, :]
+            return qkv[0], qkv[1], qkv[2]
         qkv = h @ layer["wqkv"] + layer["bqkv"]
         qkv = qkv.reshape(B, T, 3, H, hd)
         return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -311,15 +345,17 @@ class GPT2:
         elif cfg.use_flash_attention and not seq_sharded:
             # pallas fused attention: O(T) memory, fp32 accumulation
             # (ops/pallas/flash_attention.py). Heads shard over 'tensor'.
+            # Inputs arrive heads-major (B, H, T, hd) from block_qkv.
             from ..ops.pallas.flash_attention import flash_attention
-            head_spec = P(BATCH_AXES, None, "tensor", None)
+            head_spec = P(BATCH_AXES, "tensor", None, None)
             q = constrain(q, head_spec)
             kk = constrain(kk, head_spec)
             v = constrain(v, head_spec)
             attn = flash_attention(q, kk, v, causal=True,
                                    block_q=cfg.flash_block_q,
                                    block_k=cfg.flash_block_k,
-                                   block_h=cfg.flash_block_h).astype(dt)
+                                   block_h=cfg.flash_block_h,
+                                   heads_major=True).astype(dt)
             from jax.ad_checkpoint import checkpoint_name
             attn = checkpoint_name(attn, "attn_out")
         else:
@@ -343,13 +379,19 @@ class GPT2:
         return attn
 
     def block_post(self, x, attn, layer, lrng, *, constrain, act_spec,
-                   seq_sharded, train):
-        """Output projection residual + ln2 + MLP residual."""
+                   seq_sharded, train, heads_major=False):
+        """Output projection residual + ln2 + MLP residual. ``attn`` is
+        (B, T, H, hd), or (B, H, T, hd) when ``heads_major`` (flash path
+        — the wo projection contracts (h, e) directly, no transpose)."""
         cfg = self.config
         B, T = x.shape[0], x.shape[1]
-        attn = attn.reshape(B, T, cfg.n_head * cfg.d_head)
-        attn = constrain(attn, act_spec)
-        x = x + attn @ layer["wo"] + layer["bo"]
+        if heads_major:
+            wo = layer["wo"].reshape(cfg.n_head, cfg.d_head, cfg.d_model)
+            x = x + jnp.einsum("bhte,hed->btd", attn, wo) + layer["bo"]
+        else:
+            attn = attn.reshape(B, T, cfg.n_head * cfg.d_head)
+            attn = constrain(attn, act_spec)
+            x = x + attn @ layer["wo"] + layer["bo"]
         x = constrain(x, act_spec)
         from jax.ad_checkpoint import checkpoint_name
         # named so remat policies can keep the post-attention residual
@@ -357,12 +399,18 @@ class GPT2:
         # recomputes only ln2 + the MLP instead of the attention half too
         x = checkpoint_name(x, "attn_mid")
 
-        h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
+        h = self._ln(x, layer["ln2_scale"], layer["ln2_bias"])
         mlp_out, aux = self._mlp(h, layer, lrng, train=train,
                                  seq_sharded=seq_sharded,
                                  constrain=constrain)
         x = x + mlp_out
         x = constrain(x, act_spec)
+        # named block output: policies saving 'block_out' make each
+        # layer's INPUT directly available in backward — without it, a
+        # names-policy inside lax.scan reconstructs x_in_{l+1} by
+        # replaying the whole l-th MLP forward (an extra ~2.4 ms/layer
+        # wdown matmul on a layout XLA emits badly)
+        x = checkpoint_name(x, "block_out")
         return x, aux
 
     def block_forward(self, x, layer, lrng, *, causal, constrain, act_spec,
@@ -370,13 +418,14 @@ class GPT2:
         """One transformer block: (B, T, D) -> (B, T, D), plus aux loss.
         Shared by the dense scan path and the pipelined executor
         (models/gpt2_pipe.py)."""
+        hm = self.config.use_flash_attention and not seq_sharded
         q, kk, v = self.block_qkv(x, layer, constrain=constrain,
-                                  act_spec=act_spec)
+                                  act_spec=act_spec, heads_major=hm)
         attn = self.block_attn(q, kk, v, causal=causal, constrain=constrain,
                                seq_sharded=seq_sharded)
         return self.block_post(x, attn, layer, lrng, constrain=constrain,
                                act_spec=act_spec, seq_sharded=seq_sharded,
-                               train=train)
+                               train=train, heads_major=hm)
 
     def _requires_train_rng(self):
         """True when a training forward is stochastic (overridden by
@@ -421,11 +470,11 @@ class GPT2:
         cfg = self.config
         B, T = x.shape[0], x.shape[1]
         H, hd = cfg.n_head, cfg.d_head
-        h = _layernorm(x, layer["ln1_scale"], layer["ln1_bias"])
+        h = self._ln(x, layer["ln1_scale"], layer["ln1_bias"])
         qkv = (h @ layer["wqkv"] + layer["bqkv"]).reshape(B, T, 3, H, hd)
         attn, carry = attn_fn(qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2])
         x = x + attn.reshape(B, T, H * hd) @ layer["wo"] + layer["bo"]
-        h = _layernorm(x, layer["ln2_scale"], layer["ln2_bias"])
+        h = self._ln(x, layer["ln2_scale"], layer["ln2_bias"])
         mlp_out, _ = self._mlp(h, layer, None, train=False,
                                seq_sharded=False,
                                constrain=lambda t, s: t)

@@ -126,8 +126,10 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
     acc, m, l = jax.lax.fori_loop(kfull, kmax, make_body(True), carry)
     o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
     # lse carries a 128-wide lane dim (value replicated across lanes):
-    # per-row scalars are not tileable on TPU, so like the official TPU
-    # flash kernel we store (.., bq, 128) blocks
+    # per-row scalars are not tileable on TPU at head-group sizes < 8
+    # (2D (bh, bq) blocks need bh % 8 == 0), so like the official TPU
+    # flash kernel we store (.., bq, 128) blocks; the wrapper trims to
+    # one lane before anything is saved
     lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[..., None],
                                     (G, bq, lse_ref.shape[-1]))
 
@@ -254,15 +256,16 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
-def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, bh, t_real, interpret,
-         dlse=None):
+def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
+         interpret, dlse=None):
     BH, T, d = q.shape
+    lse = jnp.broadcast_to(lse_t, (BH, T, 128))
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                # (BH, T)
     if dlse is not None:
         # lse cotangent folds into delta (see _flash_bwd)
-        delta = delta - dlse[..., 0].astype(jnp.float32)
-    delta = jnp.broadcast_to(delta[..., None], lse.shape)   # lane dim
+        delta = delta - dlse.astype(jnp.float32)
+    delta = jnp.broadcast_to(delta[..., None], (BH, T, 128))
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real),
@@ -308,24 +311,34 @@ def _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, bh, t_real, interpret,
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def _flash(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
     o, lse = _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
-    return o, lse[..., :1]
+    return o, lse[..., 0]
 
 
 def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
+    from jax.ad_checkpoint import checkpoint_name
     o, lse = _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
     lse_t = lse[..., :1]                                    # (BH, T, 1)
-    return (o, lse_t), (q, k, v, o, lse_t)
+    # Name o/lse_t HERE, inside the fwd rule, so the named vars are both
+    # the primal outputs and the vjp residuals: under jax.checkpoint a
+    # save-policy keeping 'flash_o'/'flash_lse' then satisfies the
+    # backward's residual needs (q/k/v recompute from the cheap qkv
+    # matmul) WITHOUT re-running this kernel — the remat re-run the
+    # whole-block policies otherwise pay (~52 ms/step at 350M bs=24).
+    # lse is trimmed to one lane first so the saved residual is
+    # (BH, T, 1) fp32, not the kernel's 128-lane block (4.8 GB at bs=24).
+    o = checkpoint_name(o, "flash_o")
+    lse_t = checkpoint_name(lse_t, "flash_lse")
+    return (o, lse_t[..., 0]), (q, k, v, o, lse_t)
 
 
 def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, res, cts):
     do, dlse = cts
     q, k, v, o, lse_t = res
-    lse = jnp.broadcast_to(lse_t, lse_t.shape[:2] + (128,))
     # lse is a real (differentiable) output: d lse_i / d s_ij = p_ij, so a
     # cotangent on lse enters the shared ds = p * (dp - delta) term as
     # ds += p * dlse — i.e. exactly a shift of delta by -dlse. Folding it
     # there costs zero extra kernel work.
-    return _bwd(q, k, v, o, lse, do, scale, causal, bq, bk, bh, t_real,
+    return _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
                 interpret, dlse=dlse)
 
 
@@ -334,9 +347,15 @@ _flash.defvjp(_flash_fwd, _flash_bwd)
 
 def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
                              block_q=128, block_k=128, block_h=2,
-                             interpret=None):
+                             interpret=None, heads_major=False):
     """Fused attention over (batch, seq, heads, head_dim) inputs, returning
     ``(o, lse)`` where lse is the per-query logsumexp, (B, H, T) fp32.
+
+    ``heads_major=True``: inputs/outputs are (batch, heads, seq, head_dim)
+    — the kernel's native layout. The fold becomes a pure reshape (no
+    transpose), and no T-minor layout pressure propagates into the
+    caller's matmuls (XLA otherwise warps the producing matmul's output
+    layout to feed the custom call, costing ~2x on its emitter).
 
     Equivalent math to softmax(scale * q k^T + causal_mask) v with fp32
     accumulation, O(T) memory. Differentiable (custom flash backward).
@@ -351,7 +370,10 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     and a save-policy can keep exactly the flash residuals — making the
     backward reuse them instead of recomputing the forward kernel.
     """
-    B, T, H, d = q.shape
+    if heads_major:
+        B, H, T, d = q.shape
+    else:
+        B, T, H, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if interpret is None:
@@ -367,7 +389,9 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     d_pad = d if d in (64, 128) else _round_up(d, 128)
 
     def fold(x):
-        x = x.transpose(0, 2, 1, 3).reshape(B * H, T, d)
+        if not heads_major:
+            x = x.transpose(0, 2, 1, 3)
+        x = x.reshape(B * H, T, d)
         if T_pad != T or d_pad != d:
             x = jnp.pad(x, ((0, 0), (0, T_pad - T), (0, d_pad - d)))
         return x
@@ -381,17 +405,21 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     if T_pad != T or d_pad != d:
         o = o[:, :T, :d]
         lse = lse[:, :T]
-    o = o.reshape(B, H, T, d).transpose(0, 2, 1, 3)
-    return o, lse[..., 0].reshape(B, H, T)
+    o = o.reshape(B, H, T, d)
+    if not heads_major:
+        o = o.transpose(0, 2, 1, 3)
+    return o, lse.reshape(B, H, T)
 
 
 def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
-                    block_k=128, block_h=2, interpret=None):
+                    block_k=128, block_h=2, interpret=None,
+                    heads_major=False):
     """Fused attention over (batch, seq, heads, head_dim); see
     :func:`flash_attention_with_lse` (this drops the lse output)."""
     o, _ = flash_attention_with_lse(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
-        block_k=block_k, block_h=block_h, interpret=interpret)
+        block_k=block_k, block_h=block_h, interpret=interpret,
+        heads_major=heads_major)
     return o
 
 

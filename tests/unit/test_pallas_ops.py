@@ -358,3 +358,109 @@ class TestBlockSparseAttention:
         out = block_sparse_attention(q, k, v, layout, 32, causal=False)
         np.testing.assert_array_equal(np.asarray(out[:, :32]), 0.0)
         assert float(jnp.max(jnp.abs(out[:, 32:]))) > 0
+
+
+class TestFlashHeadsMajor:
+    """heads_major=True: (B, H, T, d) I/O — the kernel-native layout the
+    GPT-2 flash path feeds (no transpose between qkv projection and
+    kernel)."""
+
+    def _qkv(self, B=2, T=128, H=4, d=32, dtype=jnp.float32, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda s: jnp.asarray(rng.randn(B, H, T, d), dtype) * 0.3
+        return mk(0), mk(1), mk(2)
+
+    def test_matches_default_layout(self):
+        q, k, v = self._qkv()
+        o = flash_attention(q, k, v, block_q=64, block_k=64,
+                            heads_major=True)
+        ot = flash_attention(q.transpose(0, 2, 1, 3),
+                             k.transpose(0, 2, 1, 3),
+                             v.transpose(0, 2, 1, 3),
+                             block_q=64, block_k=64)
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.asarray(ot.transpose(0, 2, 1, 3)),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_grads_match_dense(self):
+        q, k, v = self._qkv(T=64)
+
+        def loss_f(q, k, v):
+            o = flash_attention(q, k, v, block_q=32, block_k=32,
+                                heads_major=True)
+            return jnp.sum(o ** 2)
+
+        def loss_r(q, k, v):
+            o = attention_reference(q.transpose(0, 2, 1, 3),
+                                    k.transpose(0, 2, 1, 3),
+                                    v.transpose(0, 2, 1, 3))
+            return jnp.sum(o ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_padded_seq(self):
+        q, k, v = self._qkv(T=80)      # pads to the 128 block in-kernel
+        o = flash_attention(q, k, v, heads_major=True)
+        ref = attention_reference(q.transpose(0, 2, 1, 3),
+                                  k.transpose(0, 2, 1, 3),
+                                  v.transpose(0, 2, 1, 3))
+        np.testing.assert_allclose(np.asarray(o),
+                                   np.asarray(ref.transpose(0, 2, 1, 3)),
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestFusedLayerNorm:
+    """ops/pallas/layernorm.py parity vs the model's jnp layernorm
+    (reference csrc/transformer/normalize_kernels.cu role)."""
+
+    def _ref(self, x, s, b, eps=1e-5):
+        x32 = x.astype(jnp.float32)
+        mu = jnp.mean(x32, -1, keepdims=True)
+        var = jnp.mean(jnp.square(x32 - mu), -1, keepdims=True)
+        return ((x32 - mu) * jax.lax.rsqrt(var + eps)
+                * s.astype(jnp.float32)
+                + b.astype(jnp.float32)).astype(x.dtype)
+
+    @pytest.mark.parametrize("shape,dt", [
+        ((4, 37, 256), jnp.float32),       # padded rows (4*37 % 8 != 0)
+        ((2, 128, 128), jnp.bfloat16),
+        ((300, 384), jnp.float32),
+    ])
+    def test_fwd_bwd_parity(self, shape, dt):
+        from deepspeed_tpu.ops.pallas.layernorm import fused_layernorm
+        rng = np.random.RandomState(0)
+        D = shape[-1]
+        x = jnp.asarray(rng.randn(*shape), dt)
+        s = jnp.asarray(1 + 0.1 * rng.randn(D), dt)
+        b = jnp.asarray(0.1 * rng.randn(D), dt)
+        tol = 2e-2 if dt == jnp.bfloat16 else 1e-5
+        y = fused_layernorm(x, s, b, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(y, np.float32),
+            np.asarray(self._ref(x, s, b), np.float32),
+            rtol=tol, atol=tol)
+
+        def f(x, s, b):
+            return jnp.sum(jnp.sin(fused_layernorm(
+                x, s, b, interpret=True).astype(jnp.float32)))
+
+        def fr(x, s, b):
+            return jnp.sum(jnp.sin(self._ref(x, s, b).astype(jnp.float32)))
+
+        g = jax.grad(f, argnums=(0, 1, 2))(x, s, b)
+        gr = jax.grad(fr, argnums=(0, 1, 2))(x, s, b)
+        tol2 = 5e-2 if dt == jnp.bfloat16 else 1e-4
+        for a, br_ in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a, np.float32),
+                                       np.asarray(br_, np.float32),
+                                       rtol=tol2, atol=tol2)
+
+    def test_rejects_untileable_feature_dim(self):
+        from deepspeed_tpu.ops.pallas.layernorm import fused_layernorm
+        with pytest.raises(ValueError, match="128"):
+            fused_layernorm(jnp.zeros((8, 100)), jnp.ones(100),
+                            jnp.zeros(100), interpret=True)
