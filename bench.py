@@ -54,7 +54,7 @@ def main():
                   scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")),
                   # fused LN kernel measured slower in-step (see
                   # GPT2Config.fused_layernorm): off unless forced
-                  fused_layernorm={"0": False, "1": True,
+                  fused_layernorm={"0": False, "1": True, "bwd": "bwd",
                                    "auto": "auto"}.get(
                       os.environ.get("BENCH_FUSED_LN", "0"), False),
                   loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "256")))

@@ -272,12 +272,16 @@ class GPT2:
         return constrain_fn()
 
     def _ln(self, x, scale, bias):
-        """LayerNorm dispatch: fused Pallas kernel (one HBM pass fwd, one
-        bwd, VMEM-accumulated param grads) when enabled, jnp otherwise."""
+        """LayerNorm dispatch: 'bwd' = jnp forward + one-pass Pallas
+        backward (layernorm_fused_bwd); True/'auto' = fully fused Pallas
+        kernel; False = jnp."""
         use = self.config.fused_layernorm
         if use == "auto":
             use = (jax.default_backend() == "tpu"
                    and x.shape[-1] % 128 == 0)
+        if use == "bwd":
+            from ..ops.pallas.layernorm import layernorm_fused_bwd
+            return layernorm_fused_bwd(x, scale, bias)
         if use:
             from ..ops.pallas.layernorm import fused_layernorm
             return fused_layernorm(x, scale, bias)
@@ -684,12 +688,8 @@ class GPT2:
 
 
 def _layernorm(x, scale, bias, eps=1e-5):
-    x32 = x.astype(jnp.float32)
-    mu = jnp.mean(x32, axis=-1, keepdims=True)
-    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
-    y = (x32 - mu) * lax.rsqrt(var + eps)
-    return (y * scale.astype(jnp.float32) +
-            bias.astype(jnp.float32)).astype(x.dtype)
+    from ..ops.pallas.layernorm import _ln_jnp
+    return _ln_jnp(x, scale, bias, eps)
 
 
 def _dropout(x, rate, rng):

@@ -160,51 +160,21 @@ def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
 
 
 # ----------------------------------------------------------------- backward
-def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-                   *, bq, bk, scale, causal, t_real):
-    qi = pl.program_id(1)
-    q = q_ref[...]                                          # (G, bq, d) bf16
-    G = q.shape[0]
-    do = do_ref[...]
-    lse = lse_ref[...][..., 0]                              # (G, bq)
-    delta = delta_ref[...][..., 0]
-    T = k_ref.shape[1]
-    nk = T // bk
-    kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
-    kfull = (qi * bq) // bk if (causal and t_real >= T) else (
-        nk if (not causal and t_real >= T) else 0)
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real):
+    """Fused flash backward: dq, dk, dv from ONE s/p computation.
 
-    def make_body(masked):
-        def body(j, dq):
-            kb = k_ref[:, pl.ds(j * bk, bk), :]
-            vb = v_ref[:, pl.ds(j * bk, bk), :]
-            s = jax.lax.dot_general(q, kb, _DN_QK,
-                                    preferred_element_type=jnp.float32)
-            if scale != 1.0:
-                s = s * scale
-            if masked:
-                s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
-                                               causal, t_real, T))
-            p = jnp.exp(s - lse[..., None])                 # (G, bq, bk) f32
-            dp = jax.lax.dot_general(do, vb, _DN_QK,
-                                     preferred_element_type=jnp.float32)
-            ds = p * (dp - delta[..., None])
-            return dq + jax.lax.dot_general(
-                ds.astype(kb.dtype), kb, _DN_PV,
-                preferred_element_type=jnp.float32)
-        return body
+    Grid is (BH/bh, T/bk) over key blocks; an inner loop walks the query
+    blocks this key block attends. The two-kernel formulation (separate
+    dq and dk/dv passes, as in the reference's backward and round 2
+    here) computes s = q k^T and p = exp(s - lse) TWICE; fusing halves
+    the score-matrix work — the dominant VPU+MXU cost of the backward.
 
-    d = q_ref.shape[-1]
-    dq = jax.lax.fori_loop(0, kfull, make_body(False),
-                           jnp.zeros((G, bq, d), jnp.float32))
-    dq = jax.lax.fori_loop(kfull, kmax, make_body(True), dq)
-    if scale != 1.0:
-        dq = dq * scale
-    dq_ref[...] = dq.astype(dq_ref.dtype)
-
-
-def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                    dk_ref, dv_ref, *, bq, bk, scale, causal, t_real):
+    dq accumulates ACROSS grid steps in a VMEM-resident fp32 block (the
+    TPU grid is sequential; the constant-index output block persists),
+    initialized at the first key block. dk/dv accumulate in registers
+    over the inner loop.
+    """
     ki = pl.program_id(1)
     kb = k_ref[...]                                         # (G, bk, d) bf16
     G = kb.shape[0]
@@ -216,6 +186,10 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     # below it don't. With padded keys every block masks.
     qfull = pl.cdiv((ki + 1) * bk, bq) if (causal and t_real >= T) else (
         qmin if t_real >= T else nq)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_ref[...] = jnp.zeros_like(dq_ref)
 
     def make_body(masked):
         def body(i, carry):
@@ -240,6 +214,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             ds = (p * (dp - delta[..., None])).astype(q.dtype)
             dk = dk + jax.lax.dot_general(ds, q, _DN_T,
                                           preferred_element_type=jnp.float32)
+            dq_ref[:, pl.ds(i * bq, bq), :] += jax.lax.dot_general(
+                ds, kb, _DN_PV, preferred_element_type=jnp.float32)
             return dk, dv
         return body
 
@@ -249,7 +225,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     dk, dv = jax.lax.fori_loop(qmin, qfull, make_body(True), (dk, dv))
     dk, dv = jax.lax.fori_loop(qfull, nq, make_body(False), (dk, dv))
     # ds was computed from unscaled-q dots (scale applied to s post-dot),
-    # so dk needs the scale factor once here
+    # so dk needs the scale factor once here (dq's lands in the wrapper)
     if scale != 1.0:
         dk = dk * scale
     dk_ref[...] = dk.astype(dk_ref.dtype)
@@ -259,31 +235,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
          interpret, dlse=None):
     BH, T, d = q.shape
-    lse = jnp.broadcast_to(lse_t, (BH, T, 128))
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                     axis=-1)                                # (BH, T)
     if dlse is not None:
         # lse cotangent folds into delta (see _flash_bwd)
         delta = delta - dlse.astype(jnp.float32)
+    lse = jnp.broadcast_to(lse_t, (BH, T, 128))
     delta = jnp.broadcast_to(delta[..., None], (BH, T, 128))
-    dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, bq=bq, bk=bk, scale=scale,
-                          causal=causal, t_real=t_real),
-        grid=(BH // bh, T // bq),
-        in_specs=[
-            pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((bh, bq, 128), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((bh, bq, 128), lambda b, i: (b, i, 0)),
-        ],
-        out_specs=pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
-        out_shape=_sds((BH, T, d), q.dtype, q),
-        interpret=interpret,
-    )(q, k, v, do, lse, delta)
-    dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, bq=bq, bk=bk, scale=scale,
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real),
         grid=(BH // bh, T // bk),
         in_specs=[
@@ -295,16 +255,20 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
             pl.BlockSpec((bh, T, 128), lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
+            pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
+            _sds((BH, T, d), jnp.float32, q),   # dq accumulates fp32
             _sds((BH, T, d), q.dtype, q),
             _sds((BH, T, d), q.dtype, q),
         ],
         interpret=interpret,
     )(q, k, v, do, lse, delta)
-    return dq, dk, dv
+    if scale != 1.0:
+        dq = dq * scale
+    return dq.astype(q.dtype), dk, dv
 
 
 # --------------------------------------------------------------- public API

@@ -124,6 +124,51 @@ def _ln_bwd(eps, br, interpret, res, dy):
 _ln.defvjp(_ln_fwd, _ln_bwd)
 
 
+def _ln_jnp(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    y = (x32 - mu) * jax.lax.rsqrt(var + eps)
+    return (y * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ln_hybrid(x, scale, bias, eps, br, interpret):
+    return _ln_jnp(x, scale, bias, eps)
+
+
+def _ln_hybrid_fwd(x, scale, bias, eps, br, interpret):
+    return _ln_jnp(x, scale, bias, eps), (x, scale)
+
+
+_ln_hybrid.defvjp(_ln_hybrid_fwd, _ln_bwd)
+
+
+def layernorm_fused_bwd(x, scale, bias, *, eps=1e-5, block_rows=256,
+                        interpret=None):
+    """Hybrid LayerNorm: plain-jnp forward (stays fusable with XLA's
+    surrounding elementwise ops, leaves layout choices free) + the
+    one-pass Pallas backward (dx + VMEM-accumulated dscale/dbias in a
+    single read of x/dy). Same numerics as :func:`fused_layernorm`."""
+    if interpret is None:
+        interpret = _interpret_default()
+    D = x.shape[-1]
+    if D % 128:
+        raise ValueError(f"layernorm_fused_bwd needs D % 128 == 0, got {D}")
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, D)
+    N = x2.shape[0]
+    br = max(8, min(block_rows, _round_up(N, 8)))
+    N_pad = _round_up(N, br)
+    if N_pad != N:
+        x2 = jnp.pad(x2, ((0, N_pad - N), (0, 0)))
+    y = _ln_hybrid(x2, scale, bias, float(eps), br, bool(interpret))
+    if N_pad != N:
+        y = y[:N]
+    return y.reshape(*lead, D)
+
+
 def fused_layernorm(x, scale, bias, *, eps=1e-5, block_rows=256,
                     interpret=None):
     """LayerNorm over the last dim of ``x`` (any leading shape), fp32

@@ -464,3 +464,29 @@ class TestFusedLayerNorm:
         with pytest.raises(ValueError, match="128"):
             fused_layernorm(jnp.zeros((8, 100)), jnp.ones(100),
                             jnp.zeros(100), interpret=True)
+
+
+    def test_hybrid_bwd_parity(self):
+        """layernorm_fused_bwd: jnp forward + Pallas one-pass backward."""
+        from deepspeed_tpu.ops.pallas.layernorm import layernorm_fused_bwd
+        rng = np.random.RandomState(1)
+        x = jnp.asarray(rng.randn(3, 40, 256), jnp.float32)
+        s = jnp.asarray(1 + 0.1 * rng.randn(256), jnp.float32)
+        b = jnp.asarray(0.1 * rng.randn(256), jnp.float32)
+        y = layernorm_fused_bwd(x, s, b, interpret=True)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(self._ref(x, s, b)),
+                                   rtol=1e-5, atol=1e-5)
+
+        def f(x, s, b):
+            return jnp.sum(jnp.cos(layernorm_fused_bwd(
+                x, s, b, interpret=True)))
+
+        def fr(x, s, b):
+            return jnp.sum(jnp.cos(self._ref(x, s, b)))
+
+        g = jax.grad(f, argnums=(0, 1, 2))(x, s, b)
+        gr = jax.grad(fr, argnums=(0, 1, 2))(x, s, b)
+        for a, br_ in zip(g, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(br_),
+                                       rtol=1e-4, atol=1e-4)
