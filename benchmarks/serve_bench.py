@@ -40,6 +40,14 @@ def build_model(name):
         return Llama(LlamaConfig(n_layer=16, n_head=16, n_kv_heads=8,
                                  d_model=2048, d_ff=5632, max_seq_len=2048,
                                  vocab_size=32000))
+    if name == "mixtral-tiny":
+        # MoE serving point: small enough to serve on one chip while
+        # exercising the grouped-GEMM expert path end to end
+        from deepspeed_tpu.models.mixtral import Mixtral, MixtralConfig
+        return Mixtral(MixtralConfig(
+            n_layer=8, n_head=16, n_kv_heads=8, d_model=1024, d_ff=3584,
+            max_seq_len=2048, vocab_size=32000, num_experts=8,
+            moe_top_k=2))
     raise ValueError(name)
 
 

@@ -80,6 +80,17 @@ def report(file=sys.stdout):
         mark = GREEN_OK if ok else RED_NO
         p(f"  {name:28s} {mark}{'  ' + detail if detail else ''}")
     p("-" * 64)
+    p("launcher")
+    from shutil import which
+    p(f"  ssh runner ................. {GREEN_OK}")
+    p(f"  pdsh runner ................ "
+      f"{GREEN_OK if which('pdsh') else RED_NO}")
+    p(f"  slurm (srun) ............... "
+      f"{GREEN_OK if which('srun') else RED_NO}")
+    p("  elastic supervision ........ dstpu --elastic "
+      "[--max_elastic_restarts N --min_hosts M] (whole-world restart "
+      "on membership change)")
+    p("-" * 64)
 
 
 def main():

@@ -100,6 +100,15 @@ def build_worker_cmds(hosts, coordinator, script, script_args,
     return cmds
 
 
+def _compose_remote_cmd(argv, env, extra_prefix=""):
+    """'cd <cwd> && EXPORTS [prefix] argv...' — the one remote command
+    string every runner hands to its transport."""
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
+    return (f"cd {shlex.quote(os.getcwd())} && {exports} "
+            + (extra_prefix + " " if extra_prefix else "")
+            + " ".join(shlex.quote(a) for a in argv))
+
+
 class PDSHRunner:
     """reference multinode_runner.py:51 — pdsh fan-out."""
 
@@ -113,10 +122,7 @@ class PDSHRunner:
     def launch(self, cmds):
         procs = []
         for host, argv, env in cmds:
-            exports = " ".join(f"{k}={shlex.quote(v)}"
-                               for k, v in env.items())
-            remote = (f"cd {shlex.quote(os.getcwd())} && {exports} "
-                      + " ".join(shlex.quote(a) for a in argv))
+            remote = _compose_remote_cmd(argv, env)
             procs.append(subprocess.Popen(
                 ["pdsh", "-R", "ssh", "-w", host, remote]))
         return procs
@@ -135,10 +141,7 @@ class SSHRunner:
     def launch(self, cmds):
         procs = []
         for host, argv, env in cmds:
-            exports = " ".join(f"{k}={shlex.quote(v)}"
-                               for k, v in env.items())
-            remote = (f"cd {shlex.quote(os.getcwd())} && {exports} "
-                      + " ".join(shlex.quote(a) for a in argv))
+            remote = _compose_remote_cmd(argv, env)
             if host in ("localhost", "127.0.0.1"):
                 procs.append(subprocess.Popen(
                     ["bash", "-c", remote]))
@@ -148,6 +151,46 @@ class SSHRunner:
                 # dies on its next write to the closed socket)
                 procs.append(subprocess.Popen(["ssh", "-tt", host, remote]))
         return procs
+
+
+class SlurmRunner:
+    """reference multinode_runner.py:340 SlurmRunner — one ``srun`` fans
+    the whole job out instead of per-host ssh sessions. Per-process rank
+    comes from ``SLURM_PROCID`` at runtime (srun starts all tasks with
+    identical argv), so the worker env maps it onto ``PROCESS_ID`` for
+    ``jax.distributed.initialize``."""
+
+    def __init__(self, args):
+        self.args = args
+
+    def available(self):
+        from shutil import which
+        return which("srun") is not None
+
+    def build_cmd(self, cmds):
+        """Compose the single srun invocation from per-host worker cmds.
+
+        Rank AND coordinator both come from Slurm's runtime view: srun
+        orders --nodelist nodes its own way (sorted, not as given), so a
+        statically chosen coordinator host could differ from the node
+        SLURM_PROCID 0 lands on — and jax.distributed starts the
+        coordinator service on process 0. Resolving the first job node
+        via scontrol inside the task keeps the two consistent."""
+        hosts = [h for h, _, _ in cmds]
+        _, argv, env = cmds[0]
+        port = env.get("COORDINATOR_ADDRESS", ":8476").rsplit(":", 1)[-1]
+        env = {k: v for k, v in env.items()
+               if k not in ("PROCESS_ID", "COORDINATOR_ADDRESS")}
+        prefix = ("PROCESS_ID=$SLURM_PROCID COORDINATOR_ADDRESS="
+                  "$(scontrol show hostnames $SLURM_JOB_NODELIST "
+                  f"| head -n1):{port} exec")
+        inner = _compose_remote_cmd(argv, env, extra_prefix=prefix)
+        return ["srun", f"--nodes={len(hosts)}", f"--ntasks={len(hosts)}",
+                "--ntasks-per-node=1", f"--nodelist={','.join(hosts)}",
+                "bash", "-c", inner]
+
+    def launch(self, cmds):
+        return [subprocess.Popen(self.build_cmd(cmds))]
 
 
 def parse_args(argv=None):
@@ -164,7 +207,7 @@ def parse_args(argv=None):
     parser.add_argument("--master_port", type=int,
                         default=DEFAULT_COORD_PORT)
     parser.add_argument("--launcher", default="ssh",
-                        choices=["ssh", "pdsh"])
+                        choices=["ssh", "pdsh", "slurm"])
     parser.add_argument("--env", action="append", default=[],
                         help="env var names to pass through to workers")
     parser.add_argument("--elastic", action="store_true",
@@ -196,8 +239,15 @@ def main(argv=None):
         hosts, coordinator, args.script, args.script_args,
         env_passthrough=tuple(args.env) + ("PYTHONPATH", "JAX_PLATFORMS",
                                            "XLA_FLAGS"))
-    runner = (PDSHRunner(args) if args.launcher == "pdsh"
-              else SSHRunner(args))
+    if args.elastic and args.launcher == "slurm":
+        # one srun proc stands for N hosts: per-host supervision (and
+        # per-host blame on failure) is impossible — Slurm's own
+        # requeue/--no-kill machinery owns that role there
+        raise SystemExit(
+            "--elastic requires a per-host launcher (ssh/pdsh); "
+            "with SLURM use its native requeue instead")
+    runner = {"pdsh": PDSHRunner, "slurm": SlurmRunner,
+              "ssh": SSHRunner}[args.launcher](args)
     if not runner.available():
         raise SystemExit(f"launcher {args.launcher} not available")
     if args.elastic:

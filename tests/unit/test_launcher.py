@@ -99,3 +99,98 @@ class TestEnvReport:
         # they must at least import and trace
         assert set(rows) == {"pallas_flash_attention", "pallas_quantizer",
                              "native_ckpt_writer"}
+
+
+class TestSlurmRunner:
+    def test_srun_command_shape(self):
+        """reference multinode_runner.py:340: one srun for the whole job;
+        rank mapped from SLURM_PROCID at runtime."""
+        from deepspeed_tpu.launcher.runner import (SlurmRunner,
+                                                   build_worker_cmds)
+        import argparse
+        cmds = build_worker_cmds(["node1", "node2", "node3"], "node1:8476",
+                                 "train.py", ["--lr", "1e-4"])
+        r = SlurmRunner(argparse.Namespace())
+        argv = r.build_cmd(cmds)
+        assert argv[0] == "srun"
+        assert "--nodes=3" in argv and "--ntasks=3" in argv
+        assert "--ntasks-per-node=1" in argv
+        assert "--nodelist=node1,node2,node3" in argv
+        inner = argv[-1]
+        assert "PROCESS_ID=$SLURM_PROCID" in inner
+        assert "NUM_PROCESSES=3" in inner
+        # coordinator resolves from Slurm's OWN node ordering at runtime
+        # (srun sorts --nodelist; rank 0 must own the coordinator port)
+        assert ("COORDINATOR_ADDRESS=$(scontrol show hostnames "
+                "$SLURM_JOB_NODELIST | head -n1):8476") in inner
+        assert "train.py --lr 1e-4" in inner
+        # static rendezvous values must NOT leak into the shared exports
+        assert "PROCESS_ID=0" not in inner
+        assert "COORDINATOR_ADDRESS=node1" not in inner
+
+    def test_elastic_rejected_with_slurm(self, tmp_path):
+        from deepspeed_tpu.launcher import runner as R
+        import pytest
+        hostfile = tmp_path / "hosts"
+        hostfile.write_text("node1 slots=4\nnode2 slots=4\n")
+        with pytest.raises(SystemExit, match="per-host launcher"):
+            R.main(["-H", str(hostfile), "--launcher", "slurm",
+                    "--elastic", "train.py"])
+
+    def test_selected_by_flag(self, monkeypatch, tmp_path):
+        """--launcher slurm routes through SlurmRunner (Popen captured)."""
+        from deepspeed_tpu.launcher import runner as R
+        hostfile = tmp_path / "hosts"
+        hostfile.write_text("node1 slots=4\nnode2 slots=4\n")
+        captured = []
+
+        class FakeProc:
+            def wait(self):
+                return 0
+
+        monkeypatch.setattr(R.subprocess, "Popen",
+                            lambda argv, **kw: captured.append(argv)
+                            or FakeProc())
+        monkeypatch.setattr(R.SlurmRunner, "available", lambda self: True)
+        rc = R.main(["-H", str(hostfile), "--launcher", "slurm",
+                     "train.py"])
+        assert rc == 0
+        assert len(captured) == 1 and captured[0][0] == "srun"
+
+
+class TestElasticLauncher:
+    def test_relaunch_through_killed_worker(self, tmp_path):
+        """dstpu --elastic end to end on local 'hosts': generation 0 has a
+        worker die; the agent drops that host and relaunches the world,
+        which then completes cleanly (reference bin/ds_elastic +
+        launcher/runner.py:373)."""
+        import sys
+        from deepspeed_tpu.launcher import runner as R
+        log = tmp_path / "events.log"
+        script = tmp_path / "worker.py"
+        script.write_text(
+            "import os, sys\n"
+            "gen = os.environ['ELASTIC_GENERATION']\n"
+            "pid = os.environ['PROCESS_ID']\n"
+            "n = os.environ['NUM_PROCESSES']\n"
+            "with open(sys.argv[1], 'a') as f:\n"
+            "    f.write(f'{gen} {pid} {n}\\n')\n"
+            "if gen == '0' and pid == '1':\n"
+            "    sys.exit(3)\n"
+        )
+        hostfile = tmp_path / "hosts"
+        # two 'hosts' the SSHRunner treats as local (no ssh involved)
+        hostfile.write_text("localhost slots=1\n127.0.0.1 slots=1\n")
+        rc = R.main(["-H", str(hostfile), "--elastic",
+                     "--max_elastic_restarts", "2",
+                     str(script), str(log)])
+        assert rc == 0
+        events = [l.split() for l in log.read_text().splitlines()]
+        # generation 0: 2 workers (world=2); PROCESS_ID 1 died
+        gen0 = [e for e in events if e[0] == "0"]
+        assert sorted(e[1] for e in gen0) == ["0", "1"]
+        assert all(e[2] == "2" for e in gen0)
+        # generation 1: relaunched on the surviving host only (world=1)
+        gen1 = [e for e in events if e[0] == "1"]
+        assert [e[1] for e in gen1] == ["0"]
+        assert all(e[2] == "1" for e in gen1)
