@@ -182,7 +182,7 @@ class SlurmRunner:
         env = {k: v for k, v in env.items()
                if k not in ("PROCESS_ID", "COORDINATOR_ADDRESS")}
         prefix = ("PROCESS_ID=$SLURM_PROCID COORDINATOR_ADDRESS="
-                  "$(scontrol show hostnames $SLURM_JOB_NODELIST "
+                  '$(scontrol show hostnames "$SLURM_JOB_NODELIST" '
                   f"| head -n1):{port} exec")
         inner = _compose_remote_cmd(argv, env, extra_prefix=prefix)
         return ["srun", f"--nodes={len(hosts)}", f"--ntasks={len(hosts)}",
@@ -239,6 +239,12 @@ def main(argv=None):
         hosts, coordinator, args.script, args.script_args,
         env_passthrough=tuple(args.env) + ("PYTHONPATH", "JAX_PLATFORMS",
                                            "XLA_FLAGS"))
+    if args.launcher == "slurm" and args.master_addr:
+        logger.warning(
+            "--master_addr is ignored with --launcher slurm: the "
+            "coordinator must live where SLURM_PROCID 0 runs, which "
+            "Slurm decides (resolved from SLURM_JOB_NODELIST at task "
+            "startup)")
     if args.elastic and args.launcher == "slurm":
         # one srun proc stands for N hosts: per-host supervision (and
         # per-host blame on failure) is impossible — Slurm's own

@@ -59,6 +59,11 @@ class GPT2Config:
     # pipeline parallelism (GPT2Pipe): microbatches in flight; 0 = auto
     # (2x the pipe axis size, amortizing the fill/drain bubble)
     pipe_microbatches: int = 0
+    # pipeline training schedule: 'gpipe' (all-forward then autodiff
+    # backward; residual memory grows with microbatch count) or '1f1b'
+    # (interleaved forward/backward, live activations bounded by
+    # O(stages) — runtime/pipe/spmd.py pipeline_1f1b_grads)
+    pipe_schedule: str = "gpipe"
     # chunked cross entropy: unembed+CE computed per loss_chunk tokens
     # under remat so the full (B, T, V) fp32 logits never materialize
     # (0 = off). Big-vocab memory saver; exact same loss value.

@@ -139,3 +139,90 @@ class GPT2Pipe(GPT2):
         if return_hidden:
             return x, jnp.zeros((), jnp.float32)
         return self.head(params, x), jnp.zeros((), jnp.float32)
+
+    def loss(self, params, batch, *, rng=None, train=True,
+             seq_sharded=False):
+        """1F1B-scheduled training loss when ``pipe_schedule='1f1b'`` and
+        the mesh pipelines: the interleaved executor computes loss AND
+        grads in one pass with O(stages) live activations
+        (pipeline_1f1b_grads; reference _exec_schedule +
+        schedule.py:189 TrainSchedule). Identical loss value to the
+        GPipe path — parity-tested."""
+        cfg = self.config
+        S = self._pipe_size()
+        if S == 1 or cfg.pipe_schedule != "1f1b":
+            return super().loss(params, batch, rng=rng, train=train,
+                                seq_sharded=seq_sharded)
+        if cfg.use_flash_attention or cfg.attention_backend == "ring":
+            raise NotImplementedError(
+                "flash/ring attention inside the pipelined region is not "
+                "supported; use the dense backend with pipe")
+        from ..runtime.pipe.spmd import pipeline_1f1b_loss
+        from .common import (chunked_softmax_xent, next_token_xent,
+                             resolve_remat_policy)
+
+        ids = batch["input_ids"]
+        B, T = ids.shape
+        M = cfg.pipe_microbatches or 2 * S
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by "
+                             f"pipe_microbatches {M}")
+        act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
+        constrain = lax.with_sharding_constraint
+        x = self.embed(params, ids, rng=rng, train=train,
+                       constrain=constrain, act_spec=act_spec)
+        causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+
+        if cfg.remat and cfg.remat_policy == "split_attn":
+            # same split-boundary structure as apply_with_aux: pre/post
+            # segments remat, attention sits outside any checkpoint
+            from functools import partial
+
+            def block_fn(x, layer, key_data):
+                lrng = jax.random.wrap_key_data(key_data)
+                pre = jax.checkpoint(partial(
+                    self.block_qkv, constrain=constrain,
+                    act_spec=act_spec))
+                q, kk, v = pre(x, layer)
+                attn = self.block_attn(q, kk, v, causal=causal,
+                                       constrain=constrain,
+                                       seq_sharded=seq_sharded)
+                post = jax.checkpoint(partial(
+                    self.block_post, constrain=constrain,
+                    act_spec=act_spec, seq_sharded=seq_sharded,
+                    train=train))
+                y, _aux = post(x, attn, layer, lrng)
+                return y
+        else:
+            def block_fn(x, layer, key_data):
+                lrng = jax.random.wrap_key_data(key_data)
+                y, _aux = self.block_forward(
+                    x, layer, lrng, causal=causal, constrain=constrain,
+                    act_spec=act_spec, seq_sharded=seq_sharded,
+                    train=train)
+                return y
+
+            if cfg.remat:
+                block_fn = jax.checkpoint(
+                    block_fn,
+                    policy=resolve_remat_policy(cfg.remat_policy))
+
+        layer_rngs = jax.random.key_data(jax.random.split(
+            rng if rng is not None else jax.random.key(0), cfg.n_layer))
+
+        def head_loss(hp, y, tgt):
+            # honors loss_chunk like the dense/GPipe path: never
+            # materialize the full per-microbatch (b, T, V) fp32 logits
+            if cfg.loss_chunk and y.shape[1] - 1 > cfg.loss_chunk:
+                return chunked_softmax_xent(
+                    self.head, hp, y[:, :-1], tgt[:, 1:], cfg.loss_chunk)
+            return next_token_xent(self.head(hp, y), tgt)
+
+        head_params = {"wte": params["wte"],
+                       "lnf_scale": params["lnf_scale"],
+                       "lnf_bias": params["lnf_bias"]}
+        x_mb = split_microbatches(x, M)
+        ids_mb = split_microbatches(ids, M)
+        return pipeline_1f1b_loss(
+            block_fn, head_loss, "pipe", params["blocks"], layer_rngs,
+            head_params, x_mb, ids_mb)

@@ -190,3 +190,230 @@ def merge_microbatches(x, batch_dim=0):
     x = jnp.swapaxes(x, 0, 1)
     x = x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:])
     return jnp.moveaxis(x, 0, batch_dim) if batch_dim else x
+
+
+# ------------------------------------------------------------- 1F1B executor
+def _ring_capacity(S):
+    """Saved-input slots per stage under interleaved 1F1B: stage s holds a
+    microbatch's input from its forward (tick m + s) until its backward
+    (tick m + 2(S-1) - s) — at most 2(S-1) in flight, capacity 2S with
+    slack. Independent of the microbatch count M: the memory property the
+    whole schedule exists for."""
+    return 2 * S
+
+
+def pipeline_1f1b_grads(block_fn, head_loss_fn, layers_params, layers_aux,
+                        head_params, x_mb, tgt_mb, *, pipe_axis="pipe"):
+    """Interleaved-1F1B pipelined training pass: mean loss over M
+    microbatches AND all gradients, in ONE jitted SPMD program.
+
+    The reference executes 1F1B imperatively (_exec_schedule,
+    runtime/pipe/engine.py:1382 walking schedule.py:189's TrainSchedule);
+    here the same interleave is a lax.scan over ticks inside a shard_map
+    manual on the pipe axis. Per tick every stage does one FORWARD step
+    (microbatch t - s) and one BACKWARD step (microbatch t - 2(S-1) + s):
+    the backward wave chases the forward wave S-1 ticks behind, so saved
+    block inputs live in a fixed-size ring (``_ring_capacity``) rather
+    than growing with M — unlike autodiff-of-the-GPipe-scan, which keeps
+    every tick's residuals.
+
+    Per-block backward recomputes the forward under ``jax.vjp`` from the
+    ring-saved input (activation checkpointing, the reference's trade).
+    The last stage seeds each microbatch's cotangent from
+    ``head_loss_fn(head_params, y, tgt)`` the same tick it computes y.
+
+    Args:
+      block_fn: ``(x, layer_params_slice, layer_aux_slice) -> x``.
+      head_loss_fn: ``(head_params, y_mb, tgt_mb) -> scalar`` per-mb loss.
+      layers_params: differentiable stacked layers, leading dim L,
+        sharded P(pipe_axis).
+      layers_aux: non-differentiable per-layer inputs (rng key DATA,
+        uint32 — wrap back with jax.random.wrap_key_data in block_fn),
+        leading dim L, sharded P(pipe_axis).
+      head_params / x_mb / tgt_mb: replicated over the pipe axis
+        (x/tgt leaves lead with M).
+
+    Returns (loss, (dlayers_params, dhead_params, dx_mb)).
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    S = mesh.shape[pipe_axis]
+    M = _leading(x_mb)
+    R = _ring_capacity(S)
+    n_ticks = M + 2 * (S - 1)
+    f32_boundary = jax.default_backend() == "cpu"
+
+    def _b(x):
+        """Boundary-safe collective dtype (see spmd_pipeline)."""
+        if f32_boundary and jnp.issubdtype(x.dtype, jnp.floating) \
+                and jnp.finfo(x.dtype).bits < 32:
+            return jnp.float32
+        return x.dtype
+
+    def stage_fn(lp, la, hp, x_mb, tgt_mb):
+        sid = lax.axis_index(pipe_axis)
+        # Promote head params to pipe-varying BEFORE any vjp against
+        # them: differentiating w.r.t. a pipe-INVARIANT value inside
+        # shard_map makes the transpose insert an implicit cross-stage
+        # psum (the adjoint of the invariant->varying promotion), which
+        # would multiply the masked-accumulate-then-psum pattern by S.
+        hp = jax.tree.map(
+            lambda p: lax.pcast(p, (pipe_axis,), to="varying"), hp)
+        perm_f = [(i, (i + 1) % S) for i in range(S)]
+        perm_b = [(i, (i - 1) % S) for i in range(S)]
+
+        def fwd_local(x, lp):
+            def body(c, sl):
+                p, a = sl
+                return block_fn(c, p, a), None
+            y, _ = lax.scan(body, x, (lp, la))
+            return y
+
+        def vz(x, dt=None):
+            z = lax.pcast(
+                jnp.zeros(x.shape, _b(x)), (pipe_axis,), to="varying")
+            return z.astype(dt or x.dtype)
+
+        x0 = jax.tree.map(lambda x: x[0], x_mb)
+        act0 = jax.tree.map(vz, x0)
+        dy0 = jax.tree.map(vz, x0)
+        ring0 = jax.tree.map(
+            lambda x: jnp.tile(vz(x)[None], (R,) + (1,) * x.ndim), x0)
+        gacc0 = jax.tree.map(lambda p: vz(p, jnp.float32), lp)
+        hacc0 = jax.tree.map(
+            lambda p: lax.pcast(jnp.zeros(p.shape, jnp.float32),
+                                (pipe_axis,), to="varying"), hp)
+        dx0 = jax.tree.map(
+            lambda x: jnp.zeros((M,) + x.shape[1:], _b(x)), x_mb)
+        dx0 = jax.tree.map(
+            lambda x: lax.pcast(x, (pipe_axis,), to="varying"), dx0)
+        loss0 = lax.pcast(jnp.zeros((), jnp.float32), (pipe_axis,),
+                          to="varying")
+
+        def tick(carry, t):
+            act_in, dy_in, ring, gacc, hacc, dx_out, loss_acc = carry
+            # ---------- forward half: stage s runs microbatch t - s
+            f_idx = t - sid
+            f_valid = (f_idx >= 0) & (f_idx < M)
+            f_safe = jnp.clip(f_idx, 0, M - 1)
+            # f_safe is pipe-varying (depends on sid), so indexing the
+            # replicated x_mb already yields a varying value — no pcast
+            inject = jax.tree.map(
+                lambda x, a: x[f_safe].astype(a.dtype), x_mb, act_in)
+            x_in = jax.tree.map(
+                lambda i, a: jnp.where(sid == 0, i, a), inject, act_in)
+            y = fwd_local(x_in, lp)
+            slot = f_safe % R
+            ring = jax.tree.map(
+                lambda r, x: r.at[slot].set(
+                    jnp.where(f_valid, x, r[slot])), ring, x_in)
+
+            # last stage: per-microbatch loss + cotangent seed (cotangent
+            # of the MEAN over M, hence the 1/M seed)
+            tgt = jax.tree.map(lambda x: x[f_safe], tgt_mb)
+            l_mb, vjp_h = jax.vjp(lambda hp, y: head_loss_fn(hp, y, tgt),
+                                  hp, y)
+            seed = lax.pcast(jnp.float32(1.0 / M), (pipe_axis,),
+                             to="varying")
+            dhp, dy_seed = vjp_h(seed)
+            seed_valid = f_valid & (sid == S - 1)
+            loss_acc = loss_acc + jnp.where(seed_valid, l_mb, 0.0)
+            hacc = jax.tree.map(
+                lambda a, g: a + jnp.where(seed_valid,
+                                           g.astype(jnp.float32), 0.0),
+                hacc, dhp)
+
+            # ---------- backward half: stage s runs microbatch
+            # t - 2(S-1) + s; the last stage consumes its own seed
+            b_idx = t - 2 * (S - 1) + sid
+            b_valid = (b_idx >= 0) & (b_idx < M)
+            b_safe = jnp.clip(b_idx, 0, M - 1)
+            dy = jax.tree.map(
+                lambda s_, d: jnp.where(sid == S - 1,
+                                        s_.astype(d.dtype), d),
+                dy_seed, dy_in)
+            x_saved = jax.tree.map(lambda r: r[b_safe % R], ring)
+            _, vjp_blk = jax.vjp(fwd_local, x_saved, lp)
+            dx, dlp = vjp_blk(dy)
+            gacc = jax.tree.map(
+                lambda a, g: a + jnp.where(b_valid,
+                                           g.astype(jnp.float32), 0.0),
+                gacc, dlp)
+            write_dx = (sid == 0) & b_valid
+            dx_out = jax.tree.map(
+                lambda buf, d: buf.at[b_safe].set(
+                    jnp.where(write_dx, d.astype(buf.dtype),
+                              buf[b_safe])),
+                dx_out, dx)
+
+            # rotations: activations forward, cotangents backward
+            act_nxt = jax.tree.map(
+                lambda o: lax.ppermute(
+                    o.astype(_b(o)), pipe_axis, perm_f).astype(o.dtype), y)
+            dy_nxt = jax.tree.map(
+                lambda o: lax.ppermute(
+                    o.astype(_b(o)), pipe_axis, perm_b).astype(o.dtype),
+                dx)
+            return (act_nxt, dy_nxt, ring, gacc, hacc, dx_out,
+                    loss_acc), None
+
+        carry = (act0, dy0, ring0, gacc0, hacc0, dx0, loss0)
+        (act, dy, ring, gacc, hacc, dx_out, loss_acc), _ = lax.scan(
+            tick, carry, jnp.arange(n_ticks))
+
+        loss = lax.psum(loss_acc, pipe_axis) / M
+        # layer grads stay stage-local (P(pipe) like the params); head/dx
+        # live only on their owning stage -> psum broadcasts
+        hgrads = jax.tree.map(lambda a: lax.psum(a, pipe_axis), hacc)
+        dx_mb = jax.tree.map(lambda a: lax.psum(a, pipe_axis), dx_out)
+        return loss, gacc, hgrads, dx_mb
+
+    loss, gacc, hgrads, dx_mb = jax.shard_map(
+        stage_fn,
+        in_specs=(P(pipe_axis), P(pipe_axis), P(), P(), P()),
+        out_specs=(P(), P(pipe_axis), P(), P()),
+        axis_names={pipe_axis},
+    )(layers_params, layers_aux, head_params, x_mb, tgt_mb)
+    dlayers = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                           gacc, layers_params)
+    dhead = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                         hgrads, head_params)
+    dx_mb = jax.tree.map(lambda g, x: g.astype(x.dtype), dx_mb, x_mb)
+    return loss, (dlayers, dhead, dx_mb)
+
+
+import functools as _functools
+import numpy as _np
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def pipeline_1f1b_loss(block_fn, head_loss_fn, pipe_axis, layers_params,
+                       layers_aux, head_params, x_mb, tgt_mb):
+    """Differentiable wrapper over :func:`pipeline_1f1b_grads`: returns
+    the mean microbatch loss; ``jax.grad`` through it yields the grads the
+    1F1B pass already computed (stored as vjp residuals), so the engine's
+    ordinary value_and_grad drives the pipelined schedule unchanged."""
+    loss, _ = pipeline_1f1b_grads(
+        block_fn, head_loss_fn, layers_params, layers_aux, head_params,
+        x_mb, tgt_mb, pipe_axis=pipe_axis)
+    return loss
+
+
+def _pl_fwd(block_fn, head_loss_fn, pipe_axis, layers_params, layers_aux,
+            head_params, x_mb, tgt_mb):
+    loss, (dl, dh, dx) = pipeline_1f1b_grads(
+        block_fn, head_loss_fn, layers_params, layers_aux, head_params,
+        x_mb, tgt_mb, pipe_axis=pipe_axis)
+    # the int-dtype primals ride along so the bwd rule can shape their
+    # float0 cotangents
+    return loss, (dl, dh, dx, layers_aux, tgt_mb)
+
+
+def _pl_bwd(block_fn, head_loss_fn, pipe_axis, res, g):
+    dl, dh, dx, layers_aux, tgt_mb = res
+    scale = lambda tr: jax.tree.map(lambda a: (a * g).astype(a.dtype), tr)
+    f0 = lambda tr: jax.tree.map(
+        lambda a: _np.zeros(a.shape, jax.dtypes.float0), tr)
+    return (scale(dl), f0(layers_aux), scale(dh), scale(dx), f0(tgt_mb))
+
+
+pipeline_1f1b_loss.defvjp(_pl_fwd, _pl_bwd)

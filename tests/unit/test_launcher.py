@@ -122,7 +122,7 @@ class TestSlurmRunner:
         # coordinator resolves from Slurm's OWN node ordering at runtime
         # (srun sorts --nodelist; rank 0 must own the coordinator port)
         assert ("COORDINATOR_ADDRESS=$(scontrol show hostnames "
-                "$SLURM_JOB_NODELIST | head -n1):8476") in inner
+                '"$SLURM_JOB_NODELIST" | head -n1):8476') in inner
         assert "train.py --lr 1e-4" in inner
         # static rendezvous values must NOT leak into the shared exports
         assert "PROCESS_ID=0" not in inner

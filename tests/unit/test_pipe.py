@@ -429,3 +429,71 @@ class TestGPT2Pipe:
         l1 = float(engine.train_batch({"input_ids": ids}))
         assert np.isfinite(l0) and np.isfinite(l1)
         assert l1 < l0  # optimizing the same batch must reduce loss
+
+
+class Test1F1BSchedule:
+    """pipe_schedule='1f1b': the interleaved executor
+    (runtime/pipe/spmd.py pipeline_1f1b_grads; reference
+    runtime/pipe/engine.py:1382 _exec_schedule + schedule.py:189
+    TrainSchedule as executed behavior, not schedule objects)."""
+
+    def _setup(self, sched, M, n_layer=4, pipe=4, data=2):
+        from dataclasses import replace
+        from deepspeed_tpu.models import GPT2Pipe
+        from deepspeed_tpu.models.gpt2 import GPT2Config
+        cfg = GPT2Config(n_layer=n_layer, n_head=4, d_model=128,
+                         max_seq_len=32, vocab_size=256, dtype="float32",
+                         remat=True, pipe_microbatches=M,
+                         pipe_schedule=sched)
+        groups.reset()
+        topo = groups.initialize(TopologyConfig(data_parallel_size=data,
+                                                pipe_parallel_size=pipe))
+        model = GPT2Pipe(cfg)
+        params = model.init(jax.random.key(0))
+        rng = np.random.RandomState(0)
+        batch = {"input_ids": jnp.asarray(
+            rng.randint(0, 256, (16, 32)), jnp.int32)}
+        return topo, model, params, batch
+
+    def test_loss_and_grad_parity_with_gpipe(self):
+        res = {}
+        for sched in ("gpipe", "1f1b"):
+            topo, model, params, batch = self._setup(sched, M=8)
+            with jax.set_mesh(topo.mesh):
+                loss, grads = jax.jit(jax.value_and_grad(
+                    lambda p: model.loss(p, batch,
+                                         rng=jax.random.key(1))))(params)
+            res[sched] = (float(loss), grads)
+        l0, g0 = res["gpipe"]
+        l1, g1 = res["1f1b"]
+        assert abs(l0 - l1) < 1e-5
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-3, atol=2e-4),
+            g0, g1)
+
+    def test_live_activations_bounded_by_stages(self):
+        """The property 1F1B exists for: growing the microbatch count
+        grows GPipe's live residual memory (every tick's activations are
+        saved for autodiff) but NOT 1F1B's (fixed 2S-slot input ring,
+        backward chases forward). Measured from XLA's own buffer
+        assignment, not inferred."""
+        grown = {}
+        for sched in ("gpipe", "1f1b"):
+            temps = []
+            for M in (4, 16):
+                topo, model, params, batch = self._setup(sched, M=M)
+                with jax.set_mesh(topo.mesh):
+                    c = jax.jit(jax.value_and_grad(
+                        lambda p: model.loss(p, batch,
+                                             rng=jax.random.key(1)))
+                                ).lower(params).compile()
+                temps.append(c.memory_analysis().temp_size_in_bytes)
+            grown[sched] = temps[1] - temps[0]
+        # gpipe grows with M; 1f1b must grow far less (ring is
+        # M-independent; small scheduling buffers may still vary)
+        assert grown["1f1b"] < 0.5 * grown["gpipe"], grown
+
+    def test_ring_capacity_is_stage_bound(self):
+        from deepspeed_tpu.runtime.pipe.spmd import _ring_capacity
+        assert _ring_capacity(4) == 8      # independent of microbatches
