@@ -32,6 +32,10 @@ def main():
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
+    offload = os.environ.get("BENCH_OFFLOAD", "")
+    if offload not in ("", "cpu", "nvme"):
+        raise SystemExit(f"BENCH_OFFLOAD must be ''|cpu|nvme, "
+                         f"got {offload!r}")
 
     cfg = PRESETS[preset]
     from dataclasses import replace
@@ -72,7 +76,14 @@ def main():
                           "params": {"lr": 2e-4, "weight_decay": 0.01}},
             "gradient_clipping": 1.0,
             "bf16": {"enabled": True},
-            "zero_optimization": {"stage": stage},
+            "zero_optimization": (
+                {"stage": stage,
+                 "offload_optimizer": (
+                     {"device": "nvme",
+                      "nvme_path": os.environ.get("BENCH_NVME_PATH",
+                                                  "/tmp/dstpu_nvme")}
+                     if offload == "nvme" else {"device": "cpu"})}
+                if offload else {"stage": stage}),
         })
 
     bsz = engine.config.train_batch_size
@@ -104,7 +115,9 @@ def main():
 
     a100_baseline = 312e12 * 0.40 / flops_per_token  # tokens/sec/chip
     print(json.dumps({
-        "metric": f"gpt2-{preset} zero{stage} bf16 training throughput",
+        "metric": (f"gpt2-{preset} zero{stage}"
+                   + (f"-offload-{offload}" if offload else "")
+                   + " bf16 training throughput"),
         "value": round(tok_per_sec_chip, 1),
         "unit": "tokens/sec/chip",
         "vs_baseline": round(tok_per_sec_chip / a100_baseline, 3),

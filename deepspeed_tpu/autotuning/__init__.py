@@ -1,2 +1,3 @@
 from .autotuner import Autotuner, ModelInfo
-from .tuner import GridSearchTuner, RandomTuner
+from .scheduler import Node, Reservation, ResourceManager, SubprocessRunner
+from .tuner import CostModel, GridSearchTuner, ModelBasedTuner, RandomTuner

@@ -5,3 +5,5 @@ from .gpt2_pipe import GPT2Pipe
 from .llama import (Llama, LlamaConfig, LLAMA_PRESETS, LLAMA_TINY,
                     LLAMA2_7B, MISTRAL_7B)
 from .mixtral import Mixtral, MixtralConfig, MIXTRAL_TINY, MIXTRAL_8X7B
+from .qwen import Qwen, QwenConfig, QWEN_PRESETS
+from .phi import Phi, PhiConfig, PHI_PRESETS

@@ -56,6 +56,9 @@ class LlamaConfig:
     # falcon/phi parallel residual: x + attn(ln1 x) + mlp(ln2 x) instead
     # of the sequential two-residual block
     parallel_block: bool = False
+    # 'rms' (llama/qwen/mixtral) or 'ln' (falcon/phi LayerNorm with
+    # learned bias; adds b1/b2/norm_f_b params)
+    norm_type: str = "rms"
 
     @property
     def d_head(self):
@@ -75,8 +78,11 @@ class LlamaConfig:
                  + (3 if self.mlp_gated else 2) * D * F)
         if self.qkv_bias:
             block += D + 2 * kvd
+        if self.norm_type == "ln":
+            block += 2 * D                   # norm biases
         head = 0 if self.tie_embeddings else V * D
-        return V * D + self.n_layer * block + D + head
+        extra_f = D if self.norm_type == "ln" else 0
+        return V * D + self.n_layer * block + D + extra_f + head
 
     def flops_per_token(self):
         n = self.num_params() - self.vocab_size * self.d_model
@@ -99,6 +105,14 @@ def _rms_norm(x, scale, eps):
     var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
     return (x32 * lax.rsqrt(var + eps)
             * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    return ((x32 - mu) * lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+            + bias.astype(jnp.float32)).astype(x.dtype)
 
 
 def _rope(x, pos, theta):
@@ -166,6 +180,10 @@ class Llama:
             params["blocks"]["bq"] = jnp.zeros((L, D), dt)
             params["blocks"]["bk"] = jnp.zeros((L, kvd), dt)
             params["blocks"]["bv"] = jnp.zeros((L, kvd), dt)
+        if cfg.norm_type == "ln":
+            params["blocks"]["b1"] = jnp.zeros((L, D), dt)
+            params["blocks"]["b2"] = jnp.zeros((L, D), dt)
+            params["norm_f_b"] = jnp.zeros((D,), dt)
         if not cfg.tie_embeddings:
             params["lm_head"] = nrm(next(k), (V, D))
         return params
@@ -194,6 +212,10 @@ class Llama:
             specs["blocks"]["bq"] = P(None, "tensor")
             specs["blocks"]["bk"] = P(None, "tensor")
             specs["blocks"]["bv"] = P(None, "tensor")
+        if self.config.norm_type == "ln":
+            specs["blocks"]["b1"] = P(None, None)
+            specs["blocks"]["b2"] = P(None, None)
+            specs["norm_f_b"] = P()
         if not self.config.tie_embeddings:
             specs["lm_head"] = P()
         return specs
@@ -202,8 +224,20 @@ class Llama:
     def _constrain_fn(self):
         return constrain_fn()
 
+    def _norm(self, x, layer, which):
+        """Block norm dispatch: 'rms' (llama) or 'ln' (falcon/phi)."""
+        cfg = self.config
+        if cfg.norm_type == "ln":
+            return _layer_norm(x, layer[f"rms{which}"], layer[f"b{which}"],
+                               cfg.rms_eps)
+        return _rms_norm(x, layer[f"rms{which}"], cfg.rms_eps)
+
     def head(self, params, x):
-        x = _rms_norm(x, params["norm_f"], self.config.rms_eps)
+        if self.config.norm_type == "ln":
+            x = _layer_norm(x, params["norm_f"], params["norm_f_b"],
+                            self.config.rms_eps)
+        else:
+            x = _rms_norm(x, params["norm_f"], self.config.rms_eps)
         w = params["wte"] if self.config.tie_embeddings else \
             params["lm_head"]
         return jnp.einsum("btd,vd->btv", x, w,
@@ -213,7 +247,7 @@ class Llama:
         cfg = self.config
         B, T = x.shape[0], x.shape[1]
         H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
-        h = _rms_norm(x, layer["rms1"], cfg.rms_eps)
+        h = self._norm(x, layer, 1)
         q = h @ layer["wq"]
         kk = h @ layer["wk"]
         v = h @ layer["wv"]
@@ -240,7 +274,7 @@ class Llama:
 
     def _mlp(self, x, layer):
         cfg = self.config
-        h = _rms_norm(x, layer["rms2"], cfg.rms_eps)
+        h = self._norm(x, layer, 2)
         if not cfg.mlp_gated:                 # falcon/phi plain-gelu MLP
             return jax.nn.gelu(h @ layer["wup"]) @ layer["wdown"]
         gate = jax.nn.silu(h @ layer["wgate"])
@@ -357,8 +391,11 @@ class Llama:
             layer, kc, vc = xs
             x = carry
             q, kk, v = self._attn_proj(x, layer)
-            q = _rope(q, pos_ids, cfg.rope_theta)
-            kk = _rope(kk, pos_ids, cfg.rope_theta)
+            # self._rope honors rotary_pct (phi partial rotary) — the
+            # module-level _rope would silently diverge v1 decode from
+            # training/prefill/v2 for those families
+            q = self._rope(q, pos_ids)
+            kk = self._rope(kk, pos_ids)
             kc = lax.dynamic_update_slice(kc, kk.astype(kc.dtype),
                                           (0, slot, 0, 0))
             vc = lax.dynamic_update_slice(vc, v.astype(vc.dtype),

@@ -1,0 +1,49 @@
+"""Phi model family — parallel attention/MLP block, partial rotary, LN.
+
+Counterpart of the reference's Phi serving support
+(inference/v2/model_implementations/phi/{model,policy}.py): LayerNorm
+with bias (not RMSNorm), rotary applied to only a fraction of each head
+(phi-2: 0.4), a plain-GELU (non-gated) MLP, and the PARALLEL residual
+form x + attn(ln x) + mlp(ln x) — phi shares one input LayerNorm
+between the two branches, realized here by pointing both branch norms
+at the same parameters at load time (init keeps them separate but
+identical; the math is identical while they remain tied).
+
+Training, v1 decoding, and v2 paged serving all inherit from
+:class:`~.llama.Llama` through its architecture knobs
+(parallel_block/rotary_pct/mlp_gated/norm_type) — the family is the
+config point.
+"""
+
+from dataclasses import dataclass
+
+from .llama import Llama, LlamaConfig
+
+
+@dataclass(frozen=True)
+class PhiConfig(LlamaConfig):
+    parallel_block: bool = True
+    rotary_pct: float = 0.4              # phi-2 partial rotary factor
+    mlp_gated: bool = False              # plain gelu MLP
+    norm_type: str = "ln"                # LayerNorm with bias
+    qkv_bias: bool = True                # phi projects with bias
+
+
+PHI_TINY = PhiConfig(n_layer=2, n_head=4, n_kv_heads=4, d_model=128,
+                     max_seq_len=128, vocab_size=512, remat=False)
+# phi-2 point (config.json: 32 layers, 32 heads, hidden 2560,
+# intermediate 10240, rotary over 32 of 80 dims)
+PHI_2 = PhiConfig(n_layer=32, n_head=32, n_kv_heads=32, d_model=2560,
+                  d_ff=10240, max_seq_len=2048, vocab_size=51200)
+
+PHI_PRESETS = {"tiny": PHI_TINY, "phi-2": PHI_2}
+
+
+class Phi(Llama):
+    """Phi: parallel-block partial-rotary LN model on the shared Llama
+    machinery (see module docstring)."""
+
+    def __init__(self, config: PhiConfig):
+        if not config.parallel_block:
+            raise ValueError("Phi requires parallel_block=True")
+        super().__init__(config)

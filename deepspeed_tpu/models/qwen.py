@@ -1,0 +1,48 @@
+"""Qwen model family — Llama-architecture with attention-projection bias.
+
+Counterpart of the reference's Qwen serving support
+(inference/v2/model_implementations/qwen_v2/{model,policy}.py and
+module_inject/containers for Qwen): RMSNorm + RoPE + SwiGLU + GQA like
+Llama, plus learned biases on the q/k/v projections (the reference's
+qwen containers split exactly those bias tensors for TP). Everything —
+training, v1 contiguous-cache decoding, v2 paged serving on the Pallas
+paged-attention kernel — inherits from :class:`~.llama.Llama`; the
+family is the config point, which is the honest TPU translation of the
+reference's per-family policy classes (they exist to map HF module
+trees; here the functional model IS the tree).
+"""
+
+from dataclasses import dataclass
+
+from .llama import Llama, LlamaConfig
+
+
+@dataclass(frozen=True)
+class QwenConfig(LlamaConfig):
+    qkv_bias: bool = True                # the family's distinguishing knob
+    rope_theta: float = 1000000.0        # qwen2 long-context base
+    vocab_size: int = 151936
+
+
+QWEN_TINY = QwenConfig(n_layer=2, n_head=4, n_kv_heads=2, d_model=128,
+                       max_seq_len=128, vocab_size=512, remat=False)
+# Qwen2-1.5B point (config.json: 28 layers, 12 heads, 2 KV heads,
+# hidden 1536, intermediate 8960)
+QWEN2_1_5B = QwenConfig(n_layer=28, n_head=12, n_kv_heads=2, d_model=1536,
+                        d_ff=8960, max_seq_len=32768, tie_embeddings=True)
+QWEN2_7B = QwenConfig(n_layer=28, n_head=28, n_kv_heads=4, d_model=3584,
+                      d_ff=18944, max_seq_len=32768)
+
+QWEN_PRESETS = {"tiny": QWEN_TINY, "qwen2-1.5b": QWEN2_1_5B,
+                "qwen2-7b": QWEN2_7B}
+
+
+class Qwen(Llama):
+    """Qwen: Llama forward/caching/serving with qkv bias enabled via
+    config; subclass exists so engines and tooling can name the family
+    (mirrors the reference's per-family model_implementations)."""
+
+    def __init__(self, config: QwenConfig):
+        if not config.qkv_bias:
+            raise ValueError("Qwen requires qkv_bias=True")
+        super().__init__(config)

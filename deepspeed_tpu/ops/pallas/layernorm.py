@@ -194,3 +194,47 @@ def fused_layernorm(x, scale, bias, *, eps=1e-5, block_rows=256,
     if N_pad != N:
         y = y[:N]
     return y.reshape(*lead, D)
+
+
+# ------------------------------------------------------------------ rmsnorm
+def _rms_fwd_kernel(x_ref, s_ref, y_ref, *, eps):
+    x = x_ref[...].astype(jnp.float32)                    # (R, D)
+    var = jnp.mean(x * x, axis=1, keepdims=True)
+    y_ref[...] = (x * jax.lax.rsqrt(var + eps)
+                  * s_ref[...].astype(jnp.float32)).astype(y_ref.dtype)
+
+
+def fused_rmsnorm(x, scale, *, eps=1e-5, block_rows=256, interpret=None):
+    """One-pass RMSNorm Pallas kernel over the last dim (the serving
+    models' norm; reference csrc/transformer/inference/csrc/rms_norm.cu).
+    Forward-only: the jnp-vs-Pallas decision for the v1 serving tier is
+    measured by benchmarks/kernel_microbench.py and recorded in
+    PERF_NOTES — like fused_layernorm, XLA's fused jnp form wins inside
+    real programs on v5e, so models default to jnp and this kernel
+    documents the measured alternative."""
+    if interpret is None:
+        interpret = _interpret_default()
+    D = x.shape[-1]
+    if D % 128:
+        raise ValueError(f"fused_rmsnorm needs D % 128 == 0, got {D}")
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, D)
+    N = x2.shape[0]
+    br = max(8, min(block_rows, _round_up(N, 8)))
+    N_pad = _round_up(N, br)
+    if N_pad != N:
+        x2 = jnp.pad(x2, ((0, N_pad - N), (0, 0)))
+    y = pl.pallas_call(
+        functools.partial(_rms_fwd_kernel, eps=eps),
+        grid=(N_pad // br,),
+        in_specs=[
+            pl.BlockSpec((br, D), lambda i: (i, 0)),
+            pl.BlockSpec((1, D), lambda i: (0, 0)),
+        ],
+        out_specs=pl.BlockSpec((br, D), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((N_pad, D), x.dtype),
+        interpret=interpret,
+    )(x2, scale.reshape(1, D))
+    if N_pad != N:
+        y = y[:N]
+    return y.reshape(*lead, D)

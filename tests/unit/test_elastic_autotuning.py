@@ -167,3 +167,79 @@ class TestAutotunerCustomSpace:
         c = t._exp_config({"zero_stage": 2, "micro_batch": 2})
         assert c["zero_optimization"] == {"stage": 2,
                                           "overlap_comm": False}
+
+
+
+class TestResourceScheduler:
+    """reference autotuning/scheduler.py ResourceManager: slot
+    reservation over a node pool, concurrent trial execution, and the
+    model-based tuner driven in capacity-sized rounds."""
+
+    def test_concurrent_capacity_respected(self):
+        import threading, time
+        from deepspeed_tpu.autotuning import ResourceManager
+        rm = ResourceManager([("h0", 2), ("h1", 1)])
+        assert rm.capacity == 3
+        live = []
+        peak = []
+        lock = threading.Lock()
+
+        def run_fn(exp, res):
+            with lock:
+                live.append(exp)
+                peak.append(len(live))
+            time.sleep(0.05)
+            with lock:
+                live.remove(exp)
+            return {"samples_per_sec": exp["v"], "host": res.node.host}
+
+        results = rm.run([{"v": i} for i in range(7)], run_fn)
+        assert [r["samples_per_sec"] for r in results] == list(range(7))
+        assert max(peak) <= 3            # never above pool capacity
+        assert max(peak) >= 2            # and actually concurrent
+        # every slot returned to the pool
+        assert sum(len(n.free) for n in rm.nodes) == 3
+
+    def test_trial_failure_is_data(self):
+        from deepspeed_tpu.autotuning import ResourceManager
+        rm = ResourceManager([("h0", 1)])
+
+        def run_fn(exp, res):
+            if exp["v"] == 1:
+                raise RuntimeError("oom")
+            return {"samples_per_sec": 1.0}
+
+        results = rm.run([{"v": 0}, {"v": 1}, {"v": 2}], run_fn)
+        assert results[1]["error"].startswith("RuntimeError")
+        assert results[0]["samples_per_sec"] == 1.0
+        assert len(rm.nodes[0].free) == 1
+
+    def test_model_based_rounds_find_optimum(self):
+        from deepspeed_tpu.autotuning import ResourceManager
+        rm = ResourceManager([("h0", 2)])
+        space = {"micro_bs": [1, 2, 4, 8, 16, 32], "stage": [0, 1, 2, 3]}
+
+        def run_fn(exp, res):
+            return {"samples_per_sec":
+                    -abs(exp["micro_bs"] - 16) - 3 * abs(exp["stage"] - 2)}
+
+        best_exp, best_res, all_r = rm.run_model_based(
+            space, run_fn, max_trials=14)
+        assert best_exp == {"micro_bs": 16, "stage": 2}
+        assert len(all_r) <= 14
+
+    def test_subprocess_runner_parses_json_line(self, tmp_path):
+        from deepspeed_tpu.autotuning import (Node, Reservation,
+                                              SubprocessRunner)
+        script = tmp_path / "exp.py"
+        script.write_text(
+            "import json, sys, os\n"
+            "exp = json.loads(sys.argv[sys.argv.index('--exp')+1])\n"
+            "print('noise')\n"
+            "print(json.dumps({'samples_per_sec': exp['v'] * 2,\n"
+            "                  'slots': os.environ['DSTPU_EXP_SLOTS']}))\n")
+        run = SubprocessRunner(str(script), timeout_s=60)
+        res = Reservation(Node("localhost", 4), [0, 1])
+        out = run({"v": 21}, res)
+        assert out["samples_per_sec"] == 42
+        assert out["slots"] == "0,1"

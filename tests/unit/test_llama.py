@@ -197,3 +197,111 @@ class TestMixtral:
                 jnp.asarray(valid))
             tok = int(np.argmax(np.asarray(logits)[0, -1]))
         np.testing.assert_array_equal(got, np.asarray(toks, np.int32))
+
+
+
+class TestQwen:
+    """Qwen family (reference inference/v2/model_implementations/
+    qwen_v2): Llama + attention-projection bias."""
+
+    def _model(self):
+        from deepspeed_tpu.models import Qwen
+        from deepspeed_tpu.models.qwen import QWEN_TINY
+        from dataclasses import replace
+        return Qwen(replace(QWEN_TINY, dtype="float32"))
+
+    def test_param_count_includes_bias(self):
+        m = self._model()
+        params = m.init(jax.random.key(0))
+        n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert n == m.config.num_params()
+        assert "bq" in params["blocks"]          # the family knob is real
+
+    def test_paged_serving_end_to_end(self):
+        """v2 paged decode == contiguous-cache decode token for token
+        (greedy) — the Qwen serving path end to end."""
+        from deepspeed_tpu.inference.v2.engine_v2 import (
+            InferenceEngineV2, RaggedInferenceEngineConfig)
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        m = self._model()
+        groups.reset()
+        rng = np.random.RandomState(3)
+        prompts = [rng.randint(0, 500, (n,)).astype(np.int32)
+                   for n in (7, 12)]
+        v2 = InferenceEngineV2(
+            m, RaggedInferenceEngineConfig(max_batch_size=2,
+                                           kv_block_size=16,
+                                           prompt_bucket=16))
+        uids = [v2.put(p, max_new_tokens=6, eos_token_id=-1)
+                for p in prompts]
+        while v2.has_work:
+            v2.step()
+        got = {u: np.asarray(v2.get(u)) for u in uids}
+        groups.reset()
+        ref = InferenceEngine(m, config={"dtype": "float32",
+                                         "prompt_bucket": 16})
+        for u, p in zip(uids, prompts):
+            want = np.asarray(ref.generate(p[None], max_new_tokens=6,
+                                           temperature=0.0))[0]
+            np.testing.assert_array_equal(got[u][len(p):],
+                                          want[len(p):])
+
+
+class TestPhi:
+    """Phi family (reference inference/v2/model_implementations/phi):
+    parallel attention/MLP block, partial rotary, LayerNorm with bias,
+    plain-gelu MLP."""
+
+    def _model(self):
+        from deepspeed_tpu.models import Phi
+        from deepspeed_tpu.models.phi import PHI_TINY
+        from dataclasses import replace
+        return Phi(replace(PHI_TINY, dtype="float32"))
+
+    def test_param_count_includes_ln_biases(self):
+        m = self._model()
+        params = m.init(jax.random.key(0))
+        n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert n == m.config.num_params()
+        assert "b1" in params["blocks"] and "norm_f_b" in params
+
+    def test_partial_rotary_leaves_tail_dims(self):
+        """rotary_pct < 1: trailing head dims pass through unrotated."""
+        import jax.numpy as jnp
+        m = self._model()
+        x = jnp.asarray(np.random.RandomState(0).randn(1, 4, 4, 32),
+                        jnp.float32)
+        pos = jnp.arange(4)[None, :]
+        y = m._rope(x, pos)
+        rot = max(2, int(32 * m.config.rotary_pct)) // 2 * 2
+        np.testing.assert_array_equal(np.asarray(y[..., rot:]),
+                                      np.asarray(x[..., rot:]))
+        assert not np.allclose(np.asarray(y[..., 1:rot]),
+                               np.asarray(x[..., 1:rot]))
+
+    def test_paged_serving_end_to_end(self):
+        from deepspeed_tpu.inference.v2.engine_v2 import (
+            InferenceEngineV2, RaggedInferenceEngineConfig)
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        m = self._model()
+        groups.reset()
+        rng = np.random.RandomState(4)
+        prompts = [rng.randint(0, 500, (n,)).astype(np.int32)
+                   for n in (5, 11)]
+        v2 = InferenceEngineV2(
+            m, RaggedInferenceEngineConfig(max_batch_size=2,
+                                           kv_block_size=16,
+                                           prompt_bucket=16))
+        uids = [v2.put(p, max_new_tokens=6, eos_token_id=-1)
+                for p in prompts]
+        while v2.has_work:
+            v2.step()
+        got = {u: np.asarray(v2.get(u)) for u in uids}
+        groups.reset()
+        ref = InferenceEngine(m, config={"dtype": "float32",
+                                         "prompt_bucket": 16})
+        for u, p in zip(uids, prompts):
+            want = np.asarray(ref.generate(p[None], max_new_tokens=6,
+                                           temperature=0.0))[0]
+            np.testing.assert_array_equal(got[u][len(p):],
+                                          want[len(p):])

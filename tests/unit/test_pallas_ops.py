@@ -490,3 +490,16 @@ class TestFusedLayerNorm:
         for a, br_ in zip(g, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(br_),
                                        rtol=1e-4, atol=1e-4)
+
+
+class TestFusedRMSNorm:
+    def test_matches_jnp(self):
+        from deepspeed_tpu.ops.pallas.layernorm import fused_rmsnorm
+        from deepspeed_tpu.models.llama import _rms_norm
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(3, 37, 256), jnp.float32)
+        s = jnp.asarray(1 + 0.1 * rng.randn(256), jnp.float32)
+        y = fused_rmsnorm(x, s, interpret=True)
+        np.testing.assert_allclose(np.asarray(y),
+                                   np.asarray(_rms_norm(x, s, 1e-5)),
+                                   rtol=1e-5, atol=1e-5)
