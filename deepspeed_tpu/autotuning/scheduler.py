@@ -105,9 +105,8 @@ class ResourceManager:
     """
 
     def __init__(self, nodes):
-        self.nodes = [Node(h, s) if not isinstance(h, Node) else h
-                      for h, s in nodes] if nodes and not isinstance(
-                          nodes[0], Node) else list(nodes)
+        self.nodes = [n if isinstance(n, Node) else Node(*n)
+                      for n in nodes]
         self._lock = threading.Lock()
 
     @property
@@ -190,17 +189,20 @@ class ResourceManager:
                 break
             results = self._run_batch(batch, run_fn, slots_per_exp)
             for exp, res in zip(batch, results):
-                # failed trials rank below EVERY real measurement —
-                # recording 0.0 would beat any negative-metric result
-                if res.get("error"):
-                    val = float("-inf")
+                failed = bool(res.get("error")) or metric not in res
+                if failed:
+                    # keep failed trials OUT of the cost-model fit (an
+                    # -inf observation makes the ridge solve NaN and
+                    # silently degrades every later model-guided pick);
+                    # marking them pending-forever excludes them from
+                    # both re-proposal and best()
+                    tuner._pending.append(exp)
                 else:
-                    val = float(res.get(metric, float("-inf")))
-                tuner.record(exp, val)
+                    tuner.record(exp, float(res[metric]))
                 all_results.append((exp, res))
-        best_exp, best_val = tuner.best()
-        if best_val == float("-inf"):
+        if not tuner.observed:
             raise RuntimeError(
                 "model-based tuning: every trial failed; see results")
+        best_exp, _ = tuner.best()
         best_res = next(r for e, r in all_results if e == best_exp)
         return best_exp, best_res, all_results

@@ -50,6 +50,7 @@ def resolve_remat_policy(name):
         "save_flash": ("attn_mid", "flash_o", "flash_lse"),
         "save_carry_flash": ("block_out", "flash_o", "flash_lse"),
         "save_both_flash": ("block_out", "attn_mid", "flash_o", "flash_lse"),
+        "save_flash_up": ("attn_mid", "flash_o", "flash_lse", "mlp_up"),
     }
     if name in named:
         return jax.checkpoint_policies.save_only_these_names(*named[name])

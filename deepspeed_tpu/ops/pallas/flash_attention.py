@@ -160,8 +160,9 @@ def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
 
 
 # ----------------------------------------------------------------- backward
-def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real):
+def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
+                dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real,
+                ext_delta):
     """Fused flash backward: dq, dk, dv from ONE s/p computation.
 
     Grid is (BH/bh, T/bk) over key blocks; an inner loop walks the query
@@ -197,7 +198,17 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             q = q_ref[:, pl.ds(i * bq, bq), :]
             do = do_ref[:, pl.ds(i * bq, bq), :]
             lse = lse_ref[:, pl.ds(i * bq, bq), :][..., 0]  # (G, bq)
-            delta = delta_ref[:, pl.ds(i * bq, bq), :][..., 0]
+            if ext_delta:
+                # od_ref carries a precomputed (broadcast) delta — the
+                # lse-cotangent path folds its shift in outside
+                delta = od_ref[:, pl.ds(i * bq, bq), :][..., 0]
+            else:
+                # od_ref is o: delta = rowsum(do * o), computed on the
+                # VPU from blocks already resident — no (BH, T, 128)
+                # broadcast materialization, no separate reduce pass
+                ob = od_ref[:, pl.ds(i * bq, bq), :]
+                delta = jnp.sum(do.astype(jnp.float32)
+                                * ob.astype(jnp.float32), axis=-1)
             s = jax.lax.dot_general(q, kb, _DN_QK,
                                     preferred_element_type=jnp.float32)
             if scale != 1.0:
@@ -235,16 +246,21 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
          interpret, dlse=None):
     BH, T, d = q.shape
-    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
-                    axis=-1)                                # (BH, T)
-    if dlse is not None:
-        # lse cotangent folds into delta (see _flash_bwd)
-        delta = delta - dlse.astype(jnp.float32)
     lse = jnp.broadcast_to(lse_t, (BH, T, 128))
-    delta = jnp.broadcast_to(delta[..., None], (BH, T, 128))
+    if dlse is not None:
+        # lse cotangent shifts delta (see _flash_bwd): precompute the
+        # shifted delta outside and broadcast it to the kernel
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1) - dlse.astype(jnp.float32)
+        od = jnp.broadcast_to(delta[..., None], (BH, T, 128))
+    else:
+        # common case (lse output unused): the kernel computes delta
+        # from o/do blocks in VMEM — no broadcast materialization
+        od = o
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, bq=bq, bk=bk, scale=scale,
-                          causal=causal, t_real=t_real),
+                          causal=causal, t_real=t_real,
+                          ext_delta=dlse is not None),
         grid=(BH // bh, T // bk),
         in_specs=[
             pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
@@ -252,7 +268,8 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
             pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((bh, T, 128), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((bh, T, 128), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, T, 128 if dlse is not None else d),
+                         lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
@@ -265,7 +282,7 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
             _sds((BH, T, d), q.dtype, q),
         ],
         interpret=interpret,
-    )(q, k, v, do, lse, delta)
+    )(q, k, v, do, lse, od)
     if scale != 1.0:
         dq = dq * scale
     return dq.astype(q.dtype), dk, dv
@@ -280,6 +297,8 @@ def _flash(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
 
 def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
     from jax.ad_checkpoint import checkpoint_name
+    # symbolic_zeros=True wraps primal args in CustomVJPPrimal
+    q, k, v = q.value, k.value, v.value
     o, lse = _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
     lse_t = lse[..., :1]                                    # (BH, T, 1)
     # Name o/lse_t HERE, inside the fwd rule, so the named vars are both
@@ -297,6 +316,13 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
 
 def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, res, cts):
     do, dlse = cts
+    from jax.custom_derivatives import SymbolicZero
+    # training drops the lse output -> its cotangent arrives symbolic
+    # and the kernel takes the delta-from-o fast path
+    if isinstance(dlse, SymbolicZero):
+        dlse = None
+    if isinstance(do, SymbolicZero):
+        do = jnp.zeros(do.shape, do.dtype)
     q, k, v, o, lse_t = res
     # lse is a real (differentiable) output: d lse_i / d s_ij = p_ij, so a
     # cotangent on lse enters the shared ds = p * (dp - delta) term as
@@ -306,7 +332,7 @@ def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, res, cts):
                 interpret, dlse=dlse)
 
 
-_flash.defvjp(_flash_fwd, _flash_bwd)
+_flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
 
 
 def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,

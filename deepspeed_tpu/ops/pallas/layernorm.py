@@ -145,6 +145,30 @@ def _ln_hybrid_fwd(x, scale, bias, eps, br, interpret):
 _ln_hybrid.defvjp(_ln_hybrid_fwd, _ln_bwd)
 
 
+def _row_blocked(x, run, block_rows, pad_ok=True):
+    """Shared scaffolding for one-pass row-blocked kernels over the last
+    dim: (..., D) -> reshape (N, D), pad N to the row-block multiple,
+    ``run(x2, br, n_pad)`` produces (N_pad, D), unpad + reshape back.
+    D must be lane-tileable (% 128)."""
+    D = x.shape[-1]
+    if D % 128:
+        raise ValueError(f"fused norm kernels need D % 128 == 0, got {D}")
+    lead = x.shape[:-1]
+    x2 = x.reshape(-1, D)
+    N = x2.shape[0]
+    br = max(8, min(block_rows, _round_up(N, 8)))
+    N_pad = _round_up(N, br)
+    if N_pad != N:
+        # zero-pad rows OUTSIDE any custom_vjp: sliced-output cotangents
+        # arrive zero-padded, so padded rows add 0 to param grads and
+        # their dx is dropped by the slice below
+        x2 = jnp.pad(x2, ((0, N_pad - N), (0, 0)))
+    y = run(x2, br)
+    if N_pad != N:
+        y = y[:N]
+    return y.reshape(*lead, D)
+
+
 def layernorm_fused_bwd(x, scale, bias, *, eps=1e-5, block_rows=256,
                         interpret=None):
     """Hybrid LayerNorm: plain-jnp forward (stays fusable with XLA's
@@ -153,20 +177,9 @@ def layernorm_fused_bwd(x, scale, bias, *, eps=1e-5, block_rows=256,
     single read of x/dy). Same numerics as :func:`fused_layernorm`."""
     if interpret is None:
         interpret = _interpret_default()
-    D = x.shape[-1]
-    if D % 128:
-        raise ValueError(f"layernorm_fused_bwd needs D % 128 == 0, got {D}")
-    lead = x.shape[:-1]
-    x2 = x.reshape(-1, D)
-    N = x2.shape[0]
-    br = max(8, min(block_rows, _round_up(N, 8)))
-    N_pad = _round_up(N, br)
-    if N_pad != N:
-        x2 = jnp.pad(x2, ((0, N_pad - N), (0, 0)))
-    y = _ln_hybrid(x2, scale, bias, float(eps), br, bool(interpret))
-    if N_pad != N:
-        y = y[:N]
-    return y.reshape(*lead, D)
+    return _row_blocked(
+        x, lambda x2, br: _ln_hybrid(x2, scale, bias, float(eps), br,
+                                     bool(interpret)), block_rows)
 
 
 def fused_layernorm(x, scale, bias, *, eps=1e-5, block_rows=256,
@@ -177,23 +190,9 @@ def fused_layernorm(x, scale, bias, *, eps=1e-5, block_rows=256,
     tiling); callers should fall back to a jnp layernorm otherwise."""
     if interpret is None:
         interpret = _interpret_default()
-    D = x.shape[-1]
-    if D % 128:
-        raise ValueError(f"fused_layernorm needs D % 128 == 0, got {D}")
-    lead = x.shape[:-1]
-    x2 = x.reshape(-1, D)
-    N = x2.shape[0]
-    br = max(8, min(block_rows, _round_up(N, 8)))
-    N_pad = _round_up(N, br)
-    if N_pad != N:
-        # zero-pad rows OUTSIDE the custom_vjp: sliced-output cotangents
-        # arrive zero-padded, so padded rows add 0 to dscale/dbias and
-        # their dx is dropped by the slice below
-        x2 = jnp.pad(x2, ((0, N_pad - N), (0, 0)))
-    y = _ln(x2, scale, bias, float(eps), br, bool(interpret))
-    if N_pad != N:
-        y = y[:N]
-    return y.reshape(*lead, D)
+    return _row_blocked(
+        x, lambda x2, br: _ln(x2, scale, bias, float(eps), br,
+                              bool(interpret)), block_rows)
 
 
 # ------------------------------------------------------------------ rmsnorm
@@ -215,26 +214,18 @@ def fused_rmsnorm(x, scale, *, eps=1e-5, block_rows=256, interpret=None):
     if interpret is None:
         interpret = _interpret_default()
     D = x.shape[-1]
-    if D % 128:
-        raise ValueError(f"fused_rmsnorm needs D % 128 == 0, got {D}")
-    lead = x.shape[:-1]
-    x2 = x.reshape(-1, D)
-    N = x2.shape[0]
-    br = max(8, min(block_rows, _round_up(N, 8)))
-    N_pad = _round_up(N, br)
-    if N_pad != N:
-        x2 = jnp.pad(x2, ((0, N_pad - N), (0, 0)))
-    y = pl.pallas_call(
-        functools.partial(_rms_fwd_kernel, eps=eps),
-        grid=(N_pad // br,),
-        in_specs=[
-            pl.BlockSpec((br, D), lambda i: (i, 0)),
-            pl.BlockSpec((1, D), lambda i: (0, 0)),
-        ],
-        out_specs=pl.BlockSpec((br, D), lambda i: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((N_pad, D), x.dtype),
-        interpret=interpret,
-    )(x2, scale.reshape(1, D))
-    if N_pad != N:
-        y = y[:N]
-    return y.reshape(*lead, D)
+
+    def run(x2, br):
+        return pl.pallas_call(
+            functools.partial(_rms_fwd_kernel, eps=eps),
+            grid=(x2.shape[0] // br,),
+            in_specs=[
+                pl.BlockSpec((br, D), lambda i: (i, 0)),
+                pl.BlockSpec((1, D), lambda i: (0, 0)),
+            ],
+            out_specs=pl.BlockSpec((br, D), lambda i: (i, 0)),
+            out_shape=jax.ShapeDtypeStruct(x2.shape, x.dtype),
+            interpret=interpret,
+        )(x2, scale.reshape(1, D))
+
+    return _row_blocked(x, run, block_rows)

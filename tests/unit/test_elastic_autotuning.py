@@ -243,3 +243,23 @@ class TestResourceScheduler:
         out = run({"v": 21}, res)
         assert out["samples_per_sec"] == 42
         assert out["slots"] == "0,1"
+
+
+    def test_failed_trials_do_not_poison_model(self):
+        """A crashed trial must neither rank as best (negative-metric
+        spaces) nor enter the cost-model fit (-inf observations NaN the
+        ridge solve and silently degrade every later pick)."""
+        from deepspeed_tpu.autotuning import ResourceManager
+        rm = ResourceManager([("h0", 2)])
+        space = {"micro_bs": [1, 2, 4, 8, 16, 32], "stage": [0, 1, 2, 3]}
+
+        def run_fn(exp, res):
+            if exp["micro_bs"] == 32:
+                raise MemoryError("oom")
+            return {"samples_per_sec":
+                    -abs(exp["micro_bs"] - 16) - 3 * abs(exp["stage"] - 2)}
+
+        best_exp, best_res, all_r = rm.run_model_based(
+            space, run_fn, max_trials=20)
+        assert best_exp == {"micro_bs": 16, "stage": 2}
+        assert "error" not in best_res
