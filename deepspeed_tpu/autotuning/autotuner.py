@@ -20,7 +20,7 @@ import numpy as np
 import jax
 
 from ..utils.logging import logger
-from .tuner import GridSearchTuner, RandomTuner
+from .tuner import GridSearchTuner, ModelBasedTuner, RandomTuner
 
 
 class ModelInfo:
@@ -133,14 +133,27 @@ class Autotuner:
         return result
 
     def tune(self, space=None):
-        """Run the search; returns (best_config_dict, all_results)."""
+        """Run the search; returns (best_config_dict, all_results).
+        tuner_type: 'gridsearch' | 'random' | 'model' (cost-model-guided
+        sequential search, reference tuner/model_based_tuner.py:19 — the
+        fitted ridge CostModel proposes the best predicted untried
+        config after warmup; see also scheduler.ResourceManager.
+        run_model_based for pool-parallel rounds)."""
         space = space or self.search_space()
-        tuner = (RandomTuner(space, max_trials=self.max_trials)
-                 if self.tuner_type == "random" else GridSearchTuner(space))
+        if self.tuner_type == "model":
+            tuner = ModelBasedTuner(space, max_trials=self.max_trials)
+        elif self.tuner_type == "random":
+            tuner = RandomTuner(space, max_trials=self.max_trials)
+        else:
+            tuner = GridSearchTuner(space)
         logger.info(f"autotuning over {len(tuner)} experiments")
         self.results = []
         for exp in tuner:
             res = self.run_experiment(exp)
+            if isinstance(tuner, ModelBasedTuner) and not res["error"]:
+                # failed trials stay unrecorded -> pending-forever ->
+                # excluded from the cost-model fit and best()
+                tuner.record(exp, res["samples_per_sec"])
             self.results.append(res)
             logger.info(f"  exp {exp}: "
                         f"{res['samples_per_sec']:.1f} samples/s"

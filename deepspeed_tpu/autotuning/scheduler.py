@@ -190,15 +190,13 @@ class ResourceManager:
             results = self._run_batch(batch, run_fn, slots_per_exp)
             for exp, res in zip(batch, results):
                 failed = bool(res.get("error")) or metric not in res
-                if failed:
-                    # keep failed trials OUT of the cost-model fit (an
-                    # -inf observation makes the ridge solve NaN and
-                    # silently degrades every later model-guided pick);
-                    # marking them pending-forever excludes them from
-                    # both re-proposal and best()
-                    tuner._pending.append(exp)
-                else:
+                if not failed:
                     tuner.record(exp, float(res[metric]))
+                # failed trials simply stay unrecorded: the tuner keeps
+                # yielded-but-unrecorded configs in its pending set, so
+                # they are excluded from re-proposal, from best(), and —
+                # critically — from the cost-model fit (an -inf
+                # observation would NaN the ridge solve)
                 all_results.append((exp, res))
         if not tuner.observed:
             raise RuntimeError(

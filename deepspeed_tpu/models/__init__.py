@@ -7,3 +7,4 @@ from .llama import (Llama, LlamaConfig, LLAMA_PRESETS, LLAMA_TINY,
 from .mixtral import Mixtral, MixtralConfig, MIXTRAL_TINY, MIXTRAL_8X7B
 from .qwen import Qwen, QwenConfig, QWEN_PRESETS
 from .phi import Phi, PhiConfig, PHI_PRESETS
+from .falcon import Falcon, FalconConfig, FALCON_PRESETS

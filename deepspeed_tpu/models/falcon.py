@@ -1,0 +1,49 @@
+"""Falcon model family — parallel block, LayerNorm, multi-query attention.
+
+Counterpart of the reference's Falcon serving support
+(inference/v2/model_implementations/falcon/{model,policy}.py,
+module_inject/containers/falcon): RoPE + LayerNorm (with bias) + plain
+GELU MLP + the parallel residual x + attn(ln x) + mlp(ln x), and
+falcon-7b's multi-query attention (ONE shared KV head — the extreme of
+GQA, n_kv_heads=1). All paths — training, v1 contiguous-cache decode,
+v2 paged serving on the Pallas paged-attention kernel — inherit from
+:class:`~.llama.Llama` through its architecture knobs; the family is
+the config point. Falcon-7b shares a single input LayerNorm between the
+branches; as with Phi, tie the two branch norms at load time (init
+keeps them separate but identical — identical math while tied).
+"""
+
+from dataclasses import dataclass
+
+from .llama import Llama, LlamaConfig
+
+
+@dataclass(frozen=True)
+class FalconConfig(LlamaConfig):
+    parallel_block: bool = True
+    mlp_gated: bool = False              # plain gelu MLP
+    norm_type: str = "ln"                # LayerNorm with bias
+    n_kv_heads: int = 1                  # multi-query attention
+    vocab_size: int = 65024
+
+
+FALCON_TINY = FalconConfig(n_layer=2, n_head=4, n_kv_heads=1, d_model=128,
+                           max_seq_len=128, vocab_size=512, remat=False)
+# falcon-7b point (config.json: 32 layers, 71 heads, hidden 4544, MQA)
+FALCON_7B = FalconConfig(n_layer=32, n_head=71, n_kv_heads=1, d_model=4544,
+                         d_ff=4 * 4544, max_seq_len=2048,
+                         tie_embeddings=True)
+
+FALCON_PRESETS = {"tiny": FALCON_TINY, "falcon-7b": FALCON_7B}
+
+
+class Falcon(Llama):
+    """Falcon: parallel-block MQA LN model on the shared Llama machinery
+    (see module docstring)."""
+
+    def __init__(self, config: FalconConfig):
+        if not config.parallel_block or config.n_kv_heads != 1:
+            raise ValueError(
+                "Falcon requires parallel_block=True and multi-query "
+                "attention (n_kv_heads=1)")
+        super().__init__(config)

@@ -145,10 +145,10 @@ def _ln_hybrid_fwd(x, scale, bias, eps, br, interpret):
 _ln_hybrid.defvjp(_ln_hybrid_fwd, _ln_bwd)
 
 
-def _row_blocked(x, run, block_rows, pad_ok=True):
+def _row_blocked(x, run, block_rows):
     """Shared scaffolding for one-pass row-blocked kernels over the last
     dim: (..., D) -> reshape (N, D), pad N to the row-block multiple,
-    ``run(x2, br, n_pad)`` produces (N_pad, D), unpad + reshape back.
+    ``run(x2, br)`` produces (N_pad, D), unpad + reshape back.
     D must be lane-tileable (% 128)."""
     D = x.shape[-1]
     if D % 128:
@@ -229,3 +229,4 @@ def fused_rmsnorm(x, scale, *, eps=1e-5, block_rows=256, interpret=None):
         )(x2, scale.reshape(1, D))
 
     return _row_blocked(x, run, block_rows)
+

@@ -263,3 +263,36 @@ class TestResourceScheduler:
             space, run_fn, max_trials=20)
         assert best_exp == {"micro_bs": 16, "stage": 2}
         assert "error" not in best_res
+
+
+    def test_autotuner_model_guided(self):
+        """tuner_type='model' drives the Autotuner loop end to end with
+        the cost model recording each trial (run_experiment faked)."""
+        from deepspeed_tpu.autotuning import Autotuner
+
+        class _M:
+            class config:
+                @staticmethod
+                def num_params():
+                    return 1000
+        at = Autotuner(_M(), {"train_micro_batch_size_per_gpu": 1},
+                       tuner_type="model", max_trials=10)
+        calls = []
+
+        def fake_run(exp):
+            calls.append(exp)
+            v = -abs(exp["train_micro_batch_size_per_gpu"] - 8) \
+                - 2 * abs(exp["zero_stage"] - 2)
+            return dict(exp, samples_per_sec=v, error=None)
+
+        at.run_experiment = fake_run
+        space = {"train_micro_batch_size_per_gpu": [1, 2, 4, 8, 16],
+                 "zero_stage": [0, 1, 2, 3]}
+        import tempfile
+        at.results_dir = tempfile.mkdtemp()
+        best_config, results = at.tune(space)
+        best = max((r for r in results if not r["error"]),
+                   key=lambda r: r["samples_per_sec"])
+        assert best["train_micro_batch_size_per_gpu"] == 8
+        assert best["zero_stage"] == 2
+        assert len(calls) <= 10

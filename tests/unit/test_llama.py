@@ -305,3 +305,52 @@ class TestPhi:
                                            temperature=0.0))[0]
             np.testing.assert_array_equal(got[u][len(p):],
                                           want[len(p):])
+
+
+
+class TestFalcon:
+    """Falcon family (reference inference/v2/model_implementations/
+    falcon): parallel block, LayerNorm, multi-query attention
+    (n_kv_heads=1 — one shared KV head, the paged cache stores a single
+    head per layer)."""
+
+    def _model(self):
+        from deepspeed_tpu.models import Falcon
+        from deepspeed_tpu.models.falcon import FALCON_TINY
+        from dataclasses import replace
+        return Falcon(replace(FALCON_TINY, dtype="float32"))
+
+    def test_param_count_and_mqa_cache(self):
+        m = self._model()
+        params = m.init(jax.random.key(0))
+        n = sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
+        assert n == m.config.num_params()
+        cache = m.init_paged_cache(num_blocks=4, block_size=16)
+        assert cache["k"][0].shape[1] == 1        # single KV head
+
+    def test_paged_serving_end_to_end(self):
+        from deepspeed_tpu.inference.v2.engine_v2 import (
+            InferenceEngineV2, RaggedInferenceEngineConfig)
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        m = self._model()
+        groups.reset()
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, 500, (n,)).astype(np.int32)
+                   for n in (6, 13)]
+        v2 = InferenceEngineV2(
+            m, RaggedInferenceEngineConfig(max_batch_size=2,
+                                           kv_block_size=16,
+                                           prompt_bucket=16))
+        uids = [v2.put(p, max_new_tokens=6, eos_token_id=-1)
+                for p in prompts]
+        while v2.has_work:
+            v2.step()
+        got = {u: np.asarray(v2.get(u)) for u in uids}
+        groups.reset()
+        ref = InferenceEngine(m, config={"dtype": "float32",
+                                         "prompt_bucket": 16})
+        for u, p in zip(uids, prompts):
+            want = np.asarray(ref.generate(p[None], max_new_tokens=6,
+                                           temperature=0.0))[0]
+            np.testing.assert_array_equal(got[u][len(p):],
+                                          want[len(p):])
