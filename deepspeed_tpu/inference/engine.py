@@ -30,23 +30,36 @@ from ..utils.logging import log_dist
 from .config import DeepSpeedInferenceConfig
 
 
-def _sample(logits, rng, temperature, top_k, top_p):
-    """logits: (B, V) fp32 -> (B,) int32. Static sampling config."""
-    if temperature == 0.0:
+def _sample(logits, rng, temperature, top_k, top_p, greedy):
+    """logits: (B, V) fp32 -> (B,) int32.
+
+    ``greedy`` is the ONLY static knob (argmax needs no sort and no
+    rng); temperature/top_k/top_p are TRACED scalars, so one compiled
+    program serves every sampling configuration of a shape bucket — the
+    v2 engine's convention, closing the per-(temp, k, p) program
+    explosion the v1 LRU cache only bounded. Cost of the unification:
+    the sampling path always pays its two (B, V) sorts even when top-k
+    and top-p are disabled (disabled values mask to no-ops).
+    """
+    if greedy:
         return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    V = logits.shape[-1]
     logits = logits / jnp.maximum(temperature, 1e-6)
-    if top_k > 0:
-        kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
-        logits = jnp.where(logits < kth, -1e30, logits)
-    if top_p < 1.0:
-        sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
-        probs = jax.nn.softmax(sorted_l, axis=-1)
-        cum = jnp.cumsum(probs, axis=-1)
-        # keep the smallest set with cumulative prob >= top_p
-        keep = cum - probs < top_p
-        cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1,
-                         keepdims=True)
-        logits = jnp.where(logits < cutoff, -1e30, logits)
+    # top-k with a traced k: threshold at the k-th largest via a dynamic
+    # slice of the descending sort; k <= 0 disables
+    sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
+    k = jnp.clip(top_k, 1, V).astype(jnp.int32)
+    kth = lax.dynamic_slice_in_dim(sorted_desc, k - 1, 1, axis=1)
+    logits = jnp.where((top_k > 0) & (logits < kth), -1e30, logits)
+    # top-p on the (possibly k-masked) logits; top_p >= 1 keeps all
+    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+    probs = jax.nn.softmax(sorted_l, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    # keep the smallest set with cumulative prob >= top_p
+    keep = cum - probs < top_p
+    cutoff = jnp.min(jnp.where(keep, sorted_l, jnp.inf), axis=-1,
+                     keepdims=True)
+    logits = jnp.where(logits < cutoff, -1e30, logits)
     return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
 
 
@@ -106,8 +119,7 @@ class InferenceEngine:
     __call__ = forward
 
     # ------------------------------------------------------------- generate
-    def _build_generate(self, B, T_pad, max_new, temperature, top_k, top_p,
-                        eos_id):
+    def _build_generate(self, B, T_pad, max_new, greedy, eos_id):
         model = self.model
         # shard the batch over the data axes only when it divides evenly
         # (generation batches are often 1); otherwise replicate
@@ -116,7 +128,7 @@ class InferenceEngine:
         cache_specs = model.cache_specs(batch_axes=batch_axes)
         constrain = lax.with_sharding_constraint
 
-        def gen(params, ids, lengths, rng):
+        def gen(params, ids, lengths, rng, temperature, top_k, top_p):
             """ids: (B, T_pad) LEFT-padded prompts; lengths: (B,)."""
             B = ids.shape[0]
             Tmax = T_pad + max_new
@@ -132,7 +144,8 @@ class InferenceEngine:
                 params, ids, pos.astype(jnp.int32), cache, 0, valid,
                 last_token_only=True)
             rng, sub = jax.random.split(rng)
-            last = _sample(logits[:, -1], sub, temperature, top_k, top_p)
+            last = _sample(logits[:, -1], sub, temperature, top_k, top_p,
+                           greedy)
 
             def step(carry, i):
                 cache, tok, valid, done, rng = carry
@@ -142,7 +155,8 @@ class InferenceEngine:
                 pos_t = (slot - pad).astype(jnp.int32)[:, None]
                 logits, cache = model.apply_cached(
                     params, tok[:, None], pos_t, cache, slot, valid)
-                nxt = _sample(logits[:, -1], sub, temperature, top_k, top_p)
+                nxt = _sample(logits[:, -1], sub, temperature, top_k,
+                              top_p, greedy)
                 nxt = jnp.where(done, eos_id, nxt)
                 done = done | (nxt == eos_id) if eos_id >= 0 else done
                 return (cache, nxt, valid, done, rng), tok
@@ -158,9 +172,10 @@ class InferenceEngine:
             return out
 
         batch_spec = NamedSharding(self.mesh, P(batch_axes))
+        rep = NamedSharding(self.mesh, P())
         return jax.jit(gen, in_shardings=(
-            self.param_shardings, batch_spec, batch_spec,
-            NamedSharding(self.mesh, P())))
+            self.param_shardings, batch_spec, batch_spec, rep, rep, rep,
+            rep))
 
     def generate(self, input_ids, max_new_tokens=32, temperature=None,
                  top_k=None, top_p=None, eos_token_id=-1, pad_token_id=0,
@@ -205,12 +220,14 @@ class InferenceEngine:
         for i, s in enumerate(seqs):  # LEFT pad
             ids[i, T_pad - len(s):] = s
 
-        key = (B, T_pad, max_new_tokens, float(temperature), int(top_k),
-               float(top_p), int(eos_token_id))
+        # sampling params are traced: the program key carries only the
+        # shape bucket + the static greedy/eos structure (v2 parity);
+        # the LRU now only bounds genuinely distinct shapes
+        greedy = float(temperature) == 0.0
+        key = (B, T_pad, max_new_tokens, greedy, int(eos_token_id))
         if key not in self._generate_cache:
             self._generate_cache[key] = self._build_generate(
-                B, T_pad, max_new_tokens, float(temperature), int(top_k),
-                float(top_p), int(eos_token_id))
+                B, T_pad, max_new_tokens, greedy, int(eos_token_id))
             while len(self._generate_cache) > self._generate_cache_max:
                 self._generate_cache.popitem(last=False)
         self._generate_cache.move_to_end(key)
@@ -221,7 +238,9 @@ class InferenceEngine:
         else:
             self._rng, rng = jax.random.split(self._rng)
         with jax.set_mesh(self.mesh):
-            out = fn(self.params, ids, lengths, rng)
+            out = fn(self.params, ids, lengths, rng,
+                     jnp.float32(temperature), jnp.int32(top_k),
+                     jnp.float32(top_p))
         return np.asarray(out)
 
     # ------------------------------------------------------------- weights

@@ -229,4 +229,3 @@ def fused_rmsnorm(x, scale, *, eps=1e-5, block_rows=256, interpret=None):
         )(x2, scale.reshape(1, D))
 
     return _row_blocked(x, run, block_rows)
-

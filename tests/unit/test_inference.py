@@ -183,3 +183,42 @@ class TestInferenceEngine:
         np.testing.assert_allclose(
             np.asarray(loaded["wte"], np.float32),
             np.asarray(trained["wte"], np.float32), rtol=1e-6)
+
+
+
+class TestTracedSamplingPrograms:
+    """Sampling params are traced (v2 parity): differing temperature /
+    top_k / top_p tuples share ONE compiled program per shape bucket;
+    only the greedy/sampling structure splits programs."""
+
+    def test_one_program_across_sampling_configs(self):
+        from deepspeed_tpu.models import GPT2, GPT2Config
+        cfg = GPT2Config(n_layer=1, n_head=2, d_model=64, max_seq_len=64,
+                         vocab_size=128, dtype="float32", remat=False)
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        groups.reset()
+        eng = InferenceEngine(GPT2(cfg), config={"dtype": "float32",
+                                                 "prompt_bucket": 8})
+        ids = np.random.RandomState(0).randint(0, 128, (1, 6))
+        for t, k, p in [(0.7, 0, 1.0), (1.3, 5, 1.0), (0.9, 0, 0.8),
+                        (1.0, 10, 0.95)]:
+            eng.generate(ids, max_new_tokens=3, temperature=t, top_k=k,
+                         top_p=p, seed=0)
+        # 4 sampling configs -> ONE cached program (plus none for greedy)
+        assert len(eng._generate_cache) == 1
+        eng.generate(ids, max_new_tokens=3, temperature=0.0, seed=0)
+        assert len(eng._generate_cache) == 2   # greedy structure splits
+
+    def test_traced_topk_matches_static_semantics(self):
+        """Traced top-k (dynamic k-th-largest threshold) restricts
+        sampling to exactly the k most likely tokens."""
+        from deepspeed_tpu.inference.engine import _sample
+        rng = np.random.RandomState(0)
+        logits = jnp.asarray(rng.randn(4, 64) * 3, jnp.float32)
+        top3 = np.argsort(np.asarray(logits), axis=-1)[:, -3:]
+        draws = [_sample(logits, jax.random.key(i), jnp.float32(1.0),
+                         jnp.int32(3), jnp.float32(1.0), False)
+                 for i in range(32)]
+        for d in draws:
+            for b in range(4):
+                assert int(np.asarray(d)[b]) in top3[b]
