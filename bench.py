@@ -50,6 +50,8 @@ def main():
                   flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", "1024")),
                   flash_block_k=int(os.environ.get("BENCH_FLASH_BK", "1024")),
                   flash_block_h=int(os.environ.get("BENCH_FLASH_BH", "1")),
+                  flash_block_q_bwd=int(os.environ.get("BENCH_FLASH_BQ_BWD", "0")),
+                  flash_block_k_bwd=int(os.environ.get("BENCH_FLASH_BK_BWD", "0")),
                   remat=os.environ.get("BENCH_REMAT", "1") == "1",
                   # save_flash measured best (benchmarks/PERF_NOTES.md):
                   # saved flash o/lse residuals, no fwd re-run in backward

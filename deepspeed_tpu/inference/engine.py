@@ -50,9 +50,13 @@ def _sample(logits, rng, temperature, top_k, top_p, greedy):
     sorted_desc = jnp.sort(logits, axis=-1)[:, ::-1]
     k = jnp.clip(top_k, 1, V).astype(jnp.int32)
     kth = lax.dynamic_slice_in_dim(sorted_desc, k - 1, 1, axis=1)
-    logits = jnp.where((top_k > 0) & (logits < kth), -1e30, logits)
-    # top-p on the (possibly k-masked) logits; top_p >= 1 keeps all
-    sorted_l = jnp.sort(logits, axis=-1)[:, ::-1]
+    apply_k = top_k > 0
+    logits = jnp.where(apply_k & (logits < kth), -1e30, logits)
+    # top-p on the (possibly k-masked) logits; top_p >= 1 keeps all.
+    # The masked sort derives from the first one (masking values below
+    # kth is order-preserving), saving the second (B, V) sort per token
+    sorted_l = jnp.where(apply_k & (sorted_desc < kth), -1e30,
+                         sorted_desc)
     probs = jax.nn.softmax(sorted_l, axis=-1)
     cum = jnp.cumsum(probs, axis=-1)
     # keep the smallest set with cumulative prob >= top_p
@@ -81,10 +85,9 @@ class InferenceEngine:
             config = DeepSpeedInferenceConfig.from_dict(kwargs)
         self.config = config
         self.model = model
-        # LRU-bounded: every distinct (shape-bucket, sampling params) tuple
-        # retains a compiled XLA program; long-running servers with varied
-        # requests would otherwise leak memory (v2 passes sampling params
-        # as traced args instead — one program per shape only)
+        # LRU-bounded program cache keyed on (shape bucket, greedy, eos)
+        # ONLY — sampling params are traced (v2 parity), so the LRU
+        # bounds genuinely distinct shapes, not request configurations
         from collections import OrderedDict
         self._generate_cache = OrderedDict()
         self._generate_cache_max = 32

@@ -8,3 +8,4 @@ from .mixtral import Mixtral, MixtralConfig, MIXTRAL_TINY, MIXTRAL_8X7B
 from .qwen import Qwen, QwenConfig, QWEN_PRESETS
 from .phi import Phi, PhiConfig, PHI_PRESETS
 from .falcon import Falcon, FalconConfig, FALCON_PRESETS
+from .opt import OPT, OPTConfig, OPT_PRESETS

@@ -52,6 +52,8 @@ class GPT2Config:
     flash_block_q: int = 128           # pallas attention tile sizes
     flash_block_k: int = 128
     flash_block_h: int = 2             # (batch*head) instances per grid step
+    flash_block_q_bwd: int = 0         # 0 = same as flash_block_q/_k; the
+    flash_block_k_bwd: int = 0         # fused bwd pass may prefer smaller
     # 'dense': GSPMD Ulysses resharding (all_to_all pair) when seq-sharded.
     # 'ring': ring/context-parallel attention (sequence/ring.py) — KV blocks
     #         rotate over the 'seq' axis; no head-count constraint.
@@ -71,6 +73,8 @@ class GPT2Config:
     # lax.scan unroll over layers (1 = compact single-block program;
     # higher trades compile time/code size for cross-layer overlap)
     scan_unroll: int = 1
+    # MLP activation: 'gelu' (gpt2) or 'relu' (opt)
+    activation: str = "gelu"
     # fused one-pass LayerNorm Pallas kernel (ops/pallas/layernorm.py;
     # reference csrc/transformer/normalize_kernels.cu). Measured SLOWER
     # than XLA's fused jnp layernorm inside the 350M training step (the
@@ -360,11 +364,14 @@ class GPT2:
             q = constrain(q, head_spec)
             kk = constrain(kk, head_spec)
             v = constrain(v, head_spec)
-            attn = flash_attention(q, kk, v, causal=True,
-                                   block_q=cfg.flash_block_q,
-                                   block_k=cfg.flash_block_k,
-                                   block_h=cfg.flash_block_h,
-                                   heads_major=True).astype(dt)
+            attn = flash_attention(
+                q, kk, v, causal=True,
+                block_q=cfg.flash_block_q,
+                block_k=cfg.flash_block_k,
+                block_h=cfg.flash_block_h,
+                block_q_bwd=cfg.flash_block_q_bwd or None,
+                block_k_bwd=cfg.flash_block_k_bwd or None,
+                heads_major=True).astype(dt)
             from jax.ad_checkpoint import checkpoint_name
             attn = checkpoint_name(attn, "attn_out")
         else:
@@ -448,7 +455,12 @@ class GPT2:
         # named pre-activation: saving it skips the wup matmul recompute in
         # backward (gelu' needs this tensor; gelu_out is one VPU op away)
         u = checkpoint_name(h @ layer["wup"] + layer["bup"], "mlp_up")
-        up = jax.nn.gelu(u)
+        acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}
+        if self.config.activation not in acts:
+            raise ValueError(
+                f"unknown activation {self.config.activation!r}; "
+                f"expected one of {sorted(acts)}")
+        up = acts[self.config.activation](u)
         up = constrain(up, P(BATCH_AXES, "seq" if seq_sharded else None,
                              "tensor"))
         return (up @ layer["wdown"] + layer["bdown"],

@@ -1,0 +1,41 @@
+"""OPT model family — GPT-2 architecture with ReLU MLP.
+
+Counterpart of the reference's OPT serving support
+(inference/v2/model_implementations/opt/{model,policy}.py,
+module_inject/containers/opt.py): decoder-only transformer with learned
+absolute position embeddings, pre-LayerNorm blocks, and a ReLU (not
+GELU) feed-forward — i.e. the GPT-2 machinery with the activation
+swapped, which is exactly how the reference's OPT container maps onto
+its GPT-ish kernel set. (HF OPT offsets position ids by 2 padding slots
+— a checkpoint-conversion detail, not an architecture one: handle it in
+the loader by slicing the first two wpe rows off.) Training, v1 cached
+decode, and v2 paged serving all inherit from :class:`~.gpt2.GPT2`.
+"""
+
+from dataclasses import dataclass, replace
+
+from .gpt2 import GPT2, GPT2Config
+
+
+@dataclass(frozen=True)
+class OPTConfig(GPT2Config):
+    activation: str = "relu"             # the family's distinguishing knob
+    vocab_size: int = 50272
+
+
+OPT_TINY = OPTConfig(n_layer=2, n_head=4, d_model=128, max_seq_len=128,
+                     vocab_size=512, remat=False)
+# opt-1.3b point (config.json: 24 layers, 32 heads, hidden 2048)
+OPT_1_3B = OPTConfig(n_layer=24, n_head=32, d_model=2048,
+                     max_seq_len=2048)
+
+OPT_PRESETS = {"tiny": OPT_TINY, "opt-1.3b": OPT_1_3B}
+
+
+class OPT(GPT2):
+    """OPT: GPT-2 forward/caching/serving with a ReLU MLP via config."""
+
+    def __init__(self, config: OPTConfig):
+        if config.activation != "relu":
+            raise ValueError("OPT uses a ReLU feed-forward")
+        super().__init__(config)

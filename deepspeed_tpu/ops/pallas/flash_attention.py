@@ -289,13 +289,16 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
 
 
 # --------------------------------------------------------------- public API
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
-def _flash(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _flash(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
+           bwd_bq, bwd_bk):
     o, lse = _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
     return o, lse[..., 0]
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
+def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
+               bwd_bq, bwd_bk):
     from jax.ad_checkpoint import checkpoint_name
     # symbolic_zeros=True wraps primal args in CustomVJPPrimal
     q, k, v = q.value, k.value, v.value
@@ -314,7 +317,12 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
     return (o, lse_t[..., 0]), (q, k, v, o, lse_t)
 
 
-def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, res, cts):
+def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
+               bwd_bk, res, cts):
+    # backward may run its own (smaller) blocks: the fused dq/dk/dv pass
+    # is ~2x the forward's work, so causal above-diagonal skipping wins
+    # more there than grid-step overhead costs
+    bq, bk = bwd_bq or bq, bwd_bk or bk
     do, dlse = cts
     from jax.custom_derivatives import SymbolicZero
     # training drops the lse output -> its cotangent arrives symbolic
@@ -337,7 +345,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
 
 def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
                              block_q=128, block_k=128, block_h=2,
-                             interpret=None, heads_major=False):
+                             interpret=None, heads_major=False,
+                             block_q_bwd=None, block_k_bwd=None):
     """Fused attention over (batch, seq, heads, head_dim) inputs, returning
     ``(o, lse)`` where lse is the per-query logsumexp, (B, H, T) fp32.
 
@@ -369,6 +378,12 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     if interpret is None:
         interpret = _interpret_default()
     bq, bk, T_pad = _block_sizes(T, block_q, block_k)
+    # backward may use its own blocks; T must pad to a common multiple of
+    # ALL block sizes or the backward grid would not cover every key
+    # block (silently dropping dk/dv contributions)
+    bwd_bq, bwd_bk, _ = _block_sizes(T, block_q_bwd or bq,
+                                     block_k_bwd or bk)
+    T_pad = _round_up(T, math.lcm(bq, bk, bwd_bq, bwd_bk))
     bh = max(1, min(block_h, B * H))
     while (B * H) % bh:
         bh -= 1
@@ -391,7 +406,7 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     # per-score-element multiply inside a VPU-bound kernel
     q = q * jnp.asarray(scale, q.dtype)
     o, lse = _flash(fold(q), fold(k), fold(v), 1.0, bool(causal),
-                    bq, bk, bh, T, bool(interpret))
+                    bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk)
     if T_pad != T or d_pad != d:
         o = o[:, :T, :d]
         lse = lse[:, :T]
@@ -403,13 +418,15 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
 
 def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
                     block_k=128, block_h=2, interpret=None,
-                    heads_major=False):
+                    heads_major=False, block_q_bwd=None,
+                    block_k_bwd=None):
     """Fused attention over (batch, seq, heads, head_dim); see
     :func:`flash_attention_with_lse` (this drops the lse output)."""
     o, _ = flash_attention_with_lse(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, block_h=block_h, interpret=interpret,
-        heads_major=heads_major)
+        heads_major=heads_major, block_q_bwd=block_q_bwd,
+        block_k_bwd=block_k_bwd)
     return o
 
 

@@ -503,3 +503,35 @@ class TestFusedRMSNorm:
         np.testing.assert_allclose(np.asarray(y),
                                    np.asarray(_rms_norm(x, s, 1e-5)),
                                    rtol=1e-5, atol=1e-5)
+
+
+
+class TestBwdBlockCoverage:
+    def _qkv(self, B=2, T=128, H=4, d=32, dtype=jnp.float32, seed=0):
+        rng = np.random.RandomState(seed)
+        mk = lambda s: jnp.asarray(rng.randn(B, H, T, d), dtype) * 0.3
+        return mk(0), mk(1), mk(2)
+
+    def test_bwd_blocks_nondividing_padded_seq(self):
+        """Backward-only block sizes that do not divide the forward
+        padding must still cover every key block (T pads to the lcm of
+        ALL block sizes; a miss silently zeroes dk/dv tail blocks)."""
+        q, k, v = self._qkv(T=96)       # pads beyond 96
+
+        def loss_f(q, k, v):
+            o = flash_attention(q, k, v, block_q=32, block_k=32,
+                                block_q_bwd=64, block_k_bwd=48,
+                                heads_major=True)
+            return jnp.sum(o ** 2)
+
+        def loss_r(q, k, v):
+            o = attention_reference(q.transpose(0, 2, 1, 3),
+                                    k.transpose(0, 2, 1, 3),
+                                    v.transpose(0, 2, 1, 3))
+            return jnp.sum(o.transpose(0, 2, 1, 3) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
