@@ -1,0 +1,101 @@
+"""Aggregate device-op self times from a jax.profiler Chrome trace.
+
+Usage: python benchmarks/trace_summary.py /tmp/dstpu_trace [n_steps]
+Prints per-op-name total duration (ms) sorted descending, grouped by a
+coarse family (matmul/fusion/pallas/...), divided by n_steps.
+"""
+
+import collections
+import glob
+import gzip
+import json
+import re
+import sys
+
+
+def main():
+    root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/dstpu_trace"
+    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
+    paths = glob.glob(f"{root}/**/*.trace.json.gz", recursive=True)
+    if not paths:
+        raise SystemExit(f"no trace under {root}")
+    with gzip.open(sorted(paths)[-1], "rt") as f:
+        trace = json.load(f)
+    events = trace["traceEvents"]
+
+    # find device-side track pids (TensorCore / device compute threads)
+    pid_names = {}
+    tid_names = {}
+    for e in events:
+        if e.get("ph") == "M" and e.get("name") == "process_name":
+            pid_names[e["pid"]] = e["args"].get("name", "")
+        if e.get("ph") == "M" and e.get("name") == "thread_name":
+            tid_names[(e["pid"], e["tid"])] = e["args"].get("name", "")
+    dev_pids = {p for p, n in pid_names.items()
+                if "TPU" in n or "/device" in n.lower() or "Core" in n}
+    # only the "XLA Ops" thread carries leaf device ops; Steps/Modules
+    # tracks are whole-step envelopes that would double count
+    op_tids = {k for k, n in tid_names.items()
+               if k[0] in dev_pids and n == "XLA Ops"}
+
+    # self time: duration minus nested children on the same (pid, tid)
+    by_tid = collections.defaultdict(list)
+    for e in events:
+        if e.get("ph") != "X" or (e["pid"], e.get("tid")) not in op_tids:
+            continue
+        by_tid[(e["pid"], e.get("tid"))].append(e)
+
+    per_op = collections.Counter()
+    per_op_n = collections.Counter()
+    total = 0.0
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
+        stack = []  # (end_ts, child_time_accum index into selfs)
+        selfs = []
+        for e in evs:
+            ts, dur = e["ts"], e.get("dur", 0)
+            while stack and stack[-1][0] <= ts:
+                stack.pop()
+            if stack:
+                selfs[stack[-1][1]][1] -= dur
+            selfs.append([e, dur])
+            stack.append((ts + dur, len(selfs) - 1))
+        for e, sdur in selfs:
+            name = e.get("name", "?")
+            dur = max(sdur, 0) / 1000.0  # us -> ms
+            per_op[name] += dur
+            per_op_n[name] += 1
+            total += dur
+
+    print(f"device tracks: {[pid_names[p] for p in dev_pids]}")
+    print(f"total device time: {total:.1f} ms over {steps} steps "
+          f"= {total / steps:.1f} ms/step\n")
+    print(f"{'ms/step':>9}  {'count':>6}  op")
+    for name, dur in per_op.most_common(45):
+        print(f"{dur / steps:9.2f}  {per_op_n[name] // steps:6d}  "
+              f"{name[:100]}")
+
+    # coarse families
+    fams = collections.Counter()
+    for name, dur in per_op.items():
+        n = name.lower()
+        if "custom-call" in n or "pallas" in n or "flash" in n:
+            fam = "pallas/custom-call"
+        elif re.search(r"convolution|dot|einsum", n):
+            fam = "matmul"
+        elif "fusion" in n:
+            fam = "fusion(elementwise/other)"
+        elif "copy" in n or "transpose" in n or "bitcast" in n:
+            fam = "copy/layout"
+        elif "scatter" in n or "gather" in n or "dynamic" in n:
+            fam = "gather/scatter/DUS"
+        else:
+            fam = "other"
+        fams[fam] += dur
+    print("\nfamilies (ms/step):")
+    for fam, dur in fams.most_common():
+        print(f"{dur / steps:9.2f}  {fam}")
+
+
+if __name__ == "__main__":
+    main()

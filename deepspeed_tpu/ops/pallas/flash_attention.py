@@ -50,6 +50,14 @@ def _block_sizes(T, block_q, block_k):
 
 NEG_INF = -1e30
 
+# Trailing lane dim for per-row scalar tensors (lse, delta). Per-row
+# scalars are not 2D-tileable at head-group sizes < 8, so they carry a
+# small replicated lane dim. 8 lanes (not 128): the value lives in
+# sublanes either side of the HBM round trip, so no in-kernel relayout,
+# and the HBM footprint/traffic is 16x smaller than a full 128-lane
+# block (201 MB -> 12.6 MB fp32 at 350M bs=24 shapes).
+LSE_LANES = 8
+
 # batched dot helpers: x (G, a, c) contract c against y's dim, batch over G
 _DN_QK = (((2,), (2,)), ((0,), (0,)))    # (G,bq,d) x (G,bk,d) -> (G,bq,bk)
 _DN_PV = (((2,), (1,)), ((0,), (0,)))    # (G,bq,bk) x (G,bk,d) -> (G,bq,d)
@@ -125,11 +133,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
     carry = jax.lax.fori_loop(0, kfull, make_body(False), (acc, m, l))
     acc, m, l = jax.lax.fori_loop(kfull, kmax, make_body(True), carry)
     o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
-    # lse carries a 128-wide lane dim (value replicated across lanes):
-    # per-row scalars are not tileable on TPU at head-group sizes < 8
-    # (2D (bh, bq) blocks need bh % 8 == 0), so like the official TPU
-    # flash kernel we store (.., bq, 128) blocks; the wrapper trims to
-    # one lane before anything is saved
+    # lse replicated across LSE_LANES lanes (see constant above); the
+    # wrapper trims to one lane before anything is saved
     lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[..., None],
                                     (G, bq, lse_ref.shape[-1]))
 
@@ -148,11 +153,11 @@ def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
         ],
         out_specs=[
             pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((bh, bq, 128), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, LSE_LANES), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
             _sds((BH, T, d), q.dtype, q),
-            _sds((BH, T, 128), jnp.float32, q),
+            _sds((BH, T, LSE_LANES), jnp.float32, q),
         ],
         interpret=interpret,
     )(q, k, v)
@@ -162,7 +167,7 @@ def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
 # ----------------------------------------------------------------- backward
 def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                 dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real,
-                ext_delta):
+                ext_delta, single_k):
     """Fused flash backward: dq, dk, dv from ONE s/p computation.
 
     Grid is (BH/bh, T/bk) over key blocks; an inner loop walks the query
@@ -188,9 +193,10 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     qfull = pl.cdiv((ki + 1) * bk, bq) if (causal and t_real >= T) else (
         qmin if t_real >= T else nq)
 
-    @pl.when(ki == 0)
-    def _init():
-        dq_ref[...] = jnp.zeros_like(dq_ref)
+    if not single_k:
+        @pl.when(ki == 0)
+        def _init():
+            dq_ref[...] = jnp.zeros_like(dq_ref)
 
     def make_body(masked):
         def body(i, carry):
@@ -225,8 +231,15 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
             ds = (p * (dp - delta[..., None])).astype(q.dtype)
             dk = dk + jax.lax.dot_general(ds, q, _DN_T,
                                           preferred_element_type=jnp.float32)
-            dq_ref[:, pl.ds(i * bq, bq), :] += jax.lax.dot_general(
-                ds, kb, _DN_PV, preferred_element_type=jnp.float32)
+            dq_val = jax.lax.dot_general(ds, kb, _DN_PV,
+                                         preferred_element_type=jnp.float32)
+            if single_k:
+                # one key block: each dq slice is written exactly once, so
+                # the output can be emitted in the model dtype directly —
+                # no fp32 (BH, T, d) HBM buffer + cast copy outside
+                dq_ref[:, pl.ds(i * bq, bq), :] = dq_val.astype(dq_ref.dtype)
+            else:
+                dq_ref[:, pl.ds(i * bq, bq), :] += dq_val
             return dk, dv
         return body
 
@@ -246,29 +259,32 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
 def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
          interpret, dlse=None):
     BH, T, d = q.shape
-    lse = jnp.broadcast_to(lse_t, (BH, T, 128))
+    # (BH, T, 1) -> LSE_LANES lanes for the operand block; XLA lowers
+    # this to one small relayout/broadcast per layer (~8 ms/step total)
+    lse = jnp.broadcast_to(lse_t, (BH, T, LSE_LANES))
     if dlse is not None:
         # lse cotangent shifts delta (see _flash_bwd): precompute the
-        # shifted delta outside and broadcast it to the kernel
+        # shifted delta outside and broadcast to the operand lanes
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1) - dlse.astype(jnp.float32)
-        od = jnp.broadcast_to(delta[..., None], (BH, T, 128))
+        od = jnp.broadcast_to(delta[..., None], (BH, T, LSE_LANES))
     else:
         # common case (lse output unused): the kernel computes delta
         # from o/do blocks in VMEM — no broadcast materialization
         od = o
+    single_k = (T // bk) == 1
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real,
-                          ext_delta=dlse is not None),
+                          ext_delta=dlse is not None, single_k=single_k),
         grid=(BH // bh, T // bk),
         in_specs=[
             pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((bh, T, 128), lambda b, j: (b, 0, 0)),
-            pl.BlockSpec((bh, T, 128 if dlse is not None else d),
+            pl.BlockSpec((bh, T, LSE_LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, T, LSE_LANES if dlse is not None else d),
                          lambda b, j: (b, 0, 0)),
         ],
         out_specs=[
@@ -277,7 +293,10 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
             pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
-            _sds((BH, T, d), jnp.float32, q),   # dq accumulates fp32
+            # dq accumulates fp32 across key-block grid steps; with a
+            # single key block each slice is written once, so it is
+            # emitted in the model dtype with no cast copy
+            _sds((BH, T, d), q.dtype if single_k else jnp.float32, q),
             _sds((BH, T, d), q.dtype, q),
             _sds((BH, T, d), q.dtype, q),
         ],
@@ -303,15 +322,17 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
     # symbolic_zeros=True wraps primal args in CustomVJPPrimal
     q, k, v = q.value, k.value, v.value
     o, lse = _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
-    lse_t = lse[..., :1]                                    # (BH, T, 1)
-    # Name o/lse_t HERE, inside the fwd rule, so the named vars are both
+    # Name o/lse HERE, inside the fwd rule, so the named vars are both
     # the primal outputs and the vjp residuals: under jax.checkpoint a
     # save-policy keeping 'flash_o'/'flash_lse' then satisfies the
     # backward's residual needs (q/k/v recompute from the cheap qkv
     # matmul) WITHOUT re-running this kernel — the remat re-run the
     # whole-block policies otherwise pay (~52 ms/step at 350M bs=24).
-    # lse is trimmed to one lane first so the saved residual is
-    # (BH, T, 1) fp32, not the kernel's 128-lane block (4.8 GB at bs=24).
+    # lse is trimmed to one lane so the saved residual is (BH, T, 1)
+    # fp32 (keeping the full LSE_LANES block measured 80 ms/step WORSE
+    # at 350M bs=24 — the fatter stacked residual perturbs XLA's
+    # scheduling far beyond the ~8 ms relayout it saves).
+    lse_t = lse[..., :1]
     o = checkpoint_name(o, "flash_o")
     lse_t = checkpoint_name(lse_t, "flash_lse")
     return (o, lse_t[..., 0]), (q, k, v, o, lse_t)
