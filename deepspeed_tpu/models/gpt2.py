@@ -54,6 +54,10 @@ class GPT2Config:
     flash_block_h: int = 2             # (batch*head) instances per grid step
     flash_block_q_bwd: int = 0         # 0 = same as flash_block_q/_k; the
     flash_block_k_bwd: int = 0         # fused bwd pass may prefer smaller
+    # feed the flash kernel (B, H, hd, T) operands (T in lanes) — the qkv
+    # einsum's natural output layout, eliminating the relayout copies XLA
+    # otherwise inserts at every kernel boundary (~46 ms/step at 350M)
+    flash_qkv_t: bool = True
     # 'dense': GSPMD Ulysses resharding (all_to_all pair) when seq-sharded.
     # 'ring': ring/context-parallel attention (sequence/ring.py) — KV blocks
     #         rotate over the 'seq' axis; no head-count constraint.
@@ -322,14 +326,13 @@ class GPT2:
 
     def block_qkv(self, x, layer, *, constrain, act_spec,
                   heads_major=False):
-        """ln1 + qkv projection: (B, T, D) -> q, k, v each (B, T, H, hd)
-        — or (B, H, T, hd) when ``heads_major`` (the flash kernel's
-        native layout: the einsum emits (…, T, hd)-minor tiles directly,
-        so no transpose copy exists between the projection and the
-        kernel, and no T-minor layout pressure warps the surrounding
-        matmuls). Cheap to recompute in backward (one matmul whose
-        output no grad rule needs — only ln1_out is, and that's VPU
-        work)."""
+        """ln1 + qkv projection: (B, T, D) -> q, k, v each (B, T, H, hd).
+        With ``heads_major``: (B, H, hd, T) when cfg.flash_qkv_t (the
+        default — the flash kernel's transposed-operand layout, matching
+        the einsum's natural T-minor output so no relayout copy exists
+        between the projection and the kernel), else (B, H, T, hd).
+        Cheap to recompute in backward (one matmul whose output no grad
+        rule needs — only ln1_out is, and that's VPU work)."""
         cfg = self.config
         B, T = x.shape[0], x.shape[1]
         H, hd = cfg.n_head, cfg.d_head
@@ -337,6 +340,13 @@ class GPT2:
         if heads_major:
             w = layer["wqkv"].reshape(x.shape[-1], 3, H, hd)
             b = layer["bqkv"].reshape(3, H, hd)
+            if cfg.flash_qkv_t:
+                # (B, H, hd, T): T-minor — the layout XLA prefers for the
+                # einsum output (hd=64 fills only half a lane register),
+                # consumed by the flash kernel with no relayout copy
+                qkv = jnp.einsum("btd,dshe->sbhet", h, w) \
+                    + b[:, None, :, :, None]
+                return qkv[0], qkv[1], qkv[2]
             qkv = jnp.einsum("btd,dshe->sbhte", h, w) \
                 + b[:, None, :, None, :]
             return qkv[0], qkv[1], qkv[2]
@@ -358,7 +368,8 @@ class GPT2:
         elif cfg.use_flash_attention and not seq_sharded:
             # pallas fused attention: O(T) memory, fp32 accumulation
             # (ops/pallas/flash_attention.py). Heads shard over 'tensor'.
-            # Inputs arrive heads-major (B, H, T, hd) from block_qkv.
+            # Inputs arrive from block_qkv as (B, H, hd, T) when
+            # cfg.flash_qkv_t (default), else heads-major (B, H, T, hd).
             from ..ops.pallas.flash_attention import flash_attention
             head_spec = P(BATCH_AXES, "tensor", None, None)
             q = constrain(q, head_spec)
@@ -371,7 +382,8 @@ class GPT2:
                 block_h=cfg.flash_block_h,
                 block_q_bwd=cfg.flash_block_q_bwd or None,
                 block_k_bwd=cfg.flash_block_k_bwd or None,
-                heads_major=True).astype(dt)
+                heads_major=not cfg.flash_qkv_t,
+                qkv_t=cfg.flash_qkv_t).astype(dt)
             from jax.ad_checkpoint import checkpoint_name
             attn = checkpoint_name(attn, "attn_out")
         else:

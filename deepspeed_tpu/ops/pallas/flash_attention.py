@@ -62,6 +62,14 @@ LSE_LANES = 8
 _DN_QK = (((2,), (2,)), ((0,), (0,)))    # (G,bq,d) x (G,bk,d) -> (G,bq,bk)
 _DN_PV = (((2,), (1,)), ((0,), (0,)))    # (G,bq,bk) x (G,bk,d) -> (G,bq,d)
 _DN_T = (((1,), (1,)), ((0,), (0,)))     # (G,bq,bk) x (G,bq,d) -> (G,bk,d)
+# transposed-operand variants (q/k/v carried as (G, d, T) blocks, i.e. T in
+# lanes — the layout the surrounding einsums prefer; see *_kernel_t)
+_DN_QK_T = (((1,), (1,)), ((0,), (0,)))  # (G,d,bq) x (G,d,bk) -> (G,bq,bk)
+_DN_PV_T = (((2,), (2,)), ((0,), (0,)))  # (G,bq,bk) x (G,d,bk) -> (G,bq,d)
+_DN_DO_V = (((2,), (1,)), ((0,), (0,)))  # (G,bq,d) x (G,d,bk) -> (G,bq,bk)
+_DN_DV_T = (((1,), (1,)), ((0,), (0,)))  # (G,bq,d) x (G,bq,bk) -> (G,d,bk)
+_DN_DK_T = (((2,), (1,)), ((0,), (0,)))  # (G,d,bq) x (G,bq,bk) -> (G,d,bk)
+_DN_DQ_T = (((2,), (2,)), ((0,), (0,)))  # (G,d,bk) x (G,bq,bk) -> (G,d,bq)
 
 
 def _mask_block(qi_start, kj_start, bq, bk, causal, t_real, T):
@@ -150,6 +158,88 @@ def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
             pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, LSE_LANES), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            _sds((BH, T, d), q.dtype, q),
+            _sds((BH, T, LSE_LANES), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+    return o, lse
+
+
+# ------------------------------------------------- forward, transposed q/k/v
+def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
+                  causal, t_real):
+    """Forward with q/k/v blocked (G, d, T) — T in lanes.
+
+    The surrounding qkv projection einsums emit T-minor layouts (hd=64
+    fills only half a 128-lane register, so XLA puts T in lanes); the
+    standard (G, T, d) operand forces a relayout copy per tensor per
+    layer (~46 ms/step at 350M bs=24 counting forward, remat recompute
+    and backward). Consuming the producer's layout directly makes those
+    copies bitcasts. Score-space math is IDENTICAL to _fwd_kernel —
+    softmax stats stay (G, bq) sublane vectors — only the q/k dots
+    contract the sublane dim (MXU-native transposed matmul) and the pv
+    dot contracts lanes x lanes. Output o stays (G, bq, d): its consumer
+    (the wo projection) takes it without a copy either way."""
+    qi = pl.program_id(1)
+    q = q_ref[...]                                        # (G, d, bq) bf16
+    G = q.shape[0]
+    T = k_ref.shape[2]
+    nk = T // bk
+    kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
+    kfull = (qi * bq) // bk if (causal and t_real >= T) else (
+        nk if (not causal and t_real >= T) else 0)
+
+    def make_body(masked):
+        def body(j, carry):
+            acc, m, l = carry
+            kb = k_ref[:, :, pl.ds(j * bk, bk)]
+            vb = v_ref[:, :, pl.ds(j * bk, bk)]
+            s = jax.lax.dot_general(q, kb, _DN_QK_T,
+                                    preferred_element_type=jnp.float32)
+            if scale != 1.0:
+                s = s * scale
+            if masked:
+                s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
+                                               causal, t_real, T))
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[..., None] + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, _DN_PV_T,
+                preferred_element_type=jnp.float32)
+            return acc, m_new, l
+        return body
+
+    d = q_ref.shape[1]
+    acc = jnp.zeros((G, bq, d), jnp.float32)
+    m = jnp.full((G, bq), NEG_INF, jnp.float32)
+    l = jnp.zeros((G, bq), jnp.float32)
+    carry = jax.lax.fori_loop(0, kfull, make_body(False), (acc, m, l))
+    acc, m, l = jax.lax.fori_loop(kfull, kmax, make_body(True), carry)
+    o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
+    lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[..., None],
+                                    (G, bq, lse_ref.shape[-1]))
+
+
+def _fwd_t(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
+    BH, d, T = q.shape
+    grid = (BH // bh, T // bq)
+    o, lse = pl.pallas_call(
+        functools.partial(_fwd_kernel_t, bq=bq, bk=bk, scale=scale,
+                          causal=causal, t_real=t_real),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bh, d, bq), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((bh, d, T), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, d, T), lambda b, i: (b, 0, 0)),
         ],
         out_specs=[
             pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
@@ -307,21 +397,152 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
     return dq.astype(q.dtype), dk, dv
 
 
+# ------------------------------------------------ backward, transposed q/k/v
+def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
+                  dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real,
+                  delta_mode, single_k):
+    """Fused backward with q/k/v, do AND dq/dk/dv blocked (G, d, T).
+
+    Same structure as _bwd_kernel (key-block grid, inner loop over query
+    blocks, one s/p computation feeding dq+dk+dv), with every seq-major
+    tensor consumed/produced T-in-lanes so the surrounding einsums'
+    preferred layouts connect via bitcasts, not copies.
+
+    do and o stay in the natural (G, T, d) layout — the forward emits o
+    that way and the cotangent arrives the same way — keeping
+    delta = rowsum(do * o) a lane reduction (sublane-vector result).
+    Measured alternatives at 350M bs=24 (both kept the step SLOWER):
+    do consumed (G, d, T) + delta precomputed outside (+8 ms: the
+    delta fusion/broadcast outweighs the saved do relayout), and the
+    in-kernel softmax identity delta = sum_j p_ij dp_ij (+11 ms VPU in
+    an already-VPU-bound kernel). delta_mode: 'dot' = rowsum(do * o)
+    with od_ref carrying o; 'ext' = precomputed delta via od_ref
+    (the lse-cotangent path folds -dlse in outside).
+    """
+    ki = pl.program_id(1)
+    kb = k_ref[...]                                         # (G, d, bk)
+    G = kb.shape[0]
+    vb = v_ref[...]
+    T = q_ref.shape[2]
+    nq = T // bq
+    qmin = (ki * bk) // bq if causal else 0
+    qfull = pl.cdiv((ki + 1) * bk, bq) if (causal and t_real >= T) else (
+        qmin if t_real >= T else nq)
+
+    if not single_k:
+        @pl.when(ki == 0)
+        def _init():
+            dq_ref[...] = jnp.zeros_like(dq_ref)
+
+    def make_body(masked):
+        def body(i, carry):
+            dk, dv = carry
+            q = q_ref[:, :, pl.ds(i * bq, bq)]              # (G, d, bq)
+            do = do_ref[:, pl.ds(i * bq, bq), :]            # (G, bq, d)
+            lse = lse_ref[:, pl.ds(i * bq, bq), :][..., 0]  # (G, bq)
+            if delta_mode == "ext":
+                delta = od_ref[:, pl.ds(i * bq, bq), :][..., 0]
+            else:
+                ob = od_ref[:, pl.ds(i * bq, bq), :]        # (G, bq, d)
+                delta = jnp.sum(do.astype(jnp.float32)
+                                * ob.astype(jnp.float32), axis=-1)
+            s = jax.lax.dot_general(q, kb, _DN_QK_T,
+                                    preferred_element_type=jnp.float32)
+            if scale != 1.0:
+                s = s * scale
+            if masked:
+                s = _apply_mask(s, _mask_block(i * bq, ki * bk, bq, bk,
+                                               causal, t_real, T))
+            p = jnp.exp(s - lse[..., None])                 # (G, bq, bk) f32
+            pb = p.astype(do.dtype)
+            dv = dv + jax.lax.dot_general(do, pb, _DN_DV_T,
+                                          preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, vb, _DN_DO_V,
+                                     preferred_element_type=jnp.float32)
+            ds = (p * (dp - delta[..., None])).astype(q.dtype)
+            dk = dk + jax.lax.dot_general(q, ds, _DN_DK_T,
+                                          preferred_element_type=jnp.float32)
+            dq_val = jax.lax.dot_general(kb, ds, _DN_DQ_T,
+                                         preferred_element_type=jnp.float32)
+            if single_k:
+                dq_ref[:, :, pl.ds(i * bq, bq)] = dq_val.astype(dq_ref.dtype)
+            else:
+                dq_ref[:, :, pl.ds(i * bq, bq)] += dq_val
+            return dk, dv
+        return body
+
+    d = q_ref.shape[1]
+    dk = jnp.zeros((G, d, bk), jnp.float32)
+    dv = jnp.zeros((G, d, bk), jnp.float32)
+    dk, dv = jax.lax.fori_loop(qmin, qfull, make_body(True), (dk, dv))
+    dk, dv = jax.lax.fori_loop(qfull, nq, make_body(False), (dk, dv))
+    if scale != 1.0:
+        dk = dk * scale
+    dk_ref[...] = dk.astype(dk_ref.dtype)
+    dv_ref[...] = dv.astype(dv_ref.dtype)
+
+
+def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
+           interpret, dlse=None):
+    BH, d, T = q.shape
+    lse = jnp.broadcast_to(lse_t, (BH, T, LSE_LANES))
+    single_k = (T // bk) == 1
+    if dlse is not None:
+        delta_mode = "ext"
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1) - dlse.astype(jnp.float32)
+        od = jnp.broadcast_to(delta[..., None], (BH, T, LSE_LANES))
+    else:
+        delta_mode = "dot"
+        od = o
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel_t, bq=bq, bk=bk, scale=scale,
+                          causal=causal, t_real=t_real,
+                          delta_mode=delta_mode, single_k=single_k),
+        grid=(BH // bh, T // bk),
+        in_specs=[
+            pl.BlockSpec((bh, d, T), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, d, bk), lambda b, j: (b, 0, j)),
+            pl.BlockSpec((bh, d, bk), lambda b, j: (b, 0, j)),
+            pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, T, LSE_LANES), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, T, LSE_LANES if dlse is not None else d),
+                         lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bh, d, T), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((bh, d, bk), lambda b, j: (b, 0, j)),
+            pl.BlockSpec((bh, d, bk), lambda b, j: (b, 0, j)),
+        ],
+        out_shape=[
+            _sds((BH, d, T), q.dtype if single_k else jnp.float32, q),
+            _sds((BH, d, T), q.dtype, q),
+            _sds((BH, d, T), q.dtype, q),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, od)
+    if scale != 1.0:
+        dq = dq * scale
+    return dq.astype(q.dtype), dk, dv
+
+
 # --------------------------------------------------------------- public API
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
 def _flash(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-           bwd_bq, bwd_bk):
-    o, lse = _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
+           bwd_bq, bwd_bk, qkv_t=False):
+    fwd = _fwd_t if qkv_t else _fwd
+    o, lse = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
     return o, lse[..., 0]
 
 
 def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-               bwd_bq, bwd_bk):
+               bwd_bq, bwd_bk, qkv_t=False):
     from jax.ad_checkpoint import checkpoint_name
     # symbolic_zeros=True wraps primal args in CustomVJPPrimal
     q, k, v = q.value, k.value, v.value
-    o, lse = _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
+    fwd = _fwd_t if qkv_t else _fwd
+    o, lse = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
     # Name o/lse HERE, inside the fwd rule, so the named vars are both
     # the primal outputs and the vjp residuals: under jax.checkpoint a
     # save-policy keeping 'flash_o'/'flash_lse' then satisfies the
@@ -339,7 +560,7 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
 
 
 def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
-               bwd_bk, res, cts):
+               bwd_bk, qkv_t, res, cts):
     # backward may run its own (smaller) blocks: the fused dq/dk/dv pass
     # is ~2x the forward's work, so causal above-diagonal skipping wins
     # more there than grid-step overhead costs
@@ -357,8 +578,9 @@ def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
     # cotangent on lse enters the shared ds = p * (dp - delta) term as
     # ds += p * dlse — i.e. exactly a shift of delta by -dlse. Folding it
     # there costs zero extra kernel work.
-    return _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
-                interpret, dlse=dlse)
+    bwd = _bwd_t if qkv_t else _bwd
+    return bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
+               interpret, dlse=dlse)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
@@ -367,7 +589,8 @@ _flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
 def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
                              block_q=128, block_k=128, block_h=2,
                              interpret=None, heads_major=False,
-                             block_q_bwd=None, block_k_bwd=None):
+                             block_q_bwd=None, block_k_bwd=None,
+                             qkv_t=False):
     """Fused attention over (batch, seq, heads, head_dim) inputs, returning
     ``(o, lse)`` where lse is the per-query logsumexp, (B, H, T) fp32.
 
@@ -390,7 +613,12 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     and a save-policy can keep exactly the flash residuals — making the
     backward reuse them instead of recomputing the forward kernel.
     """
-    if heads_major:
+    if qkv_t:
+        # transposed operands: (batch, heads, head_dim, seq) — the qkv
+        # projection einsum's natural T-minor layout; the kernel consumes
+        # it directly so no relayout copies exist at the call boundary
+        B, H, d, T = q.shape
+    elif heads_major:
         B, H, T, d = q.shape
     else:
         B, T, H, d = q.shape
@@ -405,6 +633,17 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     bwd_bq, bwd_bk, _ = _block_sizes(T, block_q_bwd or bq,
                                      block_k_bwd or bk)
     T_pad = _round_up(T, math.lcm(bq, bk, bwd_bq, bwd_bk))
+    if qkv_t and any(x % 128 for x in (T_pad, bq, bk, bwd_bq, bwd_bk)):
+        # In the transposed layout T (and every block) sits in the LANE
+        # dim, which Mosaic requires in 128 units — shapes/blocks that
+        # don't comply fall back to the standard kernel (one transpose;
+        # correctness over the layout win at tiny T or small blocks)
+        q, k, v = (jnp.swapaxes(x, -1, -2) for x in (q, k, v))
+        return flash_attention_with_lse(
+            q, k, v, causal=causal, scale=scale, block_q=block_q,
+            block_k=block_k, block_h=block_h, interpret=interpret,
+            heads_major=True, block_q_bwd=block_q_bwd,
+            block_k_bwd=block_k_bwd, qkv_t=False)
     bh = max(1, min(block_h, B * H))
     while (B * H) % bh:
         bh -= 1
@@ -412,9 +651,21 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     # head dims (zero columns add 0 to scores and produce zero output
     # columns, and zero cotangent columns backward — exact). d=64 is kept
     # native: the smaller DMA footprint beats the MXU's preference for 128.
+    # The rule applies under qkv_t too: d moves to sublanes for q/k/v but
+    # stays the lane dim of the o output block.
     d_pad = d if d in (64, 128) else _round_up(d, 128)
 
     def fold(x):
+        if qkv_t:
+            # flatten (H, B) — not (B, H): XLA lays the qkv einsum output
+            # out with b inner of the two (b stride < h stride), so the
+            # (H*B) flatten is a free bitcast while (B*H) is an interleave
+            # copy (~1 ms/layer/tensor at 350M). The kernel's G dim is
+            # order-agnostic.
+            x = jnp.swapaxes(x, 0, 1).reshape(H * B, d, T)
+            if T_pad != T or d_pad != d:
+                x = jnp.pad(x, ((0, 0), (0, d_pad - d), (0, T_pad - T)))
+            return x
         if not heads_major:
             x = x.transpose(0, 2, 1, 3)
         x = x.reshape(B * H, T, d)
@@ -427,10 +678,14 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     # per-score-element multiply inside a VPU-bound kernel
     q = q * jnp.asarray(scale, q.dtype)
     o, lse = _flash(fold(q), fold(k), fold(v), 1.0, bool(causal),
-                    bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk)
+                    bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk,
+                    bool(qkv_t))
     if T_pad != T or d_pad != d:
         o = o[:, :T, :d]
         lse = lse[:, :T]
+    if qkv_t:
+        o = o.reshape(H, B, T, d).swapaxes(0, 1)
+        return o, lse.reshape(H, B, T).swapaxes(0, 1)
     o = o.reshape(B, H, T, d)
     if not heads_major:
         o = o.transpose(0, 2, 1, 3)
@@ -440,14 +695,14 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
 def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
                     block_k=128, block_h=2, interpret=None,
                     heads_major=False, block_q_bwd=None,
-                    block_k_bwd=None):
+                    block_k_bwd=None, qkv_t=False):
     """Fused attention over (batch, seq, heads, head_dim); see
     :func:`flash_attention_with_lse` (this drops the lse output)."""
     o, _ = flash_attention_with_lse(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, block_h=block_h, interpret=interpret,
         heads_major=heads_major, block_q_bwd=block_q_bwd,
-        block_k_bwd=block_k_bwd)
+        block_k_bwd=block_k_bwd, qkv_t=qkv_t)
     return o
 
 
