@@ -400,7 +400,7 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
 # ------------------------------------------------ backward, transposed q/k/v
 def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                   dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real,
-                  delta_mode, single_k):
+                  ext_delta, single_k):
     """Fused backward with q/k/v, do AND dq/dk/dv blocked (G, d, T).
 
     Same structure as _bwd_kernel (key-block grid, inner loop over query
@@ -415,9 +415,9 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     do consumed (G, d, T) + delta precomputed outside (+8 ms: the
     delta fusion/broadcast outweighs the saved do relayout), and the
     in-kernel softmax identity delta = sum_j p_ij dp_ij (+11 ms VPU in
-    an already-VPU-bound kernel). delta_mode: 'dot' = rowsum(do * o)
-    with od_ref carrying o; 'ext' = precomputed delta via od_ref
-    (the lse-cotangent path folds -dlse in outside).
+    an already-VPU-bound kernel). ext_delta (as in _bwd_kernel): False = in-kernel
+    rowsum(do * o) with od_ref carrying o; True = precomputed delta via
+    od_ref (the lse-cotangent path folds -dlse in outside).
     """
     ki = pl.program_id(1)
     kb = k_ref[...]                                         # (G, d, bk)
@@ -440,7 +440,7 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
             q = q_ref[:, :, pl.ds(i * bq, bq)]              # (G, d, bq)
             do = do_ref[:, pl.ds(i * bq, bq), :]            # (G, bq, d)
             lse = lse_ref[:, pl.ds(i * bq, bq), :][..., 0]  # (G, bq)
-            if delta_mode == "ext":
+            if ext_delta:
                 delta = od_ref[:, pl.ds(i * bq, bq), :][..., 0]
             else:
                 ob = od_ref[:, pl.ds(i * bq, bq), :]        # (G, bq, d)
@@ -488,17 +488,15 @@ def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
     lse = jnp.broadcast_to(lse_t, (BH, T, LSE_LANES))
     single_k = (T // bk) == 1
     if dlse is not None:
-        delta_mode = "ext"
         delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
                         axis=-1) - dlse.astype(jnp.float32)
         od = jnp.broadcast_to(delta[..., None], (BH, T, LSE_LANES))
     else:
-        delta_mode = "dot"
         od = o
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel_t, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real,
-                          delta_mode=delta_mode, single_k=single_k),
+                          ext_delta=dlse is not None, single_k=single_k),
         grid=(BH // bh, T // bk),
         in_specs=[
             pl.BlockSpec((bh, d, T), lambda b, j: (b, 0, 0)),
@@ -586,11 +584,46 @@ def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
 _flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
 
 
+# o-only variant: training drops lse, but a custom_vjp output cannot be
+# DCE'd out of the remat closed-call — the lane-trim slice alone measured
+# ~6 ms/step at 350M bs=24. This twin never emits the lse output (the
+# residual still saves it for the backward).
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
+def _flash_o(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
+             bwd_bq, bwd_bk, qkv_t=False):
+    fwd = _fwd_t if qkv_t else _fwd
+    o, _ = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
+    return o
+
+
+def _flash_o_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
+                 bwd_bq, bwd_bk, qkv_t=False):
+    (o, _), res = _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real,
+                             interpret, bwd_bq, bwd_bk, qkv_t)
+    return o, res
+
+
+def _flash_o_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
+                 bwd_bk, qkv_t, res, do):
+    from jax.custom_derivatives import SymbolicZero
+    bq, bk = bwd_bq or bq, bwd_bk or bk
+    if isinstance(do, SymbolicZero):
+        do = jnp.zeros(do.shape, do.dtype)
+    q, k, v, o, lse_t = res
+    bwd = _bwd_t if qkv_t else _bwd
+    return bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
+               interpret, dlse=None)
+
+
+_flash_o.defvjp(_flash_o_fwd, _flash_o_bwd, symbolic_zeros=True)
+
+
 def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
                              block_q=128, block_k=128, block_h=2,
                              interpret=None, heads_major=False,
                              block_q_bwd=None, block_k_bwd=None,
-                             qkv_t=False):
+                             qkv_t=False, _with_lse=True):
     """Fused attention over (batch, seq, heads, head_dim) inputs, returning
     ``(o, lse)`` where lse is the per-query logsumexp, (B, H, T) fp32.
 
@@ -643,7 +676,8 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
             q, k, v, causal=causal, scale=scale, block_q=block_q,
             block_k=block_k, block_h=block_h, interpret=interpret,
             heads_major=True, block_q_bwd=block_q_bwd,
-            block_k_bwd=block_k_bwd, qkv_t=False)
+            block_k_bwd=block_k_bwd, qkv_t=False,
+            _with_lse=_with_lse)
     bh = max(1, min(block_h, B * H))
     while (B * H) % bh:
         bh -= 1
@@ -677,19 +711,30 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     # so autodiff chains dq): one (BH, T, d) multiply instead of a
     # per-score-element multiply inside a VPU-bound kernel
     q = q * jnp.asarray(scale, q.dtype)
-    o, lse = _flash(fold(q), fold(k), fold(v), 1.0, bool(causal),
-                    bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk,
-                    bool(qkv_t))
+    args = (fold(q), fold(k), fold(v), 1.0, bool(causal),
+            bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk, bool(qkv_t))
+    if _with_lse:
+        o, lse = _flash(*args)
+    else:
+        # o-only twin: a custom_vjp output can't be DCE'd out of the
+        # remat closed-call, so the dropped lse (and its lane-trim
+        # slice, ~6 ms/step at 350M) must never be emitted at all
+        o, lse = _flash_o(*args), None
     if T_pad != T or d_pad != d:
         o = o[:, :T, :d]
-        lse = lse[:, :T]
+        lse = lse[:, :T] if lse is not None else None
     if qkv_t:
+        # (H, B, ...) is the kernel's fold order; swap back to the
+        # conventional (B, H, ...). (Exposing the (H, B, ...) form to the
+        # caller measured neutral at 350M: it removes this interleave
+        # copy but the hbte wo einsum pays it back in a worse emitter.)
         o = o.reshape(H, B, T, d).swapaxes(0, 1)
-        return o, lse.reshape(H, B, T).swapaxes(0, 1)
+        return o, (lse.reshape(H, B, T).swapaxes(0, 1)
+                   if lse is not None else None)
     o = o.reshape(B, H, T, d)
     if not heads_major:
         o = o.transpose(0, 2, 1, 3)
-    return o, lse.reshape(B, H, T)
+    return o, lse.reshape(B, H, T) if lse is not None else None
 
 
 def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
@@ -697,12 +742,12 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
                     heads_major=False, block_q_bwd=None,
                     block_k_bwd=None, qkv_t=False):
     """Fused attention over (batch, seq, heads, head_dim); see
-    :func:`flash_attention_with_lse` (this drops the lse output)."""
+    :func:`flash_attention_with_lse` (this never emits the lse output)."""
     o, _ = flash_attention_with_lse(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, block_h=block_h, interpret=interpret,
         heads_major=heads_major, block_q_bwd=block_q_bwd,
-        block_k_bwd=block_k_bwd, qkv_t=qkv_t)
+        block_k_bwd=block_k_bwd, qkv_t=qkv_t, _with_lse=False)
     return o
 
 

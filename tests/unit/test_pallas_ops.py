@@ -441,8 +441,10 @@ class TestFlashTransposedQKV:
 
     @pytest.mark.parametrize("blocks", [(128, 128), (256, 256)])
     def test_grads_match_dense(self, blocks):
-        # (128, 128): multi-key-block grid (ext/dot delta, fp32 dq accum);
-        # (256, 256): single key block (bf16-direct dq, in-kernel delta)
+        # (128, 128): multi-key-block grid (fp32 dq accumulation);
+        # (256, 256): single key block (bf16-direct dq). Both use the
+        # in-kernel rowsum(do*o) delta — the precomputed-delta branch is
+        # exercised by test_lse_grad_ext_delta below.
         q, k, v = self._qkv()
 
         def loss_f(q, k, v):
@@ -485,6 +487,37 @@ class TestFlashTransposedQKV:
         gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
         gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
         for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_lse_grad_ext_delta(self):
+        # a loss term on the lse output sends a nonzero lse cotangent
+        # into the backward -> the precomputed (ext) delta branch of
+        # _bwd_kernel_t, otherwise unreachable from flash_attention
+        from deepspeed_tpu.ops.pallas.flash_attention import (
+            flash_attention_with_lse)
+        q, k, v = self._qkv()
+
+        def loss_f(q, k, v):
+            o, lse = flash_attention_with_lse(q, k, v, qkv_t=True,
+                                              block_q=256, block_k=256)
+            return jnp.sum(o ** 2) + 0.1 * jnp.sum(lse ** 2)
+
+        def loss_r(q, k, v):
+            t = lambda x: x.transpose(0, 3, 1, 2)
+            qq, kk, vv = t(q), t(k), t(v)
+            s = jnp.einsum("bthd,bshd->bhts", qq, kk) / np.sqrt(q.shape[2])
+            mask = jnp.tril(jnp.ones((s.shape[-2], s.shape[-1]), bool))
+            s = jnp.where(mask[None, None], s, -1e30)
+            lse = jax.nn.logsumexp(s, axis=-1)          # (B, H, T)
+            o = jnp.einsum("bhts,bshd->bthd", jax.nn.softmax(s, -1), vv)
+            return jnp.sum(o ** 2) + 0.1 * jnp.sum(lse ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            # gf: (B, H, d, T) -> reference layout (B, H, d, T) too (the
+            # reference loss takes the same transposed inputs)
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4)
 
