@@ -1,0 +1,435 @@
+"""HuggingFace checkpoint ingestion: safetensors/bin -> model param trees.
+
+Counterpart of the reference's HF loaders — the v2 serving stack's
+``HuggingFaceCheckpointEngine``
+(/root/reference/deepspeed/inference/v2/checkpoint/huggingface_engine.py:16)
+and the v1 sharded loader
+(/root/reference/deepspeed/inference/engine.py:331
+``load_model_with_checkpoint``). TPU-first differences: weights land as
+numpy/jax arrays mapped into each family's FUNCTIONAL param tree (stacked
+per-layer tensors under ``blocks``), not injected into torch modules; TP
+sharding then falls out of ``model.partition_specs()`` + device_put — no
+per-family policy classes are needed beyond the key mapping itself.
+
+Entry points:
+  read_hf_state_dict(model_dir)  -> {name: np.ndarray}
+  load_pretrained(model_dir, ...) -> (model, params)   # dispatch on
+                                                       # config model_type
+  convert_<family>(hf_cfg, sd, dtype) -> (config, params)
+
+Supported model_type values: gpt2, opt, llama, mistral, qwen2, phi,
+falcon, mixtral. Weights load from *.safetensors (single or
+index-sharded) or pytorch_model.bin (torch CPU).
+"""
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["read_hf_state_dict", "read_hf_config", "load_pretrained",
+           "CONVERTERS"]
+
+
+# --------------------------------------------------------------------- I/O
+def read_hf_config(model_dir):
+    with open(os.path.join(model_dir, "config.json")) as f:
+        return json.load(f)
+
+
+def _load_safetensors(path):
+    from safetensors.numpy import load_file
+    try:
+        return load_file(path)
+    except Exception:
+        # bf16 tensors round-trip through torch (numpy has no bf16)
+        from safetensors.torch import load_file as tload
+        return {k: _to_np(v) for k, v in tload(path).items()}
+
+
+def _to_np(t):
+    import torch
+    if t.dtype == torch.bfloat16:
+        # keep values exactly: bf16 -> fp32 numpy
+        return t.to(torch.float32).numpy()
+    return t.numpy()
+
+
+def read_hf_state_dict(model_dir):
+    """Read all weights under ``model_dir`` into {name: np.ndarray}."""
+    idx = os.path.join(model_dir, "model.safetensors.index.json")
+    single = os.path.join(model_dir, "model.safetensors")
+    binf = os.path.join(model_dir, "pytorch_model.bin")
+    sd = {}
+    if os.path.exists(idx):
+        with open(idx) as f:
+            files = sorted(set(json.load(f)["weight_map"].values()))
+        for fn in files:
+            sd.update(_load_safetensors(os.path.join(model_dir, fn)))
+    elif os.path.exists(single):
+        sd.update(_load_safetensors(single))
+    elif os.path.exists(binf):
+        import torch
+        raw = torch.load(binf, map_location="cpu", weights_only=True)
+        sd.update({k: _to_np(v) for k, v in raw.items()})
+    else:
+        raise FileNotFoundError(
+            f"no model.safetensors(.index.json) or pytorch_model.bin "
+            f"under {model_dir}")
+    return sd
+
+
+def _stack(layers, key):
+    return np.stack([l[key] for l in layers])
+
+
+# --------------------------------------------------------- family converters
+def convert_gpt2(hf, sd, dtype="bfloat16"):
+    """HF gpt2 (Conv1D weights are stored (in, out) — no transpose)."""
+    from ..models.gpt2 import GPT2Config
+    pre = "transformer." if "transformer.wte.weight" in sd else ""
+    L = hf["n_layer"]
+    cfg = GPT2Config(vocab_size=hf["vocab_size"],
+                     max_seq_len=hf["n_positions"], n_layer=L,
+                     n_head=hf["n_head"], d_model=hf["n_embd"],
+                     dtype=dtype)
+    g = lambda k: sd[pre + k]
+    layers = [{
+        "ln1_scale": g(f"h.{i}.ln_1.weight"),
+        "ln1_bias": g(f"h.{i}.ln_1.bias"),
+        "wqkv": g(f"h.{i}.attn.c_attn.weight"),
+        "bqkv": g(f"h.{i}.attn.c_attn.bias"),
+        "wo": g(f"h.{i}.attn.c_proj.weight"),
+        "bo": g(f"h.{i}.attn.c_proj.bias"),
+        "ln2_scale": g(f"h.{i}.ln_2.weight"),
+        "ln2_bias": g(f"h.{i}.ln_2.bias"),
+        "wup": g(f"h.{i}.mlp.c_fc.weight"),
+        "bup": g(f"h.{i}.mlp.c_fc.bias"),
+        "wdown": g(f"h.{i}.mlp.c_proj.weight"),
+        "bdown": g(f"h.{i}.mlp.c_proj.bias"),
+    } for i in range(L)]
+    params = {
+        "wte": g("wte.weight"),
+        "wpe": g("wpe.weight"),
+        "lnf_scale": g("ln_f.weight"),
+        "lnf_bias": g("ln_f.bias"),
+        "blocks": {k: _stack(layers, k) for k in layers[0]},
+    }
+    return cfg, _model_cast(params, cfg, dtype)
+
+
+def convert_opt(hf, sd, dtype="bfloat16"):
+    """HF OPT: linear weights are (out, in) -> transpose; positions are
+    offset by 2 padding rows (sliced off here, reference
+    module_inject/containers/opt.py handles the same detail)."""
+    from ..models.opt import OPTConfig
+    if hf.get("word_embed_proj_dim", hf["hidden_size"]) != hf["hidden_size"] \
+            or not hf.get("do_layer_norm_before", True):
+        raise ValueError(
+            "only standard pre-LN OPT variants are supported (opt-350m's "
+            "word_embed_proj_dim / post-LN layout is not)")
+    pre = "model.decoder." if "model.decoder.embed_tokens.weight" in sd \
+        else "decoder."
+    L = hf["num_hidden_layers"]
+    D = hf["hidden_size"]
+    cfg = OPTConfig(vocab_size=hf["vocab_size"],
+                    max_seq_len=hf["max_position_embeddings"],
+                    n_layer=L, n_head=hf["num_attention_heads"],
+                    d_model=D, dtype=dtype)
+    g = lambda k: sd[pre + k]
+
+    def qkv(i):
+        ws = [g(f"layers.{i}.self_attn.{m}_proj.weight").T
+              for m in ("q", "k", "v")]
+        bs = [g(f"layers.{i}.self_attn.{m}_proj.bias")
+              for m in ("q", "k", "v")]
+        return np.concatenate(ws, axis=1), np.concatenate(bs)
+
+    layers = []
+    for i in range(L):
+        wqkv, bqkv = qkv(i)
+        layers.append({
+            "ln1_scale": g(f"layers.{i}.self_attn_layer_norm.weight"),
+            "ln1_bias": g(f"layers.{i}.self_attn_layer_norm.bias"),
+            "wqkv": wqkv, "bqkv": bqkv,
+            "wo": g(f"layers.{i}.self_attn.out_proj.weight").T,
+            "bo": g(f"layers.{i}.self_attn.out_proj.bias"),
+            "ln2_scale": g(f"layers.{i}.final_layer_norm.weight"),
+            "ln2_bias": g(f"layers.{i}.final_layer_norm.bias"),
+            "wup": g(f"layers.{i}.fc1.weight").T,
+            "bup": g(f"layers.{i}.fc1.bias"),
+            "wdown": g(f"layers.{i}.fc2.weight").T,
+            "bdown": g(f"layers.{i}.fc2.bias"),
+        })
+    params = {
+        "wte": g("embed_tokens.weight"),
+        "wpe": g("embed_positions.weight")[2:],   # drop the 2 pad slots
+        "lnf_scale": g("final_layer_norm.weight"),
+        "lnf_bias": g("final_layer_norm.bias"),
+        "blocks": {k: _stack(layers, k) for k in layers[0]},
+    }
+    return cfg, _model_cast(params, cfg, dtype)
+
+
+def _llama_like(hf, sd, cfg, dtype, *, pre="model.", qkv_bias=False,
+                proj_bias=False, gated=True, ln=False, fused_qkv=False,
+                shared_ln=False, mlp_names=("gate_proj", "up_proj",
+                                            "down_proj"),
+                o_name="o_proj", moe=False, layer_prefix="layers"):
+    L = cfg.n_layer
+    H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
+    g = lambda k: sd[pre + k]
+
+    def maybe(k):
+        return sd.get(pre + k)
+
+    layers = []
+    for i in range(L):
+        lp = f"{layer_prefix}.{i}."
+        e = {}
+        if fused_qkv:
+            # falcon-style fused query_key_value with MQA tail: rows are
+            # [q (H*hd), k (KVH*hd), v (KVH*hd)] in the (out, in) weight
+            w = g(lp + "self_attention.query_key_value.weight").T
+            e["wq"] = w[:, :H * hd]
+            e["wk"] = w[:, H * hd:(H + KVH) * hd]
+            e["wv"] = w[:, (H + KVH) * hd:]
+            e["wo"] = g(lp + "self_attention.dense.weight").T
+        else:
+            e["wq"] = g(lp + "self_attn.q_proj.weight").T
+            e["wk"] = g(lp + "self_attn.k_proj.weight").T
+            e["wv"] = g(lp + "self_attn.v_proj.weight").T
+            e["wo"] = g(lp + f"self_attn.{o_name}.weight").T
+        if qkv_bias:
+            e["bq"] = g(lp + "self_attn.q_proj.bias")
+            e["bk"] = g(lp + "self_attn.k_proj.bias")
+            e["bv"] = g(lp + "self_attn.v_proj.bias")
+        if proj_bias:
+            e["bo"] = g(lp + f"self_attn.{o_name}.bias")
+        if moe:
+            E = cfg.num_experts
+            e["moe_gate"] = g(lp + "block_sparse_moe.gate.weight").T
+            for ours, theirs in (("moe_w1", "w1"), ("moe_w3", "w3"),
+                                 ("moe_w2", "w2")):
+                e[ours] = np.stack([
+                    g(lp + f"block_sparse_moe.experts.{j}.{theirs}.weight").T
+                    for j in range(E)])
+        elif gated:
+            gate_n, up_n, down_n = mlp_names
+            e["wgate"] = g(lp + f"mlp.{gate_n}.weight").T
+            e["wup"] = g(lp + f"mlp.{up_n}.weight").T
+            e["wdown"] = g(lp + f"mlp.{down_n}.weight").T
+        else:
+            up_n, down_n = mlp_names
+            e["wup"] = g(lp + f"mlp.{up_n}.weight").T
+            e["wdown"] = g(lp + f"mlp.{down_n}.weight").T
+            if proj_bias:
+                e["bup"] = g(lp + f"mlp.{up_n}.bias")
+                e["bdown"] = g(lp + f"mlp.{down_n}.bias")
+        if ln:
+            ln1 = "input_layernorm" if maybe(lp + "input_layernorm.weight") \
+                is not None else "ln_attn"
+            e["rms1"] = g(lp + f"{ln1}.weight")
+            e["b1"] = g(lp + f"{ln1}.bias")
+            if shared_ln:
+                # falcon-7b/phi parallel block: ONE input LN feeds both
+                # branches; the tree keeps both slots, tied at load
+                e["rms2"], e["b2"] = e["rms1"], e["b1"]
+            else:
+                e["rms2"] = g(lp + "post_attention_layernorm.weight")
+                e["b2"] = g(lp + "post_attention_layernorm.bias")
+        else:
+            e["rms1"] = g(lp + "input_layernorm.weight")
+            e["rms2"] = g(lp + "post_attention_layernorm.weight")
+        layers.append(e)
+
+    params = {"blocks": {k: _stack(layers, k) for k in layers[0]}}
+    return params, g, maybe
+
+
+def convert_llama(hf, sd, dtype="bfloat16"):
+    from ..models.llama import Llama, LlamaConfig
+    window = hf.get("sliding_window")
+    if window and window < hf["max_position_embeddings"]:
+        raise NotImplementedError(
+            f"checkpoint uses sliding-window attention (window={window} < "
+            f"max_position_embeddings={hf['max_position_embeddings']}); "
+            "the window knob is not implemented yet — truncate "
+            "max_position_embeddings to the window to serve short "
+            "contexts correctly")
+    qkv_bias = bool(hf.get("attention_bias", False))
+    cfg = LlamaConfig(
+        qkv_bias=qkv_bias,
+        vocab_size=hf["vocab_size"],
+        max_seq_len=hf["max_position_embeddings"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads",
+                          hf["num_attention_heads"]),
+        d_model=hf["hidden_size"], d_ff=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_eps=hf.get("rms_norm_eps", 1e-5),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        dtype=dtype)
+    params, g, maybe = _llama_like(hf, sd, cfg, dtype, qkv_bias=qkv_bias)
+    params["wte"] = g("embed_tokens.weight")
+    params["norm_f"] = g("norm.weight")
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"]
+    return cfg, _model_cast(params, cfg, dtype)
+
+
+def convert_qwen2(hf, sd, dtype="bfloat16"):
+    from ..models.qwen import QwenConfig
+    cfg = QwenConfig(
+        vocab_size=hf["vocab_size"],
+        max_seq_len=hf["max_position_embeddings"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads",
+                          hf["num_attention_heads"]),
+        d_model=hf["hidden_size"], d_ff=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 1000000.0),
+        rms_eps=hf.get("rms_norm_eps", 1e-6),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        dtype=dtype)
+    params, g, maybe = _llama_like(hf, sd, cfg, dtype, qkv_bias=True)
+    params["wte"] = g("embed_tokens.weight")
+    params["norm_f"] = g("norm.weight")
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"]
+    return cfg, _model_cast(params, cfg, dtype)
+
+
+def convert_phi(hf, sd, dtype="bfloat16"):
+    from ..models.phi import PhiConfig
+    cfg = PhiConfig(
+        vocab_size=hf["vocab_size"],
+        max_seq_len=hf["max_position_embeddings"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads")
+        or hf["num_attention_heads"],
+        d_model=hf["hidden_size"], d_ff=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_eps=hf.get("layer_norm_eps", 1e-5),
+        rotary_pct=hf.get("partial_rotary_factor", 0.4),
+        dtype=dtype)
+    params, g, maybe = _llama_like(
+        hf, sd, cfg, dtype, qkv_bias=True, proj_bias=True, gated=False,
+        ln=True, shared_ln=True, mlp_names=("fc1", "fc2"), o_name="dense")
+    params["wte"] = g("embed_tokens.weight")
+    params["norm_f"] = g("final_layernorm.weight")
+    params["norm_f_b"] = g("final_layernorm.bias")
+    params["lm_head"] = sd["lm_head.weight"]
+    params["lm_head_b"] = sd["lm_head.bias"]
+    return cfg, _model_cast(params, cfg, dtype)
+
+
+def convert_falcon(hf, sd, dtype="bfloat16"):
+    from ..models.falcon import FalconConfig
+    n_head = hf["num_attention_heads"]
+    cfg = FalconConfig(
+        vocab_size=hf["vocab_size"],
+        max_seq_len=hf.get("max_position_embeddings", 2048),
+        n_layer=hf["num_hidden_layers"], n_head=n_head,
+        n_kv_heads=hf.get("num_kv_heads", 1) if hf.get(
+            "new_decoder_architecture") else 1,
+        d_model=hf["hidden_size"], d_ff=4 * hf["hidden_size"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_eps=hf.get("layer_norm_epsilon", 1e-5),
+        tie_embeddings=True, dtype=dtype)
+    pre = "transformer."
+    params, g, maybe = _llama_like(
+        hf, sd, cfg, dtype, pre=pre, fused_qkv=True, gated=False, ln=True,
+        shared_ln=True, mlp_names=("dense_h_to_4h", "dense_4h_to_h"),
+        layer_prefix="h")
+    params["wte"] = g("word_embeddings.weight")
+    params["norm_f"] = g("ln_f.weight")
+    params["norm_f_b"] = g("ln_f.bias")
+    return cfg, _model_cast(params, cfg, dtype)
+
+
+def convert_mixtral(hf, sd, dtype="bfloat16"):
+    from ..models.mixtral import MixtralConfig
+    cfg = MixtralConfig(
+        vocab_size=hf["vocab_size"],
+        max_seq_len=hf["max_position_embeddings"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads",
+                          hf["num_attention_heads"]),
+        d_model=hf["hidden_size"], d_ff=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 1000000.0),
+        rms_eps=hf.get("rms_norm_eps", 1e-5),
+        num_experts=hf["num_local_experts"],
+        moe_top_k=hf.get("num_experts_per_tok", 2),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        dtype=dtype)
+    params, g, maybe = _llama_like(hf, sd, cfg, dtype, moe=True)
+    params["wte"] = g("embed_tokens.weight")
+    params["norm_f"] = g("norm.weight")
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"]
+    # router stays fp32 (routing is precision-sensitive)
+    return cfg, _model_cast(params, cfg, dtype,
+                            fp32_keys=("moe_gate",))
+
+
+CONVERTERS = {
+    "gpt2": convert_gpt2,
+    "opt": convert_opt,
+    "llama": convert_llama,
+    "mistral": convert_llama,      # same weight tree; converter rejects
+                                   # configs needing a sliding window
+    "qwen2": convert_qwen2,
+    "phi": convert_phi,
+    "falcon": convert_falcon,
+    "mixtral": convert_mixtral,
+}
+
+_MODEL_CLASSES = {
+    "gpt2": ("..models.gpt2", "GPT2"),
+    "opt": ("..models.opt", "OPT"),
+    "llama": ("..models.llama", "Llama"),
+    "mistral": ("..models.llama", "Llama"),
+    "qwen2": ("..models.qwen", "Qwen"),
+    "phi": ("..models.phi", "Phi"),
+    "falcon": ("..models.falcon", "Falcon"),
+    "mixtral": ("..models.mixtral", "Mixtral"),
+}
+
+
+def _model_cast(params, cfg, dtype, fp32_keys=()):
+    """numpy tree -> jax arrays in the model dtype (fp32_keys stay f32)."""
+    import jax
+    import jax.numpy as jnp
+    dt = jnp.dtype(dtype)
+
+    def walk(tree, path=()):
+        if isinstance(tree, dict):
+            return {k: walk(v, path + (k,)) for k, v in tree.items()}
+        keep = any(k in fp32_keys for k in path)
+        return jnp.asarray(tree, jnp.float32 if keep else dt)
+    return walk(params)
+
+
+def load_pretrained(model_dir, dtype="bfloat16"):
+    """Load an HF checkpoint directory -> (model, params).
+
+    The model is one of this repo's functional families; params are in
+    the family's stacked-layer tree, cast to ``dtype``. Dispatches on
+    config.json model_type.
+    """
+    import importlib
+    hf = read_hf_config(model_dir)
+    mt = hf.get("model_type")
+    if mt not in CONVERTERS:
+        raise ValueError(
+            f"unsupported model_type {mt!r}; supported: "
+            f"{sorted(CONVERTERS)}")
+    sd = read_hf_state_dict(model_dir)
+    cfg, params = CONVERTERS[mt](hf, sd, dtype=dtype)
+    mod_name, cls_name = _MODEL_CLASSES[mt]
+    mod = importlib.import_module(mod_name, package=__package__)
+    return getattr(mod, cls_name)(cfg), params

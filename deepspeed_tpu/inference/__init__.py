@@ -2,3 +2,19 @@ from .config import DeepSpeedInferenceConfig
 from .engine import InferenceEngine
 from .v2 import (InferenceEngineV2, RaggedInferenceEngineConfig,
                  BlockedAllocator, DSStateManager)
+
+
+def build_hf_engine(path, config=None, dtype="bfloat16", v2=True,
+                    **kwargs):
+    """Serve a HuggingFace checkpoint directory.
+
+    Counterpart of the reference's engine factory
+    (/root/reference/deepspeed/inference/v2/engine_factory.py:66
+    ``build_hf_engine``): reads config.json + safetensors via
+    checkpoint.hf.load_pretrained, then builds the v2 continuous-batching
+    engine (or the v1 engine with ``v2=False``) over the real weights.
+    """
+    from ..checkpoint.hf import load_pretrained
+    model, params = load_pretrained(path, dtype=dtype)
+    cls = InferenceEngineV2 if v2 else InferenceEngine
+    return cls(model, config=config, params=params, **kwargs)

@@ -5,6 +5,15 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def resolve_flash(value):
+    """Resolve a use_flash_attention config value: "auto" -> pallas flash
+    on TPU, dense elsewhere; True/False force."""
+    import jax
+    if value == "auto":
+        return jax.default_backend() == "tpu"
+    return bool(value)
+
+
 def constrain_fn():
     """Sharding constraints are advisory: no-ops without an active mesh
     (single-device tests / eager use) and under fully-manual meshes

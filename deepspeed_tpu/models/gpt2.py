@@ -48,7 +48,10 @@ class GPT2Config:
     dtype: str = "bfloat16"
     remat: bool = True
     remat_policy: str = "nothing_saveable"
-    use_flash_attention: bool = False  # pallas kernel (TPU only)
+    # pallas flash kernel: "auto" (default) = on when running on TPU,
+    # dense path elsewhere; True/False force. The benchmarked fast path
+    # is the default — users no longer opt in via env/config.
+    use_flash_attention: object = "auto"
     flash_block_q: int = 128           # pallas attention tile sizes
     flash_block_k: int = 128
     flash_block_h: int = 2             # (batch*head) instances per grid step
@@ -87,6 +90,12 @@ class GPT2Config:
     # default is off; the kernel stays available for standalone use.
     # 'auto' = on TPU when d_model is lane-tileable; True forces.
     fused_layernorm: object = False
+
+    @property
+    def flash_on(self):
+        """Resolved use_flash_attention (see common.resolve_flash)."""
+        from .common import resolve_flash
+        return resolve_flash(self.use_flash_attention)
 
     @property
     def d_head(self):
@@ -250,7 +259,7 @@ class GPT2:
             # segments remat. Backward then runs zero extra flash kernels
             # and recomputes only matmul-light segments.
             def split_block(x, layer, lrng):
-                hm = cfg.use_flash_attention and not seq_sharded
+                hm = cfg.flash_on and not seq_sharded
                 pre = jax.checkpoint(partial(
                     self.block_qkv, constrain=constrain, act_spec=act_spec,
                     heads_major=hm))
@@ -369,7 +378,7 @@ class GPT2:
             attn = ring_attention_sharded(
                 q, kk, v, jax.sharding.get_abstract_mesh(),
                 batch_spec=P(BATCH_AXES), head_axis="tensor")
-        elif cfg.use_flash_attention and not seq_sharded:
+        elif cfg.flash_on and not seq_sharded:
             # pallas fused attention: O(T) memory, fp32 accumulation
             # (ops/pallas/flash_attention.py). Heads shard over 'tensor'.
             # Inputs arrive from block_qkv as (B, H, hd, T) when
@@ -450,7 +459,7 @@ class GPT2:
         """One transformer block: (B, T, D) -> (B, T, D), plus aux loss.
         Shared by the dense scan path and the pipelined executor
         (models/gpt2_pipe.py)."""
-        hm = self.config.use_flash_attention and not seq_sharded
+        hm = self.config.flash_on and not seq_sharded
         q, kk, v = self.block_qkv(x, layer, constrain=constrain,
                                   act_spec=act_spec, heads_major=hm)
         attn = self.block_attn(q, kk, v, causal=causal, constrain=constrain,

@@ -68,7 +68,10 @@ class GPT2Pipe(GPT2):
             raise NotImplementedError(
                 "ring attention inside the pipelined region (nested "
                 "shard_map) is not supported; use Ulysses (dense) with pipe")
-        if cfg.use_flash_attention:
+        if cfg.use_flash_attention is True:
+            # explicit force only: "auto" resolves to the dense path
+            # inside the pipelined region (pallas_call under a
+            # partial-manual shard_map is not supported)
             raise NotImplementedError(
                 "flash attention inside the pipelined region is not "
                 "supported yet (pallas_call under a partial-manual "
@@ -153,7 +156,8 @@ class GPT2Pipe(GPT2):
         if S == 1 or cfg.pipe_schedule != "1f1b":
             return super().loss(params, batch, rng=rng, train=train,
                                 seq_sharded=seq_sharded)
-        if cfg.use_flash_attention or cfg.attention_backend == "ring":
+        if cfg.use_flash_attention is True \
+                or cfg.attention_backend == "ring":
             raise NotImplementedError(
                 "flash/ring attention inside the pipelined region is not "
                 "supported; use the dense backend with pipe")

@@ -43,7 +43,8 @@ class LlamaConfig:
     tie_embeddings: bool = False
     # chunked cross entropy (see gpt2.GPT2Config.loss_chunk); 0 = off
     loss_chunk: int = 0
-    use_flash_attention: bool = False  # pallas kernel (TPU)
+    # "auto" (default) = pallas flash kernel on TPU, dense elsewhere
+    use_flash_attention: object = "auto"
     flash_block_q: int = 512
     flash_block_k: int = 1024
     # architecture knobs covering the reference v2 model families
@@ -59,6 +60,15 @@ class LlamaConfig:
     # 'rms' (llama/qwen/mixtral) or 'ln' (falcon/phi LayerNorm with
     # learned bias; adds b1/b2/norm_f_b params)
     norm_type: str = "rms"
+    # phi-style learned biases on the output projection, MLP and lm head
+    # (adds bo/bup/bdown (+bgate) and lm_head_b params)
+    proj_bias: bool = False
+
+    @property
+    def flash_on(self):
+        """Resolved use_flash_attention (see common.resolve_flash)."""
+        from .common import resolve_flash
+        return resolve_flash(self.use_flash_attention)
 
     @property
     def d_head(self):
@@ -78,9 +88,13 @@ class LlamaConfig:
                  + (3 if self.mlp_gated else 2) * D * F)
         if self.qkv_bias:
             block += D + 2 * kvd
+        if self.proj_bias:
+            block += 2 * D + F * (2 if self.mlp_gated else 1)
         if self.norm_type == "ln":
             block += 2 * D                   # norm biases
         head = 0 if self.tie_embeddings else V * D
+        if self.proj_bias:
+            head += V
         extra_f = D if self.norm_type == "ln" else 0
         return V * D + self.n_layer * block + D + extra_f + head
 
@@ -180,6 +194,13 @@ class Llama:
             params["blocks"]["bq"] = jnp.zeros((L, D), dt)
             params["blocks"]["bk"] = jnp.zeros((L, kvd), dt)
             params["blocks"]["bv"] = jnp.zeros((L, kvd), dt)
+        if cfg.proj_bias:
+            params["blocks"]["bo"] = jnp.zeros((L, D), dt)
+            params["blocks"]["bup"] = jnp.zeros((L, F), dt)
+            params["blocks"]["bdown"] = jnp.zeros((L, D), dt)
+            if cfg.mlp_gated:
+                params["blocks"]["bgate"] = jnp.zeros((L, F), dt)
+            params["lm_head_b"] = jnp.zeros((V,), dt)
         if cfg.norm_type == "ln":
             params["blocks"]["b1"] = jnp.zeros((L, D), dt)
             params["blocks"]["b2"] = jnp.zeros((L, D), dt)
@@ -212,6 +233,13 @@ class Llama:
             specs["blocks"]["bq"] = P(None, "tensor")
             specs["blocks"]["bk"] = P(None, "tensor")
             specs["blocks"]["bv"] = P(None, "tensor")
+        if self.config.proj_bias:
+            specs["blocks"]["bo"] = P(None, None)
+            specs["blocks"]["bup"] = P(None, "tensor")
+            specs["blocks"]["bdown"] = P(None, None)
+            if self.config.mlp_gated:
+                specs["blocks"]["bgate"] = P(None, "tensor")
+            specs["lm_head_b"] = P()
         if self.config.norm_type == "ln":
             specs["blocks"]["b1"] = P(None, None)
             specs["blocks"]["b2"] = P(None, None)
@@ -240,8 +268,11 @@ class Llama:
             x = _rms_norm(x, params["norm_f"], self.config.rms_eps)
         w = params["wte"] if self.config.tie_embeddings else \
             params["lm_head"]
-        return jnp.einsum("btd,vd->btv", x, w,
-                          preferred_element_type=jnp.float32)
+        logits = jnp.einsum("btd,vd->btv", x, w,
+                            preferred_element_type=jnp.float32)
+        if self.config.proj_bias:
+            logits = logits + params["lm_head_b"].astype(jnp.float32)
+        return logits
 
     def _attn_proj(self, x, layer):
         cfg = self.config
@@ -272,13 +303,30 @@ class Llama:
             [_rope(x[..., :rot], pos, cfg.rope_theta), x[..., rot:]],
             axis=-1)
 
+    def _wo(self, attn, layer):
+        """Output projection (+ phi-style bias when proj_bias)."""
+        out = attn @ layer["wo"]
+        if self.config.proj_bias:
+            out = out + layer["bo"]
+        return out
+
     def _mlp(self, x, layer):
         cfg = self.config
         h = self._norm(x, layer, 2)
+        pb = cfg.proj_bias
         if not cfg.mlp_gated:                 # falcon/phi plain-gelu MLP
-            return jax.nn.gelu(h @ layer["wup"]) @ layer["wdown"]
-        gate = jax.nn.silu(h @ layer["wgate"])
-        return (gate * (h @ layer["wup"])) @ layer["wdown"]
+            u = h @ layer["wup"]
+            if pb:
+                u = u + layer["bup"]
+            out = jax.nn.gelu(u) @ layer["wdown"]
+            return out + layer["bdown"] if pb else out
+        g = h @ layer["wgate"]
+        u = h @ layer["wup"]
+        if pb:
+            g = g + layer["bgate"]
+            u = u + layer["bup"]
+        out = (jax.nn.silu(g) * u) @ layer["wdown"]
+        return out + layer["bdown"] if pb else out
 
     def block_forward(self, x, layer, pos, *, causal, constrain, act_spec):
         cfg = self.config
@@ -294,7 +342,7 @@ class Llama:
         v = constrain(v, head_spec)
         kk = _repeat_kv(kk, H // KVH)
         v = _repeat_kv(v, H // KVH)
-        if cfg.use_flash_attention:
+        if cfg.flash_on:
             from ..ops.pallas.flash_attention import flash_attention
             attn = flash_attention(q, kk, v, causal=True,
                                    block_q=cfg.flash_block_q,
@@ -308,7 +356,7 @@ class Llama:
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs,
                               v).reshape(B, T, H * hd)
-        attn_out = constrain(attn, act_spec) @ layer["wo"]
+        attn_out = self._wo(constrain(attn, act_spec), layer)
         if cfg.parallel_block:
             # falcon/phi: attention and MLP branch from the same input
             x = x + attn_out + self._mlp(x, layer)
@@ -411,7 +459,7 @@ class Llama:
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
-            attn_out = attn.reshape(B, T, H * hd) @ layer["wo"]
+            attn_out = self._wo(attn.reshape(B, T, H * hd), layer)
             if cfg.parallel_block:
                 x = x + attn_out + self._mlp(x, layer)
             else:
@@ -476,7 +524,7 @@ class Llama:
             scores = jnp.where(mask[None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
-            attn_out = attn.reshape(1, T, H * hd) @ layer["wo"]
+            attn_out = self._wo(attn.reshape(1, T, H * hd), layer)
             if cfg.parallel_block:
                 x = x + attn_out + self._mlp(x, layer)
             else:
@@ -518,7 +566,7 @@ class Llama:
             from ..ops.pallas.paged_attention import paged_decode_attention
             attn = paged_decode_attention(q[:, 0], kc, vc, block_tables,
                                           lengths)
-            attn_out = attn.reshape(B, 1, H * hd) @ layer["wo"]
+            attn_out = self._wo(attn.reshape(B, 1, H * hd), layer)
             if cfg.parallel_block:
                 x = x + attn_out + self._mlp(x, layer)
             else:

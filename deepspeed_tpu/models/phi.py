@@ -27,6 +27,7 @@ class PhiConfig(LlamaConfig):
     mlp_gated: bool = False              # plain gelu MLP
     norm_type: str = "ln"                # LayerNorm with bias
     qkv_bias: bool = True                # phi projects with bias
+    proj_bias: bool = True               # ...including wo/MLP/lm_head
 
 
 PHI_TINY = PhiConfig(n_layer=2, n_head=4, n_kv_heads=4, d_model=128,

@@ -1,0 +1,168 @@
+"""HF checkpoint ingestion parity: our converted models must reproduce
+transformers' logits on the same weights.
+
+Mirrors the reference's HF-model inference tests
+(tests/unit/inference/test_inference.py model matrix) — but stronger:
+instead of golden strings, exact logit parity vs the torch forward on a
+randomly initialized model of each supported family, saved and reloaded
+through the real safetensors path (no network; models are constructed
+from config classes offline).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+transformers = pytest.importorskip("transformers")
+torch = pytest.importorskip("torch")
+
+from deepspeed_tpu.checkpoint.hf import load_pretrained  # noqa: E402
+
+
+def _roundtrip(tmp_path, hf_model, inputs, atol=2e-3):
+    """Save hf_model, ingest via load_pretrained, compare logits fp32."""
+    d = str(tmp_path / "model")
+    hf_model.save_pretrained(d, safe_serialization=True)
+    hf_model.eval()
+    with torch.no_grad():
+        ref = hf_model(torch.tensor(inputs)).logits.float().numpy()
+
+    model, params = load_pretrained(d, dtype="float32")
+    logits = np.asarray(model.apply(params, jnp.asarray(inputs)),
+                        np.float32)
+    np.testing.assert_allclose(logits, ref, atol=atol, rtol=1e-3)
+    return model, params
+
+
+@pytest.fixture
+def inputs():
+    rng = np.random.RandomState(0)
+    return rng.randint(0, 200, (2, 24)).astype(np.int32)
+
+
+class TestHFIngestion:
+    def test_gpt2(self, tmp_path, inputs):
+        cfg = transformers.GPT2Config(
+            vocab_size=512, n_positions=64, n_embd=64, n_layer=2, n_head=4)
+        _roundtrip(tmp_path, transformers.GPT2LMHeadModel(cfg), inputs)
+
+    def test_opt(self, tmp_path, inputs):
+        cfg = transformers.OPTConfig(
+            vocab_size=512, hidden_size=64, num_hidden_layers=2,
+            ffn_dim=256, num_attention_heads=4,
+            max_position_embeddings=64, do_layer_norm_before=True,
+            word_embed_proj_dim=64, activation_function="relu")
+        _roundtrip(tmp_path, transformers.OPTForCausalLM(cfg), inputs)
+
+    def test_llama(self, tmp_path, inputs):
+        cfg = transformers.LlamaConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            attention_bias=False, tie_word_embeddings=False)
+        _roundtrip(tmp_path, transformers.LlamaForCausalLM(cfg), inputs)
+
+    def test_llama_attention_bias(self, tmp_path, inputs):
+        cfg = transformers.LlamaConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            attention_bias=True, tie_word_embeddings=False)
+        model = transformers.LlamaForCausalLM(cfg)
+        # random (not zero) biases so dropping them would fail the parity
+        with torch.no_grad():
+            for layer in model.model.layers:
+                for m in (layer.self_attn.q_proj, layer.self_attn.k_proj,
+                          layer.self_attn.v_proj):
+                    m.bias.normal_(std=0.5)
+        _roundtrip(tmp_path, model, inputs)
+
+    def test_mistral_sliding_window_rejected(self, tmp_path):
+        import pytest as _pytest
+        cfg = transformers.MistralConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            sliding_window=32)
+        model = transformers.MistralForCausalLM(cfg)
+        d = str(tmp_path / "model")
+        model.save_pretrained(d, safe_serialization=True)
+        with _pytest.raises(NotImplementedError, match="sliding-window"):
+            load_pretrained(d)
+
+    def test_mistral_sliding_window_off(self, tmp_path, inputs):
+        cfg = transformers.MistralConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            sliding_window=None)
+        _roundtrip(tmp_path, transformers.MistralForCausalLM(cfg), inputs)
+
+    def test_qwen2(self, tmp_path, inputs):
+        cfg = transformers.Qwen2Config(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            tie_word_embeddings=False)
+        _roundtrip(tmp_path, transformers.Qwen2ForCausalLM(cfg), inputs)
+
+    def test_phi(self, tmp_path, inputs):
+        cfg = transformers.PhiConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=256,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=4, max_position_embeddings=64,
+            partial_rotary_factor=0.5, hidden_act="gelu_new")
+        _roundtrip(tmp_path, transformers.PhiForCausalLM(cfg), inputs)
+
+    def test_falcon(self, tmp_path, inputs):
+        cfg = transformers.FalconConfig(
+            vocab_size=512, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=True,
+            new_decoder_architecture=False, parallel_attn=True,
+            bias=False, alibi=False, tie_word_embeddings=True)
+        _roundtrip(tmp_path, transformers.FalconForCausalLM(cfg), inputs)
+
+    def test_mixtral(self, tmp_path, inputs):
+        cfg = transformers.MixtralConfig(
+            vocab_size=512, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4,
+            num_key_value_heads=2, max_position_embeddings=64,
+            num_local_experts=4, num_experts_per_tok=2,
+            tie_word_embeddings=False)
+        _roundtrip(tmp_path, transformers.MixtralForCausalLM(cfg), inputs)
+
+    def test_serve_real_weights_greedy_parity(self, tmp_path, inputs):
+        # end to end: HF dir -> build_hf_engine (v2 paged serving) ->
+        # greedy decode must reproduce transformers' greedy continuation
+        from deepspeed_tpu.inference import build_hf_engine
+        cfg = transformers.GPT2Config(
+            vocab_size=512, n_positions=96, n_embd=64, n_layer=2, n_head=4)
+        hf_model = transformers.GPT2LMHeadModel(cfg)
+        d = str(tmp_path / "model")
+        hf_model.save_pretrained(d, safe_serialization=True)
+        hf_model.eval()
+
+        prompt = inputs[:1, :16]
+        with torch.no_grad():
+            ref = hf_model.generate(
+                torch.tensor(prompt), max_new_tokens=8, do_sample=False,
+                pad_token_id=0)[0, 16:].numpy()
+
+        eng = build_hf_engine(d, dtype="float32")
+        rid = eng.put(prompt[0].tolist(), max_new_tokens=8,
+                      temperature=0.0)
+        while not eng.is_done(rid):
+            eng.step()
+        got = np.asarray(eng.get(rid))
+        np.testing.assert_array_equal(got, ref)
+
+    def test_unsupported_type_raises(self, tmp_path):
+        import json
+        import os
+        d = tmp_path / "model"
+        os.makedirs(d)
+        with open(d / "config.json", "w") as f:
+            json.dump({"model_type": "t5"}, f)
+        with pytest.raises(ValueError, match="unsupported model_type"):
+            load_pretrained(str(d))
