@@ -18,7 +18,7 @@ Entry points:
   convert_<family>(hf_cfg, sd, dtype) -> (config, params)
 
 Supported model_type values: gpt2, opt, llama, mistral, qwen2, phi,
-falcon, mixtral. Weights load from *.safetensors (single or
+falcon, mixtral, bloom. Weights load from *.safetensors (single or
 index-sharded) or pytorch_model.bin (torch CPU).
 """
 
@@ -249,17 +249,12 @@ def _llama_like(hf, sd, cfg, dtype, *, pre="model.", qkv_bias=False,
 
 def convert_llama(hf, sd, dtype="bfloat16"):
     from ..models.llama import Llama, LlamaConfig
-    window = hf.get("sliding_window")
-    if window and window < hf["max_position_embeddings"]:
-        raise NotImplementedError(
-            f"checkpoint uses sliding-window attention (window={window} < "
-            f"max_position_embeddings={hf['max_position_embeddings']}); "
-            "the window knob is not implemented yet — truncate "
-            "max_position_embeddings to the window to serve short "
-            "contexts correctly")
+    window = hf.get("sliding_window") or 0
+    if window >= hf["max_position_embeddings"]:
+        window = 0                      # window never binds: full causal
     qkv_bias = bool(hf.get("attention_bias", False))
     cfg = LlamaConfig(
-        qkv_bias=qkv_bias,
+        qkv_bias=qkv_bias, sliding_window=window,
         vocab_size=hf["vocab_size"],
         max_seq_len=hf["max_position_embeddings"],
         n_layer=hf["num_hidden_layers"],
@@ -376,16 +371,71 @@ def convert_mixtral(hf, sd, dtype="bfloat16"):
                             fp32_keys=("moe_gate",))
 
 
+def convert_bloom(hf, sd, dtype="bfloat16"):
+    """HF bloom: fused query_key_value is INTERLEAVED per head — rows
+    group as (H, 3, hd), unlike falcon's [q..., k, v] layout."""
+    from ..models.bloom import BloomConfig
+    H = hf["n_head"]
+    D = hf["hidden_size"]
+    hd = D // H
+    L = hf["n_layer"]
+    cfg = BloomConfig(
+        vocab_size=hf["vocab_size"], max_seq_len=2048, n_layer=L,
+        n_head=H, n_kv_heads=H, d_model=D, d_ff=4 * D,
+        rms_eps=hf.get("layer_norm_epsilon", 1e-5), dtype=dtype)
+    pre = "transformer." if "transformer.word_embeddings.weight" in sd \
+        else ""
+    g = lambda k: sd[pre + k]
+
+    layers = []
+    for i in range(L):
+        lp = f"h.{i}."
+        w = g(lp + "self_attention.query_key_value.weight").T  # (D, 3Hhd)
+        b = g(lp + "self_attention.query_key_value.bias")
+        w = w.reshape(D, H, 3, hd)
+        b = b.reshape(H, 3, hd)
+        layers.append({
+            "rms1": g(lp + "input_layernorm.weight"),
+            "b1": g(lp + "input_layernorm.bias"),
+            "wq": w[:, :, 0].reshape(D, H * hd),
+            "wk": w[:, :, 1].reshape(D, H * hd),
+            "wv": w[:, :, 2].reshape(D, H * hd),
+            "bq": b[:, 0].reshape(H * hd),
+            "bk": b[:, 1].reshape(H * hd),
+            "bv": b[:, 2].reshape(H * hd),
+            "wo": g(lp + "self_attention.dense.weight").T,
+            "bo": g(lp + "self_attention.dense.bias"),
+            "rms2": g(lp + "post_attention_layernorm.weight"),
+            "b2": g(lp + "post_attention_layernorm.bias"),
+            "wup": g(lp + "mlp.dense_h_to_4h.weight").T,
+            "bup": g(lp + "mlp.dense_h_to_4h.bias"),
+            "wdown": g(lp + "mlp.dense_4h_to_h.weight").T,
+            "bdown": g(lp + "mlp.dense_4h_to_h.bias"),
+        })
+    params = {
+        "wte": g("word_embeddings.weight"),
+        "embed_ln_s": g("word_embeddings_layernorm.weight"),
+        "embed_ln_b": g("word_embeddings_layernorm.bias"),
+        "norm_f": g("ln_f.weight"),
+        "norm_f_b": g("ln_f.bias"),
+        # bloom's tied head has no bias; proj_bias adds the slot
+        "lm_head_b": np.zeros((hf["vocab_size"],), np.float32),
+        "blocks": {k: _stack(layers, k) for k in layers[0]},
+    }
+    return cfg, _model_cast(params, cfg, dtype)
+
+
 CONVERTERS = {
     "gpt2": convert_gpt2,
     "opt": convert_opt,
     "llama": convert_llama,
-    "mistral": convert_llama,      # same weight tree; converter rejects
-                                   # configs needing a sliding window
+    "mistral": convert_llama,      # same weight tree; sliding_window is
+                                   # converted and honored by all paths
     "qwen2": convert_qwen2,
     "phi": convert_phi,
     "falcon": convert_falcon,
     "mixtral": convert_mixtral,
+    "bloom": convert_bloom,
 }
 
 _MODEL_CLASSES = {
@@ -397,6 +447,7 @@ _MODEL_CLASSES = {
     "phi": ("..models.phi", "Phi"),
     "falcon": ("..models.falcon", "Falcon"),
     "mixtral": ("..models.mixtral", "Mixtral"),
+    "bloom": ("..models.bloom", "Bloom"),
 }
 
 

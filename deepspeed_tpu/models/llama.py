@@ -63,6 +63,18 @@ class LlamaConfig:
     # phi-style learned biases on the output projection, MLP and lm head
     # (adds bo/bup/bdown (+bgate) and lm_head_b params)
     proj_bias: bool = False
+    # mistral sliding-window attention: queries attend only the last
+    # ``sliding_window`` positions (0 = full causal). Honored by every
+    # path: dense training, flash kernel, v1 cached decode, v2 paged
+    # prefill/decode.
+    sliding_window: int = 0
+    # bloom ALiBi: additive per-head linear position bias INSTEAD of
+    # rotary embeddings (rope is skipped). Attention runs the dense path
+    # (the flash kernel has no bias input).
+    alibi: bool = False
+    # bloom word_embeddings_layernorm: LN applied to the embedding output
+    # (adds embed_ln_s/embed_ln_b params)
+    embed_norm: bool = False
 
     @property
     def flash_on(self):
@@ -96,6 +108,8 @@ class LlamaConfig:
         if self.proj_bias:
             head += V
         extra_f = D if self.norm_type == "ln" else 0
+        if self.embed_norm:
+            extra_f += 2 * D
         return V * D + self.n_layer * block + D + extra_f + head
 
     def flops_per_token(self):
@@ -108,7 +122,8 @@ LLAMA_TINY = LlamaConfig(n_layer=2, n_head=4, n_kv_heads=2, d_model=128,
 LLAMA2_7B = LlamaConfig(n_layer=32, n_head=32, n_kv_heads=32, d_model=4096,
                         max_seq_len=4096, vocab_size=32000)
 MISTRAL_7B = LlamaConfig(n_layer=32, n_head=32, n_kv_heads=8, d_model=4096,
-                         d_ff=14336, max_seq_len=8192, vocab_size=32000)
+                         d_ff=14336, max_seq_len=8192, vocab_size=32000,
+                         sliding_window=4096)
 
 LLAMA_PRESETS = {"tiny": LLAMA_TINY, "llama2-7b": LLAMA2_7B,
                  "mistral-7b": MISTRAL_7B}
@@ -146,6 +161,8 @@ def _rope(x, pos, theta):
 def _repeat_kv(k, n_rep):
     """(B, T, KVH, hd) -> (B, T, KVH*n_rep, hd)."""
     return k if n_rep == 1 else jnp.repeat(k, n_rep, axis=2)
+
+
 
 
 class Llama:
@@ -205,6 +222,9 @@ class Llama:
             params["blocks"]["b1"] = jnp.zeros((L, D), dt)
             params["blocks"]["b2"] = jnp.zeros((L, D), dt)
             params["norm_f_b"] = jnp.zeros((D,), dt)
+        if cfg.embed_norm:
+            params["embed_ln_s"] = jnp.ones((D,), dt)
+            params["embed_ln_b"] = jnp.zeros((D,), dt)
         if not cfg.tie_embeddings:
             params["lm_head"] = nrm(next(k), (V, D))
         return params
@@ -244,6 +264,9 @@ class Llama:
             specs["blocks"]["b1"] = P(None, None)
             specs["blocks"]["b2"] = P(None, None)
             specs["norm_f_b"] = P()
+        if self.config.embed_norm:
+            specs["embed_ln_s"] = P()
+            specs["embed_ln_b"] = P()
         if not self.config.tie_embeddings:
             specs["lm_head"] = P()
         return specs
@@ -292,8 +315,10 @@ class Llama:
     def _rope(self, x, pos):
         """Rotary with optional partial application (phi/neox
         rotary_pct < 1: only the leading fraction of each head
-        rotates)."""
+        rotates). ALiBi models carry no rotary at all."""
         cfg = self.config
+        if cfg.alibi:
+            return x
         pct = cfg.rotary_pct
         if pct >= 1.0:
             return _rope(x, pos, cfg.rope_theta)
@@ -302,6 +327,23 @@ class Llama:
         return jnp.concatenate(
             [_rope(x[..., :rot], pos, cfg.rope_theta), x[..., rot:]],
             axis=-1)
+
+    def _alibi_bias(self, k_pos):
+        """(H, ...) additive score bias: slope_h * k_pos (softmax-shift
+        equivalent to slope_h * (k_pos - q_pos); matches HF bloom)."""
+        from ..ops.pallas.paged_attention import alibi_slopes
+        slopes = jnp.asarray(alibi_slopes(self.config.n_head),
+                             jnp.float32)
+        return slopes.reshape(-1, *([1] * k_pos.ndim)) \
+            * k_pos.astype(jnp.float32)[None]
+
+    def _window_mask(self, mask, q_pos, k_pos):
+        """AND a sliding-window constraint into a boolean mask
+        (broadcastable q_pos/k_pos position index arrays)."""
+        w = self.config.sliding_window
+        if not w:
+            return mask
+        return mask & (q_pos - k_pos < w)
 
     def _wo(self, attn, layer):
         """Output projection (+ phi-style bias when proj_bias)."""
@@ -342,16 +384,22 @@ class Llama:
         v = constrain(v, head_spec)
         kk = _repeat_kv(kk, H // KVH)
         v = _repeat_kv(v, H // KVH)
-        if cfg.flash_on:
+        if cfg.flash_on and not cfg.alibi:
+            # (alibi needs an additive score bias the kernel has no
+            # input for -> dense path; the window IS kernel-supported)
             from ..ops.pallas.flash_attention import flash_attention
             attn = flash_attention(q, kk, v, causal=True,
                                    block_q=cfg.flash_block_q,
-                                   block_k=cfg.flash_block_k).astype(dt)
+                                   block_k=cfg.flash_block_k,
+                                   window=cfg.sliding_window).astype(dt)
             attn = attn.reshape(B, T, H * hd)
         else:
             scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                                 preferred_element_type=jnp.float32)
             scores = scores / math.sqrt(hd)
+            if cfg.alibi:
+                scores = scores + self._alibi_bias(
+                    jnp.arange(T))[None, :, None, :]
             scores = jnp.where(causal[None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs,
@@ -373,9 +421,14 @@ class Llama:
         constrain = self._constrain_fn()
         act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
         x = params["wte"][input_ids].astype(jnp.dtype(cfg.dtype))
+        if cfg.embed_norm:
+            x = _layer_norm(x, params["embed_ln_s"], params["embed_ln_b"],
+                            cfg.rms_eps)
         x = constrain(x, act_spec)
         pos = jnp.broadcast_to(jnp.arange(T)[None, :], input_ids.shape)
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        causal = self._window_mask(causal, jnp.arange(T)[:, None],
+                                   jnp.arange(T)[None, :])
 
         def block(x, layer):
             return self.block_forward(x, layer, pos, causal=causal,
@@ -433,6 +486,9 @@ class Llama:
         B, T = input_ids.shape
         H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
         x = params["wte"][input_ids].astype(dt)
+        if cfg.embed_norm:
+            x = _layer_norm(x, params["embed_ln_s"], params["embed_ln_b"],
+                            cfg.rms_eps)
         Tmax = cache["k"].shape[2]
 
         def body(carry, xs):
@@ -456,6 +512,10 @@ class Llama:
             s_idx = jnp.arange(Tmax)[None, None, None, :]
             q_idx = (slot + jnp.arange(T))[None, None, :, None]
             mask = (s_idx <= q_idx) & valid_mask[:, None, None, :]
+            mask = self._window_mask(mask, q_idx, s_idx)
+            if cfg.alibi:
+                scores = scores + self._alibi_bias(
+                    jnp.arange(Tmax))[None, :, None, :]
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
@@ -500,9 +560,14 @@ class Llama:
         T = input_ids.shape[1]
         H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
         x = params["wte"][input_ids].astype(dt)
+        if cfg.embed_norm:
+            x = _layer_norm(x, params["embed_ln_s"], params["embed_ln_b"],
+                            cfg.rms_eps)
         pos = jnp.arange(T)[None, :]
         valid = (jnp.arange(T) < length)
         mask = jnp.tril(jnp.ones((T, T), jnp.bool_)) & valid[None, :]
+        mask = self._window_mask(mask, jnp.arange(T)[:, None],
+                                 jnp.arange(T)[None, :])
 
         ks_out, vs_out = [], []
         for i in range(cfg.n_layer):
@@ -521,6 +586,9 @@ class Llama:
             scores = jnp.einsum("bthd,bshd->bhts", q, ku,
                                 preferred_element_type=jnp.float32)
             scores = scores / math.sqrt(hd)
+            if cfg.alibi:
+                scores = scores + self._alibi_bias(
+                    jnp.arange(T))[None, :, None, :]
             scores = jnp.where(mask[None, None], scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
@@ -545,6 +613,9 @@ class Llama:
         BS = cache["k"][0].shape[2]
         pos = jnp.minimum(lengths, cfg.max_seq_len - 1)
         x = params["wte"][tokens[:, None]].astype(dt)
+        if cfg.embed_norm:
+            x = _layer_norm(x, params["embed_ln_s"], params["embed_ln_b"],
+                            cfg.rms_eps)
         dst_block = jnp.take_along_axis(
             block_tables, (lengths // BS)[:, None], axis=1)[:, 0]
         dst_off = lengths % BS
@@ -563,9 +634,12 @@ class Llama:
             # Pallas paged kernel: GQA-native (no repeat_kv copies), K/V
             # read straight through the block table (reference
             # inference/v2/kernels/ragged_ops blocked_flash)
-            from ..ops.pallas.paged_attention import paged_decode_attention
-            attn = paged_decode_attention(q[:, 0], kc, vc, block_tables,
-                                          lengths)
+            from ..ops.pallas.paged_attention import (alibi_slopes,
+                                                      paged_decode_attention)
+            attn = paged_decode_attention(
+                q[:, 0], kc, vc, block_tables, lengths,
+                window=cfg.sliding_window,
+                alibi_slopes=(alibi_slopes(H) if cfg.alibi else None))
             attn_out = self._wo(attn.reshape(B, 1, H * hd), layer)
             if cfg.parallel_block:
                 x = x + attn_out + self._mlp(x, layer)

@@ -72,16 +72,20 @@ _DN_DK_T = (((2,), (1,)), ((0,), (0,)))  # (G,d,bq) x (G,bq,bk) -> (G,d,bk)
 _DN_DQ_T = (((2,), (2,)), ((0,), (0,)))  # (G,d,bk) x (G,bq,bk) -> (G,d,bq)
 
 
-def _mask_block(qi_start, kj_start, bq, bk, causal, t_real, T):
-    """(bq, bk) boolean mask for causal and/or padded-key masking; None
-    when neither applies (static no-op)."""
-    if not causal and t_real >= T:
+def _mask_block(qi_start, kj_start, bq, bk, causal, t_real, T,
+                window=0):
+    """(bq, bk) boolean mask for causal / padded-key / sliding-window
+    masking; None when none applies (static no-op)."""
+    if not causal and t_real >= T and not window:
         return None
     qpos = qi_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 0)
     kpos = kj_start + jax.lax.broadcasted_iota(jnp.int32, (bq, bk), 1)
     ok = None
     if causal:
         ok = qpos >= kpos
+    if window:
+        win = qpos - kpos < window
+        ok = win if ok is None else jnp.logical_and(ok, win)
     if t_real < T:
         valid = kpos < t_real
         ok = valid if ok is None else jnp.logical_and(ok, valid)
@@ -97,7 +101,7 @@ def _apply_mask(s, ok):
 
 # ------------------------------------------------------------------ forward
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
-                causal, t_real):
+                causal, t_real, window=0):
     qi = pl.program_id(1)
     q = q_ref[...]                                        # (G, bq, d) bf16
     G = q.shape[0]
@@ -111,6 +115,13 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
     kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
     kfull = (qi * bq) // bk if (causal and t_real >= T) else (
         nk if (not causal and t_real >= T) else 0)
+    kmin = 0
+    if window:
+        # blocks entirely below the window's lower edge are dead; every
+        # live block takes the masked path (the window edge can cross
+        # any of them)
+        kmin = jnp.maximum(0, (qi * bq - window + 1) // bk)
+        kfull = kmin
 
     def make_body(masked):
         def body(j, carry):
@@ -123,7 +134,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
                 s = s * scale
             if masked:
                 s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
-                                               causal, t_real, T))
+                                               causal, t_real, T,
+                                               window))
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             p = jnp.exp(s - m_new[..., None])
             alpha = jnp.exp(m - m_new)
@@ -138,7 +150,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
     acc = jnp.zeros((G, bq, d), jnp.float32)
     m = jnp.full((G, bq), NEG_INF, jnp.float32)
     l = jnp.zeros((G, bq), jnp.float32)
-    carry = jax.lax.fori_loop(0, kfull, make_body(False), (acc, m, l))
+    carry = jax.lax.fori_loop(kmin, kfull, make_body(False), (acc, m, l))
     acc, m, l = jax.lax.fori_loop(kfull, kmax, make_body(True), carry)
     o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
     # lse replicated across LSE_LANES lanes (see constant above); the
@@ -147,12 +159,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
                                     (G, bq, lse_ref.shape[-1]))
 
 
-def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
+def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret, window=0):
     BH, T, d = q.shape
     grid = (BH // bh, T // bq)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, bq=bq, bk=bk, scale=scale,
-                          causal=causal, t_real=t_real),
+                          causal=causal, t_real=t_real, window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
@@ -174,7 +186,7 @@ def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
 
 # ------------------------------------------------- forward, transposed q/k/v
 def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
-                  causal, t_real):
+                  causal, t_real, window=0):
     """Forward with q/k/v blocked (G, d, T) — T in lanes.
 
     The surrounding qkv projection einsums emit T-minor layouts (hd=64
@@ -195,6 +207,10 @@ def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
     kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
     kfull = (qi * bq) // bk if (causal and t_real >= T) else (
         nk if (not causal and t_real >= T) else 0)
+    kmin = 0
+    if window:
+        kmin = jnp.maximum(0, (qi * bq - window + 1) // bk)
+        kfull = kmin
 
     def make_body(masked):
         def body(j, carry):
@@ -207,7 +223,8 @@ def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
                 s = s * scale
             if masked:
                 s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
-                                               causal, t_real, T))
+                                               causal, t_real, T,
+                                               window))
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             p = jnp.exp(s - m_new[..., None])
             alpha = jnp.exp(m - m_new)
@@ -222,19 +239,20 @@ def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
     acc = jnp.zeros((G, bq, d), jnp.float32)
     m = jnp.full((G, bq), NEG_INF, jnp.float32)
     l = jnp.zeros((G, bq), jnp.float32)
-    carry = jax.lax.fori_loop(0, kfull, make_body(False), (acc, m, l))
+    carry = jax.lax.fori_loop(kmin, kfull, make_body(False), (acc, m, l))
     acc, m, l = jax.lax.fori_loop(kfull, kmax, make_body(True), carry)
     o_ref[...] = (acc / l[..., None]).astype(o_ref.dtype)
     lse_ref[...] = jnp.broadcast_to((m + jnp.log(l))[..., None],
                                     (G, bq, lse_ref.shape[-1]))
 
 
-def _fwd_t(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
+def _fwd_t(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
+           window=0):
     BH, d, T = q.shape
     grid = (BH // bh, T // bq)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel_t, bq=bq, bk=bk, scale=scale,
-                          causal=causal, t_real=t_real),
+                          causal=causal, t_real=t_real, window=window),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bh, d, bq), lambda b, i: (b, 0, i)),
@@ -257,7 +275,7 @@ def _fwd_t(q, k, v, scale, causal, bq, bk, bh, t_real, interpret):
 # ----------------------------------------------------------------- backward
 def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                 dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real,
-                ext_delta, single_k):
+                ext_delta, single_k, window=0):
     """Fused flash backward: dq, dk, dv from ONE s/p computation.
 
     Grid is (BH/bh, T/bk) over key blocks; an inner loop walks the query
@@ -282,6 +300,12 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     # below it don't. With padded keys every block masks.
     qfull = pl.cdiv((ki + 1) * bk, bq) if (causal and t_real >= T) else (
         qmin if t_real >= T else nq)
+    qend = nq
+    if window:
+        # highest q position attending this key block: (ki+1)*bk - 2 +
+        # window; blocks above are dead, and every live block masks
+        qend = jnp.minimum(nq, ((ki + 1) * bk - 2 + window) // bq + 1)
+        qfull = qend
 
     if not single_k:
         @pl.when(ki == 0)
@@ -311,7 +335,8 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                 s = s * scale
             if masked:
                 s = _apply_mask(s, _mask_block(i * bq, ki * bk, bq, bk,
-                                               causal, t_real, T))
+                                               causal, t_real, T,
+                                               window))
             p = jnp.exp(s - lse[..., None])                 # (G, bq, bk) f32
             pb = p.astype(do.dtype)
             dv = dv + jax.lax.dot_general(pb, do, _DN_T,
@@ -337,7 +362,7 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     dk = jnp.zeros((G, bk, d), jnp.float32)
     dv = jnp.zeros((G, bk, d), jnp.float32)
     dk, dv = jax.lax.fori_loop(qmin, qfull, make_body(True), (dk, dv))
-    dk, dv = jax.lax.fori_loop(qfull, nq, make_body(False), (dk, dv))
+    dk, dv = jax.lax.fori_loop(qfull, qend, make_body(False), (dk, dv))
     # ds was computed from unscaled-q dots (scale applied to s post-dot),
     # so dk needs the scale factor once here (dq's lands in the wrapper)
     if scale != 1.0:
@@ -347,7 +372,7 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
 
 
 def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
-         interpret, dlse=None):
+         interpret, dlse=None, window=0):
     BH, T, d = q.shape
     # (BH, T, 1) -> LSE_LANES lanes for the operand block; XLA lowers
     # this to one small relayout/broadcast per layer (~8 ms/step total)
@@ -366,7 +391,8 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real,
-                          ext_delta=dlse is not None, single_k=single_k),
+                          ext_delta=dlse is not None, single_k=single_k,
+                          window=window),
         grid=(BH // bh, T // bk),
         in_specs=[
             pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
@@ -400,7 +426,7 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
 # ------------------------------------------------ backward, transposed q/k/v
 def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                   dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real,
-                  ext_delta, single_k):
+                  ext_delta, single_k, window=0):
     """Fused backward with q/k/v, do AND dq/dk/dv blocked (G, d, T).
 
     Same structure as _bwd_kernel (key-block grid, inner loop over query
@@ -428,6 +454,10 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     qmin = (ki * bk) // bq if causal else 0
     qfull = pl.cdiv((ki + 1) * bk, bq) if (causal and t_real >= T) else (
         qmin if t_real >= T else nq)
+    qend = nq
+    if window:
+        qend = jnp.minimum(nq, ((ki + 1) * bk - 2 + window) // bq + 1)
+        qfull = qend
 
     if not single_k:
         @pl.when(ki == 0)
@@ -452,7 +482,8 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                 s = s * scale
             if masked:
                 s = _apply_mask(s, _mask_block(i * bq, ki * bk, bq, bk,
-                                               causal, t_real, T))
+                                               causal, t_real, T,
+                                               window))
             p = jnp.exp(s - lse[..., None])                 # (G, bq, bk) f32
             pb = p.astype(do.dtype)
             dv = dv + jax.lax.dot_general(do, pb, _DN_DV_T,
@@ -475,7 +506,7 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     dk = jnp.zeros((G, d, bk), jnp.float32)
     dv = jnp.zeros((G, d, bk), jnp.float32)
     dk, dv = jax.lax.fori_loop(qmin, qfull, make_body(True), (dk, dv))
-    dk, dv = jax.lax.fori_loop(qfull, nq, make_body(False), (dk, dv))
+    dk, dv = jax.lax.fori_loop(qfull, qend, make_body(False), (dk, dv))
     if scale != 1.0:
         dk = dk * scale
     dk_ref[...] = dk.astype(dk_ref.dtype)
@@ -483,7 +514,7 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
 
 
 def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
-           interpret, dlse=None):
+           interpret, dlse=None, window=0):
     BH, d, T = q.shape
     lse = jnp.broadcast_to(lse_t, (BH, T, LSE_LANES))
     single_k = (T // bk) == 1
@@ -496,7 +527,8 @@ def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
     dq, dk, dv = pl.pallas_call(
         functools.partial(_bwd_kernel_t, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real,
-                          ext_delta=dlse is not None, single_k=single_k),
+                          ext_delta=dlse is not None, single_k=single_k,
+                          window=window),
         grid=(BH // bh, T // bk),
         in_specs=[
             pl.BlockSpec((bh, d, T), lambda b, j: (b, 0, 0)),
@@ -526,21 +558,23 @@ def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
 
 # --------------------------------------------------------------- public API
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13))
 def _flash(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-           bwd_bq, bwd_bk, qkv_t=False):
+           bwd_bq, bwd_bk, qkv_t=False, window=0):
     fwd = _fwd_t if qkv_t else _fwd
-    o, lse = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
+    o, lse = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
+                 window)
     return o, lse[..., 0]
 
 
 def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-               bwd_bq, bwd_bk, qkv_t=False):
+               bwd_bq, bwd_bk, qkv_t=False, window=0):
     from jax.ad_checkpoint import checkpoint_name
     # symbolic_zeros=True wraps primal args in CustomVJPPrimal
     q, k, v = q.value, k.value, v.value
     fwd = _fwd_t if qkv_t else _fwd
-    o, lse = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
+    o, lse = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
+                 window)
     # Name o/lse HERE, inside the fwd rule, so the named vars are both
     # the primal outputs and the vjp residuals: under jax.checkpoint a
     # save-policy keeping 'flash_o'/'flash_lse' then satisfies the
@@ -558,7 +592,7 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
 
 
 def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
-               bwd_bk, qkv_t, res, cts):
+               bwd_bk, qkv_t, window, res, cts):
     # backward may run its own (smaller) blocks: the fused dq/dk/dv pass
     # is ~2x the forward's work, so causal above-diagonal skipping wins
     # more there than grid-step overhead costs
@@ -578,7 +612,7 @@ def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
     # there costs zero extra kernel work.
     bwd = _bwd_t if qkv_t else _bwd
     return bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
-               interpret, dlse=dlse)
+               interpret, dlse=dlse, window=window)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
@@ -589,23 +623,24 @@ _flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
 # ~6 ms/step at 350M bs=24. This twin never emits the lse output (the
 # residual still saves it for the backward).
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13))
 def _flash_o(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-             bwd_bq, bwd_bk, qkv_t=False):
+             bwd_bq, bwd_bk, qkv_t=False, window=0):
     fwd = _fwd_t if qkv_t else _fwd
-    o, _ = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret)
+    o, _ = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
+               window)
     return o
 
 
 def _flash_o_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-                 bwd_bq, bwd_bk, qkv_t=False):
+                 bwd_bq, bwd_bk, qkv_t=False, window=0):
     (o, _), res = _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real,
-                             interpret, bwd_bq, bwd_bk, qkv_t)
+                             interpret, bwd_bq, bwd_bk, qkv_t, window)
     return o, res
 
 
 def _flash_o_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
-                 bwd_bk, qkv_t, res, do):
+                 bwd_bk, qkv_t, window, res, do):
     from jax.custom_derivatives import SymbolicZero
     bq, bk = bwd_bq or bq, bwd_bk or bk
     if isinstance(do, SymbolicZero):
@@ -613,7 +648,7 @@ def _flash_o_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
     q, k, v, o, lse_t = res
     bwd = _bwd_t if qkv_t else _bwd
     return bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
-               interpret, dlse=None)
+               interpret, dlse=None, window=window)
 
 
 _flash_o.defvjp(_flash_o_fwd, _flash_o_bwd, symbolic_zeros=True)
@@ -623,7 +658,7 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
                              block_q=128, block_k=128, block_h=2,
                              interpret=None, heads_major=False,
                              block_q_bwd=None, block_k_bwd=None,
-                             qkv_t=False, _with_lse=True):
+                             qkv_t=False, window=0, _with_lse=True):
     """Fused attention over (batch, seq, heads, head_dim) inputs, returning
     ``(o, lse)`` where lse is the per-query logsumexp, (B, H, T) fp32.
 
@@ -676,7 +711,7 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
             q, k, v, causal=causal, scale=scale, block_q=block_q,
             block_k=block_k, block_h=block_h, interpret=interpret,
             heads_major=True, block_q_bwd=block_q_bwd,
-            block_k_bwd=block_k_bwd, qkv_t=False,
+            block_k_bwd=block_k_bwd, qkv_t=False, window=window,
             _with_lse=_with_lse)
     bh = max(1, min(block_h, B * H))
     while (B * H) % bh:
@@ -710,9 +745,12 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     # fold the softmax scale into q OUTSIDE the kernel (and the custom_vjp,
     # so autodiff chains dq): one (BH, T, d) multiply instead of a
     # per-score-element multiply inside a VPU-bound kernel
+    if window and not causal:
+        raise ValueError("sliding window requires causal attention")
     q = q * jnp.asarray(scale, q.dtype)
     args = (fold(q), fold(k), fold(v), 1.0, bool(causal),
-            bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk, bool(qkv_t))
+            bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk, bool(qkv_t),
+            int(window))
     if _with_lse:
         o, lse = _flash(*args)
     else:
@@ -740,14 +778,16 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
 def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
                     block_k=128, block_h=2, interpret=None,
                     heads_major=False, block_q_bwd=None,
-                    block_k_bwd=None, qkv_t=False):
+                    block_k_bwd=None, qkv_t=False, window=0):
     """Fused attention over (batch, seq, heads, head_dim); see
-    :func:`flash_attention_with_lse` (this never emits the lse output)."""
+    :func:`flash_attention_with_lse` (this never emits the lse output).
+    ``window`` > 0 = mistral sliding-window attention (causal only)."""
     o, _ = flash_attention_with_lse(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, block_h=block_h, interpret=interpret,
         heads_major=heads_major, block_q_bwd=block_q_bwd,
-        block_k_bwd=block_k_bwd, qkv_t=qkv_t, _with_lse=False)
+        block_k_bwd=block_k_bwd, qkv_t=qkv_t, window=window,
+        _with_lse=False)
     return o
 
 

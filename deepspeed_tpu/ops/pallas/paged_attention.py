@@ -39,8 +39,22 @@ from ._common import interpret_default as _interpret_default
 NEG_INF = -1e30
 
 
+def alibi_slopes(n_head):
+    """Per-head ALiBi slopes (the bloom formula): for the leading
+    power-of-two count cp, slope_h = 2^(-8(h+1)/cp); extra heads
+    interleave the 2cp sequence: 2^(-4(2(h-cp)+1)/cp)."""
+    cp = 2 ** math.floor(math.log2(n_head))
+    return [2.0 ** (-8.0 * (h + 1) / cp) if h < cp
+            else 2.0 ** (-4.0 * (2 * (h - cp) + 1) / cp)
+            for h in range(n_head)]
+
+
+alibi_slopes_formula = alibi_slopes
+
+
 def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
-                   m_ref, l_ref, acc_ref, *, BS, KVH, G, scale):
+                   m_ref, l_ref, acc_ref, *, BS, KVH, G, scale, window,
+                   alibi):
     b = pl.program_id(0)
     j = pl.program_id(1)
     H = KVH * G
@@ -53,7 +67,13 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
-    @pl.when(j * BS <= L)
+    live = j * BS <= L
+    if window:
+        # sliding window: the query (at position L) only attends
+        # positions > L - window; blocks entirely below that are dead
+        live = live & (j * BS + BS > L - window + 1)
+
+    @pl.when(live)
     def _step():
         kb = k_ref[0]                                     # (KVH, BS, d)
         vb = v_ref[0]
@@ -66,7 +86,23 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             q, kb, (((2,), (2,)), ((0,), (0,))),
             preferred_element_type=jnp.float32) * scale   # (KVH, G, BS)
         pos = j * BS + jax.lax.broadcasted_iota(jnp.int32, (KVH, G, BS), 2)
-        s = jnp.where(pos <= L, s, NEG_INF)
+        if alibi:
+            # ALiBi: slope_h * k_pos (softmax-shift equivalent to
+            # slope_h * (k_pos - q_pos); matches the dense paths).
+            # Slopes are computed IN-KERNEL from the head index (a
+            # captured constant array is rejected by pallas_call): the
+            # bloom formula splits at the leading power of two cp.
+            h = (jax.lax.broadcasted_iota(jnp.int32, (KVH, G, BS), 0) * G
+                 + jax.lax.broadcasted_iota(jnp.int32, (KVH, G, BS), 1)
+                 ).astype(jnp.float32)
+            cp = float(2 ** math.floor(math.log2(H)))
+            expo = jnp.where(h < cp, -(h + 1.0) * (8.0 / cp),
+                             -(2.0 * (h - cp) + 1.0) * (4.0 / cp))
+            s = s + jnp.exp2(expo) * pos.astype(jnp.float32)
+        ok = pos <= L
+        if window:
+            ok = ok & (pos > L - window)
+        s = jnp.where(ok, s, NEG_INF)
 
         m_prev = m_ref[..., 0]                            # (KVH, G)
         l_prev = l_ref[..., 0]
@@ -86,14 +122,17 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_decode_attention(q, k_cache, v_cache, block_tables, lengths, *,
-                           scale=None, interpret=None):
+                           scale=None, interpret=None, window=0,
+                           alibi_slopes=None):
     """One decode step of attention over a paged KV cache.
 
     q: (B, H, d); k_cache/v_cache: (NB, KVH, BS, d) with H % KVH == 0;
     block_tables: (B, MB) int32; lengths: (B,) int32 = the new token's
     position. Returns (B, H, d) in q's dtype. The new token's K/V must
     already be written to the cache (the callers do the dynamic-slot
-    write first).
+    write first). ``window`` > 0 restricts attention to the trailing
+    ``window`` positions (mistral); ``alibi_slopes`` (len H floats) adds
+    the bloom per-head linear position bias.
 
     Multi-layer pools: view (L, NB, ...) as (L*NB, ...) (a free reshape)
     and offset the tables by ``layer * NB`` — a lax.scan over layers then
@@ -109,6 +148,18 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, lengths, *,
     if interpret is None:
         interpret = _interpret_default()
 
+    if alibi_slopes is not None:
+        # the kernel recomputes slopes IN-KERNEL from the head count
+        # (pallas rejects captured constant arrays); reject custom
+        # slopes rather than silently ignoring them
+        expect = alibi_slopes_formula(H)
+        if len(alibi_slopes) != H or any(
+                abs(a - b) > 1e-6 * max(abs(b), 1e-9)
+                for a, b in zip(alibi_slopes, expect)):
+            raise NotImplementedError(
+                "paged_decode_attention computes bloom-formula ALiBi "
+                "slopes in-kernel; custom per-head slopes are not "
+                "supported")
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(B, MB),
@@ -136,7 +187,8 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, lengths, *,
     )
     out = pl.pallas_call(
         functools.partial(_decode_kernel, BS=BS, KVH=KVH, G=G,
-                          scale=float(scale)),
+                          scale=float(scale), window=int(window),
+                          alibi=alibi_slopes is not None),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KVH, G, d), q.dtype),
         interpret=interpret,
@@ -145,7 +197,8 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, lengths, *,
 
 
 def paged_decode_attention_reference(q, k_cache, v_cache, block_tables,
-                                     lengths, *, scale=None):
+                                     lengths, *, scale=None, window=0,
+                                     alibi_slopes=None):
     """Dense gather fallback (the pre-kernel path), for parity tests."""
     B, H, d = q.shape
     NB, KVH, BS, _ = k_cache.shape
@@ -162,7 +215,14 @@ def paged_decode_attention_reference(q, k_cache, v_cache, block_tables,
     gv = jnp.repeat(gv, G, axis=2)
     s = jnp.einsum("bhd,bshd->bhs", q, gk,
                    preferred_element_type=jnp.float32) * scale
+    if alibi_slopes is not None:
+        sl = jnp.asarray(alibi_slopes, jnp.float32)
+        s = s + sl[None, :, None] * jnp.arange(S, dtype=jnp.float32)[
+            None, None, :]
     mask = jnp.arange(S)[None, :] <= lengths[:, None]
+    if window:
+        mask = mask & (jnp.arange(S)[None, :]
+                       > lengths[:, None] - window)
     s = jnp.where(mask[:, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhs,bshd->bhd", p, gv)

@@ -78,18 +78,19 @@ class TestHFIngestion:
                     m.bias.normal_(std=0.5)
         _roundtrip(tmp_path, model, inputs)
 
-    def test_mistral_sliding_window_rejected(self, tmp_path):
-        import pytest as _pytest
+    def test_mistral_sliding_window_parity(self, tmp_path):
+        # seq (48) > window (16): the window binds, HF masks beyond it —
+        # our converted model must reproduce the windowed logits
         cfg = transformers.MistralConfig(
             vocab_size=512, hidden_size=64, intermediate_size=128,
             num_hidden_layers=2, num_attention_heads=4,
             num_key_value_heads=2, max_position_embeddings=64,
-            sliding_window=32)
-        model = transformers.MistralForCausalLM(cfg)
-        d = str(tmp_path / "model")
-        model.save_pretrained(d, safe_serialization=True)
-        with _pytest.raises(NotImplementedError, match="sliding-window"):
-            load_pretrained(d)
+            sliding_window=16, attn_implementation="eager")
+        rng = np.random.RandomState(1)
+        long_inputs = rng.randint(0, 500, (2, 48)).astype(np.int32)
+        model, params = _roundtrip(
+            tmp_path, transformers.MistralForCausalLM(cfg), long_inputs)
+        assert model.config.sliding_window == 16
 
     def test_mistral_sliding_window_off(self, tmp_path, inputs):
         cfg = transformers.MistralConfig(
@@ -122,6 +123,11 @@ class TestHFIngestion:
             new_decoder_architecture=False, parallel_attn=True,
             bias=False, alibi=False, tie_word_embeddings=True)
         _roundtrip(tmp_path, transformers.FalconForCausalLM(cfg), inputs)
+
+    def test_bloom_alibi(self, tmp_path, inputs):
+        cfg = transformers.BloomConfig(
+            vocab_size=512, hidden_size=64, n_layer=2, n_head=4)
+        _roundtrip(tmp_path, transformers.BloomForCausalLM(cfg), inputs)
 
     def test_mixtral(self, tmp_path, inputs):
         cfg = transformers.MixtralConfig(

@@ -654,3 +654,136 @@ class TestBwdBlockCoverage:
         for a, b in zip(gf, gr):
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=1e-4, atol=1e-4)
+
+
+class TestFlashSlidingWindow:
+    """window > 0: mistral sliding-window masking in the flash kernels
+    (standard and transposed), forward and fused backward."""
+
+    def _qkv(self, B=2, T=256, H=4, d=32, layout="btHd", seed=0):
+        rng = np.random.RandomState(seed)
+        shape = (B, T, H, d) if layout == "btHd" else (B, H, d, T)
+        mk = lambda s: jnp.asarray(rng.randn(*shape), jnp.float32) * 0.3
+        return mk(0), mk(1), mk(2)
+
+    def _windowed_reference(self, q, k, v, window):
+        B, T, H, d = q.shape
+        s = jnp.einsum("bthd,bshd->bhts", q, k,
+                       preferred_element_type=jnp.float32) / np.sqrt(d)
+        i = jnp.arange(T)[:, None]
+        j = jnp.arange(T)[None, :]
+        mask = (i >= j) & (i - j < window)
+        s = jnp.where(mask[None, None], s, -1e30)
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("bhts,bshd->bthd", p.astype(q.dtype), v)
+
+    @pytest.mark.parametrize("window", [8, 100, 1000])
+    def test_forward_matches_windowed_dense(self, window):
+        q, k, v = self._qkv()
+        o = flash_attention(q, k, v, window=window, block_q=64,
+                            block_k=64)
+        ref = self._windowed_reference(q, k, v, window)
+        np.testing.assert_allclose(np.asarray(o), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    @pytest.mark.parametrize("blocks", [(64, 64), (128, 256)])
+    def test_grads_match_windowed_dense(self, blocks):
+        q, k, v = self._qkv()
+        window = 40
+
+        def loss_f(q, k, v):
+            o = flash_attention(q, k, v, window=window,
+                                block_q=blocks[0], block_k=blocks[1])
+            return jnp.sum(o ** 2)
+
+        def loss_r(q, k, v):
+            return jnp.sum(
+                self._windowed_reference(q, k, v, window) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_transposed_layout_window_grads(self):
+        qt, kt, vt = self._qkv(layout="bHdT")
+        window = 48
+
+        def loss_f(q, k, v):
+            o = flash_attention(q, k, v, qkv_t=True, window=window,
+                                block_q=128, block_k=128)
+            return jnp.sum(o ** 2)
+
+        def loss_r(q, k, v):
+            t = lambda x: x.transpose(0, 3, 1, 2)
+            return jnp.sum(self._windowed_reference(
+                t(q), t(k), t(v), window) ** 2)
+
+        gf = jax.grad(loss_f, argnums=(0, 1, 2))(qt, kt, vt)
+        gr = jax.grad(loss_r, argnums=(0, 1, 2))(qt, kt, vt)
+        for a, b in zip(gf, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-4, atol=1e-4)
+
+    def test_window_noncausal_rejected(self):
+        q, k, v = self._qkv(T=128)
+        with pytest.raises(ValueError, match="causal"):
+            flash_attention(q, k, v, causal=False, window=8)
+
+
+class TestPagedWindowAlibi:
+    """window/ALiBi knobs of the paged decode kernel vs the dense-gather
+    reference (reference inference/v2 blocked attention semantics for
+    mistral/bloom)."""
+
+    def _setup(self, B=3, H=4, KVH=2, d=32, NB=12, BS=16, MB=4, seed=0):
+        from deepspeed_tpu.ops.pallas.paged_attention import (
+            paged_decode_attention, paged_decode_attention_reference)
+        rng = np.random.RandomState(seed)
+        q = jnp.asarray(rng.randn(B, H, d), jnp.float32) * 0.3
+        kc = jnp.asarray(rng.randn(NB, KVH, BS, d), jnp.float32) * 0.3
+        vc = jnp.asarray(rng.randn(NB, KVH, BS, d), jnp.float32) * 0.3
+        tables = jnp.asarray(
+            rng.permutation(NB)[:B * MB].reshape(B, MB), jnp.int32)
+        lengths = jnp.asarray([5, 37, 60], jnp.int32)
+        return (paged_decode_attention, paged_decode_attention_reference,
+                q, kc, vc, tables, lengths)
+
+    def test_window_matches_reference(self):
+        kern, ref, q, kc, vc, tables, lengths = self._setup()
+        got = kern(q, kc, vc, tables, lengths, window=10)
+        want = ref(q, kc, vc, tables, lengths, window=10)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_alibi_matches_reference(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import alibi_slopes
+        kern, ref, q, kc, vc, tables, lengths = self._setup()
+        sl = alibi_slopes(q.shape[1])
+        got = kern(q, kc, vc, tables, lengths, alibi_slopes=sl)
+        want = ref(q, kc, vc, tables, lengths, alibi_slopes=sl)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_alibi_window_combined(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import alibi_slopes
+        kern, ref, q, kc, vc, tables, lengths = self._setup(seed=3)
+        sl = alibi_slopes(q.shape[1])
+        got = kern(q, kc, vc, tables, lengths, window=20, alibi_slopes=sl)
+        want = ref(q, kc, vc, tables, lengths, window=20,
+                   alibi_slopes=sl)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_slopes_formula(self):
+        from deepspeed_tpu.ops.pallas.paged_attention import alibi_slopes
+        # canonical published values for 8 heads
+        np.testing.assert_allclose(
+            alibi_slopes(8),
+            [2 ** (-(i + 1)) for i in range(8)], rtol=1e-9)
+        # non-power-of-two interleave (bloom formula), 6 heads
+        s6 = alibi_slopes(6)
+        assert s6[:4] == alibi_slopes(4)
+        np.testing.assert_allclose(
+            s6[4:], [2 ** (-1.0), 2 ** (-3.0)], rtol=1e-9)
