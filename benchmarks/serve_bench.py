@@ -113,6 +113,57 @@ def bench_one(name, batch, prompt_len, decode_tokens, block_size=128):
     return out
 
 
+def bench_splitfuse(name, prompt_len, chunk, decode_tokens,
+                    block_size=128):
+    """Dynamic SplitFuse point: decode latency of a RUNNING stream while
+    a long prompt chunk-prefills through the fused program — the FastGen
+    no-head-of-line-blocking property (blogs/deepspeed-fastgen §3B).
+    Reports decode tokens/sec of the running stream during prefill
+    dispatches vs during pure-decode dispatches."""
+    groups.reset()
+    model = build_model(name)
+    engine = InferenceEngineV2(
+        model,
+        RaggedInferenceEngineConfig(max_batch_size=2,
+                                    kv_block_size=block_size,
+                                    prompt_bucket=chunk,
+                                    splitfuse_tokens=chunk))
+    rng = np.random.RandomState(0)
+    V = model.config.vocab_size
+    # stream A: short prompt, long decode
+    a = engine.put(rng.randint(0, V, (64,)), max_new_tokens=512,
+                   eos_token_id=-1)
+    for _ in range(3):
+        engine.step()                    # A prefilled + decoding (warm)
+    # measure pure-decode rate for A
+    t0 = time.perf_counter()
+    pure = sum(len(engine.step()) for _ in range(8))
+    t_pure = time.perf_counter() - t0
+    # admit the long prompt; measure A's decode rate DURING its prefill
+    b = engine.put(rng.randint(0, V, (prompt_len,)),
+                   max_new_tokens=decode_tokens, eos_token_id=-1)
+    during = 0
+    chunk_steps = 0
+    t0 = time.perf_counter()
+    while (any(r.uid == b for r in engine._pending)
+           or b in engine._prefill_q):
+        out = engine.step()
+        chunk_steps += 1
+        during += sum(1 for uid, _ in out if uid == a)
+    t_during = time.perf_counter() - t0
+    out = {
+        "model": name, "mode": "splitfuse",
+        "chunk_tokens": chunk, "long_prompt": prompt_len,
+        "chunk_dispatches": chunk_steps,
+        "stream_decode_tok_s_pure": round(pure / t_pure, 1),
+        "stream_decode_tok_s_during_prefill": (
+            round(during / t_during, 1) if t_during else None),
+        "devices": len(jax.devices()),
+    }
+    print(json.dumps(out))
+    return out
+
+
 def main():
     models = os.environ.get("SERVE_MODELS", "gpt2-350M,llama-1b").split(",")
     batches = [int(b) for b in
@@ -122,6 +173,12 @@ def main():
     for m in models:
         for b in batches:
             bench_one(m, b, prompt, decode)
+    if os.environ.get("SERVE_SPLITFUSE", "1") == "1":
+        for m in models:
+            bench_splitfuse(m, prompt_len=prompt,
+                            chunk=int(os.environ.get("SERVE_CHUNK",
+                                                     "256")),
+                            decode_tokens=16)
 
 
 if __name__ == "__main__":

@@ -190,6 +190,11 @@ class InferenceEngine:
         temperature = cfg.temperature if temperature is None else temperature
         top_k = cfg.top_k if top_k is None else top_k
         top_p = cfg.top_p if top_p is None else top_p
+        if not 0.0 < top_p <= 1.0:
+            # top_p is traced and its branch always executes: top_p <= 0
+            # would silently mask EVERY logit and degenerate sampling to
+            # uniform-over-vocab
+            raise ValueError(f"top_p must be in (0, 1], got {top_p}")
 
         if isinstance(input_ids, (list, tuple)):
             if input_ids and np.isscalar(input_ids[0]):

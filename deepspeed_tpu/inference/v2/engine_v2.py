@@ -51,6 +51,13 @@ class RaggedInferenceEngineConfig:
     # decode steps fused into one device program (host sync + dispatch
     # amortize over this many tokens; scheduling granularity coarsens)
     decode_steps_per_dispatch: int = 8
+    # Dynamic SplitFuse (reference blogs/deepspeed-fastgen §3B): > 0 =
+    # prompts stream through fixed-size chunks of this many tokens,
+    # FUSED with the running decodes in one program per dispatch — long
+    # prompts neither stall running decodes (no head-of-line blocking)
+    # nor compile per-length bucket programs. 0 = legacy bucketed
+    # whole-prompt prefill.
+    splitfuse_tokens: int = 0
 
 
 @dataclass
@@ -112,6 +119,9 @@ class InferenceEngineV2:
         self._rng = jax.random.key(config.seed + 23)
         self._prefill_jit = None
         self._decode_jit = None
+        self._splitfuse_jit = None
+        self._chunk_jit = None        # chunk-only (no decoders running)
+        self._prefill_q = deque()     # uids mid-chunked-prefill (SplitFuse)
         self._uid_next = 0
         log_dist(
             f"v2 engine ready: tp={config.tensor_parallel} blocks="
@@ -251,6 +261,117 @@ class InferenceEngineV2:
                 out_shardings=(None, self._cache_sh))
         return self._decode_jit
 
+    def _get_splitfuse(self):
+        """ONE fused fixed-shape program per dispatch: a C-token prompt
+        chunk for the head-of-queue prefilling sequence PLUS n decode
+        steps for every running sequence — the Dynamic SplitFuse
+        composition (reference blogs/deepspeed-fastgen §3B; the ragged
+        kernels' role). Shapes are static (C, B, MB), so exactly one
+        compilation serves every prompt length and batch mix."""
+        if self._splitfuse_jit is None:
+            model = self.model
+            n = max(1, self.config.decode_steps_per_dispatch)
+
+            def fused(params, cache, c_ids, c_tb, c_to, c_start, c_len,
+                      c_table, c_temp, c_topk, d_tokens, d_lengths,
+                      d_tables, rng, d_temps, d_topks, all_greedy):
+                c_logits, cache = model.apply_paged_chunk(
+                    params, c_ids, cache, c_tb, c_to, c_start, c_len,
+                    c_table)
+                c_tok = self._sample_per_slot(
+                    c_logits, jax.random.fold_in(rng, 7919), c_temp,
+                    c_topk, all_greedy)
+                toks = []
+                for t in range(n):
+                    logits, cache = model.apply_paged_decode(
+                        params, d_tokens, d_lengths, cache, d_tables)
+                    d_tokens = self._sample_per_slot(
+                        logits, jax.random.fold_in(rng, t), d_temps,
+                        d_topks, all_greedy)
+                    d_lengths = d_lengths + 1
+                    toks.append(d_tokens)
+                return c_tok, jnp.stack(toks), cache
+
+            self._splitfuse_jit = jax.jit(
+                fused, donate_argnums=(1,), static_argnums=(16,),
+                in_shardings=(self.param_shardings, self._cache_sh)
+                + (None,) * 14,
+                out_shardings=(None, None, self._cache_sh))
+        return self._splitfuse_jit
+
+    def _get_chunk_only(self):
+        """Chunk program WITHOUT the fused decode steps — used when no
+        sequence is decoding (e.g. a long prompt arriving at an idle
+        engine), so prefill never pays scratch-write decode forwards."""
+        if self._chunk_jit is None:
+            model = self.model
+
+            def chunk(params, cache, c_ids, c_tb, c_to, c_start, c_len,
+                      c_table, c_temp, c_topk, rng, all_greedy):
+                c_logits, cache = model.apply_paged_chunk(
+                    params, c_ids, cache, c_tb, c_to, c_start, c_len,
+                    c_table)
+                c_tok = self._sample_per_slot(
+                    c_logits, jax.random.fold_in(rng, 7919), c_temp,
+                    c_topk, all_greedy)
+                return c_tok, cache
+
+            self._chunk_jit = jax.jit(
+                chunk, donate_argnums=(1,), static_argnums=(11,),
+                in_shardings=(self.param_shardings, self._cache_sh)
+                + (None,) * 9,
+                out_shardings=(None, self._cache_sh))
+        return self._chunk_jit
+
+    def _step_splitfuse_chunk(self):
+        """Run one fused dispatch: the next chunk of the oldest
+        prefilling sequence + n decode steps (chunk-only when nothing is
+        decoding). Returns decode (uid, token) pairs."""
+        mgr = self.state_mgr
+        C = self.config.splitfuse_tokens
+        uid = self._prefill_q[0]
+        seq = mgr.get_sequence(uid)
+        off = seq.prefill_offset
+        true_len = min(C, len(seq.prompt) - off)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :true_len] = seq.prompt[off:off + true_len]
+        tb = np.zeros((C,), np.int32)
+        to = np.zeros((C,), np.int32)
+        fb, fo = mgr.token_placement(seq)
+        tb[:true_len] = fb[off:off + true_len]
+        to[:true_len] = fo[off:off + true_len]
+        table = np.zeros((self.max_blocks_per_seq,), np.int32)
+        table[:len(seq.blocks)] = seq.blocks
+
+        batch = mgr.decode_batch()
+        self._rng, sub = jax.random.split(self._rng)
+        c_temp = np.asarray([seq.temperature], np.float32)
+        c_topk = np.asarray([seq.top_k], np.int32)
+        if not batch.active.any():
+            fn = self._get_chunk_only()
+            with jax.set_mesh(self.mesh):
+                c_tok, self.cache = fn(
+                    self.params, self.cache, ids, tb, to, np.int32(off),
+                    np.int32(true_len), table, c_temp, c_topk, sub,
+                    seq.temperature == 0.0)
+            toks = np.zeros((0, self.config.max_batch_size), np.int32)
+        else:
+            all_greedy = (seq.temperature == 0.0
+                          and not bool(batch.temps.any()))
+            fn = self._get_splitfuse()
+            with jax.set_mesh(self.mesh):
+                c_tok, toks, self.cache = fn(
+                    self.params, self.cache, ids, tb, to, np.int32(off),
+                    np.int32(true_len), table, c_temp, c_topk,
+                    batch.tokens, batch.lengths, batch.block_tables, sub,
+                    batch.temps, batch.top_ks, all_greedy)
+            toks = np.asarray(toks)
+        seq.prefill_offset = off + true_len
+        if seq.prefill_offset >= len(seq.prompt):
+            self._prefill_q.popleft()
+            self._post_token(seq, int(np.asarray(c_tok)[0]))
+        return self._post_decode_tokens(batch, toks)
+
     # ----------------------------------------------------------------- step
     def _admit_pending(self):
         mgr = self.state_mgr
@@ -264,6 +385,11 @@ class InferenceEngineV2:
                                   req.eos_token_id,
                                   temperature=req.temperature,
                                   top_k=req.top_k)
+            if self.config.splitfuse_tokens:
+                # SplitFuse: the prompt streams through chunk dispatches
+                # interleaved with decodes — no bucketed prefill here
+                self._prefill_q.append(req.uid)
+                continue
             T = len(req.prompt)
             T_pad = -(-max(T, 1) // bucket) * bucket
             ids = np.zeros((1, T_pad), np.int32)
@@ -303,9 +429,13 @@ class InferenceEngineV2:
         """
         self._admit_pending()
         mgr = self.state_mgr
+        if self._prefill_q:
+            return self._step_splitfuse_chunk()
         if mgr.n_active == 0:
             return []
         batch = mgr.decode_batch()
+        if not batch.active.any():
+            return []
         self._rng, sub = jax.random.split(self._rng)
         fn = self._get_decode()
         with jax.set_mesh(self.mesh):
@@ -314,7 +444,12 @@ class InferenceEngineV2:
                                   batch.block_tables, sub,
                                   batch.temps, batch.top_ks,
                                   not bool(batch.temps.any()))
-        toks = np.asarray(toks)                     # (n, B)
+        return self._post_decode_tokens(batch, np.asarray(toks))
+
+    def _post_decode_tokens(self, batch, toks):
+        """Feed (n, B) decode outputs to their sequences; returns the
+        accepted (uid, token) pairs."""
+        mgr = self.state_mgr
         out = []
         slots = list(mgr._slots)  # snapshot: retire mutates
         for slot, uid in enumerate(slots):

@@ -29,6 +29,10 @@ class DSSequenceDescriptor:
     blocks: list = field(default_factory=list)
     generated: list = field(default_factory=list)
     done: bool = False
+    # Dynamic SplitFuse: prompt tokens already written to the cache; a
+    # sequence decodes only once the whole prompt is in (the legacy
+    # bucketed prefill writes it all at once)
+    prefill_offset: int = 0
 
     @property
     def seen_tokens(self):
@@ -143,6 +147,10 @@ class DSStateManager:
             if uid is None:
                 continue
             seq = self._seqs[uid]
+            if not seq.generated:
+                # still prefilling (SplitFuse chunks in flight): no
+                # first token yet, nothing to decode
+                continue
             active[slot] = True
             temps[slot] = seq.temperature
             top_ks[slot] = seq.top_k

@@ -660,6 +660,50 @@ class GPT2:
             x, jnp.maximum(length - 1, 0)[None, None, None], axis=1)
         return self.head(params, last)[:, 0], {"k": ks_out, "v": vs_out}
 
+    def apply_paged_chunk(self, params, input_ids, cache, token_blocks,
+                          token_offsets, start, true_len, table):
+        """Prefill ONE CHUNK of one sequence into the paged cache (the
+        Dynamic SplitFuse chunk program; see Llama.apply_paged_chunk —
+        same contract, GPT-2's learned positions and full-head cache)."""
+        cfg = self.config
+        dt = _dtype(cfg)
+        C = input_ids.shape[1]
+        H, hd = cfg.n_head, cfg.d_head
+        BS = cache["k"][0].shape[2]
+        pos = jnp.minimum(start + jnp.arange(C), cfg.max_seq_len - 1)
+        x = (params["wte"][input_ids]
+             + params["wpe"][pos][None]).astype(dt)
+        S = table.shape[0] * BS
+        q_pos = (start + jnp.arange(C))[:, None]
+        k_pos = jnp.arange(S)[None, :]
+        mask = (k_pos <= q_pos) & (k_pos < start + true_len)
+
+        ks_out, vs_out = [], []
+        for i in range(cfg.n_layer):
+            layer = self._layer_slice(params, i)
+            kc0, vc0 = cache["k"][i], cache["v"][i]
+
+            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0):
+                kc = kc0.at[token_blocks, :, token_offsets].set(
+                    kk[0].astype(kc0.dtype))
+                vc = vc0.at[token_blocks, :, token_offsets].set(
+                    v[0].astype(vc0.dtype))
+                gk = kc[table].transpose(0, 2, 1, 3).reshape(S, H, hd)
+                gv = vc[table].transpose(0, 2, 1, 3).reshape(S, H, hd)
+                scores = jnp.einsum("bthd,shd->bhts", q, gk,
+                                    preferred_element_type=jnp.float32)
+                scores = scores / math.sqrt(hd)
+                scores = jnp.where(mask[None, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+                return jnp.einsum("bhts,shd->bthd", probs, gv), (kc, vc)
+
+            x, (kc, vc) = self._block_core(x, layer, attn_fn)
+            ks_out.append(kc)
+            vs_out.append(vc)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(true_len - 1, 0)[None, None, None], axis=1)
+        return self.head(params, last)[:, 0], {"k": ks_out, "v": vs_out}
+
     def apply_paged_decode(self, params, tokens, lengths, cache,
                            block_tables):
         """One decode step for a fixed-size batch over the paged cache.

@@ -161,6 +161,14 @@ class GPT2Pipe(GPT2):
             raise NotImplementedError(
                 "flash/ring attention inside the pipelined region is not "
                 "supported; use the dense backend with pipe")
+        if getattr(self, "moe_loss_coeff", 0.0):
+            # the 1F1B executor's block_fn drops per-block aux outputs —
+            # silently losing the MoE load-balance loss; mirror the
+            # explicit flash/ring errors rather than training wrong
+            raise NotImplementedError(
+                "MoE aux (load-balance) losses are not threaded through "
+                "pipe_schedule='1f1b'; use the GPipe schedule for MoE "
+                "pipeline models")
         from ..runtime.pipe.spmd import pipeline_1f1b_loss
         from .common import (chunked_softmax_xent, next_token_xent,
                              resolve_remat_policy)

@@ -604,6 +604,79 @@ class Llama:
             x, jnp.maximum(length - 1, 0)[None, None, None], axis=1)
         return self.head(params, last)[:, 0], {"k": ks_out, "v": vs_out}
 
+    def apply_paged_chunk(self, params, input_ids, cache, token_blocks,
+                          token_offsets, start, true_len, table):
+        """Prefill ONE CHUNK of one sequence into the paged cache
+        (Dynamic SplitFuse: long prompts stream through a fixed-size
+        chunk program instead of one bucketed prefill per prompt —
+        reference blogs/deepspeed-fastgen §3B, inference/v2/ragged/).
+
+        input_ids: (1, C) chunk tokens (right-padded); token_blocks/
+        token_offsets: (C,) destination block/slot per chunk position
+        (pads point at scratch block 0); start: scalar absolute position
+        of the chunk's first token; true_len: scalar number of real
+        tokens in the chunk; table: (MB,) the sequence's full block
+        table (scratch-padded). Queries attend the sequence's PRIOR
+        cache plus the in-chunk causal prefix — K/V are scattered first,
+        then gathered back through the table, so the attention sees one
+        contiguous [0, start + true_len) key range.
+        Returns (logits (1, V) at chunk position true_len - 1, cache).
+        """
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        C = input_ids.shape[1]
+        H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
+        BS = cache["k"][0].shape[2]
+        x = params["wte"][input_ids].astype(dt)
+        if cfg.embed_norm:
+            x = _layer_norm(x, params["embed_ln_s"], params["embed_ln_b"],
+                            cfg.rms_eps)
+        pos = start + jnp.arange(C)[None, :]
+        S = table.shape[0] * BS
+        q_pos = (start + jnp.arange(C))[:, None]       # (C, 1)
+        k_pos = jnp.arange(S)[None, :]                 # (1, S)
+        mask = (k_pos <= q_pos) & (k_pos < start + true_len)
+        mask = self._window_mask(mask, q_pos, k_pos)
+
+        ks_out, vs_out = [], []
+        for i in range(cfg.n_layer):
+            layer = self._layer_slice(params, i)
+            kc0, vc0 = cache["k"][i], cache["v"][i]
+            q, kk, v = self._attn_proj(x, layer)
+            q = self._rope(q, pos)
+            kk = self._rope(kk, pos)
+            kc = kc0.at[token_blocks, :, token_offsets].set(
+                kk[0].astype(kc0.dtype))
+            vc = vc0.at[token_blocks, :, token_offsets].set(
+                v[0].astype(vc0.dtype))
+            # gather the sequence's full K/V range through its table:
+            # (MB, KVH, BS, hd) -> (S, KVH, hd); in-cache layout is
+            # heads-major, so one transpose per gathered block row
+            gk = kc[table].transpose(0, 2, 1, 3).reshape(S, KVH, hd)
+            gv = vc[table].transpose(0, 2, 1, 3).reshape(S, KVH, hd)
+            gk = _repeat_kv(gk[None], H // KVH)[0]
+            gv = _repeat_kv(gv[None], H // KVH)[0]
+            scores = jnp.einsum("bthd,shd->bhts", q, gk,
+                                preferred_element_type=jnp.float32)
+            scores = scores / math.sqrt(hd)
+            if cfg.alibi:
+                scores = scores + self._alibi_bias(
+                    jnp.arange(S))[None, :, None, :]
+            scores = jnp.where(mask[None, None], scores, -1e30)
+            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+            attn = jnp.einsum("bhts,shd->bthd", probs, gv)
+            attn_out = self._wo(attn.reshape(1, C, H * hd), layer)
+            if cfg.parallel_block:
+                x = x + attn_out + self._mlp(x, layer)
+            else:
+                x = x + attn_out
+                x = x + self._mlp(x, layer)
+            ks_out.append(kc)
+            vs_out.append(vc)
+        last = jnp.take_along_axis(
+            x, jnp.maximum(true_len - 1, 0)[None, None, None], axis=1)
+        return self.head(params, last)[:, 0], {"k": ks_out, "v": vs_out}
+
     def apply_paged_decode(self, params, tokens, lengths, cache,
                            block_tables):
         cfg = self.config

@@ -308,13 +308,33 @@ def pipeline_1f1b_grads(block_fn, head_loss_fn, layers_params, layers_aux,
                     jnp.where(f_valid, x, r[slot])), ring, x_in)
 
             # last stage: per-microbatch loss + cotangent seed (cotangent
-            # of the MEAN over M, hence the 1/M seed)
+            # of the MEAN over M, hence the 1/M seed). Guarded by
+            # lax.cond on the pipe-varying stage id — legal inside the
+            # fully-manual shard_map (per-shard control flow, no
+            # collectives in either branch) — so non-last stages skip
+            # the d_model x vocab unembed fwd+vjp at runtime instead of
+            # computing and masking it (S-fold redundant MXU work that
+            # grows with vocab size).
             tgt = jax.tree.map(lambda x: x[f_safe], tgt_mb)
-            l_mb, vjp_h = jax.vjp(lambda hp, y: head_loss_fn(hp, y, tgt),
-                                  hp, y)
             seed = lax.pcast(jnp.float32(1.0 / M), (pipe_axis,),
                              to="varying")
-            dhp, dy_seed = vjp_h(seed)
+
+            def head_branch(hp_, y_, tgt_, seed_):
+                l_mb_, vjp_h = jax.vjp(
+                    lambda h, yy: head_loss_fn(h, yy, tgt_), hp_, y_)
+                dhp_, dy_ = vjp_h(seed_)
+                return l_mb_, dhp_, dy_
+
+            def skip_branch(hp_, y_, tgt_, seed_):
+                # zeros must carry the same varying-over-pipe type as the
+                # head branch's vjp outputs or cond rejects the branches
+                zv = lambda a: lax.pcast(jnp.zeros(a.shape, a.dtype),
+                                         (pipe_axis,), to="varying")
+                return (zv(jnp.zeros((), jnp.float32)),
+                        jax.tree.map(zv, hp_), jax.tree.map(zv, y_))
+
+            l_mb, dhp, dy_seed = lax.cond(sid == S - 1, head_branch,
+                                          skip_branch, hp, y, tgt, seed)
             seed_valid = f_valid & (sid == S - 1)
             loss_acc = loss_acc + jnp.where(seed_valid, l_mb, 0.0)
             hacc = jax.tree.map(

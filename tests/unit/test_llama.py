@@ -549,3 +549,33 @@ class TestBloom:
                  .astype(np.int32)}
         losses = [float(engine.train_batch(batch)) for _ in range(3)]
         assert losses[-1] < losses[0]
+
+
+class TestSplitFuseLlama:
+    """The SplitFuse chunk program on the llama machinery (window +
+    GQA + rope must all hold through chunked prefill)."""
+
+    def test_windowed_chunked_matches_bucketed(self):
+        from dataclasses import replace
+        from deepspeed_tpu.models.llama import LLAMA_TINY
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        m = Llama(replace(LLAMA_TINY, dtype="float32", sliding_window=24))
+        params = m.init(jax.random.key(0))
+        rng = np.random.RandomState(5)
+        prompts = [rng.randint(0, 500, (n,)).astype(np.int32)
+                   for n in (7, 33, 49)]
+        groups.reset()
+        legacy = InferenceEngineV2(
+            m, params=params,
+            config={"dtype": "float32", "kv_block_size": 16,
+                    "prompt_bucket": 16, "max_batch_size": 4})
+        want = legacy.generate_all(prompts, max_new_tokens=6)
+        groups.reset()
+        sf = InferenceEngineV2(
+            m, params=params,
+            config={"dtype": "float32", "kv_block_size": 16,
+                    "prompt_bucket": 16, "max_batch_size": 4,
+                    "splitfuse_tokens": 16})
+        got = sf.generate_all(prompts, max_new_tokens=6)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(g, w)
