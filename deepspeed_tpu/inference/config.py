@@ -30,6 +30,9 @@ class DeepSpeedInferenceConfig:
     top_p: float = 1.0
     # pad prompt lengths up to a multiple of this to bound recompiles
     prompt_bucket: int = 64
+    # ZeRO-Inference weight-only int8 serving (see
+    # RaggedInferenceEngineConfig.quantize_weights)
+    quantize_weights: bool = False
 
     @classmethod
     def from_dict(cls, d):

@@ -103,6 +103,7 @@ class InferenceEngine:
         from .utils import shard_params
         self.params, self.param_shardings = shard_params(
             model, self.mesh, dtype, params=params, seed=seed,
+            quantize=self.config.quantize_weights,
             topology=topology)
         self._forward_jit = None
         self._rng = jax.random.key(seed + 17)

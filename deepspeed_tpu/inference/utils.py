@@ -1,15 +1,53 @@
 """Shared inference-engine helpers."""
 
 import jax
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 
-def shard_params(model, mesh, dtype, params=None, seed=0, topology=None):
+def shard_params(model, mesh, dtype, params=None, seed=0, topology=None,
+                 quantize=False):
     """Build NamedShardings from the model's ``partition_specs`` and place
     (or initialize) params under them, cast to ``dtype``.
 
+    ``quantize=True``: ZeRO-Inference weight-only int8 — block weights
+    are quantized HOST-SIDE (HBM never holds the bf16 copy) and placed
+    as Int8Weight pytree nodes; serving paths dequantize one layer at a
+    time (ops/int8_weights.py; reference inference/quantization/).
+
     Returns (params, param_shardings)."""
     specs = model.partition_specs(topology)
+    if quantize:
+        from ..ops.int8_weights import (quantize_tree, quantized_shardings)
+        if params is None:
+            # init on HOST: the whole point is a model whose bf16 weights
+            # exceed device memory — the fp32 init tree must never touch
+            # the accelerator
+            cpus = jax.local_devices(backend="cpu")
+            with jax.default_device(cpus[0]):
+                params = model.init(jax.random.key(seed))
+        # consume-as-you-quantize: fp32 source leaves free one at a
+        # time, so peak host memory is ~the source tree + one leaf
+        # (not source + a full quantized copy)
+        if not isinstance(params, dict):
+            params = dict(params)
+        qtree = quantize_tree(params, consume=True)
+        del params
+        # cast the un-quantized leaves (embeds/norms/biases) to dtype
+        from ..ops.int8_weights import Int8Weight
+
+        def cast_leaf(x):
+            if isinstance(x, Int8Weight):
+                return x
+            a = np.asarray(x)
+            return a.astype(np.dtype(dtype)) if np.issubdtype(
+                a.dtype, np.floating) else a
+        qtree = jax.tree.map(cast_leaf, qtree,
+                             is_leaf=lambda x: isinstance(x, Int8Weight))
+        shardings = quantized_shardings(specs, qtree, mesh)
+        with jax.set_mesh(mesh):
+            params = jax.tree.map(jax.device_put, qtree, shardings)
+        return params, shardings
     shardings = jax.tree.map(lambda s: NamedSharding(mesh, s), specs,
                              is_leaf=lambda x: isinstance(x, P))
     with jax.set_mesh(mesh):

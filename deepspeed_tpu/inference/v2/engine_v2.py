@@ -58,6 +58,11 @@ class RaggedInferenceEngineConfig:
     # nor compile per-length bucket programs. 0 = legacy bucketed
     # whole-prompt prefill.
     splitfuse_tokens: int = 0
+    # ZeRO-Inference weight-only int8 (reference README.md:30,
+    # inference/quantization/): block weights live in HBM as int8 +
+    # per-channel scales, dequantized one layer at a time in-program —
+    # ~2x weight-capacity over bf16, serving models bf16 cannot fit
+    quantize_weights: bool = False
 
 
 @dataclass
@@ -104,7 +109,7 @@ class InferenceEngineV2:
         self.dtype = dtype
         self.params, self.param_shardings = shard_params(
             model, self.mesh, dtype, params=params, seed=config.seed,
-            topology=topology)
+            topology=topology, quantize=config.quantize_weights)
         cache_sh = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), model.paged_cache_specs(),
             is_leaf=lambda x: isinstance(x, P))

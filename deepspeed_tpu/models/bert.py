@@ -1,0 +1,186 @@
+"""BERT-style encoder model over DeepSpeedTransformerLayer.
+
+Counterpart of the reference's transformer-kernel validation target: the
+fused encoder layer (ops/transformer/transformer.py:296, csrc/transformer/)
+is exercised there against a vendored HF BERT
+(tests/unit/modeling.py + the transformer-kernel parity tests under
+tests/unit/ops/transformer/). Here the encoder is a first-class model —
+embeddings (token + position + segment, post-embedding LayerNorm) over a
+stack of DeepSpeedTransformerLayer blocks with a tied-embedding MLM head —
+so the fused layer trains end to end through the engine
+(`initialize(model=Bert(cfg), ...)`) and its numerics are pinned fwd+bwd
+against an independent dense reference (tests/unit/test_bert.py).
+"""
+
+from dataclasses import dataclass
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.transformer.transformer import (DeepSpeedTransformerConfig,
+                                           DeepSpeedTransformerLayer)
+from ..utils.groups import BATCH_AXES
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30528
+    max_seq_len: int = 512
+    type_vocab_size: int = 2
+    n_layer: int = 12
+    n_head: int = 12
+    d_model: int = 768
+    intermediate_size: int = 0         # 0 = 4 * d_model
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = False       # classic BERT is post-LN
+    dropout: float = 0.0
+    dtype: str = "float32"
+    mlm_mask_ratio: float = 0.15       # MLM training objective
+    use_flash_attention: bool = False  # encoder: bidirectional flash
+
+    def layer_config(self):
+        return DeepSpeedTransformerConfig(
+            hidden_size=self.d_model, heads=self.n_head,
+            intermediate_size=self.intermediate_size,
+            num_hidden_layers=self.n_layer,
+            layer_norm_eps=self.layer_norm_eps,
+            pre_layer_norm=self.pre_layer_norm,
+            attn_dropout_ratio=self.dropout,
+            hidden_dropout_ratio=self.dropout,
+            use_flash_attention=self.use_flash_attention,
+            dtype=self.dtype)
+
+    def num_params(self):
+        D = self.d_model
+        F = self.intermediate_size or 4 * D
+        block = (4 * D + D * 3 * D + 3 * D + D * D + D
+                 + D * F + F + F * D + D)
+        embed = (self.vocab_size + self.max_seq_len
+                 + self.type_vocab_size) * D + 2 * D
+        return embed + self.n_layer * block
+
+
+BERT_TINY = BertConfig(vocab_size=512, max_seq_len=128, n_layer=2,
+                       n_head=4, d_model=64)
+BERT_BASE = BertConfig()
+
+BERT_PRESETS = {"tiny": BERT_TINY, "bert-base": BERT_BASE}
+
+
+class Bert:
+    """Functional encoder: ``init``, ``apply`` (hidden states), ``loss``
+    (masked-LM), ``partition_specs`` — the engine surface."""
+
+    moe_loss_coeff = 0.0
+
+    def __init__(self, config: BertConfig):
+        self.config = config
+        self.layer = DeepSpeedTransformerLayer(config.layer_config())
+
+    def init(self, rng):
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        D = cfg.d_model
+        k_embed, k_layers = jax.random.split(rng)
+        std = 0.02
+
+        def nrm(key, shape):
+            return (jax.random.normal(key, shape, jnp.float32)
+                    * std).astype(dt)
+
+        ke = iter(jax.random.split(k_embed, 4))
+        params = {
+            "wte": nrm(next(ke), (cfg.vocab_size, D)),
+            "wpe": nrm(next(ke), (cfg.max_seq_len, D)),
+            "wtt": nrm(next(ke), (cfg.type_vocab_size, D)),
+            "embed_ln_scale": jnp.ones((D,), jnp.float32),
+            "embed_ln_bias": jnp.zeros((D,), jnp.float32),
+            # per-layer DeepSpeedTransformerLayer params, stacked on L
+            "layers": jax.tree.map(
+                lambda *xs: jnp.stack(xs),
+                *[self.layer.init(k)
+                  for k in jax.random.split(k_layers, cfg.n_layer)]),
+        }
+        return params
+
+    def partition_specs(self, topology=None):
+        """Megatron TP on the layer projections (column: wqkv/wi, row:
+        wo/wout); embeddings/norms replicated."""
+        layer_specs = {
+            "ln1_scale": P(None, None), "ln1_bias": P(None, None),
+            "wqkv": P(None, None, "tensor"), "bqkv": P(None, "tensor"),
+            "wo": P(None, "tensor", None), "bo": P(None, None),
+            "ln2_scale": P(None, None), "ln2_bias": P(None, None),
+            "wi": P(None, None, "tensor"), "bi": P(None, "tensor"),
+            "wout": P(None, "tensor", None), "bout": P(None, None),
+        }
+        return {
+            "wte": P(), "wpe": P(), "wtt": P(),
+            "embed_ln_scale": P(), "embed_ln_bias": P(),
+            "layers": layer_specs,
+        }
+
+    # ------------------------------------------------------------- forward
+    def apply(self, params, input_ids, *, attention_mask=None,
+              token_type_ids=None, rng=None, train=False,
+              seq_sharded=False):
+        """(B, T) -> (B, T, D) final hidden states. attention_mask:
+        (B, T) validity (1 = real token)."""
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        B, T = input_ids.shape
+        pos = jnp.arange(T)[None, :]
+        tt = (jnp.zeros_like(input_ids) if token_type_ids is None
+              else token_type_ids)
+        x = (params["wte"][input_ids] + params["wpe"][pos]
+             + params["wtt"][tt])
+        from ..ops.transformer.transformer import _ln
+        x = _ln(x.astype(dt), params["embed_ln_scale"],
+                params["embed_ln_bias"], cfg.layer_norm_eps)
+
+        mask = attention_mask
+        rngs = jax.random.split(
+            rng if rng is not None else jax.random.key(0), cfg.n_layer)
+
+        def body(h, xs):
+            layer_params, lrng = xs
+            return self.layer(layer_params, h, mask=mask,
+                              rng=lrng if train else None,
+                              train=train), None
+
+        x, _ = jax.lax.scan(body, x, (params["layers"], rngs))
+        return x
+
+    def apply_with_aux(self, params, input_ids, **kw):
+        return self.apply(params, input_ids, **kw), jnp.zeros(
+            (), jnp.float32)
+
+    # ---------------------------------------------------------------- loss
+    def loss(self, params, batch, *, rng=None, train=True,
+             seq_sharded=False):
+        """Masked-LM: mask ``mlm_mask_ratio`` of positions (replaced by
+        the [MASK]-like id 0), predict the original token through the
+        tied-embedding head. batch: {"input_ids": (B, T)} (+ optional
+        "attention_mask", "token_type_ids")."""
+        cfg = self.config
+        ids = batch["input_ids"]
+        B, T = ids.shape
+        base = rng if rng is not None else jax.random.key(0)
+        mask_rng = jax.random.fold_in(base, 0xB_E_57)
+        mlm_mask = jax.random.bernoulli(mask_rng, cfg.mlm_mask_ratio,
+                                        (B, T))
+        inputs = jnp.where(mlm_mask, 0, ids)
+        x = self.apply(params, inputs,
+                       attention_mask=batch.get("attention_mask"),
+                       token_type_ids=batch.get("token_type_ids"),
+                       rng=base, train=train)
+        logits = jnp.einsum("btd,vd->btv", x, params["wte"],
+                            preferred_element_type=jnp.float32)
+        logz = jax.nn.logsumexp(logits, axis=-1)
+        gold = jnp.take_along_axis(logits, ids[..., None],
+                                   axis=-1)[..., 0]
+        per_tok = logz - gold
+        denom = jnp.maximum(jnp.sum(mlm_mask), 1)
+        return jnp.sum(jnp.where(mlm_mask, per_tok, 0.0)) / denom

@@ -221,6 +221,64 @@ class GPT2:
                                         train=train, seq_sharded=seq_sharded)
         return logits
 
+    def _apply_ltd(self, params, input_ids, ltd_keep, *, rng, train,
+                   constrain, act_spec):
+        """Random-LTD forward (reference runtime/data_pipeline/
+        data_routing + csrc/random_ltd/): first and last blocks see the
+        full sequence; the middle blocks see ``ltd_keep`` random tokens
+        (sorted indices preserve order/position), with dropped positions
+        flowing through the skip connection. ``ltd_keep`` is static —
+        distinct values are distinct programs, bounded by the schedule's
+        seq_step quantization."""
+        from ..runtime.data_pipeline.random_ltd import (token_drop,
+                                                        token_restore)
+        cfg = self.config
+        if cfg.n_layer < 3:
+            raise ValueError("random-LTD needs n_layer >= 3 (first and "
+                             "last blocks stay full-sequence)")
+        T = input_ids.shape[1]
+        x = self.embed(params, input_ids, rng=rng, train=train,
+                       constrain=constrain, act_spec=act_spec)
+        causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        base_rng = rng if rng is not None else jax.random.key(0)
+        layer_rngs = jax.random.split(base_rng, cfg.n_layer)
+        blocks = params["blocks"]
+        first = jax.tree.map(lambda a: a[0], blocks)
+        last = jax.tree.map(lambda a: a[-1], blocks)
+        mid = jax.tree.map(lambda a: a[1:-1], blocks)
+
+        x, aux0 = self.block_forward(
+            x, first, layer_rngs[0], causal=causal, constrain=constrain,
+            act_spec=act_spec, seq_sharded=False, train=train)
+        x_keep, idx = token_drop(x, ltd_keep,
+                                 jax.random.fold_in(base_rng, 0x17D))
+        # gathered causal mask: kept token i attends kept token j iff
+        # their ORIGINAL positions are causal
+        mask = idx[:, :, None] >= idx[:, None, :]
+
+        def mid_block(h, layer, lrng):
+            return self.block_forward(
+                h, layer, lrng, causal=mask, constrain=constrain,
+                act_spec=act_spec, seq_sharded=False, train=train)
+
+        block_fn = mid_block
+        if cfg.remat:
+            block_fn = jax.checkpoint(
+                mid_block, policy=resolve_remat_policy(cfg.remat_policy))
+
+        def scan_body(carry, xs):
+            layer, lrng = xs
+            h, aux = block_fn(carry, layer, lrng)
+            return h, aux
+
+        x_keep, auxs = lax.scan(scan_body, x_keep,
+                                (mid, layer_rngs[1:-1]))
+        x = token_restore(x_keep, idx, x)
+        x, auxL = self.block_forward(
+            x, last, layer_rngs[-1], causal=causal, constrain=constrain,
+            act_spec=act_spec, seq_sharded=False, train=train)
+        return x, aux0 + jnp.sum(auxs) + auxL
+
     def apply_with_aux(self, params, input_ids, *, rng=None, train=False,
                        seq_sharded=False, return_hidden=False):
         """Return (logits (B, T, V) fp32, summed aux loss) — aux is the MoE
@@ -367,8 +425,12 @@ class GPT2:
         qkv = qkv.reshape(B, T, 3, H, hd)
         return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
-    def block_attn(self, q, kk, v, *, causal, constrain, seq_sharded):
-        """Attention backend dispatch: (B, T, H, hd) x3 -> (B, T, H, hd)."""
+    def block_attn(self, q, kk, v, *, causal, constrain, seq_sharded,
+                   force_dense=False):
+        """Attention backend dispatch: (B, T, H, hd) x3 -> (B, T, H, hd).
+        ``causal`` may carry a batch dim (B, t, s) — the random-LTD
+        middle segment attends gathered (non-contiguous) positions, which
+        also forces the dense path (``force_dense``)."""
         cfg = self.config
         dt = _dtype(cfg)
         if (seq_sharded and cfg.attention_backend == "ring"
@@ -378,7 +440,7 @@ class GPT2:
             attn = ring_attention_sharded(
                 q, kk, v, jax.sharding.get_abstract_mesh(),
                 batch_spec=P(BATCH_AXES), head_axis="tensor")
-        elif cfg.flash_on and not seq_sharded:
+        elif cfg.flash_on and not seq_sharded and not force_dense:
             # pallas fused attention: O(T) memory, fp32 accumulation
             # (ops/pallas/flash_attention.py). Heads shard over 'tensor'.
             # Inputs arrive from block_qkv as (B, H, hd, T) when
@@ -412,7 +474,9 @@ class GPT2:
             scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                                 preferred_element_type=jnp.float32)
             scores = scores / math.sqrt(self.config.d_head)
-            scores = jnp.where(causal[None, None], scores, -1e30)
+            mask = causal[None, None] if causal.ndim == 2 \
+                else causal[:, None]
+            scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             attn = jnp.einsum("bhts,bshd->bthd", probs, v)
             from jax.ad_checkpoint import checkpoint_name
@@ -459,11 +523,15 @@ class GPT2:
         """One transformer block: (B, T, D) -> (B, T, D), plus aux loss.
         Shared by the dense scan path and the pipelined executor
         (models/gpt2_pipe.py)."""
-        hm = self.config.flash_on and not seq_sharded
+        from ..ops.int8_weights import dequant_tree
+        layer = dequant_tree(layer, _dtype(self.config))
+        force_dense = causal.ndim != 2      # random-LTD gathered mask
+        hm = self.config.flash_on and not seq_sharded and not force_dense
         q, kk, v = self.block_qkv(x, layer, constrain=constrain,
                                   act_spec=act_spec, heads_major=hm)
         attn = self.block_attn(q, kk, v, causal=causal, constrain=constrain,
-                               seq_sharded=seq_sharded)
+                               seq_sharded=seq_sharded,
+                               force_dense=force_dense)
         return self.block_post(x, attn, layer, lrng, constrain=constrain,
                                act_spec=act_spec, seq_sharded=seq_sharded,
                                train=train, heads_major=hm)
@@ -514,6 +582,8 @@ class GPT2:
         (B,T,H,hd), carry)`` owns masking and any cache reads/writes.
         Returns (x_out, carry)."""
         cfg = self.config
+        from ..ops.int8_weights import dequant_tree
+        layer = dequant_tree(layer, _dtype(cfg))
         B, T = x.shape[0], x.shape[1]
         H, hd = cfg.n_head, cfg.d_head
         h = self._ln(x, layer["ln1_scale"], layer["ln1_bias"])
@@ -613,8 +683,11 @@ class GPT2:
         return {"k": [spec] * L, "v": [spec] * L}
 
     def _layer_slice(self, params, i):
-        """Static per-layer view of the stacked block params."""
-        return jax.tree.map(lambda a: a[i], params["blocks"])
+        """Static per-layer view of the stacked block params (int8
+        serving weights dequantize here, one layer at a time)."""
+        from ..ops.int8_weights import dequant_tree
+        sl = jax.tree.map(lambda a: a[i], params["blocks"])
+        return dequant_tree(sl, _dtype(self.config))
 
     def apply_paged_prefill(self, params, input_ids, cache, token_blocks,
                             token_offsets, length):
@@ -751,12 +824,27 @@ class GPT2:
         return self.head(params, x)[:, 0], {"k": ks_out, "v": vs_out}
 
     # --- loss ---
-    def loss(self, params, batch, *, rng=None, train=True, seq_sharded=False):
-        """Next-token cross entropy. batch: {"input_ids": (B, T) int32}."""
+    def loss(self, params, batch, *, rng=None, train=True, seq_sharded=False,
+             ltd_keep=None):
+        """Next-token cross entropy. batch: {"input_ids": (B, T) int32}.
+        ``ltd_keep``: random-LTD kept-token count for the middle layers
+        (static; engine-scheduled — see runtime/engine.py)."""
         ids = batch["input_ids"]
         cfg = self.config
         T = ids.shape[1]
         chunk = cfg.loss_chunk
+        if ltd_keep and train and not seq_sharded and ltd_keep < T:
+            constrain = self._constrain_fn()
+            act_spec = P(BATCH_AXES, None, None)
+            x, aux = self._apply_ltd(params, ids, int(ltd_keep), rng=rng,
+                                     train=train, constrain=constrain,
+                                     act_spec=act_spec)
+            if chunk and T - 1 > chunk:
+                return chunked_softmax_xent(
+                    self.head, params, x[:, :-1], ids[:, 1:], chunk) \
+                    + self.moe_loss_coeff * aux
+            return next_token_xent(self.head(params, x), ids) \
+                + self.moe_loss_coeff * aux
         if chunk and T - 1 > chunk and not seq_sharded:
             # chunked CE: never materialize the full (B, T, V) fp32 logits
             # (3.3 GB at B=16, T=1024, V=50k) — unembed + CE per sequence

@@ -373,6 +373,8 @@ class Llama:
     def block_forward(self, x, layer, pos, *, causal, constrain, act_spec):
         cfg = self.config
         dt = jnp.dtype(cfg.dtype)
+        from ..ops.int8_weights import dequant_tree
+        layer = dequant_tree(layer, dt)
         B, T = x.shape[0], x.shape[1]
         H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
         q, kk, v = self._attn_proj(x, layer)
@@ -493,6 +495,8 @@ class Llama:
 
         def body(carry, xs):
             layer, kc, vc = xs
+            from ..ops.int8_weights import dequant_tree
+            layer = dequant_tree(layer, dt)
             x = carry
             q, kk, v = self._attn_proj(x, layer)
             # self._rope honors rotary_pct (phi partial rotary) — the
@@ -551,7 +555,11 @@ class Llama:
         return {"k": [spec] * L, "v": [spec] * L}
 
     def _layer_slice(self, params, i):
-        return jax.tree.map(lambda a: a[i], params["blocks"])
+        from ..ops.int8_weights import dequant_tree
+        sl = jax.tree.map(lambda a: a[i], params["blocks"])
+        # ZeRO-Inference weight-only serving: int8 block weights
+        # dequantize one layer at a time (identity on bf16 trees)
+        return dequant_tree(sl, jnp.dtype(self.config.dtype))
 
     def apply_paged_prefill(self, params, input_ids, cache, token_blocks,
                             token_offsets, length):

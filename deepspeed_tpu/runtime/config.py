@@ -245,6 +245,40 @@ class DeepSpeedConfig:
         self.seq_parallel_comm_dtype = config.get(C.SEQ_PARALLEL_COMM_DTYPE,
                                                   "float32")
 
+        # data efficiency (reference runtime/data_pipeline/config.py
+        # schema, condensed; consumed by the engine — curriculum changes
+        # the batches the jitted step sees, random-ltd the kept-token
+        # count — reference engine.py:336-367 + deepspeed_io:1715):
+        #   data_efficiency: {enabled, seed,
+        #     data_sampling: {enabled, curriculum_learning: {enabled,
+        #         curriculum_type, min_difficulty, max_difficulty,
+        #         schedule_type, schedule_config}},
+        #     data_routing: {enabled, random_ltd: {enabled,
+        #         random_ltd_min_value, random_ltd_max_value,
+        #         random_ltd_schedule}}}
+        # Legacy top-level curriculum_learning (v1 API) also accepted.
+        de = config.get("data_efficiency", {}) or {}
+        self.data_efficiency_enabled = bool(de.get("enabled", False))
+        self.data_efficiency_seed = int(de.get("seed", 1234))
+        sampling = de.get("data_sampling", {}) or {}
+        cl = sampling.get("curriculum_learning", {}) or {}
+        legacy_cl = config.get("curriculum_learning", {}) or {}
+        self.curriculum_config = None
+        if self.data_efficiency_enabled and sampling.get(
+                "enabled", True) and cl.get("enabled", False):
+            self.curriculum_config = {
+                k: v for k, v in cl.items() if k != "enabled"}
+        elif legacy_cl.get("enabled", False):
+            self.curriculum_config = {
+                k: v for k, v in legacy_cl.items() if k != "enabled"}
+        routing = de.get("data_routing", {}) or {}
+        ltd = routing.get("random_ltd", {}) or {}
+        self.random_ltd_config = None
+        if self.data_efficiency_enabled and routing.get(
+                "enabled", True) and ltd.get("enabled", False):
+            self.random_ltd_config = {
+                k: v for k, v in ltd.items() if k != "enabled"}
+
     # reference runtime/config.py batch resolution logic, same error text style
     def _resolve_batch_size(self):
         train = self.train_batch_size

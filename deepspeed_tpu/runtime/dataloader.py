@@ -61,3 +61,28 @@ def _default_collate(items):
     if isinstance(first, dict):
         return {k: np.stack([it[k] for it in items]) for k in first}
     return np.stack(items)
+
+
+class SamplerDataLoader:
+    """Loader driven by a DeepSpeedDataSampler (curriculum-aware,
+    resumable): each iteration draws the sampler's next global index
+    batch and collates the items (reference DeepSpeedDataLoader with
+    data_sampler, deepspeed_io:1715)."""
+
+    def __init__(self, dataset, sampler, collate_fn=None):
+        self.dataset = dataset
+        self.sampler = sampler
+        self.collate_fn = collate_fn or _default_collate
+        self._stream = iter(sampler)
+
+    def __len__(self):
+        return len(self.sampler)
+
+    def __iter__(self):
+        # the sampler is an endless resumable stream; one __iter__ call
+        # is ONE EPOCH (len(self) batches), so the normal
+        # `for batch in loader:` loop terminates like the plain loader —
+        # sampler state persists across epochs (consumed_samples)
+        for _ in range(len(self)):
+            idx = next(self._stream)
+            yield self.collate_fn([self.dataset[int(j)] for j in idx])

@@ -119,6 +119,27 @@ class DeepSpeedEngine:
         # written at step boundaries like engine.py:1993-2001)
         from ..monitor.monitor import MonitorMaster
         self.monitor = MonitorMaster(self.config.monitor_config)
+
+        # data efficiency (reference engine.py:336-367): the curriculum
+        # scheduler changes the SEQUENCE LENGTH the jitted step sees
+        # (shape buckets — difficulty_step bounds distinct programs) and
+        # random-LTD the kept-token count of middle layers
+        self.curriculum_scheduler = None
+        self._curriculum_difficulty = None
+        if self.config.curriculum_config is not None:
+            from .data_pipeline.curriculum_scheduler import (
+                CurriculumScheduler)
+            self.curriculum_scheduler = CurriculumScheduler(
+                self.config.curriculum_config)
+        self.random_ltd_scheduler = None
+        if self.config.random_ltd_config is not None:
+            from .data_pipeline.random_ltd import RandomLTDScheduler
+            self.random_ltd_scheduler = RandomLTDScheduler(
+                self.config.random_ltd_config)
+            if not self._loss_accepts_ltd():
+                raise ValueError(
+                    "random_ltd is enabled but the model's loss() takes "
+                    "no ltd_keep argument (models/gpt2.py implements it)")
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} dtype={self.param_dtype} "
             f"dp={dp_world} tp={topology.get_model_parallel_world_size()} "
@@ -169,11 +190,11 @@ class DeepSpeedEngine:
         self.offload_enabled = (self.offload_opt_cfg.enabled
                                 or self.offload_param_cfg.enabled)
         self.host_optimizer = None
-        if self.offload_enabled and jax.process_count() > 1:
-            raise NotImplementedError(
-                "ZeRO-Offload currently supports single-process meshes "
-                "(each multi-host process would need its addressable "
-                "master shard stepped host-side)")
+        # multi-process offload: each process device_gets and host-steps
+        # ONLY its addressable master shards (reference
+        # stage_1_and_2.py:1181 — every DP rank cpu-steps its partition)
+        self._offload_multi = self.offload_enabled and \
+            jax.process_count() > 1
 
         with jax.set_mesh(self.mesh):
             if self.offload_enabled:
@@ -185,7 +206,11 @@ class DeepSpeedEngine:
                 params = jax.jit(
                     lambda m: _tree_cast(m, self.param_dtype),
                     out_shardings=param_sh)(master_dev)
-                host_master = jax.device_get(master_dev)
+                if self._offload_multi:
+                    host_master = self._collect_local_shards(
+                        master_dev, record_meta=True)
+                else:
+                    host_master = jax.device_get(master_dev)
                 del master_dev
                 from .zero.offload import HostOffloadOptimizer
                 self.host_optimizer = HostOffloadOptimizer(
@@ -214,23 +239,28 @@ class DeepSpeedEngine:
                                     out_shardings=opt_sh)(master)
         self.opt_shardings = opt_sh
 
-        scale_state = jax.device_put(
-            self.loss_scaler.init_state(),
-            jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
-                         self.loss_scaler.init_state()))
+        # replicated scalars are CREATED by a jitted program rather than
+        # device_put from host: device_put cannot target non-addressable
+        # shardings on a multi-process mesh, a same-value computation can
+        def _scalars():
+            return (self.loss_scaler.init_state(),
+                    jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32),
+                    jax.random.key(seed + 1))
+
+        rep = jax.tree.map(lambda _: NamedSharding(self.mesh, P()),
+                           jax.eval_shape(_scalars))
+        scale_state, step0, skipped0, rng0 = jax.jit(
+            _scalars, out_shardings=rep)()
         self.state = {
             "params": params,
             "master": master,
             "opt": opt_state,
             "scale": scale_state,
-            "step": jax.device_put(jnp.zeros((), jnp.int32),
-                                   NamedSharding(self.mesh, P())),
+            "step": step0,
             # overflow-skip counter lives on device so counting it never
             # forces a host sync (reference syncs CheckOverflow every step)
-            "skipped": jax.device_put(jnp.zeros((), jnp.int32),
-                                      NamedSharding(self.mesh, P())),
-            "rng": jax.device_put(jax.random.key(seed + 1),
-                                  NamedSharding(self.mesh, P())),
+            "skipped": skipped0,
+            "rng": rng0,
         }
         self.state_shardings = {
             "params": param_sh,
@@ -268,7 +298,15 @@ class DeepSpeedEngine:
         except (TypeError, ValueError):
             return False
 
-    def _model_loss(self, params, batch, rng, step=None):
+    def _loss_accepts_ltd(self):
+        import inspect
+        try:
+            return "ltd_keep" in inspect.signature(
+                self.model.loss).parameters
+        except (TypeError, ValueError):
+            return False
+
+    def _model_loss(self, params, batch, rng, step=None, ltd_keep=None):
         kwargs = {}
         if self.topology.get_sequence_parallel_world_size() > 1:
             kwargs["seq_sharded"] = True
@@ -276,6 +314,8 @@ class DeepSpeedEngine:
         # traced global step for schedule_offset gating
         if step is not None and self._loss_accepts_step():
             kwargs["step"] = step
+        if ltd_keep is not None:
+            kwargs["ltd_keep"] = ltd_keep
         return self.model.loss(params, batch, rng=rng, train=True, **kwargs)
 
     def _build_programs(self):
@@ -290,10 +330,11 @@ class DeepSpeedEngine:
         constrain = jax.lax.with_sharding_constraint
 
         def micro_loss_and_grads(params, micro_batch, rng, scale,
-                                 step=None):
+                                 step=None, ltd_keep=None):
             def scaled(p):
                 return self._model_loss(p, micro_batch, rng,
-                                        step=step) * scale
+                                        step=step, ltd_keep=ltd_keep) \
+                    * scale
             loss_scaled, grads = jax.value_and_grad(scaled)(params)
             # accumulate/reduce in fp32 (reference grad_accum_dtype default)
             grads = _tree_cast(grads, jnp.float32)
@@ -342,8 +383,10 @@ class DeepSpeedEngine:
                        "loss_scale": scale}
             return new_state, metrics
 
-        def train_step(state, batch, lr):
-            """batch leaves: (gas, per_step_batch, ...)"""
+        def train_step(state, batch, lr, ltd_keep=None):
+            """batch leaves: (gas, per_step_batch, ...); ltd_keep is a
+            STATIC kept-token count (random-LTD) — distinct values are
+            distinct programs, bounded by the schedule's seq_step"""
             scale = state["scale"]["scale"]
 
             if gas == 1:
@@ -353,7 +396,7 @@ class DeepSpeedEngine:
                 loss, grads = micro_loss_and_grads(
                     state["params"], micro,
                     jax.random.fold_in(state["rng"], 0), scale,
-                    step=state["step"])
+                    step=state["step"], ltd_keep=ltd_keep)
                 grads = jax.tree.map(lambda g, s: constrain(g, s),
                                      grads, grad_specs)
                 new_state, metrics = apply_update(state, grads, lr)
@@ -364,7 +407,7 @@ class DeepSpeedEngine:
                 acc, rng, i = carry
                 loss, grads = micro_loss_and_grads(
                     state["params"], micro, jax.random.fold_in(rng, i),
-                    scale, step=state["step"])
+                    scale, step=state["step"], ltd_keep=ltd_keep)
                 grads = jax.tree.map(lambda g, s: constrain(g, s),
                                      grads, grad_specs)
                 acc = jax.tree.map(lambda a, g: a + g / gas, acc, grads)
@@ -396,7 +439,7 @@ class DeepSpeedEngine:
         def acc_add(acc, grads):
             return jax.tree.map(lambda a, g: a + g / gas, acc, grads)
 
-        def grad_step(state, batch):
+        def grad_step(state, batch, ltd_keep=None):
             """ZeRO-Offload device half: loss + clipped, UNSCALED fp32
             grads + overflow flag. The update happens on the host
             (zero/offload.py HostOffloadOptimizer)."""
@@ -406,7 +449,8 @@ class DeepSpeedEngine:
                 acc, rng, i = carry
                 loss, grads = micro_loss_and_grads(
                     state["params"], micro_batch,
-                    jax.random.fold_in(rng, i), scale, step=state["step"])
+                    jax.random.fold_in(rng, i), scale, step=state["step"],
+                    ltd_keep=ltd_keep)
                 grads = jax.tree.map(lambda g, s: constrain(g, s),
                                      grads, grad_specs)
                 acc = jax.tree.map(lambda a, g: a + g / gas, acc, grads)
@@ -417,7 +461,7 @@ class DeepSpeedEngine:
                 loss, grads = micro_loss_and_grads(
                     state["params"], first,
                     jax.random.fold_in(state["rng"], 0), scale,
-                    step=state["step"])
+                    step=state["step"], ltd_keep=ltd_keep)
                 losses = loss
             else:
                 zeros = jax.tree.map(
@@ -455,7 +499,8 @@ class DeepSpeedEngine:
         with jax.set_mesh(self.mesh):
             if self.offload_enabled:
                 self._grad_step_jit = jax.jit(
-                    grad_step, in_shardings=(st_sh(), None),
+                    grad_step, static_argnums=(2,),
+                    in_shardings=(st_sh(), None),
                     out_shardings=(self.grad_shardings, None))
                 self._offload_finalize_jit = jax.jit(
                     offload_finalize, donate_argnums=(0,),
@@ -465,8 +510,16 @@ class DeepSpeedEngine:
                     finish_grads, donate_argnums=(0,),
                     in_shardings=(self.grad_shardings, None),
                     out_shardings=(self.grad_shardings, None))
+                # multi-process push-back: updated fp32 master shards ->
+                # replicated/resharded bf16 params (GSPMD emits the
+                # all-gather); the fp32 input is transient and donated
+                self._offload_push_jit = jax.jit(
+                    lambda m: _tree_cast(m, self.param_dtype),
+                    donate_argnums=(0,),
+                    in_shardings=(self.master_shardings,),
+                    out_shardings=self.param_shardings)
             self._train_step_jit = None if self.offload_enabled else jax.jit(
-                train_step, donate_argnums=(0,),
+                train_step, donate_argnums=(0,), static_argnums=(3,),
                 in_shardings=(st_sh(), None, None),
                 out_shardings=(st_sh(), None))
             self._micro_step_jit = jax.jit(
@@ -487,6 +540,39 @@ class DeepSpeedEngine:
                 out_shardings=(st_sh(), None))
 
     # ----------------------------------------------------------------- batch
+    def deepspeed_io(self, dataset, batch_size=None, shuffle=True,
+                     seed=None):
+        """Build the engine's data loader (reference engine.py:1715
+        ``deepspeed_io``). With data efficiency enabled, a
+        DeepSpeedDataSampler drives it: deterministic across restarts
+        (``sampler.state_dict``), curriculum-aware, resumable. The
+        single-controller engine feeds GLOBAL batches, so the sampler
+        runs at dp_rank 0 / dp_size 1 and train_batch shards them."""
+        from .dataloader import DeepSpeedDataLoader, SamplerDataLoader
+        batch_size = batch_size or self.config.train_batch_size
+        seed = (self.config.data_efficiency_seed if seed is None
+                else seed)
+        if (self.config.data_efficiency_enabled
+                or self.curriculum_scheduler is not None):
+            from .data_pipeline.data_sampler import DeepSpeedDataSampler
+            sampler = DeepSpeedDataSampler(
+                total_samples=len(dataset),
+                micro_batch_size=batch_size,
+                data_parallel_rank=0, data_parallel_size=1,
+                gradient_accumulation_steps=1,
+                shuffle=shuffle, seed=seed,
+                curriculum_scheduler=self.curriculum_scheduler)
+            self.data_sampler = sampler
+            return SamplerDataLoader(dataset, sampler)
+        return DeepSpeedDataLoader(dataset, batch_size, shuffle=shuffle,
+                                   seed=seed)
+
+    @property
+    def curriculum_difficulty(self):
+        """Difficulty of the most recent train_batch (None before the
+        first step / without a curriculum)."""
+        return self._curriculum_difficulty
+
     def _current_lr(self):
         if self.lr_scheduler is not None:
             return jnp.asarray(self.lr_scheduler(self.global_step),
@@ -534,15 +620,36 @@ class DeepSpeedEngine:
         """
         gas = self.config.gradient_accumulation_steps
         self.tput_timer.start()
+        if self.curriculum_scheduler is not None:
+            # curriculum (reference engine curriculum hook): the batch is
+            # truncated to the scheduled difficulty BEFORE sharding when
+            # the metric IS sequence length, so the jitted step compiles
+            # one program per distinct difficulty (difficulty_step bounds
+            # the count). Non-seqlen metrics only record the difficulty —
+            # samplers/users consume it (truncating e.g. a vocab-rarity
+            # percentile as a length would train on garbage).
+            diff = self.curriculum_scheduler.update_difficulty(
+                self.global_step + 1)
+            self._curriculum_difficulty = diff
+            if self.config.curriculum_config.get(
+                    "curriculum_type", "seqlen") == "seqlen":
+                batch = jax.tree.map(
+                    lambda x: x[:, :diff] if getattr(x, "ndim", 0) >= 2
+                    else x, batch)
+        ltd_keep = None
+        if self.random_ltd_scheduler is not None:
+            ltd_keep = int(self.random_ltd_scheduler.update_seq(
+                self.global_step))
         batch = jax.tree.map(self._add_gas_dim, batch)
         batch = self._shard_batch(batch, with_gas_dim=True)
         with jax.set_mesh(self.mesh):
             if self.offload_enabled:
-                grads, metrics = self._grad_step_jit(self.state, batch)
+                grads, metrics = self._grad_step_jit(self.state, batch,
+                                                     ltd_keep)
                 metrics = self._host_optimizer_step(grads, metrics)
             else:
                 self.state, metrics = self._train_step_jit(
-                    self.state, batch, self._current_lr())
+                    self.state, batch, self._current_lr(), ltd_keep)
         self.global_step += 1
         self.micro_steps += gas
         if self.lr_scheduler is not None:
@@ -552,30 +659,96 @@ class DeepSpeedEngine:
         self._maybe_print(metrics)
         return metrics["loss"]
 
+    def _collect_local_shards(self, tree, record_meta=False):
+        """Multi-process offload: per leaf, the 1D concatenation of THIS
+        process's addressable shards (fp32). ``record_meta`` stores the
+        (device, index, shape, size) piece layout so gradients can be
+        validated against it and updated pieces pushed back."""
+        metas = []
+
+        def leaf(garr):
+            shards = sorted(garr.addressable_shards,
+                            key=lambda s: s.device.id)
+            metas.append([(s.device, s.index, s.data.shape) for s in shards])
+            return np.concatenate(
+                [np.ravel(np.asarray(s.data)) for s in shards])
+
+        out = jax.tree.map(leaf, tree)
+        if record_meta:
+            self._offload_shard_meta = metas
+        else:
+            for i, (got, want) in enumerate(
+                    zip(metas, self._offload_shard_meta)):
+                if [(g[1], g[2]) for g in got] != \
+                        [(w[1], w[2]) for w in want]:
+                    raise AssertionError(
+                        f"offload leaf {i}: gradient shard layout "
+                        f"{[(g[1], g[2]) for g in got]} does not match "
+                        f"the master layout — grad and master shardings "
+                        f"must partition identically for the host step")
+        return out
+
+    def _push_local_master(self, leaf_idx, w_flat):
+        """Rebuild one global fp32 master leaf from this process's updated
+        pieces (every process calls this for every leaf — the global
+        array assembly is a collective contract, not a transfer)."""
+        meta = self._offload_shard_meta[leaf_idx]
+        sharding = jax.tree.leaves(self.master_shardings)[leaf_idx]
+        shape = jax.tree.leaves(self.state["params"])[leaf_idx].shape
+        bufs, off = [], 0
+        for dev, index, pshape in meta:
+            n = int(np.prod(pshape))
+            bufs.append(jax.device_put(
+                w_flat[off:off + n].reshape(pshape), dev))
+            off += n
+        return jax.make_array_from_single_device_arrays(
+            shape, sharding, bufs)
+
     def _host_optimizer_step(self, grads, metrics):
         """ZeRO-Offload host half: pull grads, CPU-Adam the host master,
         push refreshed bf16 params leaf-by-leaf (reference
         stage_1_and_2.py:1745 step with cpu_offload; the leafwise push
-        overlaps the next leaf's NVMe reads)."""
+        overlaps the next leaf's NVMe reads). Multi-process: each process
+        steps only its addressable master shards; the refreshed params
+        are re-assembled from per-process pieces and cast/resharded by a
+        tiny jitted program (the all-gather the reference does with
+        all_gather_dp_groups falls out of GSPMD)."""
         overflow = bool(np.asarray(metrics["overflow"]))
         if not overflow:
-            host_grads = jax.device_get(grads)
-            del grads
             lr = float(np.asarray(self._current_lr()))
-            np_dtype = np.dtype(self.param_dtype)
-            shardings_flat = jax.tree.leaves(self.param_shardings)
-            leaves_out = []
+            if self._offload_multi:
+                host_grads = self._collect_local_shards(grads)
+                del grads
+                master_leaves = []
 
-            def on_leaf(path, w_flat, shape):
-                arr = w_flat.reshape(shape)
-                if arr.dtype != np_dtype:
-                    arr = arr.astype(np_dtype)
-                leaves_out.append(
-                    jax.device_put(arr, shardings_flat[len(leaves_out)]))
+                def on_leaf_multi(path, w_flat, shape):
+                    master_leaves.append(self._push_local_master(
+                        len(master_leaves), w_flat))
 
-            self.host_optimizer.step(host_grads, lr, on_leaf)
-            self.state["params"] = jax.tree.unflatten(
-                jax.tree.structure(self.state["params"]), leaves_out)
+                self.host_optimizer.step(host_grads, lr, on_leaf_multi)
+                master_global = jax.tree.unflatten(
+                    jax.tree.structure(self.state["params"]),
+                    master_leaves)
+                with jax.set_mesh(self.mesh):
+                    self.state["params"] = self._offload_push_jit(
+                        master_global)
+            else:
+                host_grads = jax.device_get(grads)
+                del grads
+                np_dtype = np.dtype(self.param_dtype)
+                shardings_flat = jax.tree.leaves(self.param_shardings)
+                leaves_out = []
+
+                def on_leaf(path, w_flat, shape):
+                    arr = w_flat.reshape(shape)
+                    if arr.dtype != np_dtype:
+                        arr = arr.astype(np_dtype)
+                    leaves_out.append(jax.device_put(
+                        arr, shardings_flat[len(leaves_out)]))
+
+                self.host_optimizer.step(host_grads, lr, on_leaf)
+                self.state["params"] = jax.tree.unflatten(
+                    jax.tree.structure(self.state["params"]), leaves_out)
         self.state = self._offload_finalize_jit(
             self.state, jnp.asarray(overflow))
         return metrics

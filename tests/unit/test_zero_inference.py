@@ -1,0 +1,141 @@
+"""ZeRO-Inference: weight-only int8 serving (reference README.md:30 —
+'20x faster inference via weight quantization'; inference/quantization/).
+
+Weights live in HBM as int8 + per-channel scales; serving paths
+dequantize one layer at a time in-program. Tests pin the quantization
+math, the ~2x capacity win, and end-to-end serving quality on both
+engines."""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.ops.int8_weights import (Int8Weight, dequant_tree,
+                                            has_quantized, quantize_leaf,
+                                            quantize_tree)
+from deepspeed_tpu.models import GPT2, GPT2Config, Llama
+from deepspeed_tpu.models.llama import LLAMA_TINY
+from deepspeed_tpu.utils import groups
+
+CFG = GPT2Config(n_layer=2, n_head=4, d_model=128, max_seq_len=128,
+                 vocab_size=512, remat=False, dtype="float32")
+
+
+class TestInt8Weights:
+    def test_roundtrip_error_bound(self):
+        rng = np.random.RandomState(0)
+        w = rng.randn(64, 96).astype(np.float32)
+        q = quantize_leaf(w)
+        back = np.asarray(q.dequant(jnp.float32))
+        # symmetric per-channel: |err| <= scale/2 per column
+        scale = np.max(np.abs(w), axis=0, keepdims=True) / 127.0
+        assert np.all(np.abs(back - w) <= scale / 2 + 1e-7)
+
+    def test_quantize_tree_selects_block_weights_only(self):
+        model = GPT2(CFG)
+        params = jax.tree.map(np.asarray, model.init(jax.random.key(0)))
+        qt = quantize_tree(params, min_size=1024)
+        assert has_quantized(qt)
+        # embeddings / norms stay float
+        assert isinstance(qt["wte"], np.ndarray)
+        assert isinstance(qt["lnf_scale"], np.ndarray)
+        assert isinstance(qt["blocks"]["wqkv"], Int8Weight)
+        assert isinstance(qt["blocks"]["ln1_scale"], np.ndarray)
+
+    def test_capacity_halved(self):
+        model = GPT2(CFG)
+        params = jax.tree.map(np.asarray, model.init(jax.random.key(0)))
+        qt = quantize_tree(params, min_size=1024)
+
+        def nbytes(t):
+            return sum(np.asarray(x).nbytes for x in jax.tree.leaves(
+                t, is_leaf=lambda y: isinstance(y, np.ndarray)))
+
+        blocks_f32 = nbytes(params["blocks"])
+        blocks_q = nbytes(qt["blocks"])
+        # fp32 -> int8 + small scales: > 3.5x smaller (vs bf16: ~2x)
+        assert blocks_q < blocks_f32 / 3.5
+
+    def test_dequant_tree_identity_on_plain(self):
+        t = {"a": jnp.ones((4,)), "b": [jnp.zeros((2,))]}
+        out = dequant_tree(t, jnp.float32)
+        np.testing.assert_array_equal(out["a"], t["a"])
+
+
+class TestQuantizedServing:
+    def _logit_close(self, a, b):
+        # int8 weight error shifts logits slightly; demand the ranking
+        # is preserved where it matters (top-1 agreement) and values
+        # close in absolute terms
+        a, b = np.asarray(a, np.float32), np.asarray(b, np.float32)
+        agree = (a.argmax(-1) == b.argmax(-1)).mean()
+        assert agree >= 0.9, f"top-1 agreement {agree}"
+
+    def test_v1_generate_int8(self):
+        from deepspeed_tpu.inference.engine import InferenceEngine
+        model = GPT2(CFG)
+        params = model.init(jax.random.key(0))
+        rng = np.random.RandomState(0)
+        prompt = rng.randint(0, 512, (1, 12)).astype(np.int32)
+        groups.reset()
+        ref = InferenceEngine(model, config={"dtype": "float32"},
+                              params=params)
+        want = np.asarray(ref.generate(prompt, max_new_tokens=8,
+                                       temperature=0.0))
+        groups.reset()
+        q = InferenceEngine(model, config={"dtype": "float32",
+                                           "quantize_weights": True},
+                            params=params)
+        got = np.asarray(q.generate(prompt, max_new_tokens=8,
+                                    temperature=0.0))
+        # logits parity (quantization-tolerant)
+        self._logit_close(q.forward(prompt), ref.forward(prompt))
+        assert got.shape == want.shape
+
+    def test_v2_paged_int8_end_to_end(self):
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        model = Llama(LLAMA_TINY.__class__(**{
+            **LLAMA_TINY.__dict__, "dtype": "float32"}))
+        params = model.init(jax.random.key(0))
+        rng = np.random.RandomState(1)
+        prompts = [rng.randint(0, 500, (n,)).astype(np.int32)
+                   for n in (6, 11)]
+        groups.reset()
+        ref = InferenceEngineV2(model, params=params,
+                                config={"dtype": "float32",
+                                        "kv_block_size": 16,
+                                        "prompt_bucket": 16,
+                                        "max_batch_size": 2})
+        want = ref.generate_all(prompts, max_new_tokens=6)
+        groups.reset()
+        q = InferenceEngineV2(model, params=params,
+                              config={"dtype": "float32",
+                                      "kv_block_size": 16,
+                                      "prompt_bucket": 16,
+                                      "max_batch_size": 2,
+                                      "quantize_weights": True})
+        assert has_quantized(q.params)
+        got = q.generate_all(prompts, max_new_tokens=6)
+        for w, g in zip(want, got):
+            assert g.shape == w.shape
+            # greedy decode over quantized weights stays on-distribution:
+            # most tokens agree with the bf16 reference on a tiny model
+            assert (np.asarray(g) == np.asarray(w)).mean() >= 0.5
+
+    def test_v2_int8_with_splitfuse(self):
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+        model = GPT2(CFG)
+        params = model.init(jax.random.key(0))
+        rng = np.random.RandomState(2)
+        prompts = [rng.randint(0, 512, (20,)).astype(np.int32)]
+        groups.reset()
+        q = InferenceEngineV2(model, params=params,
+                              config={"dtype": "float32",
+                                      "kv_block_size": 16,
+                                      "prompt_bucket": 16,
+                                      "max_batch_size": 2,
+                                      "splitfuse_tokens": 16,
+                                      "quantize_weights": True})
+        out = q.generate_all(prompts, max_new_tokens=5)
+        assert out[0].shape == (5,)
