@@ -20,6 +20,10 @@ from deepspeed_tpu.ops.transformer.transformer import (
     DeepSpeedTransformerConfig, DeepSpeedTransformerLayer)
 from deepspeed_tpu.utils import groups
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 
 def _reference_block(params, x, mask, *, pre_ln, eps):
     """Independent textbook BERT block (post-LN default): written from

@@ -16,6 +16,10 @@ from deepspeed_tpu.runtime.checkpoint_engine.engines import (
     NoneCheckpointEngine)
 from deepspeed_tpu.runtime.checkpoint_engine import serialization as ser
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 CFG = GPT2Config(n_layer=2, n_head=2, d_model=64, max_seq_len=32,
                  vocab_size=256, remat=False, dtype="float32")
 

@@ -13,6 +13,9 @@ import pytest
 from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
                                                     WorldFailure)
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 def _mock_launch(script_for_host):
     """launch_fn that runs a small python script per host."""

@@ -10,6 +10,10 @@ import deepspeed_tpu
 from deepspeed_tpu.models import GPT2, GPT2Config
 from deepspeed_tpu.utils import groups
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 
 CFG = GPT2Config(n_layer=2, n_head=2, d_model=64, max_seq_len=32,
                  vocab_size=256, remat=False, dtype="float32")

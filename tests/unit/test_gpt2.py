@@ -7,6 +7,10 @@ from deepspeed_tpu.models import GPT2, GPT2Config, GPT2_TINY
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.groups import TopologyConfig
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 
 def _batch(rng, cfg, bsz=4):
     return {"input_ids": jax.random.randint(

@@ -19,6 +19,10 @@ torch = pytest.importorskip("torch")
 
 from deepspeed_tpu.checkpoint.hf import load_pretrained  # noqa: E402
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 
 def _roundtrip(tmp_path, hf_model, inputs, atol=2e-3):
     """Save hf_model, ingest via load_pretrained, compare logits fp32."""

@@ -18,6 +18,9 @@ from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.init_on_device import (OnDevice, abstract_init,
                                                 materialize)
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
 
 class TestSparsityConfigs:
     def test_dense_layout_full(self):

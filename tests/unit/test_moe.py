@@ -79,6 +79,7 @@ class TestGating:
             TopKGate(k=3)
 
 
+@pytest.mark.slow
 class TestMoELayer:
     def test_forward_and_identity_expert(self):
         """With ample capacity and experts = identity-ish maps, the layer
@@ -129,6 +130,7 @@ class TestMoELayer:
                                    rtol=1e-4, atol=1e-5)
 
 
+@pytest.mark.slow
 class TestGPT2MoEEngine:
     def _cfg(self, **kw):
         return GPT2MoEConfig(n_layer=2, n_head=2, d_model=32, max_seq_len=16,
@@ -260,6 +262,7 @@ class TestRaggedMoEValidation:
         assert int(np.asarray(counts).sum()) == 6 * 4
 
 
+@pytest.mark.slow
 class TestGPT2MoERagged:
     def test_ragged_backend_trains_top2(self):
         from deepspeed_tpu.models import GPT2MoE, GPT2MoEConfig
@@ -283,6 +286,7 @@ class TestGPT2MoERagged:
         assert l1 < l0
 
 
+@pytest.mark.slow
 class TestRaggedEP:
     """Expert-parallel dropless MoE (moe_layer_ragged_ep): shard_map +
     all_to_all + per-shard ragged_dot (reference cutlass moe_gemm composed

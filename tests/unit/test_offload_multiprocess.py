@@ -34,6 +34,10 @@ import deepspeed_tpu
 from deepspeed_tpu.models import GPT2, PRESETS
 from deepspeed_tpu.utils import groups
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 groups.reset()
 model = GPT2(PRESETS["tiny"])
 engine, _, _, _ = deepspeed_tpu.initialize(

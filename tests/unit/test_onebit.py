@@ -17,6 +17,10 @@ from deepspeed_tpu.runtime.fp16.onebit import (OneBitAdam, OneBitLamb,
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.groups import TopologyConfig
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 
 def _mesh():
     groups.reset()

@@ -19,6 +19,10 @@ from deepspeed_tpu.ops.pallas.quantization import (
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.groups import TopologyConfig
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 
 class TestFlashAttention:
     def _qkv(self, B=2, T=128, H=4, d=32, dtype=jnp.float32, seed=0):

@@ -25,6 +25,10 @@ from deepspeed_tpu.runtime.pipe.spmd import (split_microbatches,
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.groups import TopologyConfig
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 
 # ---------------------------------------------------------------- topology
 class TestProcessTopology:

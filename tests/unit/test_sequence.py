@@ -18,6 +18,10 @@ from deepspeed_tpu.sequence import (ring_attention_sharded,
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.groups import TopologyConfig
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 
 def _dense_ref(q, k, v, causal=True):
     T = q.shape[1]

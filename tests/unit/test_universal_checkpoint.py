@@ -17,6 +17,10 @@ from deepspeed_tpu.module_inject import AutoTP, autotp_partition_specs
 from deepspeed_tpu.utils import groups
 from deepspeed_tpu.utils.groups import TopologyConfig
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 
 TINY = GPT2Config(n_layer=2, n_head=2, d_model=32, max_seq_len=32,
                   vocab_size=64, remat=False, dtype="float32")

@@ -18,6 +18,10 @@ from deepspeed_tpu.models import GPT2, GPT2Config, Llama
 from deepspeed_tpu.models.llama import LLAMA_TINY
 from deepspeed_tpu.utils import groups
 
+# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
+pytestmark = pytest.mark.slow
+
+
 CFG = GPT2Config(n_layer=2, n_head=4, d_model=128, max_seq_len=128,
                  vocab_size=512, remat=False, dtype="float32")
 
