@@ -1,0 +1,91 @@
+"""Evoformer attention (DS4Science): biased attention for AlphaFold-style
+models.
+
+Counterpart of reference ``csrc/deepspeed4science/evoformer_attn/``
+(``DS4Sci_EvoformerAttention`` — a CUTLASS fused kernel whose reason to
+exist is O(N^2) score-matrix memory at MSA shapes). The TPU shape of the
+same capability: scores never materialize for the WHOLE batch at once —
+the computation chunks over the leading (batch*seq) rows with
+``lax.map``, each chunk a plain fp32-accumulated attention with the
+additive biases, which XLA fuses; peak memory is one chunk's
+(chunk, H, N, N) scores instead of the full (B, S, H, N, N).
+
+API mirrors the reference:
+  evoformer_attention(q, k, v, biases=(bias1, bias2), chunk=...)
+with q/k/v (B, S, N, H, d) — batch, MSA rows, residues, heads, head_dim
+— and biases broadcastable to the score shape (B, S, H, N, N):
+  bias1: (B, S, 1, 1, N)  — per-row residue mask
+  bias2: (B, 1, H, N, N)  — pair-representation bias
+Returns (B, S, N, H, d) in q's dtype. Differentiable (jax autodiff
+through the chunked map).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def evoformer_attention(q, k, v, biases=(), *, scale=None, chunk=0):
+    """Biased attention over (B, S, N, H, d) MSA-shaped inputs.
+
+    ``biases``: additive fp32 terms broadcastable to (B, S, H, N, N)
+    (the reference passes [bias1, bias2]). ``chunk``: rows of the
+    flattened (B*S) dim processed per step (0 = auto: aim for ~256 MB of
+    fp32 scores per chunk; 1 row of scores is H*N*N fp32)."""
+    B, S, N, H, d = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    biases = tuple(biases)
+    for b in biases:
+        if b.ndim != 5:
+            raise ValueError(
+                f"bias must be 5D broadcastable to (B, S, H, N, N); got "
+                f"shape {b.shape}")
+
+    if chunk == 0:
+        row_bytes = H * N * N * 4
+        chunk = max(1, min(B * S, (256 << 20) // max(row_bytes, 1)))
+
+    def attend(q_, k_, v_, bias_rows):
+        # q_/k_/v_: (C, N, H, d); bias_rows: tuple of (C, H, N, N)
+        s = jnp.einsum("cnhd,cmhd->chnm", q_, k_,
+                       preferred_element_type=jnp.float32) * scale
+        for br in bias_rows:
+            s = s + br
+        p = jax.nn.softmax(s, axis=-1)
+        return jnp.einsum("chnm,cmhd->cnhd", p.astype(q_.dtype), v_)
+
+    BS = B * S
+    qf = q.reshape(BS, N, H, d)
+    kf = k.reshape(BS, N, H, d)
+    vf = v.reshape(BS, N, H, d)
+    # biases broadcast to the flattened row dim; under jit the broadcast
+    # stays lazy until consumed chunk-by-chunk in the map body (XLA
+    # fuses the expansion into the score add — the memory property)
+    bflat = [jnp.broadcast_to(b, (B, S, H, N, N)).reshape(BS, H, N, N)
+             for b in biases]
+
+    if chunk >= BS:
+        out = attend(qf, kf, vf, tuple(bflat))
+        return out.reshape(B, S, N, H, d)
+
+    n_chunks = -(-BS // chunk)
+    pad = n_chunks * chunk - BS
+
+    def padrows(x):
+        return jnp.pad(x, ((0, pad),) + ((0, 0),) * (x.ndim - 1)) \
+            if pad else x
+
+    qf, kf, vf = padrows(qf), padrows(kf), padrows(vf)
+    bflat = [padrows(b) for b in bflat]
+
+    def body(i):
+        sl = lambda x: lax.dynamic_slice_in_dim(x, i * chunk, chunk, 0)
+        return attend(sl(qf), sl(kf), sl(vf),
+                      tuple(sl(b) for b in bflat))
+
+    out = lax.map(body, jnp.arange(n_chunks))
+    out = out.reshape(n_chunks * chunk, N, H, d)[:BS]
+    return out.reshape(B, S, N, H, d)

@@ -1,0 +1,49 @@
+"""Spatial (diffusers UNet/VAE) fused bias ops.
+
+Counterpart of reference ``csrc/spatial/opt_bias_add.cu:149`` (the
+``spatial_inference`` op builder): fused NHWC bias-add variants used by
+the diffusers UNet/VAE injection containers
+(module_inject/containers/unet.py, vae.py). On TPU these are single
+XLA fusions — the value of this module is the stable API surface the
+reference exposes (opt_bias_add / opt_bias_add_add / opt_bias_add_res),
+not a custom kernel; XLA emits one fused elementwise pass per call
+(SURVEY §2.6: "XLA fusion suffices").
+
+x is NHWC (batch, height, width, channels) or any (..., C) layout;
+``bias`` is (C,). The diffusers module wrappers themselves are gated on
+the library being installed (it is not part of this image); these ops
+are what they would call.
+"""
+
+import jax.numpy as jnp
+
+
+def _check(x, bias):
+    if bias.ndim != 1 or x.shape[-1] != bias.shape[0]:
+        raise ValueError(
+            f"bias must be (C,) matching x's channel dim; got x "
+            f"{x.shape}, bias {bias.shape}")
+
+
+def opt_bias_add(x, bias):
+    """y = x + bias (reference opt_bias_add)."""
+    _check(x, bias)
+    return x + bias.astype(x.dtype)
+
+
+def opt_bias_add_add(x, bias, other):
+    """y = (x + bias) + other — the UNet dual-stream add
+    (reference opt_bias_add_add)."""
+    _check(x, bias)
+    return x + bias.astype(x.dtype) + other
+
+
+def opt_bias_add_res(x, bias, residual, residual_bias=None):
+    """y = (x + bias) + (residual [+ residual_bias]) — the residual
+    variant (reference opt_res_add_bias_add)."""
+    _check(x, bias)
+    out = x + bias.astype(x.dtype) + residual
+    if residual_bias is not None:
+        _check(residual, residual_bias)
+        out = out + residual_bias.astype(x.dtype)
+    return out
