@@ -19,6 +19,9 @@ import pytest
 REPO = os.path.dirname(os.path.dirname(
     os.path.dirname(os.path.abspath(__file__))))
 
+# multi-process spawn: excluded from the fast core set
+pytestmark = pytest.mark.slow
+
 _WORKER = r"""
 import json, os, sys
 os.environ["JAX_PLATFORMS"] = "cpu"
@@ -33,10 +36,6 @@ import numpy as np
 import deepspeed_tpu
 from deepspeed_tpu.models import GPT2, PRESETS
 from deepspeed_tpu.utils import groups
-
-# compile-heavy: excluded from the fast core set (pytest -m 'not slow')
-pytestmark = pytest.mark.slow
-
 
 groups.reset()
 model = GPT2(PRESETS["tiny"])
