@@ -11,6 +11,9 @@ import jax.numpy as jnp
 
 from deepspeed_tpu.ops.evoformer_attn import evoformer_attention
 
+# kernel-parity tier: excluded from the fast core set
+pytestmark = pytest.mark.slow
+
 
 def _dense_reference(q, k, v, biases, scale):
     s = jnp.einsum("bsnhd,bsmhd->bshnm", q, k,
