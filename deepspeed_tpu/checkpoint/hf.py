@@ -322,26 +322,105 @@ def convert_phi(hf, sd, dtype="bfloat16"):
 
 
 def convert_falcon(hf, sd, dtype="bfloat16"):
+    """All three HF falcon generations. The fused query_key_value weight
+    has three row layouts (HF modeling_falcon.py ``_split_heads``):
+
+      new_decoder_architecture (40b/180b/11b): grouped per KV head —
+        rows reshape to (KVH, G+2, hd) with G = H // KVH queries then
+        that group's k and v;
+      old arch, multi_query (7b): flat [q (H*hd) | k (hd) | v (hd)];
+      old arch, no multi_query (falcon-rw): per-head interleave (H, 3, hd).
+
+    Norms likewise: new arch carries ln_attn + ln_mlp (one per parallel
+    branch) unless num_ln_in_parallel_attn == 1; falcon-rw
+    (parallel_attn=False) carries standard input/post_attention norms;
+    7b shares one input LN between branches. Detected from the state
+    dict so sub-variants (falcon2-11b single-LN) load correctly."""
     from ..models.falcon import FalconConfig
     n_head = hf["num_attention_heads"]
+    H = n_head
+    D = hf["hidden_size"]
+    hd = D // H
+    L = hf["num_hidden_layers"]
+    new_arch = bool(hf.get("new_decoder_architecture", False))
+    multi_query = bool(hf.get("multi_query", True))
+    # mirror HF FalconConfig.num_kv_heads resolution exactly
+    KVH = hf.get("num_kv_heads", n_head) if new_arch \
+        else (1 if multi_query else n_head)
+    parallel = bool(hf.get("parallel_attn", True))
+    alibi = bool(hf.get("alibi", False))
+    has_bias = bool(hf.get("bias", False))
     cfg = FalconConfig(
         vocab_size=hf["vocab_size"],
         max_seq_len=hf.get("max_position_embeddings", 2048),
-        n_layer=hf["num_hidden_layers"], n_head=n_head,
-        n_kv_heads=hf.get("num_kv_heads", 1) if hf.get(
-            "new_decoder_architecture") else 1,
-        d_model=hf["hidden_size"], d_ff=4 * hf["hidden_size"],
+        n_layer=L, n_head=n_head, n_kv_heads=KVH,
+        d_model=D, d_ff=4 * D,
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_eps=hf.get("layer_norm_epsilon", 1e-5),
+        parallel_block=parallel, alibi=alibi, alibi_inv_norm=alibi,
+        qkv_bias=has_bias, proj_bias=has_bias,
         tie_embeddings=True, dtype=dtype)
     pre = "transformer."
-    params, g, maybe = _llama_like(
-        hf, sd, cfg, dtype, pre=pre, fused_qkv=True, gated=False, ln=True,
-        shared_ln=True, mlp_names=("dense_h_to_4h", "dense_4h_to_h"),
-        layer_prefix="h")
+    g = lambda k: sd[pre + k]
+
+    def split_qkv(w):
+        """(D, fused) -> wq (D, H*hd), wk/wv (D, KVH*hd); also splits the
+        fused bias when given a 1-D array (leading axis is the fused
+        dim either way)."""
+        lead = w.shape[:-1]                 # (D,) for weights, () for bias
+        if new_arch:
+            G = H // KVH
+            t = w.reshape(*lead, KVH, G + 2, hd)
+            q = t[..., :, :G, :].reshape(*lead, H * hd)
+            k = t[..., :, G, :].reshape(*lead, KVH * hd)
+            v = t[..., :, G + 1, :].reshape(*lead, KVH * hd)
+        elif multi_query:
+            q = w[..., :H * hd]
+            k = w[..., H * hd:(H + 1) * hd]
+            v = w[..., (H + 1) * hd:]
+        else:
+            t = w.reshape(*lead, H, 3, hd)
+            q = t[..., :, 0, :].reshape(*lead, H * hd)
+            k = t[..., :, 1, :].reshape(*lead, H * hd)
+            v = t[..., :, 2, :].reshape(*lead, H * hd)
+        return q, k, v
+
+    layers = []
+    for i in range(L):
+        lp = f"h.{i}."
+        wq, wk, wv = split_qkv(g(lp + "self_attention.query_key_value"
+                                 ".weight").T)
+        e = {"wq": wq, "wk": wk, "wv": wv,
+             "wo": g(lp + "self_attention.dense.weight").T,
+             "wup": g(lp + "mlp.dense_h_to_4h.weight").T,
+             "wdown": g(lp + "mlp.dense_4h_to_h.weight").T}
+        if has_bias:
+            e["bq"], e["bk"], e["bv"] = split_qkv(
+                g(lp + "self_attention.query_key_value.bias"))
+            e["bo"] = g(lp + "self_attention.dense.bias")
+            e["bup"] = g(lp + "mlp.dense_h_to_4h.bias")
+            e["bdown"] = g(lp + "mlp.dense_4h_to_h.bias")
+        if pre + lp + "ln_attn.weight" in sd:      # new arch, 2 norms
+            e["rms1"] = g(lp + "ln_attn.weight")
+            e["b1"] = g(lp + "ln_attn.bias")
+            e["rms2"] = g(lp + "ln_mlp.weight")
+            e["b2"] = g(lp + "ln_mlp.bias")
+        else:
+            e["rms1"] = g(lp + "input_layernorm.weight")
+            e["b1"] = g(lp + "input_layernorm.bias")
+            if pre + lp + "post_attention_layernorm.weight" in sd:
+                e["rms2"] = g(lp + "post_attention_layernorm.weight")
+                e["b2"] = g(lp + "post_attention_layernorm.bias")
+            else:                                  # 7b: one shared LN
+                e["rms2"], e["b2"] = e["rms1"], e["b1"]
+        layers.append(e)
+
+    params = {"blocks": {k: _stack(layers, k) for k in layers[0]}}
     params["wte"] = g("word_embeddings.weight")
     params["norm_f"] = g("ln_f.weight")
     params["norm_f_b"] = g("ln_f.bias")
+    if has_bias:
+        params["lm_head_b"] = np.zeros((hf["vocab_size"],), np.float32)
     return cfg, _model_cast(params, cfg, dtype)
 
 
@@ -452,16 +531,22 @@ _MODEL_CLASSES = {
 
 
 def _model_cast(params, cfg, dtype, fp32_keys=()):
-    """numpy tree -> jax arrays in the model dtype (fp32_keys stay f32)."""
-    import jax
+    """Cast the numpy tree to the model dtype ON HOST (fp32_keys stay
+    f32). bf16 works as a host dtype via ml_dtypes. Returning host
+    arrays — not committed jax arrays — is load-bearing: device
+    placement is deferred to ``shard_params``/``device_put`` so
+    ZeRO-Inference can quantize and TP serving can shard models whose
+    full bf16 tree would not fit one chip (reference loads to torch CPU
+    for the same reason, inference/engine.py:331)."""
     import jax.numpy as jnp
-    dt = jnp.dtype(dtype)
+    dt = np.dtype(jnp.dtype(dtype))
 
     def walk(tree, path=()):
         if isinstance(tree, dict):
             return {k: walk(v, path + (k,)) for k, v in tree.items()}
         keep = any(k in fp32_keys for k in path)
-        return jnp.asarray(tree, jnp.float32 if keep else dt)
+        return np.asarray(tree).astype(np.float32 if keep else dt,
+                                       copy=False)
     return walk(params)
 
 

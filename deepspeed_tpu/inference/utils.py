@@ -33,17 +33,12 @@ def shard_params(model, mesh, dtype, params=None, seed=0, topology=None,
             params = dict(params)
         qtree = quantize_tree(params, consume=True)
         del params
-        # cast the un-quantized leaves (embeds/norms/biases) to dtype
-        from ..ops.int8_weights import Int8Weight
-
-        def cast_leaf(x):
-            if isinstance(x, Int8Weight):
-                return x
-            a = np.asarray(x)
-            return a.astype(np.dtype(dtype)) if np.issubdtype(
-                a.dtype, np.floating) else a
-        qtree = jax.tree.map(cast_leaf, qtree,
-                             is_leaf=lambda x: isinstance(x, Int8Weight))
+        # cast the un-quantized leaves (embeds/norms/biases) to dtype;
+        # router weights stay fp32 (the same exclusion quantize_tree
+        # honors — downcasting them to bf16 here would undo the
+        # precision the exclusion exists to keep)
+        from ..ops.int8_weights import Int8Weight, cast_unquantized
+        qtree = cast_unquantized(qtree, dtype)
         shardings = quantized_shardings(specs, qtree, mesh)
         with jax.set_mesh(mesh):
             params = jax.tree.map(jax.device_put, qtree, shardings)
@@ -57,7 +52,20 @@ def shard_params(model, mesh, dtype, params=None, seed=0, topology=None,
                                        model.init(r)),
                 out_shardings=shardings)(jax.random.key(seed))
         else:
-            params = jax.jit(
-                lambda p: jax.tree.map(lambda x: x.astype(dtype), p),
-                out_shardings=shardings)(params)
+            # leafwise device_put: host (numpy) leaves transfer shard-by-
+            # shard straight to their placement — the full tree never
+            # materializes on one device (TP serving of > 1-chip models)
+            import jax.numpy as jnp
+
+            def place(x, s):
+                # jnp.issubdtype, not np.: host bf16 (ml_dtypes) is not
+                # a np.floating subdtype
+                if not isinstance(x, jax.Array):
+                    a = np.asarray(x)
+                    if jnp.issubdtype(a.dtype, jnp.floating):
+                        a = a.astype(np.dtype(dtype), copy=False)
+                    return jax.device_put(a, s)
+                return jax.device_put(x.astype(dtype) if jnp.issubdtype(
+                    x.dtype, jnp.floating) else x, s)
+            params = jax.tree.map(place, params, shardings)
     return params, shardings

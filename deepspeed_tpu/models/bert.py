@@ -171,6 +171,10 @@ class Bert:
         mask_rng = jax.random.fold_in(base, 0xB_E_57)
         mlm_mask = jax.random.bernoulli(mask_rng, cfg.mlm_mask_ratio,
                                         (B, T))
+        am = batch.get("attention_mask")
+        if am is not None:
+            # never mask (or count in the loss denominator) padding
+            mlm_mask = jnp.logical_and(mlm_mask, am.astype(jnp.bool_))
         inputs = jnp.where(mlm_mask, 0, ids)
         x = self.apply(params, inputs,
                        attention_mask=batch.get("attention_mask"),

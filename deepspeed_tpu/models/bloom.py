@@ -15,8 +15,9 @@ the config point. The attention paths add ``slope_h * k_pos`` to the
 scores (softmax-shift equivalent to the textbook
 ``slope_h * (k_pos - q_pos)``, matching HF bloom), and the v2 paged
 decode kernel takes the slopes as a static argument
-(ops/pallas/paged_attention.py). The flash kernel has no bias input, so
-ALiBi models use the dense attention path.
+(ops/pallas/paged_attention.py), and training/prefill ride the flash
+kernel's additive-bias input (ops/pallas/flash_attention.py ``alibi=``)
+— no dense (B, H, T, T) score materialization on any path.
 """
 
 from dataclasses import dataclass

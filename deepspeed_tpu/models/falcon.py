@@ -38,12 +38,11 @@ FALCON_PRESETS = {"tiny": FALCON_TINY, "falcon-7b": FALCON_7B}
 
 
 class Falcon(Llama):
-    """Falcon: parallel-block MQA LN model on the shared Llama machinery
-    (see module docstring)."""
+    """Falcon: LN model on the shared Llama machinery (see module
+    docstring). The family spans three generations — 7b (parallel block
+    + MQA), new-decoder-arch 40b/180b (parallel block + GQA, two input
+    norms), and falcon-rw (sequential block, per-head attention, ALiBi,
+    biases) — all expressed as config knobs; no per-variant subclass."""
 
     def __init__(self, config: FalconConfig):
-        if not config.parallel_block or config.n_kv_heads != 1:
-            raise ValueError(
-                "Falcon requires parallel_block=True and multi-query "
-                "attention (n_kv_heads=1)")
         super().__init__(config)

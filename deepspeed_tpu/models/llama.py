@@ -72,6 +72,11 @@ class LlamaConfig:
     # rotary embeddings (rope is skipped). Attention runs the dense path
     # (the flash kernel has no bias input).
     alibi: bool = False
+    # falcon-rw quirk: HF falcon adds alibi BEFORE the 1/sqrt(hd) score
+    # scaling (modeling_falcon.py:398/912) and quantizes the bias
+    # through bf16 (:162), unlike bloom which adds it unscaled; models
+    # trained that way need the same numerics
+    alibi_inv_norm: bool = False
     # bloom word_embeddings_layernorm: LN applied to the embedding output
     # (adds embed_ln_s/embed_ln_b params)
     embed_norm: bool = False
@@ -330,12 +335,18 @@ class Llama:
 
     def _alibi_bias(self, k_pos):
         """(H, ...) additive score bias: slope_h * k_pos (softmax-shift
-        equivalent to slope_h * (k_pos - q_pos); matches HF bloom)."""
+        equivalent to slope_h * (k_pos - q_pos); matches HF bloom).
+        ``alibi_inv_norm`` (falcon-rw): bf16-quantized and divided by
+        sqrt(hd), matching HF falcon's pre-scaling addition."""
         from ..ops.pallas.paged_attention import alibi_slopes
-        slopes = jnp.asarray(alibi_slopes(self.config.n_head),
-                             jnp.float32)
-        return slopes.reshape(-1, *([1] * k_pos.ndim)) \
+        cfg = self.config
+        slopes = jnp.asarray(alibi_slopes(cfg.n_head), jnp.float32)
+        bias = slopes.reshape(-1, *([1] * k_pos.ndim)) \
             * k_pos.astype(jnp.float32)[None]
+        if cfg.alibi_inv_norm:
+            bias = bias.astype(jnp.bfloat16).astype(jnp.float32) \
+                / math.sqrt(cfg.d_head)
+        return bias
 
     def _window_mask(self, mask, q_pos, k_pos):
         """AND a sliding-window constraint into a boolean mask
@@ -386,14 +397,25 @@ class Llama:
         v = constrain(v, head_spec)
         kk = _repeat_kv(kk, H // KVH)
         v = _repeat_kv(v, H // KVH)
-        if cfg.flash_on and not cfg.alibi:
-            # (alibi needs an additive score bias the kernel has no
-            # input for -> dense path; the window IS kernel-supported)
+        if cfg.flash_on:
             from ..ops.pallas.flash_attention import flash_attention
-            attn = flash_attention(q, kk, v, causal=True,
-                                   block_q=cfg.flash_block_q,
-                                   block_k=cfg.flash_block_k,
-                                   window=cfg.sliding_window).astype(dt)
+            alibi_arg = None
+            if cfg.alibi:
+                # ALiBi is computed in-kernel from the slopes (slope_h *
+                # k_pos, softmax-shift equivalent to the relative form);
+                # alibi_inv_norm reproduces HF falcon's pre-scaled
+                # bf16-quantized variant (see _alibi_bias)
+                from ..ops.pallas.paged_attention import alibi_slopes
+                alibi_arg = alibi_slopes(H)
+            attn = flash_attention(
+                q, kk, v, causal=True,
+                block_q=cfg.flash_block_q,
+                block_k=cfg.flash_block_k,
+                window=cfg.sliding_window,
+                alibi=alibi_arg,
+                alibi_scale=(1.0 / math.sqrt(hd)
+                             if cfg.alibi_inv_norm else 1.0),
+                alibi_bf16=cfg.alibi_inv_norm).astype(dt)
             attn = attn.reshape(B, T, H * hd)
         else:
             scores = jnp.einsum("bthd,bshd->bhts", q, kk,
@@ -720,7 +742,10 @@ class Llama:
             attn = paged_decode_attention(
                 q[:, 0], kc, vc, block_tables, lengths,
                 window=cfg.sliding_window,
-                alibi_slopes=(alibi_slopes(H) if cfg.alibi else None))
+                alibi_slopes=(alibi_slopes(H) if cfg.alibi else None),
+                alibi_scale=(1.0 / math.sqrt(hd)
+                             if cfg.alibi_inv_norm else 1.0),
+                alibi_bf16=cfg.alibi_inv_norm)
             attn_out = self._wo(attn.reshape(B, 1, H * hd), layer)
             if cfg.parallel_block:
                 x = x + attn_out + self._mlp(x, layer)

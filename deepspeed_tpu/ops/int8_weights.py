@@ -62,30 +62,65 @@ def quantize_leaf(w):
     return Int8Weight(q, scale)
 
 
-def quantize_tree(params, min_size=1 << 16, consume=False):
+def quantize_tree(params, min_size=1 << 16, consume=False,
+                  exclude_keys=("moe_gate",)):
     """Quantize the ``blocks`` sub-tree's float weights with >= 2 dims
     and >= min_size elements (embeddings / norms / biases / the head
     stay in the model dtype — matching the reference's linear-layer-only
-    weight quantization). ``consume=True`` pops dict entries from the
-    SOURCE tree as they are quantized, so the fp32 originals free
-    leaf-by-leaf — peak host memory stays ~the input tree + one leaf
-    rather than input + full quantized copy (the big-model use case)."""
+    weight quantization). MoE router weights (``exclude_keys``) are
+    never quantized: routing is precision-sensitive — int8 router
+    logits can flip top-k expert selection (the HF loaders keep
+    ``moe_gate`` fp32 for the same reason). ``consume=True`` pops dict
+    entries from the SOURCE tree as they are quantized, so the fp32
+    originals free leaf-by-leaf — peak host memory stays ~the input
+    tree + one leaf rather than input + full quantized copy (the
+    big-model use case)."""
+    import jax.numpy as jnp
+
     def walk(tree, in_blocks):
         if isinstance(tree, dict):
             out = {}
             for k in list(tree):
-                out[k] = walk(tree[k], in_blocks or k == "blocks")
+                if k in exclude_keys and not isinstance(tree[k], dict):
+                    out[k] = np.asarray(tree[k]) if consume else tree[k]
+                else:
+                    out[k] = walk(tree[k], in_blocks or k == "blocks")
                 if consume:
                     del tree[k]
             return out
         if isinstance(tree, (list, tuple)):
             return type(tree)(walk(v, in_blocks) for v in tree)
         arr = np.asarray(tree)
+        # jnp.issubdtype: host bf16 (ml_dtypes) is floating too
         if (in_blocks and arr.ndim >= 2 and arr.size >= min_size
-                and np.issubdtype(arr.dtype, np.floating)):
+                and jnp.issubdtype(arr.dtype, jnp.floating)):
             return quantize_leaf(arr)
         return arr if consume else tree
     return walk(params, False)
+
+
+def cast_unquantized(tree, dtype, exclude_keys=("moe_gate",)):
+    """Cast a quantized tree's remaining float leaves (embeds / norms /
+    biases) to the serving dtype, leaving Int8Weight nodes AND the
+    ``exclude_keys`` leaves untouched — router weights keep full
+    precision end to end (quantize_tree excludes them from int8 for the
+    same reason; casting them to bf16 afterwards would undo that)."""
+    import jax.numpy as jnp
+    dt = np.dtype(jnp.dtype(dtype))
+
+    def walk(tree):
+        if isinstance(tree, dict):
+            return {k: (tree[k] if k in exclude_keys
+                        and not isinstance(tree[k], dict)
+                        else walk(tree[k])) for k in tree}
+        if isinstance(tree, (list, tuple)):
+            return type(tree)(walk(v) for v in tree)
+        if isinstance(tree, Int8Weight):
+            return tree
+        a = np.asarray(tree)
+        return a.astype(dt) if jnp.issubdtype(a.dtype, jnp.floating) \
+            else a
+    return walk(tree)
 
 
 def dequant_tree(tree, dtype):

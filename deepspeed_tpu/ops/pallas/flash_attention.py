@@ -28,6 +28,7 @@ import math
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.experimental import pallas as pl
 
 from ._common import interpret_default as _interpret_default
@@ -72,6 +73,150 @@ _DN_DK_T = (((2,), (1,)), ((0,), (0,)))  # (G,d,bq) x (G,bq,bk) -> (G,d,bk)
 _DN_DQ_T = (((2,), (2,)), ((0,), (0,)))  # (G,d,bk) x (G,bq,bk) -> (G,d,bq)
 
 
+# ----------------------------------------------------------------- biases
+# Additive score biases (ALiBi, padding masks, evoformer pair bias) ride
+# as extra kernel operands shaped (rows, Tq|1, Tk) — never expanded to
+# the (B*H, T, T) score shape. Which bias row(s) a grid group g needs is
+# an affine map in block units:
+#     f(g) = (g*bh // P) * Q + ((g*bh) % R) // bh
+# parametrized per bias (a group of ``bh`` (b, h) instances shares one
+# row, spans ``bh`` rows, or cycles rows with a period — all folds used
+# by the models reduce to this form; see _bias_cfg). A cfg is the static
+# tuple (per_rows, P, Q, R, tq_full, grad):
+#   per_rows: rows the block carries (1 = whole group shares a row,
+#             bh = one row per instance)
+#   tq_full:  bias varies along the query dim (pair bias) vs broadcast
+#             (key masks, ALiBi)
+#   grad:     backward emits an accumulated d_bias output (evoformer
+#             pair-bias training); requires a monotone f over the grid
+_B_PER, _B_P, _B_Q, _B_R, _B_TQ, _B_GRAD = range(6)
+
+
+def _bias_row(cfg, bh, g):
+    """Block-row index of bias ``cfg`` for group ``g`` (traced or int)."""
+    return (g * bh // cfg[_B_P]) * cfg[_B_Q] \
+        + ((g * bh) % cfg[_B_R]) // bh
+
+
+def _bias_cfg(Bb, Hb, B, H, bh, tq_full, grad, h_outer):
+    """Cfg tuple for a (Bb, Hb, Tq, Tk) bias under the (b, h) fold
+    (``h_outer``: the qkv_t kernels fold (H, B); others fold (B, H)).
+    Bb in {1, B}; Hb in {1, H}. A size-1 model dim takes the broadcast
+    branch (full and broadcast coincide there, but the full-branch row
+    maps would index past the 1-row folded array)."""
+    full_b, full_h = Bb == B > 1, Hb == H > 1
+    if full_b and full_h:
+        cfg = (bh, bh, 1, bh)
+    elif h_outer:
+        if full_b:                       # per-batch, group spans b
+            cfg = (bh, 1, 0, B)
+        elif full_h:                     # per-head, fixed within a group
+            cfg = (1, B, 1, bh)
+        else:
+            cfg = (1, 1, 0, bh)
+    else:
+        if full_b:                       # per-batch, fixed within a group
+            cfg = (1, H, 1, bh)
+        elif full_h:                     # per-head, group spans h
+            cfg = (bh, 1, 0, H)
+        else:
+            cfg = (1, 1, 0, bh)
+    return cfg + (bool(tq_full), bool(grad))
+
+
+def _bias_constraint(Bb, Hb, B, H, h_outer):
+    """The number ``bh`` must DIVIDE so one bias block covers a group (a
+    group must not straddle two rows of a shared dim), or None when the
+    bias imposes no constraint. Note a divisor of 1 is a real
+    constraint (bh = 1): e.g. a per-batch bias on an H == 1 model —
+    groups span batch items there, so each instance needs its own
+    row."""
+    full_b = Bb == B and Bb > 1
+    full_h = Hb == H and Hb > 1
+    if (full_b and full_h) or (Bb == 1 and Hb == 1):
+        return None
+    if Bb > 1 and Hb == 1:          # per-batch bias
+        return B if h_outer else H
+    if Hb > 1 and Bb == 1:          # per-head bias
+        return B if h_outer else H
+    return None
+
+
+def _fwd_bias_specs(cfgs, biases, bq, T_pad, bh):
+    """Forward operand BlockSpecs: (per_rows, bq, T_pad); the kernel
+    walks the key dim itself (k/v are full-T blocks too).
+
+    Biases always carry a FULL query dim: a size-1 sublane dim
+    broadcast inside the online-softmax carry loop crashes Mosaic's
+    layout inference (verified on v5e), so the wrapper expands
+    query-broadcast biases (key masks, ALiBi) to (rows, T, T) up
+    front."""
+    return [pl.BlockSpec(
+        (cfg[_B_PER], bq, T_pad),
+        lambda g, i, c=cfg: (_bias_row(c, bh, g), i, 0))
+        for cfg, b in zip(cfgs, biases)]
+
+
+def _bwd_bias_specs(cfgs, biases, bk, T_pad, bh):
+    """Backward operand BlockSpecs: (per_rows, T_pad, bk); the kernel
+    walks the query dim itself."""
+    return [pl.BlockSpec(
+        (cfg[_B_PER], T_pad, bk),
+        lambda g, j, c=cfg: (_bias_row(c, bh, g), 0, j))
+        for cfg, b in zip(cfgs, biases)]
+
+
+def _fwd_bias_add(s, bias_refs, cfgs, j, bk):
+    """s (G, bq, bk) += each bias's (rows, bq, bk) block, f32.
+
+    The key dim is the LANE dim of the bias block: Mosaic needs dynamic
+    lane offsets in 128 units, so the wrapper forces bk to a multiple of
+    128 whenever biases are present (single-block refs load the static
+    full block)."""
+    for ref, cfg in zip(bias_refs, cfgs):
+        blk = ref[...] if ref.shape[2] == bk \
+            else ref[:, :, pl.ds(j * bk, bk)]
+        s = s + blk.astype(jnp.float32)
+    return s
+
+
+def _bwd_bias_add(s, bias_refs, cfgs, i, bq):
+    for ref, cfg in zip(bias_refs, cfgs):
+        s = s + ref[:, pl.ds(i * bq, bq), :].astype(jnp.float32)
+    return s
+
+
+def _alibi_add(s, alibi_cfg, apos_blk, g, bh):
+    """s (1, bq, bk) += slope_h * k_pos.
+
+    The slope is evaluated in-kernel with the bloom formula from the
+    instance's head index — a per-grid-step SCALAR (the wrapper forces
+    block_h=1 under ALiBi). k_pos arrives as a tiny shared
+    (1, T_pad, T_pad) f32 operand (``apos_blk`` is its (1, bq, bk)
+    tile): Mosaic constant-folds iota->float chains into an f32
+    ``tpu.iota`` that fails verification (and, unverified, crashes its
+    layout pass) inside the softmax carry loop, so positions must come
+    from a ref, exactly like the bias operands that compile fine. Net
+    HBM cost is one O(T^2) array shared by every (batch, head) — not
+    the (H, T, T) or (B, H, T, T) a materialized bias would need.
+    alibi_cfg = (h_outer, H, B, scale, bf16) — see the wrapper."""
+    h_outer, H, B, a_scale, a_bf16 = alibi_cfg
+    idx = g * bh                              # bh == 1: instance index
+    h = (idx // B if h_outer else idx % H).astype(jnp.float32)
+    cp = float(2 ** math.floor(math.log2(H)))
+    expo = jnp.where(h < cp, -(h + 1.0) * (8.0 / cp),
+                     -(2.0 * (h - cp) + 1.0) * (4.0 / cp))
+    slope = jnp.exp2(expo)                    # scalar
+    ab = slope * apos_blk
+    if a_bf16:
+        # HF falcon quantizes the alibi tensor through bf16 and adds it
+        # pre-scaling (models/llama.py _alibi_bias)
+        ab = ab.astype(jnp.bfloat16).astype(jnp.float32)
+    if a_scale != 1.0:
+        ab = ab * a_scale
+    return s + ab
+
+
 def _mask_block(qi_start, kj_start, bq, bk, causal, t_real, T,
                 window=0):
     """(bq, bk) boolean mask for causal / padded-key / sliding-window
@@ -100,9 +245,15 @@ def _apply_mask(s, ok):
 
 
 # ------------------------------------------------------------------ forward
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
-                causal, t_real, window=0):
+def _fwd_kernel(q_ref, k_ref, v_ref, *rest, bq, bk, scale,
+                causal, t_real, window=0, bias_cfgs=(),
+                alibi_cfg=None):
+    n_in = len(bias_cfgs) + (1 if alibi_cfg else 0)
+    bias_refs = rest[:len(bias_cfgs)]
+    apos_ref = rest[len(bias_cfgs)] if alibi_cfg else None
+    o_ref, lse_ref = rest[n_in:]
     qi = pl.program_id(1)
+    gi = pl.program_id(0)
     q = q_ref[...]                                        # (G, bq, d) bf16
     G = q.shape[0]
     T = k_ref.shape[1]
@@ -132,6 +283,12 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
                                     preferred_element_type=jnp.float32)
             if scale != 1.0:
                 s = s * scale
+            if bias_cfgs:
+                s = _fwd_bias_add(s, bias_refs, bias_cfgs, j, bk)
+            if alibi_cfg:
+                apb = apos_ref[...] if apos_ref.shape[2] == bk \
+                    else apos_ref[:, :, pl.ds(j * bk, bk)]
+                s = _alibi_add(s, alibi_cfg, apb, gi, G)
             if masked:
                 s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
                                                causal, t_real, T,
@@ -159,18 +316,22 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
                                     (G, bq, lse_ref.shape[-1]))
 
 
-def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret, window=0):
+def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret, window=0,
+         biases=(), bias_cfgs=(), alibi_cfg=None):
     BH, T, d = q.shape
     grid = (BH // bh, T // bq)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel, bq=bq, bk=bk, scale=scale,
-                          causal=causal, t_real=t_real, window=window),
+                          causal=causal, t_real=t_real, window=window,
+                          bias_cfgs=bias_cfgs, alibi_cfg=alibi_cfg),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((bh, T, d), lambda b, i: (b, 0, 0)),
-        ],
+        ] + _fwd_bias_specs(bias_cfgs, biases, bq, T, bh)
+          + ([pl.BlockSpec((1, bq, T), lambda b, i: (0, i, 0))]
+             if alibi_cfg else []),
         out_specs=[
             pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((bh, bq, LSE_LANES), lambda b, i: (b, i, 0)),
@@ -180,13 +341,14 @@ def _fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret, window=0):
             _sds((BH, T, LSE_LANES), jnp.float32, q),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, *biases)
     return o, lse
 
 
 # ------------------------------------------------- forward, transposed q/k/v
-def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
-                  causal, t_real, window=0):
+def _fwd_kernel_t(q_ref, k_ref, v_ref, *rest, bq, bk, scale,
+                  causal, t_real, window=0, bias_cfgs=(),
+                  alibi_cfg=None):
     """Forward with q/k/v blocked (G, d, T) — T in lanes.
 
     The surrounding qkv projection einsums emit T-minor layouts (hd=64
@@ -198,8 +360,16 @@ def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
     softmax stats stay (G, bq) sublane vectors — only the q/k dots
     contract the sublane dim (MXU-native transposed matmul) and the pv
     dot contracts lanes x lanes. Output o stays (G, bq, d): its consumer
-    (the wo projection) takes it without a copy either way."""
+    (the wo projection) takes it without a copy either way.
+
+    Biases are NOT transposed: score space is (bq, bk) in both layouts,
+    so bias blocks are consumed in the standard orientation."""
+    n_in = len(bias_cfgs) + (1 if alibi_cfg else 0)
+    bias_refs = rest[:len(bias_cfgs)]
+    apos_ref = rest[len(bias_cfgs)] if alibi_cfg else None
+    o_ref, lse_ref = rest[n_in:]
     qi = pl.program_id(1)
+    gi = pl.program_id(0)
     q = q_ref[...]                                        # (G, d, bq) bf16
     G = q.shape[0]
     T = k_ref.shape[2]
@@ -221,6 +391,12 @@ def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
                                     preferred_element_type=jnp.float32)
             if scale != 1.0:
                 s = s * scale
+            if bias_cfgs:
+                s = _fwd_bias_add(s, bias_refs, bias_cfgs, j, bk)
+            if alibi_cfg:
+                apb = apos_ref[...] if apos_ref.shape[2] == bk \
+                    else apos_ref[:, :, pl.ds(j * bk, bk)]
+                s = _alibi_add(s, alibi_cfg, apb, gi, G)
             if masked:
                 s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
                                                causal, t_real, T,
@@ -247,18 +423,21 @@ def _fwd_kernel_t(q_ref, k_ref, v_ref, o_ref, lse_ref, *, bq, bk, scale,
 
 
 def _fwd_t(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-           window=0):
+           window=0, biases=(), bias_cfgs=(), alibi_cfg=None):
     BH, d, T = q.shape
     grid = (BH // bh, T // bq)
     o, lse = pl.pallas_call(
         functools.partial(_fwd_kernel_t, bq=bq, bk=bk, scale=scale,
-                          causal=causal, t_real=t_real, window=window),
+                          causal=causal, t_real=t_real, window=window,
+                          bias_cfgs=bias_cfgs, alibi_cfg=alibi_cfg),
         grid=grid,
         in_specs=[
             pl.BlockSpec((bh, d, bq), lambda b, i: (b, 0, i)),
             pl.BlockSpec((bh, d, T), lambda b, i: (b, 0, 0)),
             pl.BlockSpec((bh, d, T), lambda b, i: (b, 0, 0)),
-        ],
+        ] + _fwd_bias_specs(bias_cfgs, biases, bq, T, bh)
+          + ([pl.BlockSpec((1, bq, T), lambda b, i: (0, i, 0))]
+             if alibi_cfg else []),
         out_specs=[
             pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
             pl.BlockSpec((bh, bq, LSE_LANES), lambda b, i: (b, i, 0)),
@@ -268,14 +447,52 @@ def _fwd_t(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
             _sds((BH, T, LSE_LANES), jnp.float32, q),
         ],
         interpret=interpret,
-    )(q, k, v)
+    )(q, k, v, *biases)
     return o, lse
 
 
 # ----------------------------------------------------------------- backward
+def _dbias_init(dbias_refs, grad_cfgs, bh, ki):
+    """Zero dbias accumulator blocks at the right step. per_rows==bh
+    blocks are fresh every grid step (injective index map); per_rows==1
+    blocks persist across the run of grid steps sharing a bias row —
+    zero at the run's first step (monotone maps only, enforced in the
+    wrapper)."""
+    g = pl.program_id(0)
+    for ref, cfg in zip(dbias_refs, grad_cfgs):
+        if cfg[_B_PER] == 1:
+            gp = jnp.maximum(g - 1, 0)
+            start = jnp.logical_or(
+                g == 0, _bias_row(cfg, bh, g) != _bias_row(cfg, bh, gp))
+
+            @pl.when(jnp.logical_and(ki == 0, start))
+            def _init(ref=ref):
+                ref[...] = jnp.zeros_like(ref)
+        else:
+            ref[...] = jnp.zeros_like(ref)
+
+
+def _dbias_update(dbias_refs, grad_cfgs, ds_f, i, ki, bq, bk):
+    """Accumulate ds (f32, pre-cast) into each grad bias's block,
+    summing over whichever score dims the bias broadcasts (query-
+    broadcast biases use 2D (rows, Tk) accumulators)."""
+    for ref, cfg in zip(dbias_refs, grad_cfgs):
+        contrib = ds_f
+        if cfg[_B_PER] == 1:
+            contrib = jnp.sum(contrib, axis=0, keepdims=True)
+        if cfg[_B_PER] == 1:                  # full-k persistent block
+            if ref.shape[2] == bk:            # single k block: static
+                ref[:, pl.ds(i * bq, bq), :] += contrib
+            else:
+                ref[:, pl.ds(i * bq, bq), pl.ds(ki * bk, bk)] += contrib
+        else:                                 # per-step (rows, T_pad, bk)
+            ref[:, pl.ds(i * bq, bq), :] += contrib
+
+
 def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
-                dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real,
-                ext_delta, single_k, window=0):
+                *rest, bq, bk, scale, causal, t_real,
+                ext_delta, single_k, window=0, bias_cfgs=(),
+                alibi_cfg=None):
     """Fused flash backward: dq, dk, dv from ONE s/p computation.
 
     Grid is (BH/bh, T/bk) over key blocks; an inner loop walks the query
@@ -289,12 +506,22 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     initialized at the first key block. dk/dv accumulate in registers
     over the inner loop.
     """
+    n_bias = len(bias_cfgs)
+    n_in = n_bias + (1 if alibi_cfg else 0)
+    bias_refs = rest[:n_bias]
+    apos_ref = rest[n_bias] if alibi_cfg else None
+    dq_ref, dk_ref, dv_ref = rest[n_in:n_in + 3]
+    dbias_refs = rest[n_in + 3:]
+    grad_cfgs = tuple(c for c in bias_cfgs if c[_B_GRAD])
     ki = pl.program_id(1)
+    gi = pl.program_id(0)
     kb = k_ref[...]                                         # (G, bk, d) bf16
     G = kb.shape[0]
     vb = v_ref[...]
     T = q_ref.shape[1]
     nq = T // bq
+    if dbias_refs:
+        _dbias_init(dbias_refs, grad_cfgs, G, ki)
     qmin = (ki * bk) // bq if causal else 0
     # q blocks straddling the diagonal need the causal mask; blocks fully
     # below it don't. With padded keys every block masks.
@@ -333,6 +560,11 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                                     preferred_element_type=jnp.float32)
             if scale != 1.0:
                 s = s * scale
+            if bias_cfgs:
+                s = _bwd_bias_add(s, bias_refs, bias_cfgs, i, bq)
+            if alibi_cfg:
+                apb = apos_ref[:, pl.ds(i * bq, bq), :]
+                s = _alibi_add(s, alibi_cfg, apb, gi, G)
             if masked:
                 s = _apply_mask(s, _mask_block(i * bq, ki * bk, bq, bk,
                                                causal, t_real, T,
@@ -343,7 +575,11 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                                           preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(do, vb, _DN_QK,
                                      preferred_element_type=jnp.float32)
-            ds = (p * (dp - delta[..., None])).astype(q.dtype)
+            ds_f = p * (dp - delta[..., None])
+            ds = ds_f.astype(q.dtype)
+            if dbias_refs:
+                # d(bias) = ds (bias enters s additively, post-scale)
+                _dbias_update(dbias_refs, grad_cfgs, ds_f, i, ki, bq, bk)
             dk = dk + jax.lax.dot_general(ds, q, _DN_T,
                                           preferred_element_type=jnp.float32)
             dq_val = jax.lax.dot_general(ds, kb, _DN_PV,
@@ -371,8 +607,40 @@ def _bwd_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
+def _dbias_out(biases, bias_cfgs, bk, T_pad, bh, like):
+    """(out_specs, out_shapes) for the grad biases' accumulators, and
+    the post-call distributor mapping kernel outputs back to a
+    per-bias cotangent list (zeros for non-grad biases)."""
+    specs, shapes = [], []
+    for b, cfg in zip(biases, bias_cfgs):
+        if not cfg[_B_GRAD]:
+            continue
+        if cfg[_B_PER] == 1:
+            # persistent accumulator: full (Tq, Tk) block per bias row
+            specs.append(pl.BlockSpec(
+                (1, b.shape[1], T_pad),
+                lambda g, j, c=cfg: (_bias_row(c, bh, g), 0, 0)))
+        else:
+            specs.append(pl.BlockSpec(
+                (cfg[_B_PER], b.shape[1], bk),
+                lambda g, j, c=cfg: (_bias_row(c, bh, g), 0, j)))
+        shapes.append(_sds(b.shape, jnp.float32, like))
+    return specs, shapes
+
+
+def _scatter_dbias(biases, bias_cfgs, grads):
+    """Align kernel dbias outputs with the biases tuple (zeros for
+    non-differentiable biases), cast to each bias's dtype."""
+    out, it = [], iter(grads)
+    for b, cfg in zip(biases, bias_cfgs):
+        out.append(next(it).astype(b.dtype) if cfg[_B_GRAD]
+                   else jnp.zeros(b.shape, b.dtype))
+    return tuple(out)
+
+
 def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
-         interpret, dlse=None, window=0):
+         interpret, dlse=None, window=0, biases=(), bias_cfgs=(),
+         alibi_cfg=None):
     BH, T, d = q.shape
     # (BH, T, 1) -> LSE_LANES lanes for the operand block; XLA lowers
     # this to one small relayout/broadcast per layer (~8 ms/step total)
@@ -388,11 +656,13 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
         # from o/do blocks in VMEM — no broadcast materialization
         od = o
     single_k = (T // bk) == 1
-    dq, dk, dv = pl.pallas_call(
+    db_specs, db_shapes = _dbias_out(biases, bias_cfgs, bk, T, bh, q)
+    outs = pl.pallas_call(
         functools.partial(_bwd_kernel, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real,
                           ext_delta=dlse is not None, single_k=single_k,
-                          window=window),
+                          window=window, bias_cfgs=bias_cfgs,
+                          alibi_cfg=alibi_cfg),
         grid=(BH // bh, T // bk),
         in_specs=[
             pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
@@ -402,12 +672,14 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
             pl.BlockSpec((bh, T, LSE_LANES), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((bh, T, LSE_LANES if dlse is not None else d),
                          lambda b, j: (b, 0, 0)),
-        ],
+        ] + _bwd_bias_specs(bias_cfgs, biases, bk, T, bh)
+          + ([pl.BlockSpec((1, T, bk), lambda b, j: (0, 0, j))]
+             if alibi_cfg else []),
         out_specs=[
             pl.BlockSpec((bh, T, d), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
             pl.BlockSpec((bh, bk, d), lambda b, j: (b, j, 0)),
-        ],
+        ] + db_specs,
         out_shape=[
             # dq accumulates fp32 across key-block grid steps; with a
             # single key block each slice is written once, so it is
@@ -415,18 +687,26 @@ def _bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
             _sds((BH, T, d), q.dtype if single_k else jnp.float32, q),
             _sds((BH, T, d), q.dtype, q),
             _sds((BH, T, d), q.dtype, q),
-        ],
+        ] + db_shapes,
         interpret=interpret,
-    )(q, k, v, do, lse, od)
+    )(q, k, v, do, lse, od, *biases)
+    dq, dk, dv = outs[:3]
+    dbiases = _scatter_dbias(biases, bias_cfgs, outs[3:])
+    if alibi_cfg:
+        # the trailing operand is the shared ALiBi position grid — a
+        # constant with no gradient
+        dbiases = dbiases + (jnp.zeros(biases[-1].shape,
+                                       biases[-1].dtype),)
     if scale != 1.0:
         dq = dq * scale
-    return dq.astype(q.dtype), dk, dv
+    return dq.astype(q.dtype), dk, dv, dbiases
 
 
 # ------------------------------------------------ backward, transposed q/k/v
 def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
-                  dq_ref, dk_ref, dv_ref, *, bq, bk, scale, causal, t_real,
-                  ext_delta, single_k, window=0):
+                  *rest, bq, bk, scale, causal, t_real,
+                  ext_delta, single_k, window=0, bias_cfgs=(),
+                  alibi_cfg=None):
     """Fused backward with q/k/v, do AND dq/dk/dv blocked (G, d, T).
 
     Same structure as _bwd_kernel (key-block grid, inner loop over query
@@ -444,13 +724,26 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     an already-VPU-bound kernel). ext_delta (as in _bwd_kernel): False = in-kernel
     rowsum(do * o) with od_ref carrying o; True = precomputed delta via
     od_ref (the lse-cotangent path folds -dlse in outside).
+
+    Biases and dbias accumulators stay in score-space orientation
+    (rows, Tq|1, Tk) — identical to _bwd_kernel.
     """
+    n_bias = len(bias_cfgs)
+    n_in = n_bias + (1 if alibi_cfg else 0)
+    bias_refs = rest[:n_bias]
+    apos_ref = rest[n_bias] if alibi_cfg else None
+    dq_ref, dk_ref, dv_ref = rest[n_in:n_in + 3]
+    dbias_refs = rest[n_in + 3:]
+    grad_cfgs = tuple(c for c in bias_cfgs if c[_B_GRAD])
     ki = pl.program_id(1)
+    gi = pl.program_id(0)
     kb = k_ref[...]                                         # (G, d, bk)
     G = kb.shape[0]
     vb = v_ref[...]
     T = q_ref.shape[2]
     nq = T // bq
+    if dbias_refs:
+        _dbias_init(dbias_refs, grad_cfgs, G, ki)
     qmin = (ki * bk) // bq if causal else 0
     qfull = pl.cdiv((ki + 1) * bk, bq) if (causal and t_real >= T) else (
         qmin if t_real >= T else nq)
@@ -480,6 +773,11 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                                     preferred_element_type=jnp.float32)
             if scale != 1.0:
                 s = s * scale
+            if bias_cfgs:
+                s = _bwd_bias_add(s, bias_refs, bias_cfgs, i, bq)
+            if alibi_cfg:
+                apb = apos_ref[:, pl.ds(i * bq, bq), :]
+                s = _alibi_add(s, alibi_cfg, apb, gi, G)
             if masked:
                 s = _apply_mask(s, _mask_block(i * bq, ki * bk, bq, bk,
                                                causal, t_real, T,
@@ -490,7 +788,10 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
                                           preferred_element_type=jnp.float32)
             dp = jax.lax.dot_general(do, vb, _DN_DO_V,
                                      preferred_element_type=jnp.float32)
-            ds = (p * (dp - delta[..., None])).astype(q.dtype)
+            ds_f = p * (dp - delta[..., None])
+            ds = ds_f.astype(q.dtype)
+            if dbias_refs:
+                _dbias_update(dbias_refs, grad_cfgs, ds_f, i, ki, bq, bk)
             dk = dk + jax.lax.dot_general(q, ds, _DN_DK_T,
                                           preferred_element_type=jnp.float32)
             dq_val = jax.lax.dot_general(kb, ds, _DN_DQ_T,
@@ -514,7 +815,8 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
 
 
 def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
-           interpret, dlse=None, window=0):
+           interpret, dlse=None, window=0, biases=(), bias_cfgs=(),
+           alibi_cfg=None):
     BH, d, T = q.shape
     lse = jnp.broadcast_to(lse_t, (BH, T, LSE_LANES))
     single_k = (T // bk) == 1
@@ -524,11 +826,13 @@ def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
         od = jnp.broadcast_to(delta[..., None], (BH, T, LSE_LANES))
     else:
         od = o
-    dq, dk, dv = pl.pallas_call(
+    db_specs, db_shapes = _dbias_out(biases, bias_cfgs, bk, T, bh, q)
+    outs = pl.pallas_call(
         functools.partial(_bwd_kernel_t, bq=bq, bk=bk, scale=scale,
                           causal=causal, t_real=t_real,
                           ext_delta=dlse is not None, single_k=single_k,
-                          window=window),
+                          window=window, bias_cfgs=bias_cfgs,
+                          alibi_cfg=alibi_cfg),
         grid=(BH // bh, T // bk),
         in_specs=[
             pl.BlockSpec((bh, d, T), lambda b, j: (b, 0, 0)),
@@ -538,43 +842,56 @@ def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
             pl.BlockSpec((bh, T, LSE_LANES), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((bh, T, LSE_LANES if dlse is not None else d),
                          lambda b, j: (b, 0, 0)),
-        ],
+        ] + _bwd_bias_specs(bias_cfgs, biases, bk, T, bh)
+          + ([pl.BlockSpec((1, T, bk), lambda b, j: (0, 0, j))]
+             if alibi_cfg else []),
         out_specs=[
             pl.BlockSpec((bh, d, T), lambda b, j: (b, 0, 0)),
             pl.BlockSpec((bh, d, bk), lambda b, j: (b, 0, j)),
             pl.BlockSpec((bh, d, bk), lambda b, j: (b, 0, j)),
-        ],
+        ] + db_specs,
         out_shape=[
             _sds((BH, d, T), q.dtype if single_k else jnp.float32, q),
             _sds((BH, d, T), q.dtype, q),
             _sds((BH, d, T), q.dtype, q),
-        ],
+        ] + db_shapes,
         interpret=interpret,
-    )(q, k, v, do, lse, od)
+    )(q, k, v, do, lse, od, *biases)
+    dq, dk, dv = outs[:3]
+    dbiases = _scatter_dbias(biases, bias_cfgs, outs[3:])
+    if alibi_cfg:
+        # the trailing operand is the shared ALiBi position grid — a
+        # constant with no gradient
+        dbiases = dbiases + (jnp.zeros(biases[-1].shape,
+                                       biases[-1].dtype),)
     if scale != 1.0:
         dq = dq * scale
-    return dq.astype(q.dtype), dk, dv
+    return dq.astype(q.dtype), dk, dv, dbiases
 
 
 # --------------------------------------------------------------- public API
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13))
-def _flash(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-           bwd_bq, bwd_bk, qkv_t=False, window=0):
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14,
+                                    15, 16))
+def _flash(q, k, v, biases, scale, causal, bq, bk, bh, t_real, interpret,
+           bwd_bq, bwd_bk, qkv_t=False, window=0, bias_cfgs=(),
+           alibi_cfg=None):
     fwd = _fwd_t if qkv_t else _fwd
     o, lse = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-                 window)
+                 window, biases, bias_cfgs, alibi_cfg)
     return o, lse[..., 0]
 
 
-def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-               bwd_bq, bwd_bk, qkv_t=False, window=0):
+def _flash_fwd(q, k, v, biases, scale, causal, bq, bk, bh, t_real,
+               interpret, bwd_bq, bwd_bk, qkv_t=False, window=0,
+               bias_cfgs=(), alibi_cfg=None):
     from jax.ad_checkpoint import checkpoint_name
     # symbolic_zeros=True wraps primal args in CustomVJPPrimal
     q, k, v = q.value, k.value, v.value
+    biases = tuple(b.value for b in biases)
     fwd = _fwd_t if qkv_t else _fwd
     o, lse = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-                 window)
+                 window, biases, bias_cfgs, alibi_cfg)
     # Name o/lse HERE, inside the fwd rule, so the named vars are both
     # the primal outputs and the vjp residuals: under jax.checkpoint a
     # save-policy keeping 'flash_o'/'flash_lse' then satisfies the
@@ -588,11 +905,11 @@ def _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
     lse_t = lse[..., :1]
     o = checkpoint_name(o, "flash_o")
     lse_t = checkpoint_name(lse_t, "flash_lse")
-    return (o, lse_t[..., 0]), (q, k, v, o, lse_t)
+    return (o, lse_t[..., 0]), (q, k, v, o, lse_t, biases)
 
 
 def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
-               bwd_bk, qkv_t, window, res, cts):
+               bwd_bk, qkv_t, window, bias_cfgs, alibi_cfg, res, cts):
     # backward may run its own (smaller) blocks: the fused dq/dk/dv pass
     # is ~2x the forward's work, so causal above-diagonal skipping wins
     # more there than grid-step overhead costs
@@ -605,14 +922,17 @@ def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
         dlse = None
     if isinstance(do, SymbolicZero):
         do = jnp.zeros(do.shape, do.dtype)
-    q, k, v, o, lse_t = res
+    q, k, v, o, lse_t, biases = res
     # lse is a real (differentiable) output: d lse_i / d s_ij = p_ij, so a
     # cotangent on lse enters the shared ds = p * (dp - delta) term as
     # ds += p * dlse — i.e. exactly a shift of delta by -dlse. Folding it
     # there costs zero extra kernel work.
     bwd = _bwd_t if qkv_t else _bwd
-    return bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
-               interpret, dlse=dlse, window=window)
+    dq, dk, dv, dbiases = bwd(
+        q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
+        interpret, dlse=dlse, window=window, biases=biases,
+        bias_cfgs=bias_cfgs, alibi_cfg=alibi_cfg)
+    return dq, dk, dv, dbiases
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
@@ -623,32 +943,39 @@ _flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
 # ~6 ms/step at 350M bs=24. This twin never emits the lse output (the
 # residual still saves it for the backward).
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12, 13))
-def _flash_o(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-             bwd_bq, bwd_bk, qkv_t=False, window=0):
+                   nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14,
+                                    15, 16))
+def _flash_o(q, k, v, biases, scale, causal, bq, bk, bh, t_real,
+             interpret, bwd_bq, bwd_bk, qkv_t=False, window=0,
+             bias_cfgs=(), alibi_cfg=None):
     fwd = _fwd_t if qkv_t else _fwd
     o, _ = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-               window)
+               window, biases, bias_cfgs, alibi_cfg)
     return o
 
 
-def _flash_o_fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
-                 bwd_bq, bwd_bk, qkv_t=False, window=0):
-    (o, _), res = _flash_fwd(q, k, v, scale, causal, bq, bk, bh, t_real,
-                             interpret, bwd_bq, bwd_bk, qkv_t, window)
+def _flash_o_fwd(q, k, v, biases, scale, causal, bq, bk, bh, t_real,
+                 interpret, bwd_bq, bwd_bk, qkv_t=False, window=0,
+                 bias_cfgs=(), alibi_cfg=None):
+    (o, _), res = _flash_fwd(q, k, v, biases, scale, causal, bq, bk, bh,
+                             t_real, interpret, bwd_bq, bwd_bk, qkv_t,
+                             window, bias_cfgs, alibi_cfg)
     return o, res
 
 
 def _flash_o_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
-                 bwd_bk, qkv_t, window, res, do):
+                 bwd_bk, qkv_t, window, bias_cfgs, alibi_cfg, res, do):
     from jax.custom_derivatives import SymbolicZero
     bq, bk = bwd_bq or bq, bwd_bk or bk
     if isinstance(do, SymbolicZero):
         do = jnp.zeros(do.shape, do.dtype)
-    q, k, v, o, lse_t = res
+    q, k, v, o, lse_t, biases = res
     bwd = _bwd_t if qkv_t else _bwd
-    return bwd(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
-               interpret, dlse=None, window=window)
+    dq, dk, dv, dbiases = bwd(
+        q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
+        interpret, dlse=None, window=window, biases=biases,
+        bias_cfgs=bias_cfgs, alibi_cfg=alibi_cfg)
+    return dq, dk, dv, dbiases
 
 
 _flash_o.defvjp(_flash_o_fwd, _flash_o_bwd, symbolic_zeros=True)
@@ -658,7 +985,10 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
                              block_q=128, block_k=128, block_h=2,
                              interpret=None, heads_major=False,
                              block_q_bwd=None, block_k_bwd=None,
-                             qkv_t=False, window=0, _with_lse=True):
+                             qkv_t=False, window=0, bias=None,
+                             bias_grad=False, alibi=None,
+                             alibi_scale=1.0, alibi_bf16=False,
+                             _folded_biases=None, _with_lse=True):
     """Fused attention over (batch, seq, heads, head_dim) inputs, returning
     ``(o, lse)`` where lse is the per-query logsumexp, (B, H, T) fp32.
 
@@ -668,13 +998,32 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     caller's matmuls (XLA otherwise warps the producing matmul's output
     layout to feed the custom call, costing ~2x on its emitter).
 
-    Equivalent math to softmax(scale * q k^T + causal_mask) v with fp32
-    accumulation, O(T) memory. Differentiable (custom flash backward).
-    Sequences that don't divide the block sizes are zero-padded and the
-    padded keys masked in-kernel (slicing the output transposes to
-    zero-padded cotangents, so the backward stays correct). ``block_h``
-    (b, h) instances are processed per grid step (clamped to a divisor
-    of batch*heads).
+    Additive score biases (counterpart of the reference's bias-taking
+    attention kernels — evoformer_attn kernel_forward.h:986 bias1/bias2,
+    inference softmax.cu:562 alibi+mask):
+      ``bias``: (B|1, H|1, T|1, T) added to the scaled scores before the
+        softmax, never expanded to the (B, H, T, T) score shape (kernel
+        operands carry only the given dims; the broadcast happens on
+        score tiles in VMEM). WITHOUT ``bias_grad=True`` the bias is a
+        CONSTANT (stop-gradient): differentiating through it yields
+        zeros — set ``bias_grad=True`` for learned biases (evoformer
+        pair bias), which makes the fused backward accumulate d_bias
+        in-kernel.
+      ``alibi``: (H,) ALiBi slopes — validated against the bloom formula
+        and computed IN-KERNEL per score tile as slope_h * k_pos from
+        iotas (softmax-shift-equivalent to the relative form): no HBM
+        bias array at all, like the paged decode kernel.
+        ``alibi_scale``/``alibi_bf16`` reproduce HF falcon's pre-scaling
+        bf16-quantized variant (models/llama.py _alibi_bias).
+      Masked positions (causal/window/padding) override any bias.
+
+    Equivalent math to softmax(scale * q k^T + bias + causal_mask) v with
+    fp32 accumulation, O(T) memory. Differentiable (custom flash
+    backward). Sequences that don't divide the block sizes are
+    zero-padded and the padded keys masked in-kernel (slicing the output
+    transposes to zero-padded cotangents, so the backward stays correct).
+    ``block_h`` (b, h) instances are processed per grid step (clamped to
+    a divisor of batch*heads, and of any dim a bias shares).
 
     lse is exposed (rather than kept as a hidden vjp residual) so callers
     under ``jax.checkpoint`` can tag o/lse/q/k/v with ``checkpoint_name``
@@ -712,9 +1061,66 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
             block_k=block_k, block_h=block_h, interpret=interpret,
             heads_major=True, block_q_bwd=block_q_bwd,
             block_k_bwd=block_k_bwd, qkv_t=False, window=window,
-            _with_lse=_with_lse)
+            bias=bias, bias_grad=bias_grad, alibi=alibi,
+            alibi_scale=alibi_scale, alibi_bf16=alibi_bf16,
+            _folded_biases=_folded_biases, _with_lse=_with_lse)
+
+    # -------- bias descriptors -> bh constraints (before bh is picked)
+    descs = []                                  # (arr4d, grad)
+    alibi_cfg = None
+    if alibi is not None:
+        # the kernels evaluate the bloom slope formula in-kernel from
+        # each instance's head index (_alibi_add); reject custom slopes
+        # rather than silently ignoring them (the paged kernel's rule)
+        from .paged_attention import alibi_slopes_formula
+        expect = alibi_slopes_formula(H)
+        got = [float(x) for x in np.asarray(alibi).reshape(-1)] \
+            if not isinstance(alibi, (list, tuple)) else list(alibi)
+        if len(got) != H or any(
+                abs(a - b) > 1e-6 * max(abs(b), 1e-9)
+                for a, b in zip(got, expect)):
+            raise NotImplementedError(
+                "flash_attention computes bloom-formula ALiBi slopes "
+                "in-kernel; custom per-head slopes are not supported "
+                "(pass them as a bias instead)")
+        alibi_cfg = (bool(qkv_t), H, B, float(alibi_scale),
+                     bool(alibi_bf16))
+    if bias is not None:
+        if bias.ndim != 4:
+            raise ValueError(
+                f"bias must be 4D (B|1, H|1, T|1, T); got {bias.shape}")
+        Bb, Hb, Tqb, Tk = bias.shape
+        if Bb not in (1, B) or Hb not in (1, H) or Tqb not in (1, T) \
+                or Tk != T:
+            raise ValueError(
+                f"bias shape {bias.shape} not broadcastable to "
+                f"({B}, {H}, {T}, {T})")
+        descs.append((bias, bool(bias_grad)))
+    constraints = [_bias_constraint(a.shape[0], a.shape[1], B, H, qkv_t)
+                   for a, _ in descs]
+    constraints += [c for _, c, _ in (_folded_biases or [])]
+    if descs or _folded_biases or alibi_cfg is not None:
+        # bias blocks (and the ALiBi position grid) carry the key dim in
+        # LANES; in-kernel dynamic lane offsets must be 128-aligned on
+        # Mosaic, so multi-block key walks need 128-multiple key blocks
+        # (single-block refs load statically). Fixpoint: rounding one
+        # pass's block can grow T_pad and turn the OTHER pass's block
+        # multi-block — recheck until both are either single-block or
+        # 128-aligned.
+        while True:
+            T_pad = _round_up(T, math.lcm(bq, bk, bwd_bq, bwd_bk))
+            if bk < T_pad and bk % 128:
+                bk = _round_up(bk, 128)
+            elif bwd_bk < T_pad and bwd_bk % 128:
+                bwd_bk = _round_up(bwd_bk, 128)
+            else:
+                break
+
     bh = max(1, min(block_h, B * H))
-    while (B * H) % bh:
+    if alibi_cfg is not None:
+        bh = 1          # scalar-slope ALiBi path (see _alibi_add)
+    while (B * H) % bh or any(c is not None and c % bh
+                              for c in constraints):
         bh -= 1
     # TPU tiling wants the lane (last) dim in 64/128 units: zero-pad other
     # head dims (zero columns add 0 to scores and produce zero output
@@ -742,15 +1148,76 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
             x = jnp.pad(x, ((0, 0), (0, T_pad - T), (0, d_pad - d)))
         return x
 
+    # -------- fold + pad biases; build their static cfgs
+    biases_folded, cfgs = [], []
+    for arr, grad in descs:
+        Bb, Hb, Tqb, Tk = arr.shape
+        if Tqb == 1:
+            # Expand query-broadcast biases (key masks, ALiBi) to a full
+            # query dim: a size-1 sublane broadcast inside the softmax
+            # carry loop crashes Mosaic's layout inference (verified on
+            # v5e — see _fwd_bias_specs). Costs (rows, T, T) HBM for
+            # what is logically (rows, T); acceptable at mask/ALiBi
+            # scales and still far below the dense path's (B, H, T, T)
+            # score materialization.
+            arr = jnp.broadcast_to(arr, (Bb, Hb, T, Tk))
+            Tqb = T
+        cfg = _bias_cfg(Bb, Hb, B, H, bh, True, grad, bool(qkv_t))
+        if qkv_t and Bb == B and Hb == H:
+            arr = arr.swapaxes(0, 1)     # match the kernels' (H, B) fold
+        f = arr.reshape(Bb * Hb, Tqb, Tk)
+        if Tk != T_pad or Tqb != T_pad:
+            f = jnp.pad(f, ((0, 0), (0, T_pad - Tqb),
+                            (0, T_pad - Tk)))
+        biases_folded.append(f)
+        cfgs.append(cfg)
+    for arr, _c, cfg_fn in (_folded_biases or []):
+        # pre-folded biases (the evoformer adapter): 3D (rows, Tq, Tk),
+        # full query dim required (expand upstream — see above)
+        cfg = cfg_fn(bh)
+        rows, Tqb, Tk = arr.shape
+        if Tqb != T:
+            raise ValueError(
+                f"folded bias must carry a full query dim ({T}); got "
+                f"{arr.shape} — expand query-broadcast biases upstream")
+        if Tk != T_pad or Tqb != T_pad:
+            arr = jnp.pad(arr, ((0, 0), (0, T_pad - Tqb),
+                                (0, T_pad - Tk)))
+        biases_folded.append(arr)
+        cfgs.append(cfg)
+    for cfg in cfgs:
+        if cfg[_B_GRAD]:
+            # grad accumulation relies on the row map visiting each bias
+            # block in one contiguous run (per_rows==1) or exactly once
+            # (per_rows==bh) — check statically over the real grid
+            fs = [_bias_row(cfg, bh, g) for g in range((B * H) // bh)]
+            mono = all(a <= b for a, b in zip(fs, fs[1:]))
+            if cfg[_B_PER] != 1:
+                mono = mono and len(set(fs)) == len(fs)
+            if not mono:
+                raise ValueError(
+                    "bias_grad unsupported for this broadcast pattern "
+                    "(bias rows revisited non-contiguously across the "
+                    "grid); materialize the bias per (batch, head) "
+                    "instead")
+
+    if alibi_cfg is not None:
+        # shared ALiBi position grid P[i, j] = j, one O(T^2) f32 array
+        # for ALL (batch, head) instances — the kernels scale it by the
+        # per-instance slope in VMEM (see _alibi_add)
+        biases_folded.append(jnp.broadcast_to(
+            jnp.arange(T_pad, dtype=jnp.float32)[None, :],
+            (T_pad, T_pad))[None])
+
     # fold the softmax scale into q OUTSIDE the kernel (and the custom_vjp,
     # so autodiff chains dq): one (BH, T, d) multiply instead of a
     # per-score-element multiply inside a VPU-bound kernel
     if window and not causal:
         raise ValueError("sliding window requires causal attention")
     q = q * jnp.asarray(scale, q.dtype)
-    args = (fold(q), fold(k), fold(v), 1.0, bool(causal),
-            bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk, bool(qkv_t),
-            int(window))
+    args = (fold(q), fold(k), fold(v), tuple(biases_folded), 1.0,
+            bool(causal), bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk,
+            bool(qkv_t), int(window), tuple(cfgs), alibi_cfg)
     if _with_lse:
         o, lse = _flash(*args)
     else:
@@ -778,26 +1245,35 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
 def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
                     block_k=128, block_h=2, interpret=None,
                     heads_major=False, block_q_bwd=None,
-                    block_k_bwd=None, qkv_t=False, window=0):
+                    block_k_bwd=None, qkv_t=False, window=0, bias=None,
+                    bias_grad=False, alibi=None, alibi_scale=1.0,
+                    alibi_bf16=False, _folded_biases=None):
     """Fused attention over (batch, seq, heads, head_dim); see
     :func:`flash_attention_with_lse` (this never emits the lse output).
-    ``window`` > 0 = mistral sliding-window attention (causal only)."""
+    ``window`` > 0 = mistral sliding-window attention (causal only);
+    ``bias``/``alibi`` = additive score biases (ALiBi, padding masks,
+    pair biases) applied in-kernel."""
     o, _ = flash_attention_with_lse(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, block_h=block_h, interpret=interpret,
         heads_major=heads_major, block_q_bwd=block_q_bwd,
-        block_k_bwd=block_k_bwd, qkv_t=qkv_t, window=window,
+        block_k_bwd=block_k_bwd, qkv_t=qkv_t, window=window, bias=bias,
+        bias_grad=bias_grad, alibi=alibi, alibi_scale=alibi_scale,
+        alibi_bf16=alibi_bf16, _folded_biases=_folded_biases,
         _with_lse=False)
     return o
 
 
-def attention_reference(q, k, v, *, causal=True, scale=None):
-    """Dense reference used by parity tests (same fp32 score math)."""
+def attention_reference(q, k, v, *, causal=True, scale=None, bias=None):
+    """Dense reference used by parity tests (same fp32 score math).
+    ``bias``: (B|1, H|1, T|1, T) additive, pre-mask."""
     B, T, H, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     s = jnp.einsum("bthd,bshd->bhts", q, k,
                    preferred_element_type=jnp.float32) * scale
+    if bias is not None:
+        s = s + bias.astype(jnp.float32)
     if causal:
         mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
         s = jnp.where(mask[None, None], s, NEG_INF)

@@ -54,7 +54,7 @@ alibi_slopes_formula = alibi_slopes
 
 def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
                    m_ref, l_ref, acc_ref, *, BS, KVH, G, scale, window,
-                   alibi):
+                   alibi, alibi_scale=1.0, alibi_bf16=False):
     b = pl.program_id(0)
     j = pl.program_id(1)
     H = KVH * G
@@ -98,7 +98,14 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
             cp = float(2 ** math.floor(math.log2(H)))
             expo = jnp.where(h < cp, -(h + 1.0) * (8.0 / cp),
                              -(2.0 * (h - cp) + 1.0) * (4.0 / cp))
-            s = s + jnp.exp2(expo) * pos.astype(jnp.float32)
+            ab = jnp.exp2(expo) * pos.astype(jnp.float32)
+            if alibi_bf16:
+                # HF falcon quantizes the alibi tensor through bf16 and
+                # adds it pre-scaling (models/llama.py _alibi_bias)
+                ab = ab.astype(jnp.bfloat16).astype(jnp.float32)
+            if alibi_scale != 1.0:
+                ab = ab * alibi_scale
+            s = s + ab
         ok = pos <= L
         if window:
             ok = ok & (pos > L - window)
@@ -123,7 +130,8 @@ def _decode_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
 
 def paged_decode_attention(q, k_cache, v_cache, block_tables, lengths, *,
                            scale=None, interpret=None, window=0,
-                           alibi_slopes=None):
+                           alibi_slopes=None, alibi_scale=1.0,
+                           alibi_bf16=False):
     """One decode step of attention over a paged KV cache.
 
     q: (B, H, d); k_cache/v_cache: (NB, KVH, BS, d) with H % KVH == 0;
@@ -188,7 +196,9 @@ def paged_decode_attention(q, k_cache, v_cache, block_tables, lengths, *,
     out = pl.pallas_call(
         functools.partial(_decode_kernel, BS=BS, KVH=KVH, G=G,
                           scale=float(scale), window=int(window),
-                          alibi=alibi_slopes is not None),
+                          alibi=alibi_slopes is not None,
+                          alibi_scale=float(alibi_scale),
+                          alibi_bf16=bool(alibi_bf16)),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((B, KVH, G, d), q.dtype),
         interpret=interpret,

@@ -83,6 +83,19 @@ class DeepSpeedEngine:
         self.model = model
         self.zero_stage = self.config.zero.stage
         self.param_dtype = self.config.precision_dtype
+        model_dtype = getattr(getattr(model, "config", None), "dtype",
+                              None)
+        if model_dtype is not None and \
+                jnp.dtype(model_dtype) != jnp.dtype(self.param_dtype):
+            # the engine computes in param_dtype (fp32 master handled
+            # internally); a model whose own dtype knob disagrees mixes
+            # activation dtypes mid-scan and fails with an opaque carry
+            # type error — tell the user which knob to change
+            raise ValueError(
+                f"model config dtype {jnp.dtype(model_dtype).name!r} != "
+                f"engine precision {jnp.dtype(self.param_dtype).name!r} "
+                f"(from the bf16/fp16 config blocks); set the model's "
+                f"dtype to match, or enable/disable bf16 accordingly")
         self.global_step = 0
         self.micro_steps = 0
 

@@ -128,6 +128,32 @@ class TestHFIngestion:
             bias=False, alibi=False, tie_word_embeddings=True)
         _roundtrip(tmp_path, transformers.FalconForCausalLM(cfg), inputs)
 
+    def test_falcon_new_arch(self, tmp_path, inputs):
+        # 40b/180b layout: grouped qkv de-interleave ((KVH, G+2, hd))
+        # + separate ln_attn/ln_mlp per parallel branch
+        cfg = transformers.FalconConfig(
+            vocab_size=512, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, num_kv_heads=2, multi_query=False,
+            new_decoder_architecture=True, parallel_attn=True,
+            bias=False, alibi=False, tie_word_embeddings=True)
+        model = transformers.FalconForCausalLM(cfg)
+        # distinct branch norms so tying them would fail the parity
+        with torch.no_grad():
+            for layer in model.transformer.h:
+                layer.ln_attn.weight.normal_(1.0, 0.3)
+                layer.ln_mlp.weight.normal_(1.0, 0.3)
+        _roundtrip(tmp_path, model, inputs)
+
+    def test_falcon_rw(self, tmp_path, inputs):
+        # falcon-rw layout: sequential block, per-head qkv interleave,
+        # ALiBi, linear biases
+        cfg = transformers.FalconConfig(
+            vocab_size=512, hidden_size=64, num_hidden_layers=2,
+            num_attention_heads=4, multi_query=False,
+            new_decoder_architecture=False, parallel_attn=False,
+            bias=True, alibi=True, tie_word_embeddings=True)
+        _roundtrip(tmp_path, transformers.FalconForCausalLM(cfg), inputs)
+
     def test_bloom_alibi(self, tmp_path, inputs):
         cfg = transformers.BloomConfig(
             vocab_size=512, hidden_size=64, n_layer=2, n_head=4)
