@@ -354,7 +354,7 @@ def convert_falcon(hf, sd, dtype="bfloat16"):
         vocab_size=hf["vocab_size"],
         max_seq_len=hf.get("max_position_embeddings", 2048),
         n_layer=L, n_head=n_head, n_kv_heads=KVH,
-        d_model=D, d_ff=4 * D,
+        d_model=D, d_ff=hf.get("ffn_hidden_size") or 4 * D,
         rope_theta=hf.get("rope_theta", 10000.0),
         rms_eps=hf.get("layer_norm_epsilon", 1e-5),
         parallel_block=parallel, alibi=alibi, alibi_inv_norm=alibi,

@@ -4,20 +4,27 @@ models.
 Counterpart of reference ``csrc/deepspeed4science/evoformer_attn/``
 (``DS4Sci_EvoformerAttention`` — a CUTLASS fused kernel whose reason to
 exist is O(N^2) score-matrix memory at MSA shapes). The TPU shape of the
-same capability: scores never materialize for the WHOLE batch at once —
-the computation chunks over the leading (batch*seq) rows with
-``lax.map``, each chunk a plain fp32-accumulated attention with the
-additive biases, which XLA fuses; peak memory is one chunk's
-(chunk, H, N, N) scores instead of the full (B, S, H, N, N).
+same capability is the bias-capable flash kernel
+(ops/pallas/flash_attention.py): scores NEVER materialize — the online
+softmax streams key blocks — and the two reference bias operands ride as
+kernel inputs (kernel_forward.h:986 bias1/bias2):
+
+  bias1: (B, S, 1, 1, N)  — per-row residue mask, folded (B*S, N, N)
+  bias2: (B, 1, H, N, N)  — pair-representation bias, folded (B*H, N, N)
+
+Instances are folded in (batch, head, row) order so bias2's rows are
+visited in one contiguous run each — that makes its in-kernel d_bias
+accumulation valid (pair-bias GRADIENTS flow through the fused backward;
+the reference kernel computes dB in kernel_backward.h the same way).
+bias1 is mask-like and non-differentiable on the kernel path (its rows
+revisit non-contiguously across heads); ``impl="xla"`` keeps the fully
+differentiable chunked path for consumers that need d(mask).
 
 API mirrors the reference:
-  evoformer_attention(q, k, v, biases=(bias1, bias2), chunk=...)
+  evoformer_attention(q, k, v, biases=(bias1, bias2))
 with q/k/v (B, S, N, H, d) — batch, MSA rows, residues, heads, head_dim
-— and biases broadcastable to the score shape (B, S, H, N, N):
-  bias1: (B, S, 1, 1, N)  — per-row residue mask
-  bias2: (B, 1, H, N, N)  — pair-representation bias
-Returns (B, S, N, H, d) in q's dtype. Differentiable (jax autodiff
-through the chunked map).
+— and biases broadcastable to the score shape (B, S, H, N, N). Returns
+(B, S, N, H, d) in q's dtype.
 """
 
 import math
@@ -27,13 +34,17 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def evoformer_attention(q, k, v, biases=(), *, scale=None, chunk=0):
+def evoformer_attention(q, k, v, biases=(), *, scale=None, chunk=0,
+                        impl="kernel", block_q=256, block_k=256,
+                        block_h=2):
     """Biased attention over (B, S, N, H, d) MSA-shaped inputs.
 
-    ``biases``: additive fp32 terms broadcastable to (B, S, H, N, N)
-    (the reference passes [bias1, bias2]). ``chunk``: rows of the
-    flattened (B*S) dim processed per step (0 = auto: aim for ~256 MB of
-    fp32 scores per chunk; 1 row of scores is H*N*N fp32)."""
+    ``biases``: additive terms broadcastable to (B, S, H, N, N) (the
+    reference passes [bias1, bias2]). ``impl="kernel"`` (default)
+    streams through the flash kernel — O(N) score memory, in-kernel
+    d_bias for the pair bias; ``impl="xla"`` keeps the chunked dense
+    path (fully differentiable incl. masks; ``chunk`` = rows of the
+    flattened (B*S) dim per step, 0 = auto ~256 MB of scores)."""
     B, S, N, H, d = q.shape
     if scale is None:
         scale = 1.0 / math.sqrt(d)
@@ -43,13 +54,82 @@ def evoformer_attention(q, k, v, biases=(), *, scale=None, chunk=0):
             raise ValueError(
                 f"bias must be 5D broadcastable to (B, S, H, N, N); got "
                 f"shape {b.shape}")
+    if impl == "xla":
+        return _evoformer_xla(q, k, v, biases, scale, chunk)
 
+    # ---- kernel path: fold instances (b, h, s) so bias2 rows are
+    # visited in contiguous runs (grad-accumulation validity)
+    def fold(x):                       # (B, S, N, H, d) -> (B, H*S, N, d)
+        return x.transpose(0, 3, 1, 2, 4).reshape(B, H * S, N, d)
+
+    folded = []
+    for b in biases:
+        Bb, Sb, Hb, Nq, Nk = b.shape
+        if Nk != N or Nq not in (1, N):
+            raise ValueError(
+                f"bias key/query dims {b.shape} do not match N={N}")
+        if Hb == 1:
+            # row bias/mask (bias1): rows (B*S); expand query dim (the
+            # kernel requires it) — (B*S, N, N) is still H x smaller
+            # than the score tensor the dense path would materialize
+            arr = jnp.broadcast_to(b, (B, S, 1, N, N)) \
+                .reshape(B * S, N, N)
+            cfg_fn = _row_bias_cfg(B, S, H)
+            folded.append((arr, S, cfg_fn))
+        elif Sb == 1:
+            # pair bias (bias2): rows (B*H); differentiable — the fold
+            # order gives each row one contiguous grid run
+            arr = jnp.broadcast_to(b, (B, 1, H, N, N)) \
+                .reshape(B * H, N, N)
+            cfg_fn = _pair_bias_cfg(B, S, H)
+            folded.append((arr, S, cfg_fn))
+        else:
+            # per-instance bias: identity row map
+            arr = jnp.broadcast_to(b, (B, S, H, N, N)) \
+                .transpose(0, 2, 1, 3, 4).reshape(B * H * S, N, N)
+            folded.append((arr, None, _identity_cfg()))
+
+    from .pallas.flash_attention import flash_attention
+    out = flash_attention(
+        fold(q), fold(k), fold(v), causal=False, scale=scale,
+        heads_major=True, block_q=block_q, block_k=block_k,
+        block_h=block_h, _folded_biases=folded)
+    return out.reshape(B, H, S, N, d).transpose(0, 2, 3, 1, 4)
+
+
+# cfg tuples: (per_rows, P, Q, R, tq_full, grad) with the row map
+#   f(g) = (g*bh // P) * Q + ((g*bh) % R) // bh
+# over the (b, h, s) instance fold — see flash_attention.py's bias notes.
+def _row_bias_cfg(B, S, H):
+    def cfg(bh):
+        # rows (b*S + s): groups span s; b advances every H*S instances
+        return (bh, H * S, S // bh, S, True, False)
+    return cfg
+
+
+def _pair_bias_cfg(B, S, H):
+    def cfg(bh):
+        # row (b*H + h) shared by the group's s-span: one contiguous
+        # run of S//bh grid steps -> in-kernel d_bias accumulation
+        return (1, S, 1, bh, True, True)
+    return cfg
+
+
+def _identity_cfg():
+    def cfg(bh):
+        return (bh, bh, 1, bh, True, True)
+    return cfg
+
+
+def _evoformer_xla(q, k, v, biases, scale, chunk):
+    """Chunked dense path (the pre-kernel implementation): peak memory
+    is one chunk's (chunk, H, N, N) scores; fully differentiable."""
+    B, S, N, H, d = q.shape
     if chunk == 0:
         row_bytes = H * N * N * 4
         chunk = max(1, min(B * S, (256 << 20) // max(row_bytes, 1)))
 
     def attend(q_, k_, v_, bias_rows):
-        # q_/k_/v_: (C, N, H, d); bias_rows: tuple of (C, H, N, N)
         s = jnp.einsum("cnhd,cmhd->chnm", q_, k_,
                        preferred_element_type=jnp.float32) * scale
         for br in bias_rows:
@@ -61,9 +141,6 @@ def evoformer_attention(q, k, v, biases=(), *, scale=None, chunk=0):
     qf = q.reshape(BS, N, H, d)
     kf = k.reshape(BS, N, H, d)
     vf = v.reshape(BS, N, H, d)
-    # biases broadcast to the flattened row dim; under jit the broadcast
-    # stays lazy until consumed chunk-by-chunk in the map body (XLA
-    # fuses the expansion into the score add — the memory property)
     bflat = [jnp.broadcast_to(b, (B, S, H, N, N)).reshape(BS, H, N, N)
              for b in biases]
 

@@ -1124,11 +1124,12 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
         bh -= 1
     # TPU tiling wants the lane (last) dim in 64/128 units: zero-pad other
     # head dims (zero columns add 0 to scores and produce zero output
-    # columns, and zero cotangent columns backward — exact). d=64 is kept
-    # native: the smaller DMA footprint beats the MXU's preference for 128.
-    # The rule applies under qkv_t too: d moves to sublanes for q/k/v but
-    # stays the lane dim of the o output block.
-    d_pad = d if d in (64, 128) else _round_up(d, 128)
+    # columns, and zero cotangent columns backward — exact). d <= 64 pads
+    # to 64, kept native: the smaller DMA footprint beats the MXU's
+    # preference for 128 (evoformer's d=32 pays 2x, not 4x). The rule
+    # applies under qkv_t too: d moves to sublanes for q/k/v but stays
+    # the lane dim of the o output block.
+    d_pad = _round_up(d, 64) if d <= 64 else _round_up(d, 128)
 
     def fold(x):
         if qkv_t:

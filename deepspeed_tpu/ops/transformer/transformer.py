@@ -103,20 +103,26 @@ class DeepSpeedTransformerLayer:
         qkv = (h @ params["wqkv"] + params["bqkv"]).reshape(B, T, 3, H, hd)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
         attn_drop = cfg.attn_dropout_ratio if train else 0.0
+        add = None
+        if mask is not None:
+            if mask.ndim == 2:           # (B, T) validity -> additive
+                add = jnp.where(mask[:, None, None, :], 0.0,
+                                -1e30).astype(jnp.float32)
+            else:
+                add = mask
         # flash path has no probability-dropout hook: fall back to dense
         # whenever attn dropout must actually apply (never drop silently)
-        if cfg.use_flash_attention and mask is None and not attn_drop:
+        if cfg.use_flash_attention and not attn_drop:
+            # padding masks ride the kernel's additive-bias input
+            # (reference softmax.cu:562 applies the mask in-kernel)
             from ..pallas.flash_attention import flash_attention
-            attn = flash_attention(q, k, v, causal=False).astype(x.dtype)
+            attn = flash_attention(q, k, v, causal=False,
+                                   bias=add).astype(x.dtype)
         else:
             scores = jnp.einsum("bthd,bshd->bhts", q, k,
                                 preferred_element_type=jnp.float32)
             scores = scores / math.sqrt(hd)
-            if mask is not None:
-                if mask.ndim == 2:       # (B, T) validity -> additive
-                    add = jnp.where(mask[:, None, None, :], 0.0, -1e30)
-                else:
-                    add = mask
+            if add is not None:
                 scores = scores + add
             probs = jax.nn.softmax(scores, axis=-1)
             probs = _dropout(probs.astype(x.dtype), attn_drop, r_attn)

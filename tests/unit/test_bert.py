@@ -122,6 +122,33 @@ class TestBertModel:
                                    np.asarray(h2[:, :20]),
                                    rtol=1e-5, atol=1e-5)
 
+    def test_flash_mask_matches_dense(self):
+        # padding masks ride the flash kernel's additive-bias input:
+        # the flash encoder must reproduce the dense encoder exactly
+        from dataclasses import replace
+        dense = Bert(BERT_TINY)
+        flash = Bert(replace(BERT_TINY, use_flash_attention=True))
+        params = dense.init(jax.random.key(0))
+        rng = np.random.RandomState(0)
+        ids = rng.randint(0, 512, (2, 48)).astype(np.int32)
+        mask = np.ones((2, 48), bool)
+        mask[0, 30:] = False
+        mask[1, 10:] = False
+        h0 = dense.apply(params, jnp.asarray(ids),
+                         attention_mask=jnp.asarray(mask))
+        h1 = flash.apply(params, jnp.asarray(ids),
+                         attention_mask=jnp.asarray(mask))
+        np.testing.assert_allclose(np.asarray(h0), np.asarray(h1),
+                                   rtol=1e-4, atol=1e-4)
+        # and the MLM loss gradient
+        batch = {"input_ids": jnp.asarray(ids),
+                 "attention_mask": jnp.asarray(mask)}
+        g0 = jax.grad(lambda p: dense.loss(p, batch, train=False))(params)
+        g1 = jax.grad(lambda p: flash.loss(p, batch, train=False))(params)
+        for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-3, atol=1e-4)
+
     def test_trains_through_engine(self):
         groups.reset()
         m = Bert(BERT_TINY)
