@@ -70,7 +70,10 @@ def main():
                   fused_layernorm={"0": False, "1": True, "bwd": "bwd",
                                    "auto": "auto"}.get(
                       os.environ.get("BENCH_FUSED_LN", "0"), False),
-                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "256")))
+                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "512")),
+                  # grad-in-forward fused CE (common.fused_linear_xent):
+                  # kills the backward logits-recompute matmul
+                  fused_loss=os.environ.get("BENCH_FUSED_LOSS", "1") == "1")
     model = GPT2(cfg)
 
     n_dev = len(jax.devices())
@@ -115,6 +118,21 @@ def main():
     sync()
     dt = time.perf_counter() - t0
 
+    # on-chip Pallas kernel parity gate (real-Mosaic numerics vs the
+    # dense references; CI only exercises interpreter mode). Runs after
+    # timing so its compiles never pollute the measurement.
+    kernels_parity = "skipped"
+    if os.environ.get("BENCH_KERNEL_PARITY", "1") == "1" \
+            and jax.default_backend() != "cpu":
+        import sys
+        sys.path.insert(0, os.path.join(os.path.dirname(
+            os.path.abspath(__file__)), "benchmarks"))
+        try:
+            from kernel_parity import run as _kernel_parity
+            kernels_parity = _kernel_parity()
+        except Exception as e:          # report, don't hide the bench
+            kernels_parity = f"FAILED: {type(e).__name__}: {e}"[:300]
+
     tokens = bsz * seq_len * steps
     tok_per_sec_chip = tokens / dt / n_dev
     flops_per_token = cfg.flops_per_token()
@@ -136,6 +154,7 @@ def main():
             "mfu_vs_v5e_peak": round(mfu, 3),
             "final_loss": float(loss),
             "baseline_tokens_per_sec_chip_8xA100_est": round(a100_baseline, 1),
+            "kernels_parity": kernels_parity,
         },
     }))
 

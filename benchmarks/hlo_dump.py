@@ -1,7 +1,7 @@
-"""Capture a jax.profiler trace of the headline training step.
+"""Dump the compiled train-step HLO and print the definitions of named
+fusions (to map trace op names back to computation bodies).
 
-Usage: python benchmarks/profile_step.py [outdir]
-Then aggregate with benchmarks/trace_summary.py.
+Usage: python benchmarks/hlo_dump.py fusion.485 fusion.486 add_add_fusion.2
 """
 
 import os
@@ -21,18 +21,14 @@ from deepspeed_tpu.utils import groups
 
 
 def main():
-    outdir = sys.argv[1] if len(sys.argv) > 1 else "/tmp/dstpu_trace"
+    names = [a for a in sys.argv[1:] if not a.startswith("-")]
     preset = os.environ.get("BENCH_PRESET", "350M")
     seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
     micro = int(os.environ.get("BENCH_MICRO_BS", "24"))
-
     cfg = PRESETS[preset]
     from dataclasses import replace
-    cfg = replace(cfg, max_seq_len=seq_len,
-                  use_flash_attention=os.environ.get("BENCH_FLASH", "1") == "1",
-                  flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", "1024")),
-                  flash_block_k=int(os.environ.get("BENCH_FLASH_BK", "1024")),
-                  flash_block_h=int(os.environ.get("BENCH_FLASH_BH", "1")),
+    cfg = replace(cfg, max_seq_len=seq_len, use_flash_attention=True,
+                  flash_block_q=1024, flash_block_k=1024, flash_block_h=1,
                   remat=True,
                   remat_policy=os.environ.get("BENCH_REMAT_POLICY",
                                               "save_flash"),
@@ -52,21 +48,27 @@ def main():
             "bf16": {"enabled": True},
             "zero_optimization": {"stage": 2},
         })
-
     bsz = engine.config.train_batch_size
     rng = np.random.RandomState(0)
     batch = {"input_ids": rng.randint(0, cfg.vocab_size, (bsz, seq_len))
              .astype(np.int32)}
-
-    for _ in range(3):
-        engine.train_batch(batch)
-    float(np.asarray(engine.state["step"]))
-
-    with jax.profiler.trace(outdir):
-        for _ in range(3):
-            engine.train_batch(batch)
-        float(np.asarray(engine.state["step"]))
-    print("trace written to", outdir)
+    batch = jax.tree.map(engine._add_gas_dim, batch)
+    batch = engine._shard_batch(batch, with_gas_dim=True)
+    with jax.set_mesh(engine.mesh):
+        compiled = engine._train_step_jit.lower(
+            engine.state, batch, engine._current_lr()).compile()
+    txt = compiled.as_text()
+    out = os.environ.get("HLO_OUT", "/tmp/train_step.hlo")
+    with open(out, "w") as f:
+        f.write(txt)
+    print(f"HLO written to {out} ({len(txt)} bytes)")
+    if names:
+        import re
+        for name in names:
+            # print the fusion computation the instruction calls
+            pat = re.compile(rf'^\s*%?{re.escape(name)} = .*$', re.M)
+            for m in pat.finditer(txt):
+                print("==== instr:", m.group(0)[:400])
 
 
 if __name__ == "__main__":

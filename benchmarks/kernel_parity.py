@@ -1,0 +1,136 @@
+"""On-chip Pallas kernel parity gate.
+
+CI exercises every Pallas kernel in interpreter mode (tests/conftest.py
+provisions a CPU mesh); this module is the real-Mosaic counterpart: tiny
+shapes, compiled for the actual TPU, asserted against the dense
+references — so every driver ``bench.py`` run also validates that
+interpreter numerics and Mosaic numerics agree (a divergence would
+otherwise ship silently). The TPU substitute for the reference's
+per-kernel GPU CI (tests/unit/ops/).
+
+Budget: well under a second of device time; a few seconds of compiles.
+Tolerances are bf16-scale — on TPU both the kernels and the dense
+references run their dots on the MXU in bf16.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+__all__ = ["run"]
+
+_TOL = dict(rtol=2e-2, atol=2e-2)
+
+
+def _close(a, b, what, tol=_TOL):
+    a = np.asarray(a, np.float32)
+    b = np.asarray(b, np.float32)
+    np.testing.assert_allclose(a, b, err_msg=what, **tol)
+
+
+def _flash(rng):
+    from deepspeed_tpu.ops.pallas.flash_attention import (
+        attention_reference, flash_attention)
+    B, H, T, d = 2, 4, 256, 64
+    ks = jax.random.split(rng, 4)
+    q, k, v = (jax.random.normal(ks[i], (B, H, T, d), jnp.bfloat16)
+               for i in range(3))
+    do = jax.random.normal(ks[3], (B, H, T, d), jnp.bfloat16)
+
+    def fl(q, k, v):
+        return flash_attention(q, k, v, causal=True, heads_major=True,
+                               block_q=128, block_k=128, interpret=False)
+
+    def ref(q, k, v):
+        return attention_reference(
+            q.swapaxes(1, 2), k.swapaxes(1, 2), v.swapaxes(1, 2),
+            causal=True).swapaxes(1, 2)
+
+    # elementwise forward parity (outputs are O(1) post-softmax values),
+    # then elementwise cotangent parity through each backward
+    of, pull_f = jax.vjp(fl, q, k, v)
+    orf, pull_r = jax.vjp(ref, q, k, v)
+    _close(of, orf, "flash fwd")
+    for a, b, n in zip(pull_f(do), pull_r(do), "qkv"):
+        _close(a, b, f"flash d{n}", dict(rtol=5e-2, atol=5e-2))
+
+
+def _paged(rng):
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_attention_reference)
+    B, H, d = 4, 8, 64
+    NB, BS, MB = 16, 16, 4
+    ks = jax.random.split(rng, 4)
+    q = jax.random.normal(ks[0], (B, H, d), jnp.bfloat16)
+    kc = jax.random.normal(ks[1], (NB, H, BS, d), jnp.bfloat16)
+    vc = jax.random.normal(ks[2], (NB, H, BS, d), jnp.bfloat16)
+    tables = jax.random.randint(ks[3], (B, MB), 0, NB, jnp.int32)
+    lengths = jnp.asarray([5, 63, 17, 30], jnp.int32)
+    out = jax.jit(lambda *a: paged_decode_attention(*a, interpret=False))(
+        q, kc, vc, tables, lengths)
+    ref = jax.jit(paged_decode_attention_reference)(
+        q, kc, vc, tables, lengths)
+    _close(out, ref, "paged decode")
+
+
+def _block_sparse(rng):
+    from deepspeed_tpu.ops.pallas.block_sparse_attention import (
+        block_sparse_attention)
+    from deepspeed_tpu.ops.sparse_attention import FixedSparsityConfig
+    B, H, T, d = 2, 4, 256, 64
+    blk = 64
+    layout = FixedSparsityConfig(
+        num_heads=H, block=blk).make_layout(T)
+    ks = jax.random.split(rng, 3)
+    q, k, v = (jax.random.normal(ks[i], (B, T, H, d), jnp.bfloat16)
+               for i in range(3))
+    out = jax.jit(lambda q, k, v: block_sparse_attention(
+        q, k, v, layout, blk, causal=True, interpret=False))(q, k, v)
+    # dense reference with the same layout mask
+    lay = np.asarray(jax.device_get(layout))
+    if lay.ndim == 2:
+        lay = np.broadcast_to(lay[None], (H,) + lay.shape)
+    mask = np.kron(lay, np.ones((blk, blk), bool))[:, :T, :T]
+    mask = np.tril(np.ones((T, T), bool))[None] & mask.astype(bool)
+    s = jnp.einsum("bthd,bshd->bhts", q, k,
+                   preferred_element_type=jnp.float32) / np.sqrt(d)
+    s = jnp.where(jnp.asarray(mask)[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    ref = jnp.einsum("bhts,bshd->bthd", p, v)
+    _close(out, ref, "block-sparse fwd")
+
+
+def _quant(rng):
+    from deepspeed_tpu.ops.pallas.quantization import (
+        dequantize_blockwise, quantize_blockwise)
+    x = jax.random.normal(rng, (512, 256), jnp.float32) * 3.0
+    qp, sp, meta = quantize_blockwise(x, use_pallas=True, interpret=False)
+    qr, sr, _ = quantize_blockwise(x, use_pallas=False)
+    _close(qp, qr, "int8 quantize codes", dict(rtol=0, atol=1))
+    yp = dequantize_blockwise(qp, sp, meta, use_pallas=True,
+                              interpret=False)
+    # roundtrip error bound is s/2 = blockwise absmax/254 (~0.055 for
+    # |x| up to ~14 here)
+    _close(yp, x, "int8 roundtrip", dict(rtol=0, atol=0.08))
+
+
+def run(seed=0):
+    """Run all kernel parity checks on the default backend. Returns
+    'ok' or raises with the failing kernel named."""
+    rng = jax.random.key(seed)
+    rngs = jax.random.split(rng, 4)
+    _flash(rngs[0])
+    _paged(rngs[1])
+    _block_sparse(rngs[2])
+    _quant(rngs[3])
+    return "ok"
+
+
+if __name__ == "__main__":
+    print({"kernels_parity": run()})

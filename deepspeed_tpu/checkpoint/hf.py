@@ -18,8 +18,9 @@ Entry points:
   convert_<family>(hf_cfg, sd, dtype) -> (config, params)
 
 Supported model_type values: gpt2, opt, llama, mistral, qwen2, phi,
-falcon, mixtral, bloom. Weights load from *.safetensors (single or
-index-sharded) or pytorch_model.bin (torch CPU).
+falcon, mixtral, bloom, gptj, gpt_neo, gpt_neox, internlm. Weights load
+from *.safetensors (single or index-sharded) or pytorch_model.bin
+(torch CPU).
 """
 
 import json
@@ -172,7 +173,8 @@ def convert_opt(hf, sd, dtype="bfloat16"):
 
 
 def _llama_like(hf, sd, cfg, dtype, *, pre="model.", qkv_bias=False,
-                proj_bias=False, gated=True, ln=False, fused_qkv=False,
+                proj_bias=False, o_bias=False, gated=True, ln=False,
+                fused_qkv=False,
                 shared_ln=False, mlp_names=("gate_proj", "up_proj",
                                             "down_proj"),
                 o_name="o_proj", moe=False, layer_prefix="layers"):
@@ -204,7 +206,7 @@ def _llama_like(hf, sd, cfg, dtype, *, pre="model.", qkv_bias=False,
             e["bq"] = g(lp + "self_attn.q_proj.bias")
             e["bk"] = g(lp + "self_attn.k_proj.bias")
             e["bv"] = g(lp + "self_attn.v_proj.bias")
-        if proj_bias:
+        if proj_bias or o_bias:
             e["bo"] = g(lp + f"self_attn.{o_name}.bias")
         if moe:
             E = cfg.num_experts
@@ -424,6 +426,209 @@ def convert_falcon(hf, sd, dtype="bfloat16"):
     return cfg, _model_cast(params, cfg, dtype)
 
 
+def convert_gptj(hf, sd, dtype="bfloat16"):
+    """HF gptj: separate unbiased q/k/v/out projections, biased
+    fc_in/fc_out MLP, one shared input LN per layer (tied into both
+    branch slots), biased untied lm_head, interleaved partial rotary
+    (reference module_inject/containers/gptj.py)."""
+    from ..models.gptj import GPTJConfig
+    L = hf["n_layer"]
+    D = hf["n_embd"]
+    hd = D // hf["n_head"]
+    cfg = GPTJConfig(
+        vocab_size=hf["vocab_size"], max_seq_len=hf["n_positions"],
+        n_layer=L, n_head=hf["n_head"], n_kv_heads=hf["n_head"],
+        d_model=D, d_ff=hf.get("n_inner") or 4 * D,
+        rms_eps=hf.get("layer_norm_epsilon", 1e-5),
+        rotary_pct=hf.get("rotary_dim", hd) / hd,
+        dtype=dtype)
+    pre = "transformer."
+    g = lambda k: sd[pre + k]
+    layers = []
+    for i in range(L):
+        lp = f"h.{i}."
+        e = {
+            "wq": g(lp + "attn.q_proj.weight").T,
+            "wk": g(lp + "attn.k_proj.weight").T,
+            "wv": g(lp + "attn.v_proj.weight").T,
+            "wo": g(lp + "attn.out_proj.weight").T,
+            "wup": g(lp + "mlp.fc_in.weight").T,
+            "bup": g(lp + "mlp.fc_in.bias"),
+            "wdown": g(lp + "mlp.fc_out.weight").T,
+            "bdown": g(lp + "mlp.fc_out.bias"),
+            "rms1": g(lp + "ln_1.weight"),
+            "b1": g(lp + "ln_1.bias"),
+        }
+        e["rms2"], e["b2"] = e["rms1"], e["b1"]  # shared-LN parallel block
+        layers.append(e)
+    params = {
+        "blocks": {k: _stack(layers, k) for k in layers[0]},
+        "wte": g("wte.weight"),
+        "norm_f": g("ln_f.weight"),
+        "norm_f_b": g("ln_f.bias"),
+        "lm_head": sd["lm_head.weight"],
+        "lm_head_b": sd["lm_head.bias"],
+    }
+    return cfg, _model_cast(params, cfg, dtype)
+
+
+def convert_gpt_neo(hf, sd, dtype="bfloat16"):
+    """HF gpt_neo: gpt2-family blocks with nn.Linear weights
+    (transposed at load), NO qkv bias (zero rows in the fused bqkv), NO
+    score scaling, and the attention_types global/local layer pattern
+    (reference module_inject/containers/gptneo.py)."""
+    from ..models.gpt_neo import GPTNeoConfig
+    L = hf["num_layers"]
+    D = hf["hidden_size"]
+    inner = hf.get("intermediate_size") or 4 * D
+    if inner != 4 * D:
+        raise ValueError(
+            f"gpt_neo intermediate_size {inner} != 4*hidden {4 * D}: the "
+            f"GPT2 family derives d_ff as 4*d_model")
+    # expand attention_types [[['global','local'], k], ...] -> per-layer
+    # windows (0 = global)
+    pattern = []
+    for kinds, reps in hf.get("attention_types",
+                              [[["global"], L]]):
+        pattern.extend(kinds * reps)
+    if len(pattern) != L:
+        raise ValueError(f"attention_types expands to {len(pattern)} "
+                         f"layers, config has {L}")
+    win = hf.get("window_size", 256)
+    windows = tuple(win if k == "local" else 0 for k in pattern)
+    cfg = GPTNeoConfig(
+        vocab_size=hf["vocab_size"],
+        max_seq_len=hf["max_position_embeddings"], n_layer=L,
+        n_head=hf["num_heads"], d_model=D,
+        attn_layer_windows=() if not any(windows) else windows,
+        dtype=dtype)
+    pre = "transformer."
+    g = lambda k: sd[pre + k]
+    layers = []
+    for i in range(L):
+        lp = f"h.{i}."
+        wq = g(lp + "attn.attention.q_proj.weight").T
+        wk = g(lp + "attn.attention.k_proj.weight").T
+        wv = g(lp + "attn.attention.v_proj.weight").T
+        layers.append({
+            "ln1_scale": g(lp + "ln_1.weight"),
+            "ln1_bias": g(lp + "ln_1.bias"),
+            "wqkv": np.concatenate([wq, wk, wv], axis=1),
+            "bqkv": np.zeros((3 * D,), np.float32),
+            "wo": g(lp + "attn.attention.out_proj.weight").T,
+            "bo": g(lp + "attn.attention.out_proj.bias"),
+            "ln2_scale": g(lp + "ln_2.weight"),
+            "ln2_bias": g(lp + "ln_2.bias"),
+            "wup": g(lp + "mlp.c_fc.weight").T,
+            "bup": g(lp + "mlp.c_fc.bias"),
+            "wdown": g(lp + "mlp.c_proj.weight").T,
+            "bdown": g(lp + "mlp.c_proj.bias"),
+        })
+    params = {
+        "wte": g("wte.weight"),
+        "wpe": g("wpe.weight"),
+        "lnf_scale": g("ln_f.weight"),
+        "lnf_bias": g("ln_f.bias"),
+        "blocks": {k: _stack(layers, k) for k in layers[0]},
+    }
+    return cfg, _model_cast(params, cfg, dtype)
+
+
+def convert_gpt_neox(hf, sd, dtype="bfloat16"):
+    """HF gpt_neox / pythia: fused query_key_value is INTERLEAVED per
+    head ((H, 3, hd) rows, megatron layout — reference
+    module_inject/containers/gptneox.py notes the same split), biases
+    on qkv/dense/MLP, bias-free untied embed_out, use_parallel_residual
+    with two independent branch norms."""
+    from ..models.gpt_neox import GPTNeoXConfig
+    L = hf["num_hidden_layers"]
+    D = hf["hidden_size"]
+    H = hf["num_attention_heads"]
+    hd = D // H
+    cfg = GPTNeoXConfig(
+        vocab_size=hf["vocab_size"],
+        max_seq_len=hf["max_position_embeddings"], n_layer=L,
+        n_head=H, n_kv_heads=H, d_model=D,
+        d_ff=hf.get("intermediate_size") or 4 * D,
+        rope_theta=hf.get("rotary_emb_base", 10000.0),
+        rms_eps=hf.get("layer_norm_eps", 1e-5),
+        rotary_pct=hf.get("rotary_pct", 0.25),
+        parallel_block=hf.get("use_parallel_residual", True),
+        mlp_act={"gelu": "gelu", "gelu_new": "gelu_tanh",
+                 "gelu_fast": "gelu_tanh"}.get(
+            hf.get("hidden_act", "gelu"), "gelu"),
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        dtype=dtype)
+    pre = "gpt_neox."
+    g = lambda k: sd[pre + k]
+
+    def deinterleave(w):
+        """(..., 3*D) fused qkv with per-head (H, 3, hd) layout ->
+        q/k/v (..., D) each; works for the (D, 3D) weight (transposed
+        from HF's (3D, D)) and the (3D,) bias alike."""
+        lead = w.shape[:-1]
+        t = w.reshape(*lead, H, 3, hd)
+        return tuple(t[..., :, j, :].reshape(*lead, D) for j in range(3))
+
+    layers = []
+    for i in range(L):
+        lp = f"layers.{i}."
+        wq, wk, wv = deinterleave(
+            g(lp + "attention.query_key_value.weight").T)
+        bq, bk, bv = deinterleave(g(lp + "attention.query_key_value.bias"))
+        layers.append({
+            "wq": wq, "wk": wk, "wv": wv,
+            "bq": bq, "bk": bk, "bv": bv,
+            "wo": g(lp + "attention.dense.weight").T,
+            "bo": g(lp + "attention.dense.bias"),
+            "wup": g(lp + "mlp.dense_h_to_4h.weight").T,
+            "bup": g(lp + "mlp.dense_h_to_4h.bias"),
+            "wdown": g(lp + "mlp.dense_4h_to_h.weight").T,
+            "bdown": g(lp + "mlp.dense_4h_to_h.bias"),
+            "rms1": g(lp + "input_layernorm.weight"),
+            "b1": g(lp + "input_layernorm.bias"),
+            "rms2": g(lp + "post_attention_layernorm.weight"),
+            "b2": g(lp + "post_attention_layernorm.bias"),
+        })
+    params = {
+        "blocks": {k: _stack(layers, k) for k in layers[0]},
+        "wte": g("embed_in.weight"),
+        "norm_f": g("final_layer_norm.weight"),
+        "norm_f_b": g("final_layer_norm.bias"),
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["embed_out.weight"]
+    return cfg, _model_cast(params, cfg, dtype)
+
+
+def convert_internlm(hf, sd, dtype="bfloat16"):
+    """HF internlm (v1): the llama block with learned biases on the
+    q/k/v AND output projections when config ``bias`` is true
+    (reference module_inject/containers/internlm.py)."""
+    from ..models.internlm import InternLMConfig
+    has_bias = bool(hf.get("bias", True))
+    cfg = InternLMConfig(
+        vocab_size=hf["vocab_size"],
+        max_seq_len=hf["max_position_embeddings"],
+        n_layer=hf["num_hidden_layers"],
+        n_head=hf["num_attention_heads"],
+        n_kv_heads=hf.get("num_key_value_heads",
+                          hf["num_attention_heads"]),
+        d_model=hf["hidden_size"], d_ff=hf["intermediate_size"],
+        rope_theta=hf.get("rope_theta", 10000.0),
+        rms_eps=hf.get("rms_norm_eps", 1e-6),
+        qkv_bias=has_bias, o_bias=has_bias,
+        tie_embeddings=hf.get("tie_word_embeddings", False),
+        dtype=dtype)
+    params, g, maybe = _llama_like(hf, sd, cfg, dtype, qkv_bias=has_bias,
+                                   o_bias=has_bias)
+    params["wte"] = g("embed_tokens.weight")
+    params["norm_f"] = g("norm.weight")
+    if not cfg.tie_embeddings:
+        params["lm_head"] = sd["lm_head.weight"]
+    return cfg, _model_cast(params, cfg, dtype)
+
+
 def convert_mixtral(hf, sd, dtype="bfloat16"):
     from ..models.mixtral import MixtralConfig
     cfg = MixtralConfig(
@@ -515,6 +720,10 @@ CONVERTERS = {
     "falcon": convert_falcon,
     "mixtral": convert_mixtral,
     "bloom": convert_bloom,
+    "gptj": convert_gptj,
+    "gpt_neo": convert_gpt_neo,
+    "gpt_neox": convert_gpt_neox,
+    "internlm": convert_internlm,
 }
 
 _MODEL_CLASSES = {
@@ -527,6 +736,10 @@ _MODEL_CLASSES = {
     "falcon": ("..models.falcon", "Falcon"),
     "mixtral": ("..models.mixtral", "Mixtral"),
     "bloom": ("..models.bloom", "Bloom"),
+    "gptj": ("..models.gptj", "GPTJ"),
+    "gpt_neo": ("..models.gpt_neo", "GPTNeo"),
+    "gpt_neox": ("..models.gpt_neox", "GPTNeoX"),
+    "internlm": ("..models.internlm", "InternLM"),
 }
 
 

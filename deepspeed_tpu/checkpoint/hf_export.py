@@ -1,0 +1,274 @@
+"""HF checkpoint EXPORT: model param trees -> safetensors + config.json.
+
+Counterpart of the reference's ``save_16bit_model`` / zero_to_fp32 HF
+export path (/root/reference/deepspeed/runtime/engine.py:3625
+``save_16bit_model``, ``utils/zero_to_fp32.py``
+``convert_zero_checkpoint_to_fp32_state_dict``): a trained model leaves
+the framework as a standard HuggingFace checkpoint directory that
+``transformers`` loads directly. The inverse of ``checkpoint/hf.py`` —
+stacked functional trees are sliced per layer and renamed to each
+family's HF key set.
+
+TPU-first difference: there is no per-rank partitioned state to stitch
+offline — the engine consolidates by reading the GLOBAL jax.Arrays
+(single process) or a process_allgather (multi-host), then one writer
+emits the file. Supported families: gpt2, opt, llama, mistral, qwen2,
+internlm, gpt_neox.
+
+Entry points:
+  export_hf(model, params, save_dir, dtype=...)   # numpy/jax tree in
+  DeepSpeedEngine.save_16bit_model(save_dir)      # runtime/engine.py
+"""
+
+import json
+import os
+
+import numpy as np
+
+__all__ = ["export_hf"]
+
+
+def _to_host(tree):
+    import jax
+    return jax.tree.map(lambda a: np.asarray(a), tree)
+
+
+def _save(sd, save_dir, dtype):
+    """Write {name: np.ndarray} as model.safetensors in ``dtype``
+    (bf16 rides through torch — numpy has no bf16 serialization)."""
+    os.makedirs(save_dir, exist_ok=True)
+    import torch
+    tdt = {"bfloat16": torch.bfloat16, "float16": torch.float16,
+           "float32": torch.float32}[dtype]
+    out = {k: torch.from_numpy(
+        np.array(v, np.float32, copy=True)).to(tdt).contiguous()
+        for k, v in sd.items()}
+    from safetensors.torch import save_file
+    save_file(out, os.path.join(save_dir, "model.safetensors"))
+
+
+def _write_config(save_dir, cfg_dict):
+    os.makedirs(save_dir, exist_ok=True)
+    with open(os.path.join(save_dir, "config.json"), "w") as f:
+        json.dump(cfg_dict, f, indent=1)
+
+
+def _unstack(blocks, i):
+    return {k: np.asarray(v[i]) for k, v in blocks.items()}
+
+
+# ------------------------------------------------------------- gpt2 / opt
+def _export_gpt2(cfg, params, save_dir, dtype):
+    sd = {}
+    pre = "transformer."
+    sd[pre + "wte.weight"] = params["wte"]
+    sd[pre + "wpe.weight"] = params["wpe"]
+    sd[pre + "ln_f.weight"] = params["lnf_scale"]
+    sd[pre + "ln_f.bias"] = params["lnf_bias"]
+    for i in range(cfg.n_layer):
+        e = _unstack(params["blocks"], i)
+        lp = f"{pre}h.{i}."
+        sd[lp + "ln_1.weight"] = e["ln1_scale"]
+        sd[lp + "ln_1.bias"] = e["ln1_bias"]
+        sd[lp + "attn.c_attn.weight"] = e["wqkv"]     # Conv1D (in, out)
+        sd[lp + "attn.c_attn.bias"] = e["bqkv"]
+        sd[lp + "attn.c_proj.weight"] = e["wo"]
+        sd[lp + "attn.c_proj.bias"] = e["bo"]
+        sd[lp + "ln_2.weight"] = e["ln2_scale"]
+        sd[lp + "ln_2.bias"] = e["ln2_bias"]
+        sd[lp + "mlp.c_fc.weight"] = e["wup"]
+        sd[lp + "mlp.c_fc.bias"] = e["bup"]
+        sd[lp + "mlp.c_proj.weight"] = e["wdown"]
+        sd[lp + "mlp.c_proj.bias"] = e["bdown"]
+    _write_config(save_dir, {
+        "model_type": "gpt2", "architectures": ["GPT2LMHeadModel"],
+        "vocab_size": cfg.vocab_size, "n_positions": cfg.max_seq_len,
+        "n_ctx": cfg.max_seq_len, "n_embd": cfg.d_model,
+        "n_layer": cfg.n_layer, "n_head": cfg.n_head,
+        "activation_function": ("gelu_new" if cfg.activation == "gelu"
+                                else cfg.activation),
+        "layer_norm_epsilon": 1e-5, "tie_word_embeddings": True,
+        "torch_dtype": dtype,
+    })
+    _save(sd, save_dir, dtype)
+
+
+def _export_opt(cfg, params, save_dir, dtype):
+    sd = {}
+    pre = "model.decoder."
+    D = cfg.d_model
+    sd[pre + "embed_tokens.weight"] = params["wte"]
+    # HF OPT positions carry 2 leading pad slots (see convert_opt)
+    wpe = np.asarray(params["wpe"], np.float32)
+    sd[pre + "embed_positions.weight"] = np.concatenate(
+        [np.zeros((2, D), np.float32), wpe])
+    sd[pre + "final_layer_norm.weight"] = params["lnf_scale"]
+    sd[pre + "final_layer_norm.bias"] = params["lnf_bias"]
+    sd["lm_head.weight"] = params["wte"]
+    for i in range(cfg.n_layer):
+        e = _unstack(params["blocks"], i)
+        lp = f"{pre}layers.{i}."
+        w = np.asarray(e["wqkv"], np.float32)
+        b = np.asarray(e["bqkv"], np.float32)
+        for j, m in enumerate(("q", "k", "v")):
+            sd[lp + f"self_attn.{m}_proj.weight"] = \
+                w[:, j * D:(j + 1) * D].T
+            sd[lp + f"self_attn.{m}_proj.bias"] = b[j * D:(j + 1) * D]
+        sd[lp + "self_attn.out_proj.weight"] = np.asarray(e["wo"]).T
+        sd[lp + "self_attn.out_proj.bias"] = e["bo"]
+        sd[lp + "self_attn_layer_norm.weight"] = e["ln1_scale"]
+        sd[lp + "self_attn_layer_norm.bias"] = e["ln1_bias"]
+        sd[lp + "final_layer_norm.weight"] = e["ln2_scale"]
+        sd[lp + "final_layer_norm.bias"] = e["ln2_bias"]
+        sd[lp + "fc1.weight"] = np.asarray(e["wup"]).T
+        sd[lp + "fc1.bias"] = e["bup"]
+        sd[lp + "fc2.weight"] = np.asarray(e["wdown"]).T
+        sd[lp + "fc2.bias"] = e["bdown"]
+    _write_config(save_dir, {
+        "model_type": "opt", "architectures": ["OPTForCausalLM"],
+        "vocab_size": cfg.vocab_size,
+        "max_position_embeddings": cfg.max_seq_len,
+        "hidden_size": cfg.d_model, "ffn_dim": 4 * cfg.d_model,
+        "num_hidden_layers": cfg.n_layer,
+        "num_attention_heads": cfg.n_head,
+        "word_embed_proj_dim": cfg.d_model,
+        "do_layer_norm_before": True, "activation_function": "relu",
+        "tie_word_embeddings": True, "torch_dtype": dtype,
+    })
+    _save(sd, save_dir, dtype)
+
+
+# --------------------------------------------------------- llama family
+def _export_llama_like(cfg, params, save_dir, dtype, model_type):
+    sd = {}
+    pre = "model."
+    sd[pre + "embed_tokens.weight"] = params["wte"]
+    sd[pre + "norm.weight"] = params["norm_f"]
+    sd["lm_head.weight"] = params["wte"] if cfg.tie_embeddings \
+        else params["lm_head"]
+    for i in range(cfg.n_layer):
+        e = _unstack(params["blocks"], i)
+        lp = f"{pre}layers.{i}."
+        sd[lp + "self_attn.q_proj.weight"] = np.asarray(e["wq"]).T
+        sd[lp + "self_attn.k_proj.weight"] = np.asarray(e["wk"]).T
+        sd[lp + "self_attn.v_proj.weight"] = np.asarray(e["wv"]).T
+        sd[lp + "self_attn.o_proj.weight"] = np.asarray(e["wo"]).T
+        if cfg.qkv_bias:
+            sd[lp + "self_attn.q_proj.bias"] = e["bq"]
+            sd[lp + "self_attn.k_proj.bias"] = e["bk"]
+            sd[lp + "self_attn.v_proj.bias"] = e["bv"]
+        if cfg.o_bias_on:
+            sd[lp + "self_attn.o_proj.bias"] = e["bo"]
+        sd[lp + "mlp.gate_proj.weight"] = np.asarray(e["wgate"]).T
+        sd[lp + "mlp.up_proj.weight"] = np.asarray(e["wup"]).T
+        sd[lp + "mlp.down_proj.weight"] = np.asarray(e["wdown"]).T
+        sd[lp + "input_layernorm.weight"] = e["rms1"]
+        sd[lp + "post_attention_layernorm.weight"] = e["rms2"]
+    c = {
+        "model_type": model_type,
+        "vocab_size": cfg.vocab_size,
+        "max_position_embeddings": cfg.max_seq_len,
+        "hidden_size": cfg.d_model, "intermediate_size": cfg.ffn_dim,
+        "num_hidden_layers": cfg.n_layer,
+        "num_attention_heads": cfg.n_head,
+        "num_key_value_heads": cfg.n_kv_heads,
+        "rope_theta": cfg.rope_theta, "rms_norm_eps": cfg.rms_eps,
+        "tie_word_embeddings": cfg.tie_embeddings,
+        "hidden_act": "silu", "torch_dtype": dtype,
+    }
+    if model_type == "llama":
+        c["architectures"] = ["LlamaForCausalLM"]
+        c["attention_bias"] = cfg.qkv_bias
+    elif model_type == "mistral":
+        c["architectures"] = ["MistralForCausalLM"]
+        c["sliding_window"] = cfg.sliding_window or None
+    elif model_type == "qwen2":
+        c["architectures"] = ["Qwen2ForCausalLM"]
+    elif model_type == "internlm":
+        c["architectures"] = ["InternLMForCausalLM"]
+        c["bias"] = cfg.qkv_bias
+    _write_config(save_dir, c)
+    _save(sd, save_dir, dtype)
+
+
+def _export_gpt_neox(cfg, params, save_dir, dtype):
+    H, hd = cfg.n_head, cfg.d_head
+    D = cfg.d_model
+    sd = {}
+    pre = "gpt_neox."
+    sd[pre + "embed_in.weight"] = params["wte"]
+    sd[pre + "final_layer_norm.weight"] = params["norm_f"]
+    sd[pre + "final_layer_norm.bias"] = params["norm_f_b"]
+    sd["embed_out.weight"] = params["wte"] if cfg.tie_embeddings \
+        else params["lm_head"]
+
+    def interleave(q, k, v):
+        """inverse of the loader's per-head de-interleave: stack
+        (..., D) x3 -> (..., H, 3, hd) -> (..., 3D)"""
+        parts = [np.asarray(t, np.float32).reshape(
+            *t.shape[:-1], H, 1, hd) for t in (q, k, v)]
+        t = np.concatenate(parts, axis=-2)
+        return t.reshape(*t.shape[:-3], 3 * D)
+
+    for i in range(cfg.n_layer):
+        e = _unstack(params["blocks"], i)
+        lp = f"{pre}layers.{i}."
+        sd[lp + "attention.query_key_value.weight"] = interleave(
+            e["wq"], e["wk"], e["wv"]).T
+        sd[lp + "attention.query_key_value.bias"] = interleave(
+            e["bq"], e["bk"], e["bv"])
+        sd[lp + "attention.dense.weight"] = np.asarray(e["wo"]).T
+        sd[lp + "attention.dense.bias"] = e["bo"]
+        sd[lp + "mlp.dense_h_to_4h.weight"] = np.asarray(e["wup"]).T
+        sd[lp + "mlp.dense_h_to_4h.bias"] = e["bup"]
+        sd[lp + "mlp.dense_4h_to_h.weight"] = np.asarray(e["wdown"]).T
+        sd[lp + "mlp.dense_4h_to_h.bias"] = e["bdown"]
+        sd[lp + "input_layernorm.weight"] = e["rms1"]
+        sd[lp + "input_layernorm.bias"] = e["b1"]
+        sd[lp + "post_attention_layernorm.weight"] = e["rms2"]
+        sd[lp + "post_attention_layernorm.bias"] = e["b2"]
+    _write_config(save_dir, {
+        "model_type": "gpt_neox", "architectures": ["GPTNeoXForCausalLM"],
+        "vocab_size": cfg.vocab_size,
+        "max_position_embeddings": cfg.max_seq_len,
+        "hidden_size": cfg.d_model, "intermediate_size": cfg.ffn_dim,
+        "num_hidden_layers": cfg.n_layer,
+        "num_attention_heads": cfg.n_head,
+        "rotary_pct": cfg.rotary_pct, "rotary_emb_base": cfg.rope_theta,
+        "layer_norm_eps": cfg.rms_eps,
+        "use_parallel_residual": cfg.parallel_block,
+        "hidden_act": "gelu" if cfg.mlp_act == "gelu" else "gelu_new",
+        "tie_word_embeddings": cfg.tie_embeddings, "torch_dtype": dtype,
+    })
+    _save(sd, save_dir, dtype)
+
+
+def export_hf(model, params, save_dir, dtype="bfloat16"):
+    """Write ``params`` of ``model`` as an HF checkpoint directory.
+    Dispatches on the model's config class. params may be jax or numpy
+    arrays (jax arrays must be fully addressable — consolidate first)."""
+    from ..models.gpt2 import GPT2Config
+    from ..models.opt import OPTConfig
+    from ..models.llama import LlamaConfig
+    from ..models.qwen import QwenConfig
+    from ..models.internlm import InternLMConfig
+    from ..models.gpt_neox import GPTNeoXConfig
+    cfg = model.config
+    params = _to_host(params)
+    if isinstance(cfg, OPTConfig):
+        _export_opt(cfg, params, save_dir, dtype)
+    elif isinstance(cfg, GPT2Config) and type(cfg) is GPT2Config:
+        _export_gpt2(cfg, params, save_dir, dtype)
+    elif isinstance(cfg, GPTNeoXConfig):
+        _export_gpt_neox(cfg, params, save_dir, dtype)
+    elif isinstance(cfg, QwenConfig):
+        _export_llama_like(cfg, params, save_dir, dtype, "qwen2")
+    elif isinstance(cfg, InternLMConfig):
+        _export_llama_like(cfg, params, save_dir, dtype, "internlm")
+    elif isinstance(cfg, LlamaConfig) and type(cfg) is LlamaConfig:
+        mt = "mistral" if cfg.sliding_window else "llama"
+        _export_llama_like(cfg, params, save_dir, dtype, mt)
+    else:
+        raise ValueError(
+            f"no HF exporter for config {type(cfg).__name__}; supported: "
+            f"GPT2, OPT, Llama/Mistral, Qwen, InternLM, GPTNeoX")

@@ -10,3 +10,7 @@ from .qwen import Qwen, QwenConfig, QWEN_PRESETS
 from .phi import Phi, PhiConfig, PHI_PRESETS
 from .falcon import Falcon, FalconConfig, FALCON_PRESETS
 from .opt import OPT, OPTConfig, OPT_PRESETS
+from .gptj import GPTJ, GPTJConfig, GPTJ_PRESETS
+from .gpt_neo import GPTNeo, GPTNeoConfig, GPTNEO_PRESETS
+from .gpt_neox import GPTNeoX, GPTNeoXConfig, GPTNEOX_PRESETS
+from .internlm import InternLM, InternLMConfig, INTERNLM_PRESETS

@@ -22,6 +22,7 @@ from .llama import Llama, LlamaConfig
 class FalconConfig(LlamaConfig):
     parallel_block: bool = True
     mlp_gated: bool = False              # plain gelu MLP
+    mlp_act: str = "gelu"                # HF FalconMLP: exact-erf nn.GELU
     norm_type: str = "ln"                # LayerNorm with bias
     n_kv_heads: int = 1                  # multi-query attention
     vocab_size: int = 65024

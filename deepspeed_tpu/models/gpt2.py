@@ -33,7 +33,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..utils.groups import BATCH_AXES
-from .common import (chunked_softmax_xent, constrain_fn, next_token_xent,
+from .common import (chunked_softmax_xent, constrain_fn, fused_linear_xent,
+                     next_token_xent,
                      resolve_remat_policy)
 
 
@@ -77,11 +78,23 @@ class GPT2Config:
     # under remat so the full (B, T, V) fp32 logits never materialize
     # (0 = off). Big-vocab memory saver; exact same loss value.
     loss_chunk: int = 0
+    # fused linear+CE with gradients computed IN FORWARD (the scalar-loss
+    # custom_vjp trick — common.fused_linear_xent): removes the backward
+    # logits-recompute matmul and a softmax pass vs the remat'd chunked
+    # path. Requires loss_chunk > 0; same loss value.
+    fused_loss: bool = False
     # lax.scan unroll over layers (1 = compact single-block program;
     # higher trades compile time/code size for cross-layer overlap)
     scan_unroll: int = 1
     # MLP activation: 'gelu' (gpt2) or 'relu' (opt)
     activation: str = "gelu"
+    # gpt-neo knobs (reference module_inject/containers/gptneo.py):
+    # scale_attn=False — HF GPT-Neo does NOT divide scores by sqrt(hd);
+    # attn_layer_windows — per-layer sliding window from the config's
+    # attention_types pattern (0 = global); non-empty forces the dense
+    # attention path (the window is a per-layer scan operand)
+    scale_attn: bool = True
+    attn_layer_windows: tuple = ()
     # fused one-pass LayerNorm Pallas kernel (ops/pallas/layernorm.py;
     # reference csrc/transformer/normalize_kernels.cu). Measured SLOWER
     # than XLA's fused jnp layernorm inside the 350M training step (the
@@ -236,6 +249,12 @@ class GPT2:
         if cfg.n_layer < 3:
             raise ValueError("random-LTD needs n_layer >= 3 (first and "
                              "last blocks stay full-sequence)")
+        if cfg.attn_layer_windows:
+            # windowed distances are undefined over LTD's gathered
+            # (non-contiguous) token subsets — refuse loudly rather than
+            # silently train all layers global
+            raise ValueError("random-LTD is not supported with per-layer "
+                             "local attention windows (attn_layer_windows)")
         T = input_ids.shape[1]
         x = self.embed(params, input_ids, rng=rng, train=train,
                        constrain=constrain, act_spec=act_spec)
@@ -301,12 +320,19 @@ class GPT2:
         # causal mask built once; fp32 scores
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
 
-        def block(x, layer, lrng):
+        def block(x, layer, lrng, window=None):
             return self.block_forward(x, layer, lrng, causal=causal,
                                       constrain=constrain, act_spec=act_spec,
-                                      seq_sharded=seq_sharded, train=train)
+                                      seq_sharded=seq_sharded, train=train,
+                                      window=window)
 
         block_fn = block
+        if cfg.attn_layer_windows and cfg.remat \
+                and cfg.remat_policy == "split_attn":
+            raise ValueError(
+                "attn_layer_windows is not supported with "
+                "remat_policy='split_attn' (the split block does not "
+                "thread the per-layer window)")
         if cfg.remat and cfg.remat_policy == "split_attn":
             # jax NEVER stores custom_vjp residuals across a checkpoint
             # inside scan — a whole-block remat re-runs the flash forward
@@ -337,13 +363,27 @@ class GPT2:
         layer_rngs = jax.random.split(
             rng if rng is not None else jax.random.key(0), cfg.n_layer)
 
-        def scan_body(carry, xs):
-            layer, lrng = xs
-            x, aux = block_fn(carry, layer, lrng)
-            return x, aux
+        if cfg.attn_layer_windows:
+            # per-layer local windows ride the scan as an operand (not a
+            # param: the optimizer never sees them)
+            windows = jnp.asarray(cfg.attn_layer_windows, jnp.int32)
 
-        x, auxs = lax.scan(scan_body, x, (params["blocks"], layer_rngs),
-                           unroll=cfg.scan_unroll)
+            def scan_body(carry, xs):
+                layer, lrng, w = xs
+                x, aux = block_fn(carry, layer, lrng, w)
+                return x, aux
+
+            x, auxs = lax.scan(scan_body, x,
+                               (params["blocks"], layer_rngs, windows),
+                               unroll=cfg.scan_unroll)
+        else:
+            def scan_body(carry, xs):
+                layer, lrng = xs
+                x, aux = block_fn(carry, layer, lrng)
+                return x, aux
+
+            x, auxs = lax.scan(scan_body, x, (params["blocks"], layer_rngs),
+                               unroll=cfg.scan_unroll)
         if return_hidden:
             return x, jnp.sum(auxs)
         return self.head(params, x), jnp.sum(auxs)
@@ -426,15 +466,25 @@ class GPT2:
         return qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
     def block_attn(self, q, kk, v, *, causal, constrain, seq_sharded,
-                   force_dense=False):
+                   force_dense=False, window=None):
         """Attention backend dispatch: (B, T, H, hd) x3 -> (B, T, H, hd).
         ``causal`` may carry a batch dim (B, t, s) — the random-LTD
         middle segment attends gathered (non-contiguous) positions, which
-        also forces the dense path (``force_dense``)."""
+        also forces the dense path (``force_dense``). ``window``: traced
+        per-layer sliding window (gpt-neo local attention; 0 = global),
+        dense path only."""
         cfg = self.config
         dt = _dtype(cfg)
+        if window is not None and causal.ndim == 2:
+            T_ = causal.shape[-1]
+            qp, kp = jnp.arange(T_)[:, None], jnp.arange(T_)[None, :]
+            causal = causal & ((window == 0) | (qp - kp < window))
         if (seq_sharded and cfg.attention_backend == "ring"
                 and not jax.sharding.get_abstract_mesh().empty):
+            if window is not None or not cfg.scale_attn:
+                raise ValueError(
+                    "ring attention supports neither per-layer local "
+                    "windows nor unscaled (gpt-neo) scores")
             # context parallel: KV rotates the 'seq' ring (ppermute)
             from ..sequence.ring import ring_attention_sharded
             attn = ring_attention_sharded(
@@ -452,6 +502,7 @@ class GPT2:
             v = constrain(v, head_spec)
             attn = flash_attention(
                 q, kk, v, causal=True,
+                scale=None if cfg.scale_attn else 1.0,
                 block_q=cfg.flash_block_q,
                 block_k=cfg.flash_block_k,
                 block_h=cfg.flash_block_h,
@@ -473,7 +524,8 @@ class GPT2:
 
             scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                                 preferred_element_type=jnp.float32)
-            scores = scores / math.sqrt(self.config.d_head)
+            if cfg.scale_attn:
+                scores = scores / math.sqrt(self.config.d_head)
             mask = causal[None, None] if causal.ndim == 2 \
                 else causal[:, None]
             scores = jnp.where(mask, scores, -1e30)
@@ -519,19 +571,23 @@ class GPT2:
         return x, aux
 
     def block_forward(self, x, layer, lrng, *, causal, constrain, act_spec,
-                      seq_sharded, train):
+                      seq_sharded, train, window=None):
         """One transformer block: (B, T, D) -> (B, T, D), plus aux loss.
         Shared by the dense scan path and the pipelined executor
         (models/gpt2_pipe.py)."""
         from ..ops.int8_weights import dequant_tree
         layer = dequant_tree(layer, _dtype(self.config))
-        force_dense = causal.ndim != 2      # random-LTD gathered mask
+        # dense path for: random-LTD gathered masks and per-layer local
+        # windows (a traced scan operand cannot pick a kernel per layer);
+        # unscaled gpt-neo attention keeps the flash kernel via its
+        # scale input
+        force_dense = causal.ndim != 2 or window is not None
         hm = self.config.flash_on and not seq_sharded and not force_dense
         q, kk, v = self.block_qkv(x, layer, constrain=constrain,
                                   act_spec=act_spec, heads_major=hm)
         attn = self.block_attn(q, kk, v, causal=causal, constrain=constrain,
                                seq_sharded=seq_sharded,
-                               force_dense=force_dense)
+                               force_dense=force_dense, window=window)
         return self.block_post(x, attn, layer, lrng, constrain=constrain,
                                act_spec=act_spec, seq_sharded=seq_sharded,
                                train=train, heads_major=hm)
@@ -597,13 +653,14 @@ class GPT2:
         return x + mlp_out, carry
 
     def block_forward_cached(self, x, layer, k_cache, v_cache, slot,
-                             valid_mask):
+                             valid_mask, window=None):
         """One block over new tokens with a KV cache.
 
         x: (B, T, D) new-token activations, written at cache slots
         [slot, slot+T). k_cache/v_cache: (B, Tmax, H, hd).
         valid_mask: (B, Tmax) bool — True where the cache holds a real
         token AFTER this write (left-padded prompts carry False slots).
+        ``window``: traced per-layer local window (gpt-neo; 0 = global).
         Returns (x_out, k_cache, v_cache).
         """
         cfg = self.config
@@ -619,12 +676,15 @@ class GPT2:
                                           (0, slot, 0, 0))
             scores = jnp.einsum("bthd,bshd->bhts", q, kc,
                                 preferred_element_type=jnp.float32)
-            scores = scores / math.sqrt(hd)
+            if cfg.scale_attn:
+                scores = scores / math.sqrt(hd)
             # slot-causal: query at slot s_q = slot+t sees slots s <= s_q
             # that hold valid tokens (pads masked out forever)
             s_idx = jnp.arange(Tmax)[None, None, None, :]
             q_idx = (slot + jnp.arange(T))[None, None, :, None]
             mask = (s_idx <= q_idx) & valid_mask[:, None, None, :]
+            if window is not None:
+                mask = mask & ((window == 0) | (q_idx - s_idx < window))
             scores = jnp.where(mask, scores, -1e30)
             probs = jax.nn.softmax(scores, axis=-1).astype(dt)
             return jnp.einsum("bhts,bshd->bthd", probs, vc), (kc, vc)
@@ -646,14 +706,26 @@ class GPT2:
         x = (params["wte"][input_ids]
              + params["wpe"][pos_ids]).astype(_dtype(self.config))
 
-        def body(carry, xs):
-            layer, kc, vc = xs
-            y, kc, vc = self.block_forward_cached(carry, layer, kc, vc,
-                                                  slot, valid_mask)
-            return y, (kc, vc)
+        if self.config.attn_layer_windows:
+            windows = jnp.asarray(self.config.attn_layer_windows, jnp.int32)
 
-        x, (kc, vc) = lax.scan(body, x,
-                               (params["blocks"], cache["k"], cache["v"]))
+            def body(carry, xs):
+                layer, kc, vc, w = xs
+                y, kc, vc = self.block_forward_cached(carry, layer, kc, vc,
+                                                      slot, valid_mask, w)
+                return y, (kc, vc)
+
+            x, (kc, vc) = lax.scan(
+                body, x, (params["blocks"], cache["k"], cache["v"], windows))
+        else:
+            def body(carry, xs):
+                layer, kc, vc = xs
+                y, kc, vc = self.block_forward_cached(carry, layer, kc, vc,
+                                                      slot, valid_mask)
+                return y, (kc, vc)
+
+            x, (kc, vc) = lax.scan(body, x,
+                                   (params["blocks"], cache["k"], cache["v"]))
         if last_token_only:
             x = x[:, -1:]
         return self.head(params, x), {"k": kc, "v": vc}
@@ -707,13 +779,16 @@ class GPT2:
         valid = (jnp.arange(T) < length)
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
         mask = causal & valid[None, :]
+        qp, kp = jnp.arange(T)[:, None], jnp.arange(T)[None, :]
 
         ks_out, vs_out = [], []
         for i in range(cfg.n_layer):
             layer = self._layer_slice(params, i)
             kc0, vc0 = cache["k"][i], cache["v"][i]
+            w = cfg.attn_layer_windows[i] if cfg.attn_layer_windows else 0
+            m = mask & (qp - kp < w) if w else mask
 
-            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0):
+            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0, m=m):
                 # in-place scatter on this layer's own donated pool buffer
                 kc = kc0.at[token_blocks, :, token_offsets].set(
                     kk[0].astype(kc0.dtype))
@@ -721,8 +796,9 @@ class GPT2:
                     v[0].astype(vc0.dtype))
                 scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                                     preferred_element_type=jnp.float32)
-                scores = scores / math.sqrt(hd)
-                scores = jnp.where(mask[None, None], scores, -1e30)
+                if cfg.scale_attn:
+                    scores = scores / math.sqrt(hd)
+                scores = jnp.where(m[None, None], scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1).astype(dt)
                 return jnp.einsum("bhts,bshd->bthd", probs, v), (kc, vc)
 
@@ -755,8 +831,10 @@ class GPT2:
         for i in range(cfg.n_layer):
             layer = self._layer_slice(params, i)
             kc0, vc0 = cache["k"][i], cache["v"][i]
+            w = cfg.attn_layer_windows[i] if cfg.attn_layer_windows else 0
+            m = mask & (q_pos - k_pos < w) if w else mask
 
-            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0):
+            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0, m=m):
                 kc = kc0.at[token_blocks, :, token_offsets].set(
                     kk[0].astype(kc0.dtype))
                 vc = vc0.at[token_blocks, :, token_offsets].set(
@@ -765,8 +843,9 @@ class GPT2:
                 gv = vc[table].transpose(0, 2, 1, 3).reshape(S, H, hd)
                 scores = jnp.einsum("bthd,shd->bhts", q, gk,
                                     preferred_element_type=jnp.float32)
-                scores = scores / math.sqrt(hd)
-                scores = jnp.where(mask[None, None], scores, -1e30)
+                if cfg.scale_attn:
+                    scores = scores / math.sqrt(hd)
+                scores = jnp.where(m[None, None], scores, -1e30)
                 probs = jax.nn.softmax(scores, axis=-1).astype(dt)
                 return jnp.einsum("bhts,shd->bthd", probs, gv), (kc, vc)
 
@@ -801,8 +880,9 @@ class GPT2:
         for i in range(cfg.n_layer):
             layer = self._layer_slice(params, i)
             kc0, vc0 = cache["k"][i], cache["v"][i]
+            w = cfg.attn_layer_windows[i] if cfg.attn_layer_windows else 0
 
-            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0):
+            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0, w=w):
                 # q/kk/v: (B, 1, H, hd) — the single new token per slot.
                 # In-place write into this layer's donated pool, then the
                 # Pallas paged kernel reads K/V straight through the block
@@ -815,7 +895,8 @@ class GPT2:
                 vc = vc0.at[dst_block, :, dst_off].set(
                     v[:, 0].astype(vc0.dtype))
                 attn = paged_decode_attention(
-                    q[:, 0], kc, vc, block_tables, lengths)
+                    q[:, 0], kc, vc, block_tables, lengths,
+                    scale=None if cfg.scale_attn else 1.0, window=w)
                 return attn[:, None], (kc, vc)
 
             x, (kc, vc) = self._block_core(x, layer, attn_fn)
@@ -840,8 +921,8 @@ class GPT2:
                                      train=train, constrain=constrain,
                                      act_spec=act_spec)
             if chunk and T - 1 > chunk:
-                return chunked_softmax_xent(
-                    self.head, params, x[:, :-1], ids[:, 1:], chunk) \
+                return self._chunked_head_loss(params, x[:, :-1],
+                                               ids[:, 1:], chunk) \
                     + self.moe_loss_coeff * aux
             return next_token_xent(self.head(params, x), ids) \
                 + self.moe_loss_coeff * aux
@@ -852,12 +933,25 @@ class GPT2:
             x, aux = self.apply_with_aux(params, ids, rng=rng, train=train,
                                          seq_sharded=seq_sharded,
                                          return_hidden=True)
-            return chunked_softmax_xent(
-                self.head, params, x[:, :-1], ids[:, 1:], chunk) \
+            return self._chunked_head_loss(params, x[:, :-1], ids[:, 1:],
+                                           chunk) \
                 + self.moe_loss_coeff * aux
         logits, aux = self.apply_with_aux(params, ids, rng=rng, train=train,
                                           seq_sharded=seq_sharded)
         return next_token_xent(logits, ids) + self.moe_loss_coeff * aux
+
+    # head leaves the fused-CE d_params accumulator tracks (the subset
+    # ``head`` reads; see common.fused_linear_xent)
+    _head_keys = ("wte", "lnf_scale", "lnf_bias")
+
+    def _chunked_head_loss(self, params, hidden, targets, chunk):
+        """Dispatch the big-vocab head: fused grad-in-forward CE when
+        cfg.fused_loss, else the remat'd chunked path."""
+        if self.config.fused_loss:
+            hp = {k: params[k] for k in self._head_keys}
+            return fused_linear_xent(self.head, chunk, hp, hidden, targets)
+        return chunked_softmax_xent(self.head, params, hidden, targets,
+                                    chunk)
 
 
 

@@ -32,6 +32,15 @@ class GPT2Pipe(GPT2):
     mesh has pipe > 1 (falls back to the dense scan otherwise, so one model
     object serves any topology)."""
 
+    def __init__(self, config):
+        if config.attn_layer_windows:
+            # the pipelined executors do not thread the per-layer window
+            # operand; refuse loudly rather than silently attend globally
+            raise ValueError(
+                "attn_layer_windows (gpt-neo local attention) is not "
+                "supported by the pipelined executor")
+        super().__init__(config)
+
     def partition_specs(self, topology=None):
         specs = super().partition_specs(topology)
         pipe = 1

@@ -1,0 +1,47 @@
+"""GPT-Neo family — gpt2-style blocks, unscaled attention, local layers.
+
+Counterpart of the reference's GPT-Neo injection support
+(module_inject/containers/gptneo.py, HFGPTNEOLayerPolicy). On the GPT2
+family (learned positions, sequential LN blocks, tied unembed) with two
+quirks expressed as GPT2Config knobs:
+
+  * ``scale_attn=False`` — HF GPT-Neo never divides scores by
+    sqrt(head_dim) (modeling_gpt_neo.py GPTNeoSelfAttention);
+  * ``attn_layer_windows`` — the config's ``attention_types`` pattern
+    alternates global and LOCAL (sliding-window, ``window_size``)
+    attention per layer; the per-layer window rides the layer scan as an
+    operand (0 = global).
+
+q/k/v projections carry no bias (loaded as zero rows of the fused
+bqkv); out_proj and the MLP are biased, weights are nn.Linear (out, in)
+— transposed at load, unlike gpt2's Conv1D.
+"""
+
+from dataclasses import dataclass
+
+from .gpt2 import GPT2, GPT2Config
+
+
+@dataclass(frozen=True)
+class GPTNeoConfig(GPT2Config):
+    scale_attn: bool = False
+
+
+GPTNEO_TINY = GPTNeoConfig(n_layer=2, n_head=4, d_model=128,
+                           max_seq_len=128, vocab_size=512, remat=False,
+                           attn_layer_windows=(0, 64))
+# gpt-neo-1.3B point (24 layers alternating global/local window 256)
+GPTNEO_1_3B = GPTNeoConfig(n_layer=24, n_head=16, d_model=2048,
+                           max_seq_len=2048, vocab_size=50257,
+                           attn_layer_windows=tuple(
+                               0 if i % 2 == 0 else 256
+                               for i in range(24)))
+
+GPTNEO_PRESETS = {"tiny": GPTNEO_TINY, "gpt-neo-1.3b": GPTNEO_1_3B}
+
+
+class GPTNeo(GPT2):
+    """GPT-Neo on the GPT2 machinery (see module docstring)."""
+
+    def __init__(self, config: GPTNeoConfig):
+        super().__init__(config)

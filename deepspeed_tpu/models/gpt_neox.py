@@ -1,0 +1,50 @@
+"""GPT-NeoX / Pythia family — biased everything, two-LN parallel residual.
+
+Counterpart of the reference's GPT-NeoX injection support
+(module_inject/containers/gptneox.py, megatron-style fused qkv). On the
+shared Llama knob system: LayerNorm with bias, partial rotary
+(rotary_pct, llama/neox half-split pairing), un-gated EXACT-erf gelu
+MLP, biases on qkv/dense/MLP but a plain (bias-free) untied embed_out,
+and the use_parallel_residual block: x + attn(ln1 x) + mlp(ln2 x) with
+TWO independent norms (unlike falcon-7b/gptj's shared one). Pythia
+variants with use_parallel_residual=False load as sequential blocks.
+
+The HF checkpoint's fused query_key_value is interleaved per head
+((H, 3, hd) rows); the converter de-interleaves (checkpoint/hf.py).
+"""
+
+from dataclasses import dataclass
+
+from .llama import Llama, LlamaConfig
+
+
+@dataclass(frozen=True)
+class GPTNeoXConfig(LlamaConfig):
+    norm_type: str = "ln"
+    mlp_gated: bool = False
+    mlp_act: str = "gelu"                # nn.GELU default: exact erf
+    qkv_bias: bool = True
+    o_bias: bool = True
+    mlp_bias: bool = True
+    head_bias: object = False            # embed_out has no bias
+    parallel_block: bool = True          # use_parallel_residual
+    rotary_pct: float = 0.25
+    vocab_size: int = 50432
+
+
+GPTNEOX_TINY = GPTNeoXConfig(n_layer=2, n_head=4, n_kv_heads=4,
+                             d_model=128, max_seq_len=128, vocab_size=512,
+                             remat=False)
+# gpt-neox-20b point (config.json: 44 layers, 64 heads, hidden 6144)
+GPTNEOX_20B = GPTNeoXConfig(n_layer=44, n_head=64, n_kv_heads=64,
+                            d_model=6144, d_ff=24576, max_seq_len=2048,
+                            vocab_size=50432)
+
+GPTNEOX_PRESETS = {"tiny": GPTNEOX_TINY, "gpt-neox-20b": GPTNEOX_20B}
+
+
+class GPTNeoX(Llama):
+    """GPT-NeoX on the shared Llama machinery (see module docstring)."""
+
+    def __init__(self, config: GPTNeoXConfig):
+        super().__init__(config)

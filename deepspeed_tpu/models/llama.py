@@ -23,7 +23,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..utils.groups import BATCH_AXES
-from .common import chunked_softmax_xent, constrain_fn, next_token_xent
+from .common import (chunked_softmax_xent, constrain_fn, fused_linear_xent,
+                     next_token_xent)
 
 
 @dataclass(frozen=True)
@@ -43,6 +44,8 @@ class LlamaConfig:
     tie_embeddings: bool = False
     # chunked cross entropy (see gpt2.GPT2Config.loss_chunk); 0 = off
     loss_chunk: int = 0
+    # grad-in-forward fused CE (common.fused_linear_xent); needs loss_chunk
+    fused_loss: bool = False
     # "auto" (default) = pallas flash kernel on TPU, dense elsewhere
     use_flash_attention: object = "auto"
     flash_block_q: int = 512
@@ -63,6 +66,21 @@ class LlamaConfig:
     # phi-style learned biases on the output projection, MLP and lm head
     # (adds bo/bup/bdown (+bgate) and lm_head_b params)
     proj_bias: bool = False
+    # granular bias knobs for families where proj_bias is too broad
+    # (reference module_inject/containers/{gptj,gptneox,internlm}.py):
+    #   o_bias    — bo only (internlm: qkv+o biased, MLP not)
+    #   mlp_bias  — bup/bdown (+bgate) only (gptj: fc biased, o not)
+    #   head_bias — lm_head bias; "auto" follows proj_bias (gptj: biased
+    #               head without o bias; gpt-neox: biased blocks, plain head)
+    o_bias: bool = False
+    mlp_bias: bool = False
+    head_bias: object = "auto"
+    # gptj rotate_every_two pairing: rotary pairs are (x0,x1),(x2,x3),...
+    # instead of the llama/neox half-split (x_i, x_{i+rot/2})
+    rotary_interleaved: bool = False
+    # non-gated MLP activation: 'gelu_tanh' (HF gelu_new — gptj/phi) or
+    # 'gelu' (exact erf gelu — gpt-neox/falcon nn.GELU default)
+    mlp_act: str = "gelu_tanh"
     # mistral sliding-window attention: queries attend only the last
     # ``sliding_window`` positions (0 = full causal). Honored by every
     # path: dense training, flash kernel, v1 cached decode, v2 paged
@@ -88,6 +106,19 @@ class LlamaConfig:
         return resolve_flash(self.use_flash_attention)
 
     @property
+    def o_bias_on(self):
+        return self.proj_bias or self.o_bias
+
+    @property
+    def mlp_bias_on(self):
+        return self.proj_bias or self.mlp_bias
+
+    @property
+    def head_bias_on(self):
+        return self.proj_bias if self.head_bias == "auto" \
+            else bool(self.head_bias)
+
+    @property
     def d_head(self):
         return self.d_model // self.n_head
 
@@ -105,12 +136,14 @@ class LlamaConfig:
                  + (3 if self.mlp_gated else 2) * D * F)
         if self.qkv_bias:
             block += D + 2 * kvd
-        if self.proj_bias:
-            block += 2 * D + F * (2 if self.mlp_gated else 1)
+        if self.o_bias_on:
+            block += D
+        if self.mlp_bias_on:
+            block += D + F * (2 if self.mlp_gated else 1)
         if self.norm_type == "ln":
             block += 2 * D                   # norm biases
         head = 0 if self.tie_embeddings else V * D
-        if self.proj_bias:
+        if self.head_bias_on:
             head += V
         extra_f = D if self.norm_type == "ln" else 0
         if self.embed_norm:
@@ -149,17 +182,26 @@ def _layer_norm(x, scale, bias, eps):
             + bias.astype(jnp.float32)).astype(x.dtype)
 
 
-def _rope(x, pos, theta):
-    """x: (..., T, H, hd) with positions pos (..., T) -> rotated."""
+def _rope(x, pos, theta, interleaved=False):
+    """x: (..., T, H, hd) with positions pos (..., T) -> rotated.
+
+    ``interleaved`` (gptj rotate_every_two, HF modeling_gptj.py): pairs
+    are adjacent lanes (x0,x1),(x2,x3),... instead of the llama/neox
+    half-split (x_i, x_{i+hd/2}). Frequencies are identical."""
     hd = x.shape[-1]
     half = hd // 2
     freqs = theta ** (-jnp.arange(0, half, dtype=jnp.float32) / half)
     angles = (pos.astype(jnp.float32)[..., None, None]
               * freqs[None, None, :])                  # (..., T, 1, half)
     cos, sin = jnp.cos(angles), jnp.sin(angles)
-    x1, x2 = x[..., :half], x[..., half:]
-    out = jnp.concatenate(
-        [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if interleaved:
+        x1, x2 = x[..., 0::2], x[..., 1::2]
+        out = jnp.stack([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                        axis=-1).reshape(x.shape)
+    else:
+        x1, x2 = x[..., :half], x[..., half:]
+        out = jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
     return out.astype(x.dtype)
 
 
@@ -216,12 +258,14 @@ class Llama:
             params["blocks"]["bq"] = jnp.zeros((L, D), dt)
             params["blocks"]["bk"] = jnp.zeros((L, kvd), dt)
             params["blocks"]["bv"] = jnp.zeros((L, kvd), dt)
-        if cfg.proj_bias:
+        if cfg.o_bias_on:
             params["blocks"]["bo"] = jnp.zeros((L, D), dt)
+        if cfg.mlp_bias_on:
             params["blocks"]["bup"] = jnp.zeros((L, F), dt)
             params["blocks"]["bdown"] = jnp.zeros((L, D), dt)
             if cfg.mlp_gated:
                 params["blocks"]["bgate"] = jnp.zeros((L, F), dt)
+        if cfg.head_bias_on:
             params["lm_head_b"] = jnp.zeros((V,), dt)
         if cfg.norm_type == "ln":
             params["blocks"]["b1"] = jnp.zeros((L, D), dt)
@@ -258,12 +302,14 @@ class Llama:
             specs["blocks"]["bq"] = P(None, "tensor")
             specs["blocks"]["bk"] = P(None, "tensor")
             specs["blocks"]["bv"] = P(None, "tensor")
-        if self.config.proj_bias:
+        if self.config.o_bias_on:
             specs["blocks"]["bo"] = P(None, None)
+        if self.config.mlp_bias_on:
             specs["blocks"]["bup"] = P(None, "tensor")
             specs["blocks"]["bdown"] = P(None, None)
             if self.config.mlp_gated:
                 specs["blocks"]["bgate"] = P(None, "tensor")
+        if self.config.head_bias_on:
             specs["lm_head_b"] = P()
         if self.config.norm_type == "ln":
             specs["blocks"]["b1"] = P(None, None)
@@ -298,7 +344,7 @@ class Llama:
             params["lm_head"]
         logits = jnp.einsum("btd,vd->btv", x, w,
                             preferred_element_type=jnp.float32)
-        if self.config.proj_bias:
+        if self.config.head_bias_on:
             logits = logits + params["lm_head_b"].astype(jnp.float32)
         return logits
 
@@ -325,12 +371,14 @@ class Llama:
         if cfg.alibi:
             return x
         pct = cfg.rotary_pct
+        il = cfg.rotary_interleaved
         if pct >= 1.0:
-            return _rope(x, pos, cfg.rope_theta)
+            return _rope(x, pos, cfg.rope_theta, interleaved=il)
         hd = x.shape[-1]
         rot = max(2, int(hd * pct)) // 2 * 2
         return jnp.concatenate(
-            [_rope(x[..., :rot], pos, cfg.rope_theta), x[..., rot:]],
+            [_rope(x[..., :rot], pos, cfg.rope_theta, interleaved=il),
+             x[..., rot:]],
             axis=-1)
 
     def _alibi_bias(self, k_pos):
@@ -357,21 +405,22 @@ class Llama:
         return mask & (q_pos - k_pos < w)
 
     def _wo(self, attn, layer):
-        """Output projection (+ phi-style bias when proj_bias)."""
+        """Output projection (+ bias when proj_bias/o_bias)."""
         out = attn @ layer["wo"]
-        if self.config.proj_bias:
+        if self.config.o_bias_on:
             out = out + layer["bo"]
         return out
 
     def _mlp(self, x, layer):
         cfg = self.config
         h = self._norm(x, layer, 2)
-        pb = cfg.proj_bias
+        pb = cfg.mlp_bias_on
         if not cfg.mlp_gated:                 # falcon/phi plain-gelu MLP
             u = h @ layer["wup"]
             if pb:
                 u = u + layer["bup"]
-            out = jax.nn.gelu(u) @ layer["wdown"]
+            act = jax.nn.gelu(u, approximate=cfg.mlp_act == "gelu_tanh")
+            out = act @ layer["wdown"]
             return out + layer["bdown"] if pb else out
         g = h @ layer["wgate"]
         u = h @ layer["wup"]
@@ -475,6 +524,17 @@ class Llama:
         return self.apply(params, input_ids, **kw), jnp.zeros((),
                                                               jnp.float32)
 
+    def _head_keys(self):
+        """Param leaves ``head`` reads (the fused-CE d_params subset)."""
+        cfg = self.config
+        keys = ["norm_f"]
+        if cfg.norm_type == "ln":
+            keys.append("norm_f_b")
+        keys.append("wte" if cfg.tie_embeddings else "lm_head")
+        if cfg.head_bias_on:
+            keys.append("lm_head_b")
+        return keys
+
     def loss(self, params, batch, *, rng=None, train=True,
              seq_sharded=False):
         ids = batch["input_ids"]
@@ -483,6 +543,10 @@ class Llama:
         if chunk and T - 1 > chunk and not seq_sharded:
             x = self.apply(params, ids, rng=rng, train=train,
                            seq_sharded=seq_sharded, return_hidden=True)
+            if self.config.fused_loss:
+                hp = {k: params[k] for k in self._head_keys()}
+                return fused_linear_xent(self.head, chunk, hp,
+                                         x[:, :-1], ids[:, 1:])
             return chunked_softmax_xent(self.head, params, x[:, :-1],
                                         ids[:, 1:], chunk)
         logits = self.apply(params, ids, rng=rng, train=train,

@@ -905,7 +905,14 @@ def _flash_fwd(q, k, v, biases, scale, causal, bq, bk, bh, t_real,
     lse_t = lse[..., :1]
     o = checkpoint_name(o, "flash_o")
     lse_t = checkpoint_name(lse_t, "flash_lse")
-    return (o, lse_t[..., 0]), (q, k, v, o, lse_t, biases)
+    # q/k/v named as residuals too: the 'save_flash_qkv' policy keeps
+    # them, so backward skips the ln1+qkv-projection recompute entirely
+    # (at +3x48 MB/layer saved residuals; policies not listing these
+    # names behave exactly as before)
+    qr = checkpoint_name(q, "flash_q")
+    kr = checkpoint_name(k, "flash_k")
+    vr = checkpoint_name(v, "flash_v")
+    return (o, lse_t[..., 0]), (qr, kr, vr, o, lse_t, biases)
 
 
 def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,

@@ -1051,6 +1051,32 @@ class DeepSpeedEngine:
         self.checkpoint_engine.shutdown()
         dist.barrier()
 
+    def save_16bit_model(self, save_dir, dtype=None):
+        """Consolidated HF export (reference engine.py:3625
+        ``save_16bit_model`` + utils/zero_to_fp32.py): write the CURRENT
+        model weights — whatever the ZeRO stage or mesh sharding — as a
+        standard HuggingFace checkpoint directory that ``transformers``
+        loads directly.
+
+        TPU-first: no per-rank partitioned files to stitch. The bf16
+        param tree already exists as global jax.Arrays; a single host
+        gather (process_allgather across hosts) consolidates it, and
+        rank 0 writes model.safetensors + config.json via
+        checkpoint/hf_export.py. Returns the save path (all ranks).
+        """
+        from ..checkpoint.hf_export import export_hf
+        params = self.state["params"]
+        if jax.process_count() > 1:
+            from jax.experimental import multihost_utils
+            params = multihost_utils.process_allgather(params, tiled=True)
+        else:
+            params = jax.tree.map(lambda a: np.asarray(a), params)
+        if jax.process_index() == 0:
+            export_hf(self.model, params, save_dir,
+                      dtype=dtype or jnp.dtype(self.param_dtype).name)
+        dist.barrier()
+        return save_dir
+
     def eval_loss(self, batch):
         batch = self._shard_batch(batch, with_gas_dim=False)
         with jax.set_mesh(self.mesh):

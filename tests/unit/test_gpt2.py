@@ -132,3 +132,30 @@ class TestChunkedLoss:
         err = max(float(jnp.abs(a - b).max())
                   for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)))
         assert err < 1e-4, err
+
+    def test_fused_loss_matches_dense(self):
+        """fused_loss (grad-in-forward CE) must match the dense path in
+        value AND gradient — including the wte leaf, whose cotangent sums
+        the embedding-path and unembed-path contributions."""
+        import jax
+        from dataclasses import replace
+        from deepspeed_tpu.models import GPT2, GPT2Config
+        base = GPT2Config(n_layer=2, n_head=2, d_model=32, max_seq_len=64,
+                          vocab_size=128, remat=False, dtype="float32")
+        ids = jnp.asarray(np.random.RandomState(1).randint(0, 128, (3, 64)),
+                          jnp.int32)
+        dense = GPT2(base)
+        params = dense.init(jax.random.key(2))
+        fused = GPT2(replace(base, loss_chunk=24, fused_loss=True))
+        l0, g0 = jax.value_and_grad(
+            lambda p: dense.loss(p, {"input_ids": ids}, train=False))(params)
+        l1, g1 = jax.jit(jax.value_and_grad(
+            lambda p: fused.loss(p, {"input_ids": ids}, train=False)))(params)
+        assert abs(float(l0) - float(l1)) < 1e-5, (float(l0), float(l1))
+        err = max(float(jnp.abs(a - b).max())
+                  for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)))
+        assert err < 1e-4, err
+        # eval (no-AD) primal path
+        le = float(jax.jit(lambda p: fused.loss(p, {"input_ids": ids},
+                                                train=False))(params))
+        assert abs(le - float(l0)) < 1e-5
