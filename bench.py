@@ -15,6 +15,14 @@ throughput is reported per chip.
 
 import json
 import os
+import sys
+
+# autotuning protocol (dstpu --autotuning, launcher/runner.py): a trial
+# passes its knobs as --exp '{"BENCH_MICRO_BS": 16, ...}'; they apply as
+# the equivalent env overrides BEFORE the bench reads them
+if "--exp" in sys.argv:
+    _exp = json.loads(sys.argv[sys.argv.index("--exp") + 1])
+    os.environ.update({k: str(v) for k, v in _exp.items()})
 
 # measured win on v5e at the 350M point (571 vs 577 ms/step): a 2x
 # scoped-VMEM budget lets XLA form deeper fusions; 40 MB+ regresses.
@@ -73,7 +81,11 @@ def main():
                   loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "512")),
                   # grad-in-forward fused CE (common.fused_linear_xent):
                   # kills the backward logits-recompute matmul
-                  fused_loss=os.environ.get("BENCH_FUSED_LOSS", "1") == "1")
+                  fused_loss=os.environ.get("BENCH_FUSED_LOSS", "1") == "1",
+                  # + Pallas unembed/online-stats kernel (fp32 logits
+                  # never in HBM)
+                  fused_loss_kernel=os.environ.get("BENCH_FUSED_LOSS_KERNEL",
+                                                   "1") == "1")
     model = GPT2(cfg)
 
     n_dev = len(jax.devices())

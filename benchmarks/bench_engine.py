@@ -1,0 +1,57 @@
+"""Shared engine setup for the bench-adjacent tools (profile_step,
+hlo_dump): ONE place reads the BENCH_* env knobs and builds the exact
+engine/batch `bench.py` measures, so the tools can never drift from the
+measured config."""
+
+import os
+
+os.environ.setdefault("LIBTPU_INIT_ARGS",
+                      "--xla_tpu_scoped_vmem_limit_kib=32768")
+
+import numpy as np  # noqa: E402
+
+
+def build_bench_engine():
+    """Returns (engine, batch) for the headline bench config, honoring
+    the same BENCH_* env knobs as bench.py."""
+    import jax  # noqa: F401  (device init after LIBTPU_INIT_ARGS)
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2, PRESETS
+    from deepspeed_tpu.utils import groups
+    from dataclasses import replace
+
+    preset = os.environ.get("BENCH_PRESET", "350M")
+    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+    micro = int(os.environ.get("BENCH_MICRO_BS", "24"))
+    cfg = replace(
+        PRESETS[preset], max_seq_len=seq_len,
+        use_flash_attention=os.environ.get("BENCH_FLASH", "1") == "1",
+        flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", "1024")),
+        flash_block_k=int(os.environ.get("BENCH_FLASH_BK", "1024")),
+        flash_block_h=int(os.environ.get("BENCH_FLASH_BH", "1")),
+        remat=os.environ.get("BENCH_REMAT", "1") == "1",
+        remat_policy=os.environ.get("BENCH_REMAT_POLICY", "save_flash"),
+        loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "512")),
+        fused_loss=os.environ.get("BENCH_FUSED_LOSS", "1") == "1",
+        fused_loss_kernel=os.environ.get("BENCH_FUSED_LOSS_KERNEL",
+                                         "1") == "1")
+    model = GPT2(cfg)
+    groups.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model,
+        config={
+            "train_micro_batch_size_per_gpu": micro,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 0,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 2e-4, "weight_decay": 0.01}},
+            "gradient_clipping": 1.0,
+            "bf16": {"enabled": True},
+            "zero_optimization": {
+                "stage": int(os.environ.get("BENCH_ZERO_STAGE", "2"))},
+        })
+    bsz = engine.config.train_batch_size
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, cfg.vocab_size, (bsz, seq_len))
+             .astype(np.int32)}
+    return engine, batch

@@ -2,56 +2,23 @@
 fusions (to map trace op names back to computation bodies).
 
 Usage: python benchmarks/hlo_dump.py fusion.485 fusion.486 add_add_fusion.2
+Honors the same BENCH_* env knobs as bench.py (benchmarks/bench_engine.py).
 """
 
 import os
+import re
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
-os.environ.setdefault("LIBTPU_INIT_ARGS",
-                      "--xla_tpu_scoped_vmem_limit_kib=32768")
-
-import numpy as np
-import jax
-
-import deepspeed_tpu
-from deepspeed_tpu.models import GPT2, PRESETS
-from deepspeed_tpu.utils import groups
+from bench_engine import build_bench_engine  # noqa: E402
 
 
 def main():
     names = [a for a in sys.argv[1:] if not a.startswith("-")]
-    preset = os.environ.get("BENCH_PRESET", "350M")
-    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
-    micro = int(os.environ.get("BENCH_MICRO_BS", "24"))
-    cfg = PRESETS[preset]
-    from dataclasses import replace
-    cfg = replace(cfg, max_seq_len=seq_len, use_flash_attention=True,
-                  flash_block_q=1024, flash_block_k=1024, flash_block_h=1,
-                  remat=True,
-                  remat_policy=os.environ.get("BENCH_REMAT_POLICY",
-                                              "save_flash"),
-                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "512")),
-                  fused_loss=os.environ.get("BENCH_FUSED_LOSS", "1") == "1")
-    model = GPT2(cfg)
-    groups.reset()
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model,
-        config={
-            "train_micro_batch_size_per_gpu": micro,
-            "gradient_accumulation_steps": 1,
-            "steps_per_print": 0,
-            "optimizer": {"type": "AdamW",
-                          "params": {"lr": 2e-4, "weight_decay": 0.01}},
-            "gradient_clipping": 1.0,
-            "bf16": {"enabled": True},
-            "zero_optimization": {"stage": 2},
-        })
-    bsz = engine.config.train_batch_size
-    rng = np.random.RandomState(0)
-    batch = {"input_ids": rng.randint(0, cfg.vocab_size, (bsz, seq_len))
-             .astype(np.int32)}
+    import jax
+    engine, batch = build_bench_engine()
     batch = jax.tree.map(engine._add_gas_dim, batch)
     batch = engine._shard_batch(batch, with_gas_dim=True)
     with jax.set_mesh(engine.mesh):
@@ -62,13 +29,10 @@ def main():
     with open(out, "w") as f:
         f.write(txt)
     print(f"HLO written to {out} ({len(txt)} bytes)")
-    if names:
-        import re
-        for name in names:
-            # print the fusion computation the instruction calls
-            pat = re.compile(rf'^\s*%?{re.escape(name)} = .*$', re.M)
-            for m in pat.finditer(txt):
-                print("==== instr:", m.group(0)[:400])
+    for name in names:
+        pat = re.compile(rf'^\s*%?{re.escape(name)} = .*$', re.M)
+        for m in pat.finditer(txt):
+            print("==== instr:", m.group(0)[:400])
 
 
 if __name__ == "__main__":

@@ -106,6 +106,24 @@ def _block_sparse(rng):
     _close(out, ref, "block-sparse fwd")
 
 
+def _fused_ce(rng):
+    from deepspeed_tpu.ops.pallas.fused_ce import unembed_logits_stats
+    N, D, V = 256, 128, 1000     # V deliberately not a block multiple
+    ks = jax.random.split(rng, 3)
+    h = jax.random.normal(ks[0], (N, D), jnp.bfloat16)
+    w = jax.random.normal(ks[1], (V, D), jnp.bfloat16)
+    t = jax.random.randint(ks[2], (N,), 0, V, jnp.int32)
+    logits, logz, gold = unembed_logits_stats(h, w, t, block_m=128,
+                                              block_n=256,
+                                              interpret=False)
+    ref = jnp.einsum("nd,vd->nv", h, w,
+                     preferred_element_type=jnp.float32)
+    _close(logits, ref.astype(jnp.bfloat16), "fused-ce logits")
+    _close(logz, jax.nn.logsumexp(ref, axis=-1), "fused-ce logz")
+    _close(gold, jnp.take_along_axis(ref, t[:, None], axis=1)[:, 0],
+           "fused-ce gold")
+
+
 def _quant(rng):
     from deepspeed_tpu.ops.pallas.quantization import (
         dequantize_blockwise, quantize_blockwise)
@@ -124,11 +142,12 @@ def run(seed=0):
     """Run all kernel parity checks on the default backend. Returns
     'ok' or raises with the failing kernel named."""
     rng = jax.random.key(seed)
-    rngs = jax.random.split(rng, 4)
+    rngs = jax.random.split(rng, 5)
     _flash(rngs[0])
     _paged(rngs[1])
     _block_sparse(rngs[2])
     _quant(rngs[3])
+    _fused_ce(rngs[4])
     return "ok"
 
 

@@ -33,7 +33,7 @@ from ...utils import groups
 from ...utils.groups import TopologyConfig
 from ...utils.logging import log_dist
 from ..utils import shard_params
-from .ragged import DSStateManager
+from .ragged import DSStateManager, RaggedBatchWrapper
 
 
 @dataclass
@@ -41,6 +41,11 @@ class RaggedInferenceEngineConfig:
     """Reference config_v2.py RaggedInferenceEngineConfig (condensed)."""
     dtype: str = "bfloat16"
     tensor_parallel: int = 1
+    # EP-sharded MoE serving (reference module_inject/layers.py EP+TP
+    # inference MoE): experts shard over the 'expert' mesh axis in the
+    # decode/prefill programs (Mixtral partition_specs put moe_w* on
+    # ('expert', 'tensor'))
+    expert_parallel: int = 1
     max_batch_size: int = 8          # concurrent sequences
     kv_block_size: int = 64
     num_kv_blocks: int = 0           # 0 = auto from max_seq_len * max_batch
@@ -63,6 +68,14 @@ class RaggedInferenceEngineConfig:
     # per-channel scales, dequantized one layer at a time in-program —
     # ~2x weight-capacity over bf16, serving models bf16 cannot fit
     quantize_weights: bool = False
+    # ZeRO-Inference KV host offload (reference README.md:30 "and
+    # KV-cache offload"): the logical block space lives in host RAM,
+    # the device holds an LRU-cached pool of device_kv_blocks slots;
+    # decode dispatches run in groups whose working set fits the pool,
+    # with the next group's H2D uploads prefetched under the current
+    # group's compute (inference/v2/kv_offload.py)
+    kv_host_offload: bool = False
+    device_kv_blocks: int = 0        # required > 1 when kv_host_offload
 
 
 @dataclass
@@ -92,7 +105,8 @@ class InferenceEngineV2:
 
         if topology is None:
             topology = groups.initialize(TopologyConfig(
-                tensor_parallel_size=config.tensor_parallel))
+                tensor_parallel_size=config.tensor_parallel,
+                expert_parallel_size=config.expert_parallel))
         self.topology = topology
         self.mesh = topology.mesh
 
@@ -114,9 +128,21 @@ class InferenceEngineV2:
             lambda s: NamedSharding(self.mesh, s), model.paged_cache_specs(),
             is_leaf=lambda x: isinstance(x, P))
         self._cache_sh = cache_sh
+        self.kv_pool = None
+        device_blocks = num_blocks
+        if config.kv_host_offload:
+            if config.device_kv_blocks < 2:
+                raise ValueError(
+                    "kv_host_offload requires device_kv_blocks >= 2")
+            from .kv_offload import OffloadKVPool
+            device_blocks = config.device_kv_blocks
+            self.kv_pool = OffloadKVPool(
+                model, num_blocks, device_blocks, BS, dtype,
+                cache_sh, self.mesh)
         with jax.set_mesh(self.mesh):
             self.cache = jax.jit(
-                lambda: model.init_paged_cache(num_blocks, BS, dtype=dtype),
+                lambda: model.init_paged_cache(device_blocks, BS,
+                                               dtype=dtype),
                 out_shardings=cache_sh)()
 
         self._pending = deque()
@@ -155,6 +181,13 @@ class InferenceEngineV2:
                 f"request needs {mgr.blocks_needed(total)} KV blocks but "
                 f"the pool only has {mgr.allocator.total_blocks}; raise "
                 "num_kv_blocks")
+        if self.kv_pool is not None \
+                and mgr.blocks_needed(total) > self.kv_pool.D - 1:
+            raise ValueError(
+                f"request needs {mgr.blocks_needed(total)} KV blocks but "
+                f"the device pool holds {self.kv_pool.D - 1} (+scratch); "
+                "a single sequence's working set must fit on device — "
+                "raise device_kv_blocks")
         self._pending.append(_Request(
             uid, prompt, max_new_tokens, eos_token_id,
             temperature=(self.config.temperature if temperature is None
@@ -348,6 +381,31 @@ class InferenceEngineV2:
         table = np.zeros((self.max_blocks_per_seq,), np.int32)
         table[:len(seq.blocks)] = seq.blocks
 
+        if self.kv_pool is not None:
+            # offload: chunk-only dispatch over the resident history +
+            # destination blocks, then the grouped decode path keeps the
+            # running sequences fed (the fused program would need the
+            # union working set resident)
+            live = seq.blocks[:mgr.blocks_needed(off + true_len)]
+            self.cache = self.kv_pool.ensure(self.cache, live)
+            dest = sorted({int(b) for b in tb[:true_len]})
+            self._rng, sub = jax.random.split(self._rng)
+            fn = self._get_chunk_only()
+            with jax.set_mesh(self.mesh):
+                c_tok, self.cache = fn(
+                    self.params, self.cache, ids,
+                    self.kv_pool.translate(tb), to, np.int32(off),
+                    np.int32(true_len), self.kv_pool.translate(table),
+                    np.asarray([seq.temperature], np.float32),
+                    np.asarray([seq.top_k], np.int32), sub,
+                    seq.temperature == 0.0)
+            self.kv_pool.mark_dirty(dest)
+            seq.prefill_offset = off + true_len
+            if seq.prefill_offset >= len(seq.prompt):
+                self._prefill_q.popleft()
+                self._post_token(seq, int(np.asarray(c_tok)[0]))
+            return self._step_offload_decode()
+
         batch = mgr.decode_batch()
         self._rng, sub = jax.random.split(self._rng)
         c_temp = np.asarray([seq.temperature], np.float32)
@@ -402,6 +460,11 @@ class InferenceEngineV2:
             tb = np.zeros((T_pad,), np.int32)       # scratch for pads
             to = np.zeros((T_pad,), np.int32)
             tb[:T], to[:T] = mgr.token_placement(seq)
+            prompt_blocks = seq.blocks[:mgr.blocks_needed(T)]
+            if self.kv_pool is not None:
+                self.cache = self.kv_pool.ensure(self.cache,
+                                                 prompt_blocks)
+                tb = self.kv_pool.translate(tb)
             self._rng, sub = jax.random.split(self._rng)
             fn = self._get_prefill()
             with jax.set_mesh(self.mesh):
@@ -410,6 +473,8 @@ class InferenceEngineV2:
                     np.asarray([seq.temperature], np.float32),
                     np.asarray([seq.top_k], np.int32),
                     seq.temperature == 0.0)
+            if self.kv_pool is not None:
+                self.kv_pool.mark_dirty(prompt_blocks)
             self._post_token(seq, int(np.asarray(tok)[0]))
 
     def _post_token(self, seq, token):
@@ -417,8 +482,84 @@ class InferenceEngineV2:
         if ((seq.eos_token_id >= 0 and token == seq.eos_token_id)
                 or len(seq.generated) >= seq.max_new_tokens):
             self._results[seq.uid] = np.asarray(seq.generated, np.int32)
+            if self.kv_pool is not None:
+                # drop residency before the allocator recycles the ids
+                self.kv_pool.release(seq.blocks)
             self.state_mgr.retire(seq.uid)
             self.state_mgr.flush(seq.uid)
+
+    # ------------------------------------------------- KV host offload path
+    def _seq_live_blocks(self, seq, n_steps=0):
+        """Logical blocks a decode dispatch touches for ``seq``: the
+        history it attends plus the tail blocks the next ``n_steps``
+        writes land in."""
+        last = seq.seen_tokens - 1 + max(0, n_steps - 1)
+        hi = min(last // self.state_mgr.block_size, len(seq.blocks) - 1)
+        return seq.blocks[:hi + 1]
+
+    def _offload_decode_groups(self, batch, n_steps):
+        """Greedy-pack active slots into dispatch groups whose combined
+        working set fits the device pool."""
+        mgr = self.state_mgr
+        cap = self.kv_pool.D - 1
+        groups = []
+        cur, cur_blocks = [], set()
+        for slot in np.nonzero(batch.active)[0]:
+            seq = mgr.get_sequence(mgr._slots[slot])
+            nb = set(self._seq_live_blocks(seq, n_steps))
+            if cur and len(cur_blocks | nb) > cap:
+                groups.append((cur, cur_blocks))
+                cur, cur_blocks = [], set()
+            cur.append(int(slot))
+            cur_blocks |= nb
+        if cur:
+            groups.append((cur, cur_blocks))
+        return groups
+
+    def _step_offload_decode(self):
+        """Grouped decode under KV host offload: each group's blocks are
+        made device-resident (next group's H2D prefetched under the
+        current group's compute), tables are translated to device slots,
+        and tail blocks are marked dirty."""
+        mgr = self.state_mgr
+        pool = self.kv_pool
+        n = max(1, self.config.decode_steps_per_dispatch)
+        batch = mgr.decode_batch()
+        if not batch.active.any():
+            return []
+        groups = self._offload_decode_groups(batch, n)
+        fn = self._get_decode()
+        out = []
+        prepared = pool.prepare(sorted(groups[0][1])) if groups else None
+        for gi, (slots_g, blocks_g) in enumerate(groups):
+            self.cache = pool.ensure(self.cache, sorted(blocks_g),
+                                     prepared)
+            prepared = (pool.prepare(sorted(groups[gi + 1][1]))
+                        if gi + 1 < len(groups) else None)
+            sub_active = np.zeros_like(batch.active)
+            sub_active[slots_g] = batch.active[slots_g]
+            tables = np.zeros_like(batch.block_tables)
+            tokens = np.where(sub_active, batch.tokens, 0)
+            lengths = np.where(sub_active, batch.lengths, 0)
+            for s in slots_g:
+                tables[s] = pool.translate(batch.block_tables[s])
+            self._rng, sub = jax.random.split(self._rng)
+            with jax.set_mesh(self.mesh):
+                toks, self.cache = fn(
+                    self.params, self.cache, tokens,
+                    lengths, tables, sub, batch.temps, batch.top_ks,
+                    not bool(batch.temps[sub_active].any()))
+            toks = np.asarray(toks)
+            for s in slots_g:
+                seq = mgr.get_sequence(mgr._slots[s])
+                pool.mark_dirty(self._seq_live_blocks(seq, n)[
+                    (batch.lengths[s]) // mgr.block_size:])
+            sub_batch = RaggedBatchWrapper(
+                tokens=tokens, lengths=lengths, block_tables=tables,
+                active=sub_active, temps=batch.temps,
+                top_ks=batch.top_ks)
+            out.extend(self._post_decode_tokens(sub_batch, toks))
+        return out
 
     def step(self):
         """One scheduler iteration: admit+prefill pending, then up to
@@ -438,6 +579,8 @@ class InferenceEngineV2:
             return self._step_splitfuse_chunk()
         if mgr.n_active == 0:
             return []
+        if self.kv_pool is not None:
+            return self._step_offload_decode()
         batch = mgr.decode_batch()
         if not batch.active.any():
             return []

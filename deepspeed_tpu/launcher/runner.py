@@ -216,13 +216,104 @@ def parse_args(argv=None):
                              "DSElasticAgent)")
     parser.add_argument("--max_elastic_restarts", type=int, default=10)
     parser.add_argument("--min_hosts", type=int, default=1)
+    parser.add_argument(
+        "--autotuning", choices=["tune", "run"], default=None,
+        help="autotune the script's config before (run) or instead of "
+             "(tune) launching it (reference launcher/runner.py:359 "
+             "deepspeed --autotuning). The script must accept "
+             "--exp '<json>' and print one JSON result line — bench.py "
+             "does.")
+    parser.add_argument(
+        "--autotuning_space", default=None,
+        help="JSON file {knob: [values...]}; default: micro-batch + "
+             "remat policy + flash block sizes for bench.py")
+    parser.add_argument(
+        "--autotuning_metric", default="value",
+        help="result-JSON key to maximize (bench.py: 'value' = "
+             "tokens/sec/chip)")
+    parser.add_argument("--autotuning_trials", type=int, default=12)
+    parser.add_argument("--autotuning_results",
+                        default="autotuning_results")
     parser.add_argument("script", help="training script")
     parser.add_argument("script_args", nargs=argparse.REMAINDER)
     return parser.parse_args(argv)
 
 
+# the VERDICT-named bench knobs: micro-batch, remat, flash blocks
+DEFAULT_TUNING_SPACE = {
+    "BENCH_MICRO_BS": [16, 24, 32],
+    "BENCH_REMAT_POLICY": ["save_flash", "save_mid"],
+    "BENCH_FLASH_BQ": [512, 1024],
+    "BENCH_FLASH_BK": [512, 1024],
+}
+
+
+def run_autotuning(args, hosts=None):
+    """``dstpu --autotuning {tune,run} script`` — drive the Autotuner's
+    search through the ResourceManager over the host pool (localhost
+    when no hostfile), each trial a subprocess of ``script --exp
+    '<json>'`` whose last JSON stdout line is the result (reference
+    launcher/runner.py:359-386 + autotuning/scheduler.py). Writes
+    ``exps.jsonl``, ``best_config.json`` and ``report.txt`` under
+    --autotuning_results; 'run' mode then launches the script with the
+    winning knobs exported."""
+    import json as _json
+    from ..autotuning.scheduler import (Node, ResourceManager,
+                                        SubprocessRunner)
+    if args.autotuning_space:
+        with open(args.autotuning_space) as f:
+            space = _json.load(f)
+    else:
+        space = dict(DEFAULT_TUNING_SPACE)
+    nodes = [Node(h, 1) for h in (hosts or ["localhost"])]
+    rm = ResourceManager(nodes)
+    runner = SubprocessRunner(args.script)
+    best_exp, best_res, all_results = rm.run_model_based(
+        space, runner, metric=args.autotuning_metric,
+        max_trials=args.autotuning_trials)
+    os.makedirs(args.autotuning_results, exist_ok=True)
+    with open(os.path.join(args.autotuning_results, "exps.jsonl"),
+              "w") as f:
+        for exp, res in all_results:
+            f.write(_json.dumps({"exp": exp, "result": res}) + "\n")
+    with open(os.path.join(args.autotuning_results,
+                           "best_config.json"), "w") as f:
+        _json.dump(best_exp, f, indent=1)
+    lines = [f"autotuning: {len(all_results)} trials over "
+             f"{len(nodes)} node(s); metric={args.autotuning_metric}"]
+    for exp, res in sorted(
+            all_results,
+            key=lambda er: float(er[1].get(args.autotuning_metric,
+                                           float("-inf"))),
+            reverse=True):
+        val = res.get(args.autotuning_metric, res.get("error", "?"))
+        lines.append(f"  {val}  {exp}")
+    lines.append(f"best: {best_exp} -> "
+                 f"{best_res.get(args.autotuning_metric)}")
+    report = "\n".join(lines)
+    with open(os.path.join(args.autotuning_results, "report.txt"),
+              "w") as f:
+        f.write(report + "\n")
+    logger.info(report)
+    return best_exp
+
+
 def main(argv=None):
     args = parse_args(argv)
+    if args.autotuning:
+        hosts = None
+        if args.hostfile is not None:
+            pool = parse_inclusion_exclusion(
+                fetch_hostfile(args.hostfile), args.include, args.exclude)
+            hosts = list(pool)
+        best = run_autotuning(args, hosts)
+        if args.autotuning == "tune":
+            return 0
+        # 'run': export the winning knobs and FALL THROUGH to the normal
+        # launch path — single-host exec or the hostfile ssh launch (env
+        # passthrough carries the knobs to every worker)
+        os.environ.update({k: str(v) for k, v in best.items()})
+        args.env = list(args.env) + list(best.keys())
     if args.hostfile is None:
         # single host: exec in place; jax discovers local chips
         os.execvpe(sys.executable,

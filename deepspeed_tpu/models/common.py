@@ -184,6 +184,96 @@ def _fused_xent_bwd(head_fn, chunk, res, g):
 _fused_xent.defvjp(_fused_xent_fwd, _fused_xent_bwd)
 
 
+def fused_linear_xent_kernel(norm_fn, chunk, norm_params, w, hidden,
+                             targets):
+    """``fused_linear_xent`` with the unembed computed by the Pallas
+    online-stats kernel (ops/pallas/fused_ce.py): fp32 logits never
+    touch HBM — the kernel emits bf16 logits + exact fp32 logz/gold in
+    one pass, and d_logits forms from the bf16 copy (identical numerics
+    to the MXU's own bf16 operand truncation).
+
+    norm_fn(norm_params, x) -> normed hidden (the pre-unembed final
+    norm); w: the (V, D) unembed matrix (tied or not). Head bias is not
+    supported here — callers fall back to the generic path."""
+    return _fused_xent_k(norm_fn, chunk, norm_params, w, hidden, targets)
+
+
+@_partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _fused_xent_k(norm_fn, chunk, norm_params, w, hidden, targets):
+    # primal/eval path: loss only, no gradient work
+    from ..ops.pallas.fused_ce import unembed_logits_stats
+    B, T, D = hidden.shape
+    xs, ts, valid, _ = _xent_chunks(hidden, targets, chunk)
+
+    def body(acc, xtm):
+        x, t, m = xtm
+        h = norm_fn(norm_params, x)
+        _, logz, gold = unembed_logits_stats(
+            h.reshape(-1, D), w, t.reshape(-1))
+        per = (logz - gold).reshape(x.shape[0], x.shape[1])
+        return acc + jnp.sum(jnp.where(m, per, 0.0)), None
+
+    total, _ = lax.scan(body, jnp.zeros((), jnp.float32),
+                        (xs, ts, valid))
+    return total / (B * T)
+
+
+def _fused_xent_k_fwd(norm_fn, chunk, norm_params, w, hidden, targets):
+    from ..ops.pallas.fused_ce import unembed_logits_stats
+    B, T, D = hidden.shape
+    xs, ts, valid, n = _xent_chunks(hidden, targets, chunk)
+    denom = B * T
+    V = w.shape[0]
+
+    acc0 = (jnp.zeros((), jnp.float32),
+            jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                         norm_params),
+            jnp.zeros(w.shape, jnp.float32))
+
+    def body(carry, xtm):
+        acc_loss, acc_np, acc_w = carry
+        x, t, m = xtm
+        c = x.shape[1]
+        h, norm_vjp = jax.vjp(norm_fn, norm_params, x)
+        hf = h.reshape(-1, D)
+        tf = t.reshape(-1)
+        logits, logz, gold = unembed_logits_stats(hf, w, tf)
+        per = (logz - gold).reshape(x.shape[0], c)
+        acc_loss = acc_loss + jnp.sum(jnp.where(m, per, 0.0))
+        p = jnp.exp(logits.astype(jnp.float32) - logz[:, None])
+        onehot = tf[:, None] == jnp.arange(V)[None]
+        mflat = jnp.broadcast_to(m, (x.shape[0], c)).reshape(-1, 1)
+        d_logits = (jnp.where(mflat, p - onehot, 0.0) / denom).astype(
+            hidden.dtype)
+        d_w = jnp.einsum("nv,nd->vd", d_logits, hf,
+                         preferred_element_type=jnp.float32)
+        d_h = jnp.einsum("nv,vd->nd", d_logits, w,
+                         preferred_element_type=jnp.float32).astype(
+            hidden.dtype).reshape(h.shape)
+        d_np, d_x = norm_vjp(d_h)
+        acc_np = jax.tree.map(lambda a, d: a + d.astype(jnp.float32),
+                              acc_np, d_np)
+        return (acc_loss, acc_np, acc_w + d_w), d_x
+
+    (total, d_np, d_w), d_xs = lax.scan(body, acc0, (xs, ts, valid))
+    d_hidden = d_xs.swapaxes(0, 1).reshape(B, n * chunk, D)[:, :T]
+    d_np = jax.tree.map(lambda d, p: d.astype(p.dtype), d_np, norm_params)
+    res = (d_np, d_w.astype(w.dtype), d_hidden.astype(hidden.dtype),
+           targets.shape)
+    return total / denom, res
+
+
+def _fused_xent_k_bwd(norm_fn, chunk, res, g):
+    import numpy as np
+    d_np, d_w, d_hidden, tshape = res
+    scale = lambda t: (g * t.astype(jnp.float32)).astype(t.dtype)
+    return (jax.tree.map(scale, d_np), scale(d_w), scale(d_hidden),
+            np.zeros(tshape, jax.dtypes.float0))
+
+
+_fused_xent_k.defvjp(_fused_xent_k_fwd, _fused_xent_k_bwd)
+
+
 def chunked_softmax_xent(head_fn, params, hidden, targets, chunk):
     """Mean next-token CE over (B, T, D) hidden states computed ``chunk``
     tokens at a time: ``head_fn(params, x_chunk)`` produces fp32 logits

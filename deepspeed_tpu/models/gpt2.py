@@ -83,6 +83,10 @@ class GPT2Config:
     # logits-recompute matmul and a softmax pass vs the remat'd chunked
     # path. Requires loss_chunk > 0; same loss value.
     fused_loss: bool = False
+    # + the Pallas unembed/online-stats kernel (ops/pallas/fused_ce.py):
+    # fp32 logits never touch HBM; logz/gold exact, d_logits from the
+    # bf16 logits (the MXU's own operand truncation)
+    fused_loss_kernel: bool = False
     # lax.scan unroll over layers (1 = compact single-block program;
     # higher trades compile time/code size for cross-layer overlap)
     scan_unroll: int = 1
@@ -946,7 +950,18 @@ class GPT2:
 
     def _chunked_head_loss(self, params, hidden, targets, chunk):
         """Dispatch the big-vocab head: fused grad-in-forward CE when
-        cfg.fused_loss, else the remat'd chunked path."""
+        cfg.fused_loss (optionally over the Pallas unembed/stats
+        kernel), else the remat'd chunked path."""
+        if self.config.fused_loss and self.config.fused_loss_kernel:
+            from .common import fused_linear_xent_kernel
+
+            def norm(np_, x):
+                return self._ln(x, np_["lnf_scale"], np_["lnf_bias"])
+
+            np_ = {k: params[k] for k in ("lnf_scale", "lnf_bias")}
+            return fused_linear_xent_kernel(norm, chunk, np_,
+                                            params["wte"], hidden,
+                                            targets)
         if self.config.fused_loss:
             hp = {k: params[k] for k in self._head_keys}
             return fused_linear_xent(self.head, chunk, hp, hidden, targets)

@@ -173,6 +173,38 @@ class TestEngineV2:
         ref = _v1_greedy(model, params, prompts, 6)
         np.testing.assert_array_equal(outs[0], ref[0])
 
+    def test_ep_sharded_mixtral_matches_single(self):
+        """EP x TP serving (reference module_inject/layers.py EP+TP
+        inference MoE): mixtral experts sharded over 'expert' and
+        heads/FFN over 'tensor' in the v2 decode/prefill programs must
+        reproduce the single-device greedy tokens exactly."""
+        from deepspeed_tpu.models.mixtral import Mixtral, MixtralConfig
+        mcfg = MixtralConfig(n_layer=2, n_head=4, n_kv_heads=2,
+                             d_model=64, max_seq_len=128, vocab_size=512,
+                             remat=False, num_experts=4, moe_top_k=2,
+                             dtype="float32")
+        model = Mixtral(mcfg)
+        params = model.init(jax.random.key(5))
+        prompts = [np.arange(9) % 500, (np.arange(13) + 41) % 500]
+
+        groups.reset()
+        single = InferenceEngineV2(model, params=params,
+                                   config={"dtype": "float32",
+                                           "kv_block_size": 16,
+                                           "max_batch_size": 2})
+        ref = single.generate_all(prompts, max_new_tokens=6)
+
+        groups.reset()
+        eng = InferenceEngineV2(model, params=params,
+                                config={"dtype": "float32",
+                                        "kv_block_size": 16,
+                                        "max_batch_size": 2,
+                                        "tensor_parallel": 2,
+                                        "expert_parallel": 2})
+        outs = eng.generate_all(prompts, max_new_tokens=6)
+        for a, b in zip(ref, outs):
+            np.testing.assert_array_equal(a, b)
+
 
 class TestPerRequestSampling:
     def test_mixed_greedy_and_sampled_batch(self):

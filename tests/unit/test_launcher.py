@@ -128,6 +128,38 @@ class TestSlurmRunner:
         assert "PROCESS_ID=0" not in inner
         assert "COORDINATOR_ADDRESS=node1" not in inner
 
+    def test_autotuning_cli_end_to_end(self, tmp_path, monkeypatch):
+        """dstpu --autotuning tune <script>: the full CLI path —
+        ResourceManager over localhost, subprocess trials via the
+        --exp JSON protocol, best_config.json + report emitted
+        (reference launcher/runner.py:359 deepspeed --autotuning)."""
+        import json
+        from deepspeed_tpu.launcher import runner as R
+        script = tmp_path / "trial.py"
+        # synthetic objective: best at micro=24, bq=1024
+        script.write_text(
+            "import json, sys\n"
+            "exp = json.loads(sys.argv[sys.argv.index('--exp') + 1])\n"
+            "m = int(exp['BENCH_MICRO_BS']); bq = int(exp['BENCH_FLASH_BQ'])\n"
+            "v = 100 - abs(m - 24) + (5 if bq == 1024 else 0)\n"
+            "print(json.dumps({'value': v}))\n")
+        space = tmp_path / "space.json"
+        space.write_text(json.dumps({
+            "BENCH_MICRO_BS": [16, 24, 32],
+            "BENCH_FLASH_BQ": [512, 1024]}))
+        results = tmp_path / "results"
+        rc = R.main(["--autotuning", "tune",
+                     "--autotuning_space", str(space),
+                     "--autotuning_trials", "6",
+                     "--autotuning_results", str(results),
+                     str(script)])
+        assert rc == 0
+        best = json.loads((results / "best_config.json").read_text())
+        assert best == {"BENCH_MICRO_BS": 24, "BENCH_FLASH_BQ": 1024}
+        lines = (results / "exps.jsonl").read_text().strip().splitlines()
+        assert 1 <= len(lines) <= 6
+        assert (results / "report.txt").exists()
+
     def test_elastic_rejected_with_slurm(self, tmp_path):
         from deepspeed_tpu.launcher import runner as R
         import pytest
