@@ -35,85 +35,28 @@ import time
 import numpy as np
 import jax
 
-import deepspeed_tpu
-from deepspeed_tpu.models import GPT2, PRESETS
-from deepspeed_tpu.utils import groups
+sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "benchmarks"))
+from bench_engine import build_bench_engine  # noqa: E402
 
 
 def main():
-    preset = os.environ.get("BENCH_PRESET", "350M")
-    seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
-    micro = int(os.environ.get("BENCH_MICRO_BS", "24"))
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
     stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
     offload = os.environ.get("BENCH_OFFLOAD", "")
-    if offload not in ("", "cpu", "nvme"):
-        raise SystemExit(f"BENCH_OFFLOAD must be ''|cpu|nvme, "
-                         f"got {offload!r}")
 
-    cfg = PRESETS[preset]
-    from dataclasses import replace
-    # tuned v5e config: pallas flash attention with a full-KV inner loop
-    # + per-layer remat (~2x over the dense-attention baseline). Chunked
-    # cross entropy (BENCH_LOSS_CHUNK=256) trades ~2% speed for the
-    # (B,T,V) fp32 logits never materializing — needed for larger micro
-    # batches / vocabs; bs=24 fits dense, so default off.
-    flash = os.environ.get("BENCH_FLASH", "1") == "1"
-    cfg = replace(cfg, max_seq_len=seq_len,
-                  use_flash_attention=flash,
-                  flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", "1024")),
-                  flash_block_k=int(os.environ.get("BENCH_FLASH_BK", "1024")),
-                  flash_block_h=int(os.environ.get("BENCH_FLASH_BH", "1")),
-                  flash_block_q_bwd=int(os.environ.get("BENCH_FLASH_BQ_BWD", "0")),
-                  flash_block_k_bwd=int(os.environ.get("BENCH_FLASH_BK_BWD", "0")),
-                  remat=os.environ.get("BENCH_REMAT", "1") == "1",
-                  # save_flash measured best (benchmarks/PERF_NOTES.md):
-                  # saved flash o/lse residuals, no fwd re-run in backward
-                  remat_policy=os.environ.get("BENCH_REMAT_POLICY",
-                                              "save_flash"),
-                  scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")),
-                  # fused LN kernel measured slower in-step (see
-                  # GPT2Config.fused_layernorm): off unless forced
-                  fused_layernorm={"0": False, "1": True, "bwd": "bwd",
-                                   "auto": "auto"}.get(
-                      os.environ.get("BENCH_FUSED_LN", "0"), False),
-                  loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "512")),
-                  # grad-in-forward fused CE (common.fused_linear_xent):
-                  # kills the backward logits-recompute matmul
-                  fused_loss=os.environ.get("BENCH_FUSED_LOSS", "1") == "1",
-                  # + Pallas unembed/online-stats kernel (fp32 logits
-                  # never in HBM)
-                  fused_loss_kernel=os.environ.get("BENCH_FUSED_LOSS_KERNEL",
-                                                   "1") == "1")
-    model = GPT2(cfg)
-
+    # tuned v5e config: pallas flash attention with a full-KV inner
+    # loop + per-layer save_flash remat, grad-in-forward fused CE over
+    # the Pallas unembed/online-stats kernel (fp32 logits never in
+    # HBM). ONE config source shared with profile_step/hlo_dump:
+    # benchmarks/bench_engine.py reads every BENCH_* knob.
+    engine, batch = build_bench_engine()
+    cfg = engine.model.config
+    preset = os.environ.get("BENCH_PRESET", "350M")
+    seq_len = cfg.max_seq_len
     n_dev = len(jax.devices())
-    groups.reset()
-    engine, _, _, _ = deepspeed_tpu.initialize(
-        model=model,
-        config={
-            "train_micro_batch_size_per_gpu": micro,
-            "gradient_accumulation_steps": 1,
-            "steps_per_print": 0,
-            "optimizer": {"type": "AdamW",
-                          "params": {"lr": 2e-4, "weight_decay": 0.01}},
-            "gradient_clipping": 1.0,
-            "bf16": {"enabled": True},
-            "zero_optimization": (
-                {"stage": stage,
-                 "offload_optimizer": (
-                     {"device": "nvme",
-                      "nvme_path": os.environ.get("BENCH_NVME_PATH",
-                                                  "/tmp/dstpu_nvme")}
-                     if offload == "nvme" else {"device": "cpu"})}
-                if offload else {"stage": stage}),
-        })
-
     bsz = engine.config.train_batch_size
-    rng = np.random.RandomState(0)
-    batch = {"input_ids": rng.randint(0, cfg.vocab_size, (bsz, seq_len))
-             .astype(np.int32)}
 
     def sync():
         # force completion via host materialization: on some transports

@@ -11,30 +11,52 @@ os.environ.setdefault("LIBTPU_INIT_ARGS",
 import numpy as np  # noqa: E402
 
 
-def build_bench_engine():
-    """Returns (engine, batch) for the headline bench config, honoring
-    the same BENCH_* env knobs as bench.py."""
-    import jax  # noqa: F401  (device init after LIBTPU_INIT_ARGS)
-    import deepspeed_tpu
-    from deepspeed_tpu.models import GPT2, PRESETS
-    from deepspeed_tpu.utils import groups
+def build_bench_config():
+    """The headline bench model config from the BENCH_* env knobs —
+    the single source bench.py and the tools share (every knob, incl.
+    the backward flash blocks and LN/unroll experiments)."""
+    from deepspeed_tpu.models import PRESETS
     from dataclasses import replace
 
     preset = os.environ.get("BENCH_PRESET", "350M")
     seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
-    micro = int(os.environ.get("BENCH_MICRO_BS", "24"))
-    cfg = replace(
+    return replace(
         PRESETS[preset], max_seq_len=seq_len,
         use_flash_attention=os.environ.get("BENCH_FLASH", "1") == "1",
         flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", "1024")),
         flash_block_k=int(os.environ.get("BENCH_FLASH_BK", "1024")),
         flash_block_h=int(os.environ.get("BENCH_FLASH_BH", "1")),
+        flash_block_q_bwd=int(os.environ.get("BENCH_FLASH_BQ_BWD", "0")),
+        flash_block_k_bwd=int(os.environ.get("BENCH_FLASH_BK_BWD", "0")),
         remat=os.environ.get("BENCH_REMAT", "1") == "1",
         remat_policy=os.environ.get("BENCH_REMAT_POLICY", "save_flash"),
+        scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")),
+        fused_layernorm={"0": False, "1": True, "bwd": "bwd",
+                         "auto": "auto"}.get(
+            os.environ.get("BENCH_FUSED_LN", "0"), False),
         loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "512")),
         fused_loss=os.environ.get("BENCH_FUSED_LOSS", "1") == "1",
         fused_loss_kernel=os.environ.get("BENCH_FUSED_LOSS_KERNEL",
                                          "1") == "1")
+
+
+def build_bench_engine():
+    """Returns (engine, batch) for the headline bench config, honoring
+    the same BENCH_* env knobs (incl. BENCH_ZERO_STAGE/BENCH_OFFLOAD)
+    as bench.py."""
+    import jax  # noqa: F401  (device init after LIBTPU_INIT_ARGS)
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2
+    from deepspeed_tpu.utils import groups
+
+    cfg = build_bench_config()
+    seq_len = cfg.max_seq_len
+    micro = int(os.environ.get("BENCH_MICRO_BS", "24"))
+    stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
+    offload = os.environ.get("BENCH_OFFLOAD", "")
+    if offload not in ("", "cpu", "nvme"):
+        raise SystemExit(f"BENCH_OFFLOAD must be ''|cpu|nvme, "
+                         f"got {offload!r}")
     model = GPT2(cfg)
     groups.reset()
     engine, _, _, _ = deepspeed_tpu.initialize(
@@ -47,8 +69,14 @@ def build_bench_engine():
                           "params": {"lr": 2e-4, "weight_decay": 0.01}},
             "gradient_clipping": 1.0,
             "bf16": {"enabled": True},
-            "zero_optimization": {
-                "stage": int(os.environ.get("BENCH_ZERO_STAGE", "2"))},
+            "zero_optimization": (
+                {"stage": stage,
+                 "offload_optimizer": (
+                     {"device": "nvme",
+                      "nvme_path": os.environ.get("BENCH_NVME_PATH",
+                                                  "/tmp/dstpu_nvme")}
+                     if offload == "nvme" else {"device": "cpu"})}
+                if offload else {"stage": stage}),
         })
     bsz = engine.config.train_batch_size
     rng = np.random.RandomState(0)

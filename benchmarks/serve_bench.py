@@ -330,11 +330,20 @@ def bench_sla(name="gpt2-350M", rates=(1.0, 2.0, 4.0), n_requests=24,
         r = np.random.RandomState(seed)
         arrivals = np.cumsum(r.exponential(1.0 / rate, n_requests))
         prompts = [r.randint(0, V, (prompt_len,)) for _ in range(n_requests)]
-        # warm the programs
-        w = engine.put(prompts[0], max_new_tokens=4, eos_token_id=-1)
-        while not engine.is_done(w):
+        # warm EVERY program the run will hit: chunk-only, decode, and —
+        # critically under SplitFuse — the FUSED chunk+decode program,
+        # which only traces when a prompt chunk arrives while another
+        # sequence is DECODING (without this the first mid-run overlap
+        # pays a full XLA compile inside the timed window). w1 gets a
+        # long decode budget so it is guaranteed still running when w2's
+        # chunks dispatch.
+        w1 = engine.put(prompts[0], max_new_tokens=64, eos_token_id=-1)
+        for _ in range(1 + prompt_len // max(1, splitfuse or prompt_len)):
+            engine.step()               # w1 fully prefilled + decoding
+        w2 = engine.put(prompts[1], max_new_tokens=4, eos_token_id=-1)
+        while not (engine.is_done(w1) and engine.is_done(w2)):
             engine.step()
-        engine.get(w)
+        engine.get(w1), engine.get(w2)
 
         tok_times = {}          # uid -> [t_first, ..., t_last]
         submit = {}

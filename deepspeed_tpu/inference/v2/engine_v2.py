@@ -387,7 +387,12 @@ class InferenceEngineV2:
             # running sequences fed (the fused program would need the
             # union working set resident)
             live = seq.blocks[:mgr.blocks_needed(off + true_len)]
-            self.cache = self.kv_pool.ensure(self.cache, live)
+            # blocks starting at/after the chunk's first position hold
+            # no prior tokens — this dispatch writes them from scratch,
+            # so they need slots but no host upload
+            first_fresh = -(-off // mgr.block_size)
+            self.cache = self.kv_pool.ensure(
+                self.cache, live, skip_upload=live[first_fresh:])
             dest = sorted({int(b) for b in tb[:true_len]})
             self._rng, sub = jax.random.split(self._rng)
             fn = self._get_chunk_only()
@@ -462,8 +467,10 @@ class InferenceEngineV2:
             tb[:T], to[:T] = mgr.token_placement(seq)
             prompt_blocks = seq.blocks[:mgr.blocks_needed(T)]
             if self.kv_pool is not None:
-                self.cache = self.kv_pool.ensure(self.cache,
-                                                 prompt_blocks)
+                # every prompt block is fully written by this dispatch:
+                # slots only, no garbage H2D (code-review finding)
+                self.cache = self.kv_pool.ensure(
+                    self.cache, prompt_blocks, skip_upload=prompt_blocks)
                 tb = self.kv_pool.translate(tb)
             self._rng, sub = jax.random.split(self._rng)
             fn = self._get_prefill()

@@ -108,14 +108,18 @@ class OffloadKVPool:
         return self._gather_jit
 
     # ------------------------------------------------------------ prefetch
-    def prepare(self, logical_ids):
+    def prepare(self, logical_ids, skip_upload=()):
         """Host-gather + async device_put of the upload payload for the
         blocks in ``logical_ids`` that are NOT yet resident. Returns an
         opaque handle ``ensure`` accepts (None when nothing to upload).
         Does not touch the slot maps — call ``ensure`` with the handle
-        to commit."""
+        to commit. ``skip_upload``: blocks the coming dispatch fully
+        overwrites (never-written prefill/chunk destinations) — they
+        are excluded here and get bare slot assignments in ``ensure``,
+        skipping the pointless H2D of garbage host contents."""
+        skip = {int(b) for b in skip_upload}
         missing = [b for b in dict.fromkeys(int(b) for b in logical_ids)
-                   if self.slot_of[b] < 0]
+                   if self.slot_of[b] < 0 and b not in skip]
         if not missing:
             return None
         # pad the upload to a power-of-two bucket so the scatter program
@@ -130,19 +134,25 @@ class OffloadKVPool:
         return (missing, jax.device_put(blk_k), jax.device_put(blk_v))
 
     # -------------------------------------------------------------- ensure
-    def ensure(self, cache, logical_ids, prepared=None):
+    def ensure(self, cache, logical_ids, prepared=None, skip_upload=()):
         """Make every block in ``logical_ids`` device-resident.
         Returns the updated cache. ``prepared``: a matching
-        ``prepare`` handle (uploads already in flight)."""
+        ``prepare`` handle (uploads already in flight). ``skip_upload``:
+        see ``prepare`` — such blocks get slots but no data transfer
+        (the dispatch fully overwrites them / never attends their
+        stale positions)."""
         need = list(dict.fromkeys(int(b) for b in logical_ids))
         self._tick += 1
         if prepared is None:
-            prepared = self.prepare(need)
-        if prepared is None:
+            prepared = self.prepare(need, skip_upload)
+        skip = [b for b in dict.fromkeys(int(b) for b in skip_upload)
+                if self.slot_of[b] < 0 and b in set(need)]
+        missing, blk_k, blk_v = prepared if prepared is not None \
+            else ([], None, None)
+        if not missing and not skip:
             for b in need:
                 self.last_used[self.slot_of[b]] = self._tick
             return cache
-        missing, blk_k, blk_v = prepared
         if len(need) > self.D - 1:
             raise ValueError(
                 f"dispatch references {len(need)} KV blocks but the "
@@ -159,37 +169,39 @@ class OffloadKVPool:
             (s for s in range(1, self.D)
              if self.logical_of[s] >= 0 and s not in needed_slots),
             key=lambda s: self.last_used[s])
-        slots = []
-        for _ in missing:
+
+        def take_slot():
             if free:
-                slots.append(free.pop())
-            elif evictable:
-                slots.append(evictable.pop(0))
-            else:
-                raise ValueError(
-                    "KV device pool exhausted mid-ensure (should be "
-                    "unreachable given the size check above)")
-        # the upload was padded to a power-of-two bucket: route the pad
-        # rows at the scratch slot (never attended)
-        n_pad = blk_k.shape[1]
-        pad_slots = [0] * (n_pad - len(slots))
+                return free.pop()
+            if evictable:
+                return evictable.pop(0)
+            raise ValueError(
+                "KV device pool exhausted mid-ensure (should be "
+                "unreachable given the size check above)")
+
+        slots = [take_slot() for _ in missing]
+        skip_slots = [take_slot() for _ in skip]
 
         # write back dirty victims before their slots are overwritten
-        dirty_slots = [s for s in slots
+        dirty_slots = [s for s in slots + skip_slots
                        if self.logical_of[s] >= 0 and self.dirty[s]]
         if dirty_slots:
             cache = self._writeback(cache, dirty_slots)
-        for s in slots:
+        for s in slots + skip_slots:
             old = self.logical_of[s]
             if old >= 0:
                 self.slot_of[old] = -1
             self.logical_of[s] = -1
             self.dirty[s] = False
 
-        sl = jnp.asarray(np.asarray(slots + pad_slots, np.int32))
-        with jax.set_mesh(self.mesh):
-            cache = self._get_scatter()(cache, sl, blk_k, blk_v)
-        for b, s in zip(missing, slots):
+        if missing:
+            # the upload was padded to a power-of-two bucket: route the
+            # pad rows at the scratch slot (never attended)
+            pad_slots = [0] * (blk_k.shape[1] - len(slots))
+            sl = jnp.asarray(np.asarray(slots + pad_slots, np.int32))
+            with jax.set_mesh(self.mesh):
+                cache = self._get_scatter()(cache, sl, blk_k, blk_v)
+        for b, s in zip(list(missing) + skip, slots + skip_slots):
             self.logical_of[s] = b
             self.slot_of[b] = s
         for b in need:
@@ -198,9 +210,15 @@ class OffloadKVPool:
         return cache
 
     def _writeback(self, cache, slots):
+        # pad to the same power-of-two buckets as the upload path so the
+        # gather program compiles once per bucket, not per victim count
+        # (pad rows re-read slot 0 and are discarded below)
+        n = len(slots)
+        n_pad = 1 << (n - 1).bit_length()
+        padded = list(slots) + [0] * (n_pad - n)
         with jax.set_mesh(self.mesh):
             k, v = self._get_gather()(cache,
-                                      jnp.asarray(slots, jnp.int32))
+                                      jnp.asarray(padded, jnp.int32))
         k = np.asarray(k)
         v = np.asarray(v)
         for j, s in enumerate(slots):

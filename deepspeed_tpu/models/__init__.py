@@ -14,3 +14,5 @@ from .gptj import GPTJ, GPTJConfig, GPTJ_PRESETS
 from .gpt_neo import GPTNeo, GPTNeoConfig, GPTNEO_PRESETS
 from .gpt_neox import GPTNeoX, GPTNeoXConfig, GPTNEOX_PRESETS
 from .internlm import InternLM, InternLMConfig, INTERNLM_PRESETS
+from .diffusion import (UNet2D, UNet2DConfig, VAEDecoder,
+                        VAEDecoderConfig, DSUNet, DSVAE)

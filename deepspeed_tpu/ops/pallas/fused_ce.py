@@ -47,7 +47,9 @@ def _ce_kernel(x_ref, w_ref, t_ref, logits_ref, logz_ref, gold_ref,
     logits_ref[...] = s.astype(logits_ref.dtype)
 
     t = t_ref[...]                                   # (bm, 1) int32
-    gold_blk = jnp.sum(jnp.where(col == t, s, 0.0), axis=1)
+    # col < V guard: targets landing in the padded tail [V, Vp) must
+    # contribute 0, not the pad columns' NEG_INF
+    gold_blk = jnp.sum(jnp.where((col == t) & (col < V), s, 0.0), axis=1)
     blk_max = jnp.max(s, axis=1)
 
     @pl.when(j == 0)

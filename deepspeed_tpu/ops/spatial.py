@@ -10,9 +10,10 @@ not a custom kernel; XLA emits one fused elementwise pass per call
 (SURVEY §2.6: "XLA fusion suffices").
 
 x is NHWC (batch, height, width, channels) or any (..., C) layout;
-``bias`` is (C,). The diffusers module wrappers themselves are gated on
-the library being installed (it is not part of this image); these ops
-are what they would call.
+``bias`` is (C,). The model side lives in ``models/diffusion.py``:
+UNet2D / VAEDecoder call these at every conv-bias and residual join,
+and DSUNet / DSVAE wrap them with the compile-once-per-shape dispatch
+that plays the reference wrappers' CUDA-graph role.
 """
 
 import jax.numpy as jnp
