@@ -11,8 +11,22 @@ ZeRO-2 MFU at 350M scale) / flops-per-token. vs_baseline > 1.0 beats it.
 
 Runs on however many chips are visible (the driver gives one v5e chip);
 throughput is reported per chip.
+
+After the headline, ``extras.variants`` measures the round-6 levers —
+each rebuilt+retimed under its own env overrides, failures isolated so a
+variant can never cost the headline number:
+  mlp_kernel_down  the layout-owning Pallas wdown projection
+                   (BENCH_MLP_KERNEL=down)
+  flash_bwd_qmajor the query-major fused flash backward
+                   (BENCH_FLASH_BWD_QMAJOR=1)
+  gpt2_1.3B_zero3  the BASELINE.md row-3 model point (ZeRO-3, bf16
+                   moments+grad accumulation to fit one 16 GB chip),
+                   where per-step fixed costs amortize
+Disable with BENCH_VARIANTS=none, or pick a subset
+(BENCH_VARIANTS=mlp_down,bwd_qmajor,1.3B).
 """
 
+import gc
 import json
 import os
 import sys
@@ -39,22 +53,15 @@ sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)),
                                 "benchmarks"))
 from bench_engine import build_bench_engine  # noqa: E402
 
+A100_PEAK_MFU = 312e12 * 0.40     # the BASELINE.md per-chip bar
+V5E_PEAK = 197e12                 # bf16 peak per chip
 
-def main():
-    steps = int(os.environ.get("BENCH_STEPS", "10"))
-    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
-    stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
-    offload = os.environ.get("BENCH_OFFLOAD", "")
 
-    # tuned v5e config: pallas flash attention with a full-KV inner
-    # loop + per-layer save_flash remat, grad-in-forward fused CE over
-    # the Pallas unembed/online-stats kernel (fp32 logits never in
-    # HBM). ONE config source shared with profile_step/hlo_dump:
-    # benchmarks/bench_engine.py reads every BENCH_* knob.
+def _measure(steps, warmup):
+    """Build the engine for the CURRENT env knobs and time ``steps``.
+    Returns the raw numbers a caller folds into its own report shape."""
     engine, batch = build_bench_engine()
     cfg = engine.model.config
-    preset = os.environ.get("BENCH_PRESET", "350M")
-    seq_len = cfg.max_seq_len
     n_dev = len(jax.devices())
     bsz = engine.config.train_batch_size
 
@@ -63,6 +70,7 @@ def main():
         # (axon tunnel) block_until_ready does not actually block.
         return float(np.asarray(engine.state["step"]))
 
+    loss = None
     for _ in range(warmup):
         loss = engine.train_batch(batch)
     sync()
@@ -73,43 +81,123 @@ def main():
     sync()
     dt = time.perf_counter() - t0
 
+    tokens = bsz * cfg.max_seq_len * steps
+    tok_per_sec_chip = tokens / dt / n_dev
+    fpt = cfg.flops_per_token()
+    out = {
+        "_fpt": fpt,                  # popped by main(); not serialized
+        "tokens_per_sec_chip": round(tok_per_sec_chip, 1),
+        "step_time_s": round(dt / steps, 4),
+        "vs_baseline": round(tok_per_sec_chip / (A100_PEAK_MFU / fpt), 3),
+        "mfu_vs_v5e_peak": round(tok_per_sec_chip * fpt / V5E_PEAK, 3),
+        "final_loss": float(loss),
+        "devices": n_dev,
+        "seq_len": cfg.max_seq_len,
+        "global_batch": bsz,
+        "steps": steps,
+    }
+    del engine, batch
+    gc.collect()
+    return out
+
+
+# the round-6 lever configs; each is measured in isolation on top of
+# whatever knobs the headline ran with. bwd_qmajor_512: at full-T
+# backward blocks the q-major and k-major kernels coincide (one grid
+# step per group); the q-major design's win — causal skipping at finer
+# grain WITHOUT the k-major multi-block fp32-dq HBM round trip — only
+# shows at sub-T blocks, so both points are measured.
+_VARIANTS = {
+    "mlp_down": ("mlp_kernel_down", {"BENCH_MLP_KERNEL": "down"}),
+    "bwd_qmajor": ("flash_bwd_qmajor", {"BENCH_FLASH_BWD_QMAJOR": "1"}),
+    "bwd_qmajor_512": ("flash_bwd_qmajor_512",
+                       {"BENCH_FLASH_BWD_QMAJOR": "1",
+                        "BENCH_FLASH_BQ_BWD": "512",
+                        "BENCH_FLASH_BK_BWD": "512"}),
+    "1.3B": ("gpt2_1.3B_zero3", {"BENCH_PRESET": "1.3B",
+                                 "BENCH_ZERO_STAGE": "3"}),
+}
+
+
+def _run_variants(names, steps, warmup):
+    out = {}
+    for name in names:
+        if name not in _VARIANTS:
+            out[name] = {"error": f"unknown variant {name!r}"}
+            continue
+        label, overrides = _VARIANTS[name]
+        saved = {k: os.environ.get(k) for k in overrides}
+        os.environ.update(overrides)
+        try:
+            out[label] = _measure(steps, warmup)
+            out[label].pop("_fpt", None)
+        except Exception as e:       # isolate: a variant OOM/compile
+            out[label] = {"error":   # failure must not cost the headline
+                          f"{type(e).__name__}: {e}"[:300]}
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+            gc.collect()
+    return out
+
+
+def main():
+    steps = int(os.environ.get("BENCH_STEPS", "10"))
+    warmup = int(os.environ.get("BENCH_WARMUP", "3"))
+    stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
+    offload = os.environ.get("BENCH_OFFLOAD", "")
+    preset = os.environ.get("BENCH_PRESET", "350M")
+
+    # tuned v5e config: pallas flash attention with a full-KV inner
+    # loop + per-layer save_flash remat, grad-in-forward fused CE over
+    # the Pallas unembed/online-stats kernel (fp32 logits never in
+    # HBM). ONE config source shared with profile_step/hlo_dump:
+    # benchmarks/bench_engine.py reads every BENCH_* knob.
+    head = _measure(steps, warmup)
+    head_fpt = head.pop("_fpt")
+
     # on-chip Pallas kernel parity gate (real-Mosaic numerics vs the
     # dense references; CI only exercises interpreter mode). Runs after
-    # timing so its compiles never pollute the measurement.
+    # timing so its compiles never pollute the measurement. Returns a
+    # dict enumerating every shipped kernel path.
     kernels_parity = "skipped"
     if os.environ.get("BENCH_KERNEL_PARITY", "1") == "1" \
             and jax.default_backend() != "cpu":
-        import sys
-        sys.path.insert(0, os.path.join(os.path.dirname(
-            os.path.abspath(__file__)), "benchmarks"))
         try:
             from kernel_parity import run as _kernel_parity
             kernels_parity = _kernel_parity()
         except Exception as e:          # report, don't hide the bench
             kernels_parity = f"FAILED: {type(e).__name__}: {e}"[:300]
 
-    tokens = bsz * seq_len * steps
-    tok_per_sec_chip = tokens / dt / n_dev
-    flops_per_token = cfg.flops_per_token()
-    mfu_peak = {"tpu": 197e12}.get("tpu")  # v5e bf16 peak per chip
-    achieved_flops = tok_per_sec_chip * flops_per_token
-    mfu = achieved_flops / mfu_peak
+    variants = {}
+    vnames = os.environ.get("BENCH_VARIANTS",
+                            "mlp_down,bwd_qmajor,bwd_qmajor_512,1.3B")
+    if vnames and vnames != "none":
+        variants = _run_variants(
+            [v for v in vnames.split(",") if v],
+            int(os.environ.get("BENCH_VARIANT_STEPS", "5")),
+            int(os.environ.get("BENCH_VARIANT_WARMUP", "2")))
 
-    a100_baseline = 312e12 * 0.40 / flops_per_token  # tokens/sec/chip
     print(json.dumps({
         "metric": (f"gpt2-{preset} zero{stage}"
                    + (f"-offload-{offload}" if offload else "")
                    + " bf16 training throughput"),
-        "value": round(tok_per_sec_chip, 1),
+        "value": head["tokens_per_sec_chip"],
         "unit": "tokens/sec/chip",
-        "vs_baseline": round(tok_per_sec_chip / a100_baseline, 3),
+        "vs_baseline": head["vs_baseline"],
         "extras": {
-            "devices": n_dev, "seq_len": seq_len, "global_batch": bsz,
-            "steps": steps, "step_time_s": round(dt / steps, 4),
-            "mfu_vs_v5e_peak": round(mfu, 3),
-            "final_loss": float(loss),
-            "baseline_tokens_per_sec_chip_8xA100_est": round(a100_baseline, 1),
+            "devices": head["devices"], "seq_len": head["seq_len"],
+            "global_batch": head["global_batch"],
+            "steps": head["steps"], "step_time_s": head["step_time_s"],
+            "mfu_vs_v5e_peak": head["mfu_vs_v5e_peak"],
+            "final_loss": head["final_loss"],
+            "baseline_tokens_per_sec_chip_8xA100_est": round(
+                A100_PEAK_MFU / head_fpt, 1),
             "kernels_parity": kernels_parity,
+            "variants": variants,
         },
     }))
 

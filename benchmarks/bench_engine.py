@@ -37,7 +37,16 @@ def build_bench_config():
         loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "512")),
         fused_loss=os.environ.get("BENCH_FUSED_LOSS", "1") == "1",
         fused_loss_kernel=os.environ.get("BENCH_FUSED_LOSS_KERNEL",
-                                         "1") == "1")
+                                         "1") == "1",
+        # layout-owning Pallas MLP projection matmul (ops/pallas/
+        # mlp_matmul.py): 0 (XLA, default) | down | both | auto
+        mlp_kernel={"0": False, "auto": "auto", "down": "down",
+                    "both": "both"}.get(
+            os.environ.get("BENCH_MLP_KERNEL", "0"), False),
+        mlp_kernel_fuse_dw=os.environ.get("BENCH_MLP_FUSE_DW", "1") == "1",
+        # query-major fused flash backward (dkv VMEM-resident retune)
+        flash_bwd_qmajor=os.environ.get("BENCH_FLASH_BWD_QMAJOR",
+                                        "0") == "1")
 
 
 def build_bench_engine():
@@ -51,24 +60,35 @@ def build_bench_engine():
 
     cfg = build_bench_config()
     seq_len = cfg.max_seq_len
-    micro = int(os.environ.get("BENCH_MICRO_BS", "24"))
+    preset = os.environ.get("BENCH_PRESET", "350M")
+    # 1.3B on one 16 GB chip needs the memory knobs: micro 8, bf16 Adam
+    # moments, bf16 grad accumulation (the update still computes fp32)
+    big = preset == "1.3B"
+    micro = int(os.environ.get("BENCH_MICRO_BS", "8" if big else "24"))
     stage = int(os.environ.get("BENCH_ZERO_STAGE", "2"))
     offload = os.environ.get("BENCH_OFFLOAD", "")
+    moments = os.environ.get("BENCH_MOMENTS_DTYPE",
+                             "bfloat16" if big else "")
+    gdtype = os.environ.get("BENCH_GRAD_DTYPE", "bf16" if big else "")
     if offload not in ("", "cpu", "nvme"):
         raise SystemExit(f"BENCH_OFFLOAD must be ''|cpu|nvme, "
                          f"got {offload!r}")
     model = GPT2(cfg)
     groups.reset()
+    opt_params = {"lr": 2e-4, "weight_decay": 0.01}
+    if moments:
+        opt_params["moments_dtype"] = moments
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         config={
             "train_micro_batch_size_per_gpu": micro,
             "gradient_accumulation_steps": 1,
             "steps_per_print": 0,
-            "optimizer": {"type": "AdamW",
-                          "params": {"lr": 2e-4, "weight_decay": 0.01}},
+            "optimizer": {"type": "AdamW", "params": opt_params},
             "gradient_clipping": 1.0,
             "bf16": {"enabled": True},
+            **({"data_types": {"grad_accum_dtype": gdtype}}
+               if gdtype else {}),
             "zero_optimization": (
                 {"stage": stage,
                  "offload_optimizer": (

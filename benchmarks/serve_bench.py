@@ -381,18 +381,25 @@ def bench_sla(name="gpt2-350M", rates=(1.0, 2.0, 4.0), n_requests=24,
             if mean_tok_ms <= sla_ms:
                 met += 1
         per_tok = np.asarray(per_tok)
+
+        def pct(arr, p, nd):
+            # a run where no request produced tokens (all failed /
+            # killed early) must report None fields, not NaN or a
+            # percentile-of-empty crash
+            if len(arr) == 0:
+                return None
+            return round(float(np.percentile(arr, p)), nd)
+
         row = {
             "model": name, "mode": "sla",
             "splitfuse_tokens": splitfuse,
             "arrival_rate_qps": rate,
             "n_requests": n_requests,
             "prompt_len": prompt_len, "decode_tokens": decode_tokens,
-            "token_latency_ms_p50": round(float(np.percentile(per_tok,
-                                                              50)), 1),
-            "token_latency_ms_p95": round(float(np.percentile(per_tok,
-                                                              95)), 1),
-            "e2e_s_p50": round(float(np.percentile(e2e, 50)), 2),
-            "e2e_s_p95": round(float(np.percentile(e2e, 95)), 2),
+            "token_latency_ms_p50": pct(per_tok, 50, 1),
+            "token_latency_ms_p95": pct(per_tok, 95, 1),
+            "e2e_s_p50": pct(e2e, 50, 2),
+            "e2e_s_p95": pct(e2e, 95, 2),
             "sla_ms_per_token": sla_ms,
             "goodput_qps": round(met / wall, 2),
             "offered_qps": round(n_requests / wall, 2),

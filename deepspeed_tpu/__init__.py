@@ -9,6 +9,7 @@ reference's ``deepspeed/__init__.py``: ``initialize`` (:69),
 
 __version__ = "0.1.0"
 
+from .utils import compat as _compat  # noqa: F401  (older-jax shims)
 from . import comm
 from .accelerator import get_accelerator
 from .comm import init_distributed

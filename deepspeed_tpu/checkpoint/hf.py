@@ -440,7 +440,11 @@ def convert_gptj(hf, sd, dtype="bfloat16"):
         n_layer=L, n_head=hf["n_head"], n_kv_heads=hf["n_head"],
         d_model=D, d_ff=hf.get("n_inner") or 4 * D,
         rms_eps=hf.get("layer_norm_epsilon", 1e-5),
-        rotary_pct=hf.get("rotary_dim", hd) / hd,
+        # HF configs may carry an explicit "rotary_dim": null — that
+        # means full-head rotary, same as the key being absent (but an
+        # explicit 0 stays 0: rotate nothing)
+        rotary_pct=(hd if hf.get("rotary_dim") is None
+                    else hf["rotary_dim"]) / hd,
         dtype=dtype)
     pre = "transformer."
     g = lambda k: sd[pre + k]

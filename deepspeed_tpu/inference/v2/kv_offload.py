@@ -150,6 +150,7 @@ class OffloadKVPool:
         missing, blk_k, blk_v = prepared if prepared is not None \
             else ([], None, None)
         if not missing and not skip:
+            self._check_resident(need)
             for b in need:
                 self.last_used[self.slot_of[b]] = self._tick
             return cache
@@ -204,10 +205,24 @@ class OffloadKVPool:
         for b, s in zip(list(missing) + skip, slots + skip_slots):
             self.logical_of[s] = b
             self.slot_of[b] = s
+        # a stale ``prepared`` handle (built for a different block list)
+        # can leave a needed block without a slot — translate() would
+        # then silently route its reads to the scratch slot and the
+        # dispatch would attend garbage; fail loudly instead
+        self._check_resident(need)
         for b in need:
             self.last_used[self.slot_of[b]] = self._tick
         self.swapped_in += len(missing)
         return cache
+
+    def _check_resident(self, need):
+        stale = [b for b in need if self.slot_of[b] < 0]
+        if stale:
+            raise RuntimeError(
+                f"ensure() commit left blocks {stale} without device "
+                "slots — the prepared handle was built for a different "
+                "block list (stale prepare()); re-prepare with the "
+                "dispatch's actual blocks")
 
     def _writeback(self, cache, slots):
         # pad to the same power-of-two buckets as the upload path so the

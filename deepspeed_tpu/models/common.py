@@ -20,8 +20,13 @@ def constrain_fn():
     (inside shard_map, e.g. the 1-bit trainer), GSPMD directives
     otherwise."""
     mesh = jax.sharding.get_abstract_mesh()
+    if mesh.empty:
+        return lambda x, spec: x
+    axis_types = getattr(mesh, "axis_types", None)
+    if axis_types is None:        # older jax (compat shim): the ambient
+        return lax.with_sharding_constraint   # mesh is always GSPMD-auto
     from jax.sharding import AxisType
-    if mesh.empty or not any(t == AxisType.Auto for t in mesh.axis_types):
+    if not any(t == AxisType.Auto for t in axis_types):
         return lambda x, spec: x
     return lax.with_sharding_constraint
 

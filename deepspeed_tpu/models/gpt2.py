@@ -99,6 +99,32 @@ class GPT2Config:
     # attention path (the window is a per-layer scan operand)
     scale_attn: bool = True
     attn_layer_windows: tuple = ()
+    # layout-owning Pallas MLP projection matmul (ops/pallas/
+    # mlp_matmul.py; reference csrc/transformer/cublas_wrappers.cu —
+    # the epilogue-fusing GEMM tier). Attacks the measured T-minor
+    # wdown emitter penalty (~13 ms/step at 350M: XLA's
+    # EmitOutputBatchInLanesKernelOutputFeatureInLanes half-rates the
+    # down projection under the flash path's T-in-lanes layout
+    # pressure) by giving the projection a kernel that consumes the
+    # einsum's natural T-minor activation and emits the residual-add
+    # layout directly, with the backward dx emitted in the activation's
+    # own orientation and dw's fp32-accumulate + weight-dtype cast
+    # fused. Values: False (XLA, default) | 'auto' (kernel on TPU) |
+    # 'down' (down projection only) | 'both' (up emits T-minor via the
+    # kernel too). Not used when seq-sharded (Ulysses keeps the XLA
+    # path).
+    mlp_kernel: object = False
+    # False leaves the weight grad to XLA (inside the layer scan it
+    # fuses into the grad-stacking DUS at full MXU rate — the round-3
+    # trace finding); True uses the kernel's fused fp32-accum dw
+    mlp_kernel_fuse_dw: bool = True
+    # q-major fused flash backward (ops/pallas/flash_attention.py
+    # _bwd_kernel_t_qmajor): dq written once per grid step in the model
+    # dtype (no fp32 HBM round trip + cast copy) and dk/dv accumulated
+    # VMEM-resident across the sequential grid — the trick that won
+    # -38 ms on dq, applied to the dkv side. qkv_t layouts only;
+    # biased/ALiBi paths keep the k-major kernel.
+    flash_bwd_qmajor: bool = False
     # fused one-pass LayerNorm Pallas kernel (ops/pallas/layernorm.py;
     # reference csrc/transformer/normalize_kernels.cu). Measured SLOWER
     # than XLA's fused jnp layernorm inside the 350M training step (the
@@ -513,7 +539,8 @@ class GPT2:
                 block_q_bwd=cfg.flash_block_q_bwd or None,
                 block_k_bwd=cfg.flash_block_k_bwd or None,
                 heads_major=not cfg.flash_qkv_t,
-                qkv_t=cfg.flash_qkv_t).astype(dt)
+                qkv_t=cfg.flash_qkv_t,
+                bwd_qmajor=cfg.flash_bwd_qmajor).astype(dt)
             from jax.ad_checkpoint import checkpoint_name
             attn = checkpoint_name(attn, "attn_out")
         else:
@@ -601,18 +628,47 @@ class GPT2:
         GPT2MoE for noisy gating / top-2 sampling)."""
         return self.config.dropout > 0
 
+    def _mlp_kernel_mode(self):
+        """Resolved cfg.mlp_kernel: None (XLA path) | 'down' | 'both'."""
+        v = self.config.mlp_kernel
+        if not v:
+            return None
+        if v == "auto":
+            return "down" if jax.default_backend() == "tpu" else None
+        return "down" if v is True else v
+
     def _mlp(self, h, layer, rng, *, train, seq_sharded, constrain):
         """Dense MLP; overridden by GPT2MoE with an expert-parallel MoE.
         Returns (output, aux_loss)."""
         from jax.ad_checkpoint import checkpoint_name
-        # named pre-activation: saving it skips the wup matmul recompute in
-        # backward (gelu' needs this tensor; gelu_out is one VPU op away)
-        u = checkpoint_name(h @ layer["wup"] + layer["bup"], "mlp_up")
         acts = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}
         if self.config.activation not in acts:
             raise ValueError(
                 f"unknown activation {self.config.activation!r}; "
                 f"expected one of {sorted(acts)}")
+        mode = self._mlp_kernel_mode() if not seq_sharded else None
+        if mode:
+            # layout-owning projection kernels: the pre-activation is
+            # carried (B, F, T) — the up einsum's NATURAL T-minor output
+            # (no transpose anywhere) — and the down kernel consumes it
+            # directly, emitting the residual-add (B, T, D) layout, so
+            # neither XLA's half-rate T-minor wdown emitter nor the
+            # backward relayout copies exist on this path
+            from ..ops.pallas.mlp_matmul import mlp_matmul
+            if mode == "both":
+                u = mlp_matmul(h, layer["wup"], out_t=True,
+                               fuse_dw=self.config.mlp_kernel_fuse_dw)
+            else:
+                u = jnp.einsum("btd,df->bft", h, layer["wup"])
+            u = checkpoint_name(u + layer["bup"][None, :, None], "mlp_up")
+            up = acts[self.config.activation](u)
+            up = constrain(up, P(BATCH_AXES, "tensor", None))
+            out = mlp_matmul(up, layer["wdown"], x_t=True,
+                             fuse_dw=self.config.mlp_kernel_fuse_dw)
+            return out + layer["bdown"], jnp.zeros((), jnp.float32)
+        # named pre-activation: saving it skips the wup matmul recompute in
+        # backward (gelu' needs this tensor; gelu_out is one VPU op away)
+        u = checkpoint_name(h @ layer["wup"] + layer["bup"], "mlp_up")
         up = acts[self.config.activation](u)
         up = constrain(up, P(BATCH_AXES, "seq" if seq_sharded else None,
                              "tensor"))

@@ -30,21 +30,37 @@ def _tree_zeros_like(tree):
 
 class FusedAdam:
     """Adam/AdamW (reference ops/adam/fused_adam.py:18; adam_w_mode=True
-    gives AdamW decoupled weight decay, matching the reference default)."""
+    gives AdamW decoupled weight decay, matching the reference default).
+
+    ``moments_dtype``: storage dtype for m/v (e.g. "bfloat16" — halves
+    optimizer-state HBM, the lever that lets GPT-2 1.3B ZeRO-3 training
+    state fit a single 16 GB chip). The update itself always computes in
+    fp32 from the upcast moments; None (default) stores them in the
+    master-param dtype (fp32), bitwise-identical to the prior behavior
+    for fp32 inputs."""
 
     def __init__(self, lr=1e-3, betas=(0.9, 0.999), eps=1e-8,
-                 weight_decay=0.0, bias_correction=True, adam_w_mode=True):
+                 weight_decay=0.0, bias_correction=True, adam_w_mode=True,
+                 moments_dtype=None):
         self.lr = lr
         self.b1, self.b2 = betas
         self.eps = eps
         self.weight_decay = weight_decay
         self.bias_correction = bias_correction
         self.adam_w_mode = adam_w_mode
+        self.moments_dtype = None if moments_dtype is None \
+            else jnp.dtype(moments_dtype)
+
+    def _moments_like(self, params):
+        if self.moments_dtype is None:
+            return _tree_zeros_like(params)
+        return jax.tree.map(
+            lambda p: jnp.zeros(p.shape, self.moments_dtype), params)
 
     def init(self, params):
         return {"step": jnp.zeros((), jnp.int32),
-                "m": _tree_zeros_like(params),
-                "v": _tree_zeros_like(params)}
+                "m": self._moments_like(params),
+                "v": self._moments_like(params)}
 
     def update(self, grads, state, params, lr=None):
         lr = self.lr if lr is None else lr
@@ -57,6 +73,10 @@ class FusedAdam:
             c1 = c2 = 1.0
 
         def leaf(p, g, m, v):
+            mdt = m.dtype
+            g = g.astype(jnp.float32)
+            m = m.astype(jnp.float32)
+            v = v.astype(jnp.float32)
             if not self.adam_w_mode and self.weight_decay:
                 g = g + self.weight_decay * p  # classic L2
             m = b1 * m + (1 - b1) * g
@@ -64,7 +84,10 @@ class FusedAdam:
             upd = (m / c1) / (jnp.sqrt(v / c2) + self.eps)
             if self.adam_w_mode and self.weight_decay:
                 upd = upd + self.weight_decay * p
-            return p - lr * upd, m, v
+            # params keep their own dtype (fp32 update math must not
+            # promote a bf16 master-less param tree)
+            return (p - lr * upd).astype(p.dtype), \
+                m.astype(mdt), v.astype(mdt)
 
         out = jax.tree.map(leaf, params, grads, state["m"], state["v"])
         new_params = jax.tree.map(lambda t: t[0], out,

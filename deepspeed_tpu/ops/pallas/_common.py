@@ -14,7 +14,8 @@ def sds(shape, dtype, like):
     """ShapeDtypeStruct whose varying-manual-axes match ``like`` — required
     when a kernel runs inside a shard_map region (e.g. quantized
     collectives, pipelined blocks)."""
-    vma = getattr(jax.typeof(like), "vma", None)
+    typeof = getattr(jax, "typeof", None)
+    vma = getattr(typeof(like), "vma", None) if typeof is not None else None
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
     return jax.ShapeDtypeStruct(shape, dtype)

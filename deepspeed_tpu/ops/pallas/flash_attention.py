@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
 
 from ._common import interpret_default as _interpret_default
 from ._common import round_up as _round_up
@@ -814,6 +815,142 @@ def _bwd_kernel_t(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
     dv_ref[...] = dv.astype(dv_ref.dtype)
 
 
+def _bwd_kernel_t_qmajor(q_ref, k_ref, v_ref, do_ref, lse_ref, od_ref,
+                         dq_ref, dk_ref, dv_ref, dk_scr, dv_scr, *, bq,
+                         bk, scale, causal, t_real, ext_delta, window=0):
+    """Fused backward, transposed layout, walked QUERY-major.
+
+    The k-major kernel (_bwd_kernel_t) accumulates dq across grid steps
+    in a VMEM-resident fp32 OUTPUT block — which must then round-trip
+    HBM in fp32 and pay a cast copy outside. This variant applies the
+    same VMEM-resident-accumulation trick to the dkv side instead: the
+    grid walks query blocks (the forward's access pattern), dq for each
+    block completes in ONE grid step and is written once, directly in
+    the model dtype (no fp32 HBM buffer, no cast copy), while dk/dv
+    accumulate in fp32 VMEM scratch across the sequential grid and cast
+    in the final step's epilogue. delta = rowsum(do * o) is computed
+    once per QUERY block (the k-major kernel recomputes it for every
+    (q, k) pair when bk < T). Inner-loop bounds are exactly the forward
+    kernel's causal/window/padding bounds. Bias operands are not
+    supported here — biased paths keep the k-major kernel."""
+    qi = pl.program_id(1)
+    nq = pl.num_programs(1)
+    q = q_ref[...]                                          # (G, d, bq)
+    G = q.shape[0]
+    kb_all = k_ref
+    T = k_ref.shape[2]
+    nk = T // bk
+    kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
+    kfull = (qi * bq) // bk if (causal and t_real >= T) else (
+        nk if (not causal and t_real >= T) else 0)
+    kmin = 0
+    if window:
+        kmin = jnp.maximum(0, (qi * bq - window + 1) // bk)
+        kfull = kmin
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    do = do_ref[...]                                        # (G, bq, d)
+    lse = lse_ref[...][..., 0]                              # (G, bq)
+    if ext_delta:
+        delta = od_ref[...][..., 0]
+    else:
+        ob = od_ref[...]                                    # (G, bq, d)
+        delta = jnp.sum(do.astype(jnp.float32)
+                        * ob.astype(jnp.float32), axis=-1)
+
+    def make_body(masked):
+        def body(j, dq):
+            kb = kb_all[:, :, pl.ds(j * bk, bk)]
+            vb = v_ref[:, :, pl.ds(j * bk, bk)]
+            s = jax.lax.dot_general(q, kb, _DN_QK_T,
+                                    preferred_element_type=jnp.float32)
+            if scale != 1.0:
+                s = s * scale
+            if masked:
+                s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
+                                               causal, t_real, T,
+                                               window))
+            p = jnp.exp(s - lse[..., None])                 # (G, bq, bk)
+            pb = p.astype(do.dtype)
+            dv_scr[:, :, pl.ds(j * bk, bk)] += jax.lax.dot_general(
+                do, pb, _DN_DV_T, preferred_element_type=jnp.float32)
+            dp = jax.lax.dot_general(do, vb, _DN_DO_V,
+                                     preferred_element_type=jnp.float32)
+            ds_f = p * (dp - delta[..., None])
+            ds = ds_f.astype(q.dtype)
+            dk_scr[:, :, pl.ds(j * bk, bk)] += jax.lax.dot_general(
+                q, ds, _DN_DK_T, preferred_element_type=jnp.float32)
+            return dq + jax.lax.dot_general(
+                kb, ds, _DN_DQ_T, preferred_element_type=jnp.float32)
+        return body
+
+    d = q_ref.shape[1]
+    dq = jnp.zeros((G, d, bq), jnp.float32)
+    dq = jax.lax.fori_loop(kmin, kfull, make_body(False), dq)
+    dq = jax.lax.fori_loop(kfull, kmax, make_body(True), dq)
+    if scale != 1.0:
+        dq = dq * scale
+    dq_ref[...] = dq.astype(dq_ref.dtype)
+
+    @pl.when(qi == nq - 1)
+    def _flush():
+        dk = dk_scr[...]
+        if scale != 1.0:
+            dk = dk * scale
+        dk_ref[...] = dk.astype(dk_ref.dtype)
+        dv_ref[...] = dv_scr[...].astype(dv_ref.dtype)
+
+
+def _bwd_t_qmajor(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh,
+                  t_real, interpret, dlse=None, window=0):
+    BH, d, T = q.shape
+    lse = jnp.broadcast_to(lse_t, (BH, T, LSE_LANES))
+    if dlse is not None:
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1) - dlse.astype(jnp.float32)
+        od = jnp.broadcast_to(delta[..., None], (BH, T, LSE_LANES))
+    else:
+        od = o
+    dq, dk, dv = pl.pallas_call(
+        functools.partial(_bwd_kernel_t_qmajor, bq=bq, bk=bk, scale=scale,
+                          causal=causal, t_real=t_real,
+                          ext_delta=dlse is not None, window=window),
+        grid=(BH // bh, T // bq),
+        in_specs=[
+            pl.BlockSpec((bh, d, bq), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((bh, d, T), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, d, T), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, bq, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, LSE_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, LSE_LANES if dlse is not None else d),
+                         lambda b, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bh, d, bq), lambda b, i: (b, 0, i)),
+            pl.BlockSpec((bh, d, T), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, d, T), lambda b, i: (b, 0, 0)),
+        ],
+        out_shape=[
+            # every output in the model dtype: dq slices are written
+            # exactly once (their grid step), dk/dv cast from the fp32
+            # VMEM accumulators in the last step's epilogue
+            _sds((BH, d, T), q.dtype, q),
+            _sds((BH, d, T), q.dtype, q),
+            _sds((BH, d, T), q.dtype, q),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bh, d, T), jnp.float32),
+            pltpu.VMEM((bh, d, T), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v, do, lse, od)
+    return dq, dk, dv, ()
+
+
 def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
            interpret, dlse=None, window=0, biases=(), bias_cfgs=(),
            alibi_cfg=None):
@@ -872,10 +1009,10 @@ def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
 # --------------------------------------------------------------- public API
 @functools.partial(jax.custom_vjp,
                    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14,
-                                    15, 16))
+                                    15, 16, 17))
 def _flash(q, k, v, biases, scale, causal, bq, bk, bh, t_real, interpret,
            bwd_bq, bwd_bk, qkv_t=False, window=0, bias_cfgs=(),
-           alibi_cfg=None):
+           alibi_cfg=None, bwd_qmajor=False):
     fwd = _fwd_t if qkv_t else _fwd
     o, lse = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
                  window, biases, bias_cfgs, alibi_cfg)
@@ -884,7 +1021,7 @@ def _flash(q, k, v, biases, scale, causal, bq, bk, bh, t_real, interpret,
 
 def _flash_fwd(q, k, v, biases, scale, causal, bq, bk, bh, t_real,
                interpret, bwd_bq, bwd_bk, qkv_t=False, window=0,
-               bias_cfgs=(), alibi_cfg=None):
+               bias_cfgs=(), alibi_cfg=None, bwd_qmajor=False):
     from jax.ad_checkpoint import checkpoint_name
     # symbolic_zeros=True wraps primal args in CustomVJPPrimal
     q, k, v = q.value, k.value, v.value
@@ -916,7 +1053,8 @@ def _flash_fwd(q, k, v, biases, scale, causal, bq, bk, bh, t_real,
 
 
 def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
-               bwd_bk, qkv_t, window, bias_cfgs, alibi_cfg, res, cts):
+               bwd_bk, qkv_t, window, bias_cfgs, alibi_cfg, bwd_qmajor,
+               res, cts):
     # backward may run its own (smaller) blocks: the fused dq/dk/dv pass
     # is ~2x the forward's work, so causal above-diagonal skipping wins
     # more there than grid-step overhead costs
@@ -934,6 +1072,10 @@ def _flash_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
     # cotangent on lse enters the shared ds = p * (dp - delta) term as
     # ds += p * dlse — i.e. exactly a shift of delta by -dlse. Folding it
     # there costs zero extra kernel work.
+    if bwd_qmajor and qkv_t and not biases and alibi_cfg is None:
+        return _bwd_t_qmajor(
+            q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
+            interpret, dlse=dlse, window=window)
     bwd = _bwd_t if qkv_t else _bwd
     dq, dk, dv, dbiases = bwd(
         q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
@@ -951,10 +1093,10 @@ _flash.defvjp(_flash_fwd, _flash_bwd, symbolic_zeros=True)
 # residual still saves it for the backward).
 @functools.partial(jax.custom_vjp,
                    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14,
-                                    15, 16))
+                                    15, 16, 17))
 def _flash_o(q, k, v, biases, scale, causal, bq, bk, bh, t_real,
              interpret, bwd_bq, bwd_bk, qkv_t=False, window=0,
-             bias_cfgs=(), alibi_cfg=None):
+             bias_cfgs=(), alibi_cfg=None, bwd_qmajor=False):
     fwd = _fwd_t if qkv_t else _fwd
     o, _ = fwd(q, k, v, scale, causal, bq, bk, bh, t_real, interpret,
                window, biases, bias_cfgs, alibi_cfg)
@@ -963,20 +1105,25 @@ def _flash_o(q, k, v, biases, scale, causal, bq, bk, bh, t_real,
 
 def _flash_o_fwd(q, k, v, biases, scale, causal, bq, bk, bh, t_real,
                  interpret, bwd_bq, bwd_bk, qkv_t=False, window=0,
-                 bias_cfgs=(), alibi_cfg=None):
+                 bias_cfgs=(), alibi_cfg=None, bwd_qmajor=False):
     (o, _), res = _flash_fwd(q, k, v, biases, scale, causal, bq, bk, bh,
                              t_real, interpret, bwd_bq, bwd_bk, qkv_t,
-                             window, bias_cfgs, alibi_cfg)
+                             window, bias_cfgs, alibi_cfg, bwd_qmajor)
     return o, res
 
 
 def _flash_o_bwd(scale, causal, bq, bk, bh, t_real, interpret, bwd_bq,
-                 bwd_bk, qkv_t, window, bias_cfgs, alibi_cfg, res, do):
+                 bwd_bk, qkv_t, window, bias_cfgs, alibi_cfg, bwd_qmajor,
+                 res, do):
     from jax.custom_derivatives import SymbolicZero
     bq, bk = bwd_bq or bq, bwd_bk or bk
     if isinstance(do, SymbolicZero):
         do = jnp.zeros(do.shape, do.dtype)
     q, k, v, o, lse_t, biases = res
+    if bwd_qmajor and qkv_t and not biases and alibi_cfg is None:
+        return _bwd_t_qmajor(
+            q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
+            interpret, dlse=None, window=window)
     bwd = _bwd_t if qkv_t else _bwd
     dq, dk, dv, dbiases = bwd(
         q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
@@ -995,7 +1142,8 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
                              qkv_t=False, window=0, bias=None,
                              bias_grad=False, alibi=None,
                              alibi_scale=1.0, alibi_bf16=False,
-                             _folded_biases=None, _with_lse=True):
+                             bwd_qmajor=False, _folded_biases=None,
+                             _with_lse=True):
     """Fused attention over (batch, seq, heads, head_dim) inputs, returning
     ``(o, lse)`` where lse is the per-query logsumexp, (B, H, T) fp32.
 
@@ -1070,7 +1218,8 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
             block_k_bwd=block_k_bwd, qkv_t=False, window=window,
             bias=bias, bias_grad=bias_grad, alibi=alibi,
             alibi_scale=alibi_scale, alibi_bf16=alibi_bf16,
-            _folded_biases=_folded_biases, _with_lse=_with_lse)
+            bwd_qmajor=False, _folded_biases=_folded_biases,
+            _with_lse=_with_lse)
 
     # -------- bias descriptors -> bh constraints (before bh is picked)
     descs = []                                  # (arr4d, grad)
@@ -1223,9 +1372,13 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
     if window and not causal:
         raise ValueError("sliding window requires causal attention")
     q = q * jnp.asarray(scale, q.dtype)
+    # q-major backward: transposed-operand, bias-free paths only (the
+    # biased kernels need the k-major dbias accumulation structure)
+    qmaj = bool(bwd_qmajor) and bool(qkv_t) and not biases_folded \
+        and alibi_cfg is None
     args = (fold(q), fold(k), fold(v), tuple(biases_folded), 1.0,
             bool(causal), bq, bk, bh, T, bool(interpret), bwd_bq, bwd_bk,
-            bool(qkv_t), int(window), tuple(cfgs), alibi_cfg)
+            bool(qkv_t), int(window), tuple(cfgs), alibi_cfg, qmaj)
     if _with_lse:
         o, lse = _flash(*args)
     else:
@@ -1255,20 +1408,23 @@ def flash_attention(q, k, v, *, causal=True, scale=None, block_q=128,
                     heads_major=False, block_q_bwd=None,
                     block_k_bwd=None, qkv_t=False, window=0, bias=None,
                     bias_grad=False, alibi=None, alibi_scale=1.0,
-                    alibi_bf16=False, _folded_biases=None):
+                    alibi_bf16=False, bwd_qmajor=False,
+                    _folded_biases=None):
     """Fused attention over (batch, seq, heads, head_dim); see
     :func:`flash_attention_with_lse` (this never emits the lse output).
     ``window`` > 0 = mistral sliding-window attention (causal only);
     ``bias``/``alibi`` = additive score biases (ALiBi, padding masks,
-    pair biases) applied in-kernel."""
+    pair biases) applied in-kernel. ``bwd_qmajor``: query-major fused
+    backward (dq written once in the model dtype, dk/dv VMEM-resident;
+    qkv_t bias-free paths only — silently k-major otherwise)."""
     o, _ = flash_attention_with_lse(
         q, k, v, causal=causal, scale=scale, block_q=block_q,
         block_k=block_k, block_h=block_h, interpret=interpret,
         heads_major=heads_major, block_q_bwd=block_q_bwd,
         block_k_bwd=block_k_bwd, qkv_t=qkv_t, window=window, bias=bias,
         bias_grad=bias_grad, alibi=alibi, alibi_scale=alibi_scale,
-        alibi_bf16=alibi_bf16, _folded_biases=_folded_biases,
-        _with_lse=False)
+        alibi_bf16=alibi_bf16, bwd_qmajor=bwd_qmajor,
+        _folded_biases=_folded_biases, _with_lse=False)
     return o
 
 

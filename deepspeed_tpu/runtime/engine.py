@@ -341,6 +341,14 @@ class DeepSpeedEngine:
         pdtype = self.param_dtype
         use_master = self.use_master
         constrain = jax.lax.with_sharding_constraint
+        # accumulate/reduce dtype: fp32 default (the reference
+        # grad_accum_dtype default); data_types.grad_accum_dtype "bf16"
+        # halves the full-model transient grad tree — the knob the 1.3B
+        # ZeRO-3 single-chip point needs to fit 16 GB HBM (the optimizer
+        # still computes its update in fp32)
+        gdtype = jnp.dtype({"fp32": "float32", "bf16": "bfloat16",
+                            "fp16": "float16", None: "float32"}.get(
+            self.config.grad_accum_dtype, self.config.grad_accum_dtype))
 
         def micro_loss_and_grads(params, micro_batch, rng, scale,
                                  step=None, ltd_keep=None):
@@ -349,8 +357,7 @@ class DeepSpeedEngine:
                                         step=step, ltd_keep=ltd_keep) \
                     * scale
             loss_scaled, grads = jax.value_and_grad(scaled)(params)
-            # accumulate/reduce in fp32 (reference grad_accum_dtype default)
-            grads = _tree_cast(grads, jnp.float32)
+            grads = _tree_cast(grads, gdtype)
             return loss_scaled / scale, grads
 
         def unscale_clip_grads(grads, scale):
@@ -358,15 +365,20 @@ class DeepSpeedEngine:
             definition so the fused, offload, and staged paths cannot
             drift. Returns (grads, finite, gnorm); the global norm's
             cross-shard psum falls out of GSPMD."""
-            grads = jax.tree.map(lambda g, s: constrain(g / scale, s),
-                                 grads, grad_specs)
+            # keep each leaf's own dtype through the unscale (the fp32
+            # scalar would silently promote a bf16 grad tree to fp32 —
+            # exactly the materialization grad_accum_dtype=bf16 avoids)
+            grads = jax.tree.map(
+                lambda g, s: constrain((g / scale).astype(g.dtype), s),
+                grads, grad_specs)
             finite = grads_finite(grads)
-            sq = sum(jnp.sum(jnp.square(g))
+            sq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
                      for g in jax.tree.leaves(grads))
             gnorm = jnp.sqrt(sq)
             if clip and clip > 0:
                 coef = jnp.minimum(1.0, clip / (gnorm + 1e-6))
-                grads = jax.tree.map(lambda g: g * coef, grads)
+                grads = jax.tree.map(
+                    lambda g: (g * coef).astype(g.dtype), grads)
             return grads, finite, gnorm
 
         def apply_update(state, grads, lr):
@@ -427,8 +439,8 @@ class DeepSpeedEngine:
                 return (acc, rng, i + 1), loss
 
             zero_grads = jax.tree.map(
-                lambda s: jnp.zeros(s.shape, jnp.float32),
-                jax.eval_shape(lambda p: _tree_cast(p, jnp.float32),
+                lambda s: jnp.zeros(s.shape, gdtype),
+                jax.eval_shape(lambda p: _tree_cast(p, gdtype),
                                state["params"]))
             zero_grads = jax.tree.map(lambda g, s: constrain(g, s),
                                       zero_grads, grad_specs)

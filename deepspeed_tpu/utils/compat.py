@@ -1,0 +1,70 @@
+"""Back-compat shims for older jax releases.
+
+The codebase targets current jax (``jax.set_mesh`` as the ambient-mesh
+context, ``jax.typeof``, ``jax.sharding.get_abstract_mesh``). CI / dev
+containers sometimes carry an older jaxlib where those entry points do
+not exist yet; this module installs the closest older-API equivalents so
+the same code runs in both places. On a current jax every shim is a
+no-op (the real attribute wins).
+"""
+
+import jax
+
+
+def _ambient_mesh():
+    """The legacy ambient mesh (set by the Mesh context manager)."""
+    from jax._src.mesh import thread_resources
+    return thread_resources.env.physical_mesh
+
+
+def install():
+    if not hasattr(jax, "set_mesh"):
+        # the legacy Mesh context manager provides the same ambient
+        # mesh for with_sharding_constraint / PartitionSpec resolution
+        def _set_mesh(mesh):
+            return mesh
+
+        jax.set_mesh = _set_mesh
+
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = _ambient_mesh
+
+    if not hasattr(jax, "shard_map"):
+        # jax.shard_map(f, mesh=, in_specs=, out_specs=, axis_names=,
+        # check_vma=) -> experimental shard_map(f, mesh, in_specs,
+        # out_specs, check_rep=, auto=); mesh defaults to the ambient
+        # mesh, axis_names maps to its complement ``auto`` set
+        def _shard_map(f, *, mesh=None, in_specs=None, out_specs=None,
+                       axis_names=None, check_vma=None, **kw):
+            from jax.experimental.shard_map import shard_map as _sm
+            if mesh is None:
+                mesh = _ambient_mesh()
+            if check_vma is not None:
+                kw["check_rep"] = check_vma
+            if axis_names is not None:
+                auto = frozenset(mesh.axis_names) - frozenset(axis_names)
+                if auto:
+                    kw["auto"] = auto
+            return _sm(f, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, **kw)
+
+        jax.shard_map = _shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        # static axis size from the legacy axis-env frame
+        def _axis_size(axis_name):
+            from jax._src.core import axis_frame
+            f = axis_frame(axis_name)
+            return f if isinstance(f, int) else f.size
+
+        jax.lax.axis_size = _axis_size
+
+    if not hasattr(jax.tree, "leaves_with_path"):
+        from jax import tree_util as _tu
+        jax.tree.leaves_with_path = _tu.tree_leaves_with_path
+        jax.tree.flatten_with_path = _tu.tree_flatten_with_path
+        if not hasattr(jax.tree, "map_with_path"):
+            jax.tree.map_with_path = _tu.tree_map_with_path
+
+
+install()

@@ -359,3 +359,48 @@ class TestHFIngestion:
             json.dump({"model_type": "t5"}, f)
         with pytest.raises(ValueError, match="unsupported model_type"):
             load_pretrained(str(d))
+
+
+class TestGPTJNullRotaryDim:
+    """HF configs may carry an explicit ``"rotary_dim": null`` — that
+    means full-head rotary (same as the key being absent), and must not
+    crash the converter with a None / int division."""
+
+    def _convert(self, hf_extra):
+        from deepspeed_tpu.checkpoint.hf import convert_gptj
+        L, D, H, V, T = 2, 64, 4, 128, 32
+        F = 4 * D
+        hf = dict({"n_layer": L, "n_embd": D, "n_head": H,
+                   "vocab_size": V, "n_positions": T}, **hf_extra)
+        r = np.random.RandomState(0)
+        sd = {"transformer.wte.weight": r.randn(V, D).astype(np.float32),
+              "transformer.ln_f.weight": np.ones(D, np.float32),
+              "transformer.ln_f.bias": np.zeros(D, np.float32),
+              "lm_head.weight": r.randn(V, D).astype(np.float32),
+              "lm_head.bias": np.zeros(V, np.float32)}
+        for i in range(L):
+            lp = f"transformer.h.{i}."
+            for nm in ("q_proj", "k_proj", "v_proj", "out_proj"):
+                sd[lp + f"attn.{nm}.weight"] = \
+                    r.randn(D, D).astype(np.float32)
+            sd[lp + "mlp.fc_in.weight"] = r.randn(F, D).astype(np.float32)
+            sd[lp + "mlp.fc_in.bias"] = np.zeros(F, np.float32)
+            sd[lp + "mlp.fc_out.weight"] = r.randn(D, F).astype(np.float32)
+            sd[lp + "mlp.fc_out.bias"] = np.zeros(D, np.float32)
+            sd[lp + "ln_1.weight"] = np.ones(D, np.float32)
+            sd[lp + "ln_1.bias"] = np.zeros(D, np.float32)
+        return convert_gptj(hf, sd, dtype="float32")
+
+    def test_null_rotary_dim_means_full_head(self):
+        cfg_null, _ = self._convert({"rotary_dim": None})
+        cfg_abs, _ = self._convert({})
+        assert cfg_null.rotary_pct == 1.0
+        assert cfg_abs.rotary_pct == 1.0
+
+    def test_explicit_rotary_dim_still_partial(self):
+        cfg, _ = self._convert({"rotary_dim": 8})
+        assert cfg.rotary_pct == 8 / 16
+
+    def test_zero_rotary_dim_means_no_rotary(self):
+        cfg, _ = self._convert({"rotary_dim": 0})
+        assert cfg.rotary_pct == 0.0

@@ -115,3 +115,33 @@ class TestKVOffload:
         params = model.init(jax.random.key(2))
         with pytest.raises(ValueError, match="device_kv_blocks"):
             InferenceEngineV2(model, params=params, kv_host_offload=True)
+
+
+class TestStaleHandleGuard:
+    def setup_method(self, method):
+        groups.reset()
+
+    def test_stale_prepare_handle_raises(self):
+        """A prepare() handle built for a DIFFERENT block list must make
+        ensure() fail loudly — not silently leave the extra blocks
+        routed at the scratch slot (attending garbage)."""
+        model = _model()
+        params = model.init(jax.random.key(0))
+        eng = InferenceEngineV2(model, params=params, max_batch_size=4,
+                                kv_block_size=16, kv_host_offload=True,
+                                device_kv_blocks=8)
+        pool = eng.kv_pool
+        handle = pool.prepare([1])            # upload payload for 1 only
+        with pytest.raises(RuntimeError, match="stale prepare"):
+            eng.cache = pool.ensure(eng.cache, [1, 2], prepared=handle)
+
+    def test_fresh_handle_commits(self):
+        model = _model()
+        params = model.init(jax.random.key(0))
+        eng = InferenceEngineV2(model, params=params, max_batch_size=4,
+                                kv_block_size=16, kv_host_offload=True,
+                                device_kv_blocks=8)
+        pool = eng.kv_pool
+        handle = pool.prepare([1, 2])
+        eng.cache = pool.ensure(eng.cache, [1, 2], prepared=handle)
+        assert pool.slot_of[1] >= 0 and pool.slot_of[2] >= 0

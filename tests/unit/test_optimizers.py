@@ -130,3 +130,66 @@ def test_grads_finite():
     assert bool(grads_finite({"a": jnp.ones(3)}))
     assert not bool(grads_finite({"a": jnp.asarray([1.0, jnp.inf])}))
     assert not bool(grads_finite({"a": jnp.asarray([jnp.nan])}))
+
+
+class TestAdamMomentsDtype:
+    """moments_dtype: m/v stored low-precision, update computed fp32
+    (the 1.3B-on-one-chip memory lever)."""
+
+    def test_moments_stored_bf16(self):
+        opt = FusedAdam(lr=1e-2, moments_dtype="bfloat16")
+        p = _params()
+        s = opt.init(p)
+        assert all(l.dtype == jnp.bfloat16
+                   for l in jax.tree.leaves(s["m"]))
+        assert all(l.dtype == jnp.bfloat16
+                   for l in jax.tree.leaves(s["v"]))
+        p2, s2 = opt.update(_grads(), s, p)
+        # params stay in their own dtype; moments stay bf16
+        assert p2["w"].dtype == p["w"].dtype
+        assert jax.tree.leaves(s2["m"])[0].dtype == jnp.bfloat16
+
+    def test_update_close_to_fp32_adam(self):
+        """bf16 moment storage rounds the state, not the math: a single
+        step matches fp32 Adam to bf16 tolerance."""
+        a32 = FusedAdam(lr=1e-2)
+        a16 = FusedAdam(lr=1e-2, moments_dtype="bfloat16")
+        p = _params()
+        p32, _ = a32.update(_grads(), a32.init(p), p)
+        p16, _ = a16.update(_grads(), a16.init(p), p)
+        np.testing.assert_allclose(np.asarray(p32["w"]),
+                                   np.asarray(p16["w"]),
+                                   rtol=1e-2, atol=1e-2)
+
+    def test_default_path_unchanged(self):
+        """moments_dtype=None stores fp32 — identical to the historical
+        behavior (bitwise, fp32 inputs)."""
+        a = FusedAdam(lr=0.1, betas=(0.9, 0.999), eps=1e-8)
+        p = {"w": jnp.asarray([1.0])}
+        g = {"w": jnp.asarray([0.5])}
+        p2, _ = a.update(g, a.init(p), p)
+        m = 0.1 * 0.5
+        v = 0.001 * 0.25
+        mh, vh = m / (1 - 0.9), v / (1 - 0.999)
+        expect = 1.0 - 0.1 * mh / (np.sqrt(vh) + 1e-8)
+        np.testing.assert_allclose(float(p2["w"][0]), expect, rtol=1e-6)
+
+    def test_bf16_grads_upcast(self):
+        """bf16 grads (grad_accum_dtype=bf16) update fp32 params without
+        silently degrading the moment math to bf16."""
+        a = FusedAdam(lr=1e-2)
+        p = _params()
+        g16 = jax.tree.map(lambda g: g.astype(jnp.bfloat16), _grads())
+        p2, s2 = a.update(g16, a.init(p), p)
+        assert jax.tree.leaves(s2["m"])[0].dtype == jnp.float32
+        assert np.isfinite(np.asarray(p2["w"])).all()
+
+
+def test_adam_preserves_param_dtype():
+    """fp32 update math must not promote a bf16 (master-less) param
+    tree to fp32."""
+    opt = FusedAdam(lr=1e-2)
+    p = jax.tree.map(lambda x: x.astype(jnp.bfloat16), _params())
+    g = jax.tree.map(lambda x: x.astype(jnp.bfloat16), _grads())
+    p2, _ = opt.update(g, opt.init(p), p)
+    assert all(l.dtype == jnp.bfloat16 for l in jax.tree.leaves(p2))
