@@ -13,6 +13,8 @@ relaunches. Workers resume from the latest checkpoint (the engine's
 durable-`latest` pointer), which is the reference's recovery model too.
 """
 
+import os
+import re
 import time
 
 from ..utils.logging import logger
@@ -40,11 +42,29 @@ class DSElasticAgent:
       min_hosts: refuse to shrink below this.
       poll_s: liveness poll interval.
       on_restart(gen, hosts): hook (tests observe membership changes).
+      heartbeat_timeout_s: when set, a worker whose heartbeat file
+        (``heartbeat_path(host)``; workers beat via
+        ``DSTPU_HEARTBEAT_FILE`` -> utils.touch_heartbeat, once per
+        train_batch) goes stale for longer than this is treated as HUNG:
+        killed and routed through the same restart-from-latest path as a
+        worker that died. A worker that never beats is measured from its
+        launch time. None (default) disables hang detection.
+      heartbeat_dir: where heartbeat files live (created on demand;
+        default ``/tmp/dstpu_heartbeats_<pid>``). The launcher must
+        export ``DSTPU_HEARTBEAT_FILE=agent.heartbeat_path(host)`` into
+        each worker's env for beats to land. IMPORTANT: the agent stats
+        these files on ITS host — with remote (e.g. ssh-launched)
+        workers, heartbeat_dir must be on a filesystem shared between
+        the agent and every worker (the same shared-FS assumption the
+        checkpoint 'latest' protocol already makes); the /tmp default
+        is only correct for local workers. A non-shared dir would make
+        every healthy remote worker look hung.
     """
 
     def __init__(self, launch_fn, hosts, ds_config=None, chips_per_host=1,
                  max_restarts=10, min_hosts=1, poll_s=0.5,
-                 on_restart=None):
+                 on_restart=None, heartbeat_timeout_s=None,
+                 heartbeat_dir=None):
         self.launch_fn = launch_fn
         self.hosts = list(hosts)
         self.ds_config = ds_config
@@ -54,6 +74,40 @@ class DSElasticAgent:
         self.poll_s = poll_s
         self.on_restart = on_restart
         self.restart_count = 0
+        self.heartbeat_timeout_s = heartbeat_timeout_s
+        self.heartbeat_dir = heartbeat_dir or os.path.join(
+            "/tmp", f"dstpu_heartbeats_{os.getpid()}")
+
+    # ------------------------------------------------------------ heartbeat
+    def heartbeat_path(self, host):
+        """Heartbeat file for ``host`` — export as DSTPU_HEARTBEAT_FILE
+        in that worker's env."""
+        safe = re.sub(r"[^A-Za-z0-9_.-]", "_", str(host))
+        return os.path.join(self.heartbeat_dir, f"{safe}.hb")
+
+    def _clear_heartbeats(self, hosts):
+        """Before (re)launch: stale beats from the previous generation
+        must not count for — or against — the new one."""
+        if self.heartbeat_timeout_s is None:
+            return
+        os.makedirs(self.heartbeat_dir, exist_ok=True)
+        for h in hosts:
+            try:
+                os.remove(self.heartbeat_path(h))
+            except OSError:
+                pass
+
+    def _hung(self, host, launched_at):
+        """True when hang detection is on and ``host`` has not beaten
+        (or been launched) within the timeout."""
+        if self.heartbeat_timeout_s is None:
+            return False
+        beat = launched_at
+        try:
+            beat = max(beat, os.path.getmtime(self.heartbeat_path(host)))
+        except OSError:
+            pass
+        return (time.time() - beat) > self.heartbeat_timeout_s
 
     # ------------------------------------------------------------ internals
     def _validate_world(self, hosts):
@@ -72,14 +126,29 @@ class DSElasticAgent:
 
     def _supervise(self, procs):
         """Block until every worker exits. On the FIRST failure, terminate
-        the rest (a jax.distributed world is all-or-nothing). Returns
-        (ok, failed_hosts)."""
+        the rest (a jax.distributed world is all-or-nothing). A worker
+        that HANGS (no heartbeat within heartbeat_timeout_s) is killed
+        and counted as failed — same recovery path as a dead one.
+        Returns (ok, failed_hosts)."""
         live = dict(procs)
         failed = []
+        launched_at = time.time()
         while live:
             for host, p in list(live.items()):
                 rc = p.poll()
                 if rc is None:
+                    if self._hung(host, launched_at):
+                        logger.warning(
+                            f"elastic agent: worker on {host} missed its "
+                            f"heartbeat for > {self.heartbeat_timeout_s}s"
+                            f"; killing hung worker")
+                        try:
+                            p.kill()
+                            p.wait(timeout=5)   # reap, no zombie
+                        except Exception:  # noqa: BLE001
+                            pass
+                        del live[host]
+                        failed.append(host)
                     continue
                 del live[host]
                 if rc != 0:
@@ -113,6 +182,7 @@ class DSElasticAgent:
             logger.info(
                 f"elastic agent: launching generation {gen} on "
                 f"{len(self.hosts)} hosts")
+            self._clear_heartbeats(self.hosts)
             procs = self.launch_fn(list(self.hosts))
             ok, failed = self._supervise(procs)
             if ok:

@@ -256,13 +256,15 @@ class InferenceEngine:
     def load_checkpoint(self, load_dir, tag=None):
         """Load a training checkpoint's master weights into the inference
         shardings (reference load_model_with_checkpoint:331 — MP-sharded
-        load falls out of device_put with NamedShardings)."""
-        import os
+        load falls out of device_put with NamedShardings). Same recovery
+        semantics as the training engine: CRC-verified shards, and a
+        corrupt newest generation falls back to the previous durable
+        tag (an explicit ``tag`` is never substituted)."""
         from ..runtime.checkpoint_engine import serialization as ser
+        from ..runtime.checkpoint_engine import manager as ckpt_manager
+        tag, flat, header = ckpt_manager.load_best(load_dir, tag)
         if tag is None:
-            with open(os.path.join(load_dir, "latest")) as f:
-                tag = f.read().strip()
-        flat, header = ser.load_state(os.path.join(load_dir, tag))
+            raise FileNotFoundError(f"no checkpoint under {load_dir}")
         abstract = jax.eval_shape(self.model.init, jax.random.key(0))
         tree = ser.unflatten_into({"master": abstract}, {
             k: v for k, v in flat.items() if k.startswith("master")

@@ -4,6 +4,8 @@ Counterpart of the reference's py_ds_veloc.cpp pybind layer."""
 
 import ctypes
 
+from ...utils import fault_injection
+
 
 class Writer:
     def __init__(self, threads=4, fsync=False):
@@ -21,6 +23,10 @@ class Writer:
 
     def write(self, path, data):
         """data: bytes-like (memoryview/bytes/bytearray)."""
+        # chaos harness hook: a 'write' fault here models the C++ pool
+        # failing (full disk, dead thread) so the engine's retry/degrade
+        # path — not the training step — absorbs it
+        fault_injection.fire("write")
         mv = memoryview(data)
         if not mv.c_contiguous:
             mv = memoryview(bytes(mv))

@@ -10,12 +10,62 @@ A "state_dict" here is a pytree of host numpy arrays plus JSON-able
 metadata; engines only move bytes. Device->host staging is the engine
 caller's job (runtime/engine.py save_checkpoint), mirroring how the
 reference's VELOC engine receives tensors and owns the D2H pipeline.
+
+Robustness contract (all engines):
+  * a save that raises has NOT called ``on_durable`` — the 'latest'
+    pointer can never name a torn generation;
+  * transient write failures are retried with capped exponential
+    backoff (``save_retries`` / ``retry_backoff_s`` knobs on
+    CheckpointEngineConfig), then degrade to the engine's fallback
+    writer when it has one (native -> python, async -> in-caller sync);
+  * every failed save version surfaces exactly ONE CheckpointSaveError
+    from ``wait()``/``commit()`` — failed futures never wedge
+    ``_inflight``;
+  * ``counters`` records saves/loads/retries/fallbacks/errors so the
+    runtime engine can emit them as monitor events.
 """
+
+import time
+
+from ...utils import fault_injection
+from ...utils.logging import logger
+
+
+class CheckpointSaveError(RuntimeError):
+    """One save version failed durably (retries + fallback exhausted).
+    Carries the version and target path so the operator knows exactly
+    which generation is NOT on disk."""
+
+    def __init__(self, version, path, cause):
+        super().__init__(
+            f"checkpoint save (version {version}) to {path} failed "
+            f"after retries/fallback: {cause}")
+        self.version = version
+        self.path = path
+        self.cause = cause
+
+
+def _new_counters():
+    return {
+        "saves": 0,            # successful engine-level saves
+        "loads": 0,
+        "retries": 0,          # write attempts that failed and were retried
+        "fallbacks": 0,        # saves completed by the degraded writer
+        "save_errors": 0,      # versions that failed even after fallback
+        "load_fallbacks": 0,   # loads served by an older durable tag
+        "gc_removed": 0,       # tags deleted by retention GC
+    }
 
 
 class CheckpointEngine:
     def __init__(self, config_params=None):
         self.config = config_params
+        self.save_retries = int(getattr(config_params, "save_retries", 2))
+        self.retry_backoff_s = float(
+            getattr(config_params, "retry_backoff_s", 0.05))
+        self.retry_backoff_cap_s = float(
+            getattr(config_params, "retry_backoff_cap_s", 2.0))
+        self.counters = _new_counters()
 
     def create(self, tag):
         """Log/prepare for a save under ``tag``."""
@@ -31,14 +81,61 @@ class CheckpointEngine:
         raise NotImplementedError
 
     def commit(self, tag):
-        """Mark ``tag`` durable (reference: nebula/veloc commit)."""
+        """Mark ``tag`` durable (reference: nebula/veloc commit).
+        Surfaces any already-completed failed save (non-blocking)."""
         return True
 
     def wait(self, version=None):
         """Block until async work for ``version`` (or all) is durable.
-        Fork addition (veloc_checkpoint_engine.py wait)."""
+        Fork addition (veloc_checkpoint_engine.py wait). Raises
+        CheckpointSaveError once per failed version."""
         return True
+
+    def drain(self, version=None):
+        """Like wait(), but never raises for failed saves (they stay
+        queued for the next wait()/commit()). Load/recovery paths use
+        this: a failed save must not block reading durable data."""
+        return self.wait(version)
 
     def shutdown(self):
         """Drain and stop background machinery (fork addition)."""
         return True
+
+    # ------------------------------------------------------- retry/degrade
+    def _write_with_retry(self, attempt, fallback, desc):
+        """Run ``attempt()`` with capped exponential backoff on failure;
+        after ``save_retries`` failed retries, run ``fallback()`` (the
+        degraded writer) when provided. SimulatedKill is never retried —
+        it models SIGKILL. Raises the last error when everything fails
+        (callers wrap it into CheckpointSaveError with version info)."""
+        last = None
+        for i in range(self.save_retries + 1):
+            try:
+                return attempt()
+            except fault_injection.SimulatedKill:
+                raise
+            except Exception as e:  # noqa: BLE001 - any IO failure retries
+                last = e
+                if i < self.save_retries:
+                    self.counters["retries"] += 1
+                    delay = min(self.retry_backoff_cap_s,
+                                self.retry_backoff_s * (2 ** i))
+                    logger.warning(
+                        f"checkpoint write to {desc} failed "
+                        f"(attempt {i + 1}/{self.save_retries + 1}): {e}; "
+                        f"retrying in {delay:.2f}s")
+                    time.sleep(delay)
+        if fallback is not None:
+            try:
+                result = fallback()
+                self.counters["fallbacks"] += 1
+                logger.warning(
+                    f"checkpoint write to {desc} degraded to the "
+                    f"fallback writer after {self.save_retries + 1} "
+                    f"failed attempts ({last})")
+                return result
+            except fault_injection.SimulatedKill:
+                raise
+            except Exception as e:  # noqa: BLE001
+                last = e
+        raise last

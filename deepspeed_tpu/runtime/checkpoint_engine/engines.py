@@ -11,14 +11,28 @@ Parity with the reference's plugin set (engine.py:931-963 selection):
     the VELOC _d2h_trf/_h2f_trf split re-imagined for TPU hosts.
   * NoneCheckpointEngine   — none_checkpoint_engine.py:12: no-op for
     measuring checkpoint overhead.
+
+Failure semantics (the crash-consistency layer):
+  * every write is retried with capped exponential backoff
+    (base.CheckpointEngine._write_with_retry), then degrades — native
+    falls back to the pure-python writer, async falls back to an
+    in-caller synchronous write when its pool is dead;
+  * ``on_durable`` only ever fires after the bytes are durable, so a
+    failed save can never publish 'latest';
+  * a version whose save failed is popped from ``_inflight`` and its
+    error raised exactly once, from ``wait()`` or ``commit()`` —
+    ``drain()`` (used by load/recovery paths) collects failures without
+    raising so durable data stays readable after a failed save.
 """
 
 import concurrent.futures as futures
+import io
 import os
 import threading
 
-from ...utils.logging import logger, log_dist
-from .base import CheckpointEngine
+from ...utils import fault_injection
+from ...utils.logging import logger
+from .base import CheckpointEngine, CheckpointSaveError
 from . import serialization as ser
 
 
@@ -26,11 +40,21 @@ class SyncCheckpointEngine(CheckpointEngine):
     def save(self, state_dict, path, on_durable=None):
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tree, extra = state_dict
-        ser.save_file(path, tree, extra_meta=extra)
+        try:
+            self._write_with_retry(
+                lambda: ser.save_file(path, tree, extra_meta=extra),
+                None, path)
+        except fault_injection.SimulatedKill:
+            raise
+        except Exception as e:  # noqa: BLE001
+            self.counters["save_errors"] += 1
+            raise CheckpointSaveError(0, path, e) from e
+        self.counters["saves"] += 1
         if on_durable is not None:
             on_durable()
 
     def load(self, path, map_location=None):
+        self.counters["loads"] += 1
         return ser.load_file(path)
 
 
@@ -54,45 +78,128 @@ class AsyncCheckpointEngine(CheckpointEngine):
                                     max_inflight)
         self._pool = futures.ThreadPoolExecutor(max_workers=workers)
         self._inflight = {}
+        self._failures = {}      # version -> exception, each raised ONCE
         self._lock = threading.Lock()
         self._version = 0
+
+    # --------------------------------------------------------------- write
+    def _write_payload(self, path, tree, extra):
+        """One write attempt (overridden by the native engine)."""
+        ser.save_file(path, tree, extra_meta=extra)
+
+    def _fallback_writer(self, path, tree, extra):
+        """-> zero-arg callable performing the degraded write, or None
+        when no lower tier exists (the python writer IS the last tier
+        for the plain async engine)."""
+        return None
+
+    def _run_save(self, version, path, tree, extra, on_durable):
+        try:
+            self._write_with_retry(
+                lambda: self._write_payload(path, tree, extra),
+                self._fallback_writer(path, tree, extra), path)
+        except fault_injection.SimulatedKill:
+            raise
+        except Exception as e:  # noqa: BLE001
+            self.counters["save_errors"] += 1
+            raise CheckpointSaveError(version, path, e) from e
+        self.counters["saves"] += 1
+        # durability callback runs on the writer thread AFTER the bytes
+        # land, so e.g. the 'latest' pointer never names a torn file
+        if on_durable is not None:
+            on_durable()
 
     def save(self, state_dict, path, on_durable=None):
         with self._lock:
             self._version += 1
             version = self._version
-        # backpressure: bound staged-copy memory like VELOC's host cache
-        while len([f for f in self._inflight.values() if not f.done()]) \
-                >= self.max_inflight:
+        self._reap()
+        # backpressure: bound staged-copy memory like VELOC's host cache.
+        # A failed old save surfaces here (once) rather than wedging the
+        # window shut forever.
+        while len(self._inflight) >= self.max_inflight:
             self.wait(min(self._inflight))
         os.makedirs(os.path.dirname(path), exist_ok=True)
         tree, extra = state_dict
-
-        def task():
-            ser.save_file(path, tree, extra_meta=extra)
-            # durability callback runs on the writer thread AFTER the bytes
-            # land, so e.g. the 'latest' pointer never names a torn file
-            if on_durable is not None:
-                on_durable()
-
-        fut = self._pool.submit(task)
+        try:
+            fut = self._pool.submit(self._run_save, version, path, tree,
+                                    extra, on_durable)
+        except RuntimeError as e:
+            # writer pool dead (shutdown/interpreter teardown): degrade
+            # this save to a synchronous in-caller write instead of
+            # losing the generation
+            logger.warning(
+                f"async checkpoint pool unavailable ({e}); degrading "
+                f"save v{version} to a synchronous write")
+            self.counters["fallbacks"] += 1
+            self._run_save(version, path, tree, extra, on_durable)
+            return version
         self._inflight[version] = fut
         return version
 
-    def load(self, path, map_location=None):
-        self.wait()
-        return ser.load_file(path)
+    # ------------------------------------------------------------ wait/err
+    def _collect(self, version, fut):
+        """Record the outcome of a finished future. The version is
+        ALREADY popped from _inflight — a failure is queued in _failures
+        to be raised exactly once."""
+        exc = fut.exception()
+        if exc is None:
+            return
+        if not isinstance(exc, Exception):   # SimulatedKill et al.
+            raise exc
+        self._failures[version] = exc
+
+    def _reap(self):
+        """Non-blocking: fold any finished futures into _failures."""
+        for v, fut in list(self._inflight.items()):
+            if fut.done():
+                self._inflight.pop(v, None)
+                self._collect(v, fut)
+
+    def _raise_one_failure(self):
+        if not self._failures:
+            return
+        v = min(self._failures)
+        exc = self._failures.pop(v)
+        if isinstance(exc, CheckpointSaveError):
+            raise exc
+        raise CheckpointSaveError(v, "<unknown>", exc) from exc
+
+    def _drain_targets(self, version):
+        if version is None:
+            return sorted(self._inflight)
+        return [version] if version in self._inflight else []
 
     def wait(self, version=None):
-        items = (list(self._inflight.items()) if version is None
-                 else [(version, self._inflight[version])]
-                 if version in self._inflight else [])
-        for v, fut in items:
-            fut.result()
-            self._inflight.pop(v, None)
+        # pop BEFORE result: one failed save must not raise from every
+        # later wait()/load() forever
+        for v in self._drain_targets(version):
+            fut = self._inflight.pop(v, None)
+            if fut is not None:
+                fut.exception()   # block until done
+                self._collect(v, fut)
+        self._raise_one_failure()
         return True
 
+    def drain(self, version=None):
+        """wait() without raising: failures stay queued for the next
+        wait()/commit(). Recovery paths use this so a failed save can't
+        block loading the previous durable generation."""
+        for v in self._drain_targets(version):
+            fut = self._inflight.pop(v, None)
+            if fut is not None:
+                fut.exception()
+                self._collect(v, fut)
+        return True
+
+    def load(self, path, map_location=None):
+        self.drain()
+        self.counters["loads"] += 1
+        return ser.load_file(path)
+
     def commit(self, tag):
+        self._reap()
+        self._raise_one_failure()
         return True
 
     def shutdown(self):
@@ -103,40 +210,55 @@ class AsyncCheckpointEngine(CheckpointEngine):
 
 class NativeCheckpointEngine(AsyncCheckpointEngine):
     """Async engine whose byte-writing goes through the C++ writer pool
-    when available (falls back to the pure-python path)."""
+    when available; degrades to the pure-python writer per save when the
+    native path fails."""
 
     def __init__(self, config_params=None, **kw):
         super().__init__(config_params, **kw)
         try:
             from ...ops.native import ckpt_writer
+            # fsync=True: the tmp's bytes must be durable BEFORE the
+            # rename publishes them — otherwise on_durable fires (and
+            # retention GC deletes older generations) while the shard
+            # is still page cache, and a power loss strands 'latest' on
+            # a torn file with the known-good tags already gone
             self._writer = ckpt_writer.Writer(
-                threads=getattr(config_params, "writer_threads", 2))
+                threads=getattr(config_params, "writer_threads", 2),
+                fsync=True)
         except Exception as e:  # noqa: BLE001 - optional native ext
             logger.warning(f"native ckpt writer unavailable ({e}); "
                            "using python writer")
             self._writer = None
 
-    def save(self, state_dict, path, on_durable=None):
+    def _write_payload(self, path, tree, extra):
         if self._writer is None:
-            return super().save(state_dict, path, on_durable=on_durable)
-        with self._lock:
-            self._version += 1
-            version = self._version
-        os.makedirs(os.path.dirname(path), exist_ok=True)
-        tree, extra = state_dict
-        fut = self._pool.submit(self._native_save, path, tree, extra,
-                                on_durable)
-        self._inflight[version] = fut
-        return version
-
-    def _native_save(self, path, tree, extra, on_durable=None):
-        # serialize to bytes in-thread, write via the native pwrite pool
-        import io
+            return super()._write_payload(path, tree, extra)
+        # serialize to bytes in-thread (CRC manifest included), write via
+        # the native pwrite pool to a tmp name, then atomic rename — the
+        # C++ path gets the same two-phase durability as the python one
         bio = io.BytesIO()
         ser.save_file(bio, tree, extra_meta=extra)
-        self._writer.write(path, bio.getbuffer())
-        if on_durable is not None:
-            on_durable()
+        tmp = str(path) + ".tmp"
+        os.makedirs(os.path.dirname(str(path)) or ".", exist_ok=True)
+        try:
+            self._writer.write(tmp, bio.getbuffer())
+            fault_injection.fire("rename")
+            os.replace(tmp, path)
+        except Exception:
+            # failed attempts must not leak full-size tmp shards (a
+            # SimulatedKill/real crash still leaves one, like SIGKILL)
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            raise
+        ser._fsync_dir(os.path.dirname(str(path)))
+
+    def _fallback_writer(self, path, tree, extra):
+        if self._writer is None:
+            return None     # already on the python writer
+        # degrade: the plain python writer (its own tmp+fsync+rename)
+        return lambda: ser.save_file(path, tree, extra_meta=extra)
 
 
 ENGINES = {

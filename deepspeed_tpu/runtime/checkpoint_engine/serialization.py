@@ -8,15 +8,25 @@ checkpoint written under one ZeRO stage / mesh loads under any other — the
 capability the reference needs offline conversion for
 (checkpoint/ds_to_universal.py)."""
 
-import io
 import json
 import os
+import zipfile
+import zlib
 
 import numpy as np
 import jax
 
+from ...utils import fault_injection
+
 
 _SEP = "/"
+
+
+class CheckpointCorruptionError(ValueError):
+    """A shard failed integrity verification (truncated zip, CRC
+    mismatch, missing manifest entry). Subclasses ValueError so callers
+    that already guard reassembly errors catch it too; load paths use
+    it to fall back to the previous durable generation."""
 
 
 def flatten_state(tree):
@@ -49,29 +59,112 @@ def unflatten_into(template, flat, meta=None):
     return jax.tree.map_with_path(pick, template)
 
 
+def _crc(arr):
+    a = np.ascontiguousarray(arr)
+    return zlib.crc32(a.view(np.uint8).reshape(-1)) & 0xFFFFFFFF
+
+
 def save_file(path, tree, extra_meta=None):
+    """Write one shard. Integrity: the header carries a per-entry CRC32
+    manifest (verified by load_file). Durability: file-path writes go to
+    ``path + ".tmp"``, fsync, then atomic ``os.replace`` — a crash at
+    ANY byte of the write leaves the previously durable shard at
+    ``path`` untouched (the CheckFreq/VELOC two-phase rule)."""
+    fault_injection.fire("serialize")
     flat, meta = flatten_state(tree)
     arrays = {}
+    manifest = {}
     for k, v in flat.items():
         arr = np.asarray(v)
         # np.savez keys cannot contain '/': escape
-        arrays[k.replace("/", "%2F")] = arr
-    header = {"meta": meta, "extra": extra_meta or {}, "version": 1}
+        key = k.replace("/", "%2F")
+        arrays[key] = arr
+        manifest[key] = _crc(arr)
+    header = {"meta": meta, "extra": extra_meta or {}, "version": 2,
+              "crc": manifest}
     arrays["__meta__"] = np.frombuffer(
         json.dumps(header).encode(), dtype=np.uint8)
     if hasattr(path, "write"):
+        # in-memory target (native engine serializes to bytes; the C++
+        # pool owns the byte write — and fires the 'write' point — plus
+        # its own tmp/rename)
         np.savez(path, **arrays)
-    else:
-        with open(path, "wb") as f:
+        return
+    tmp = str(path) + ".tmp"
+    # re-create the tag dir: a retrying attempt must heal even if the
+    # (then-empty) dir was swept by retention GC in between
+    os.makedirs(os.path.dirname(str(path)) or ".", exist_ok=True)
+    try:
+        with open(tmp, "wb") as f:
+            fault_injection.fire("write")
             np.savez(f, **arrays)
+            f.flush()
+            os.fsync(f.fileno())
+        fault_injection.fire("rename")
+        os.replace(tmp, path)
+    except Exception:
+        # a failed attempt must not leak a full-size tmp shard; a
+        # SimulatedKill/real crash still leaves one, faithfully to
+        # SIGKILL (retention GC sweeps the emptied tag dirs)
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    _fsync_dir(os.path.dirname(str(path)))
 
 
-def load_file(path):
-    """-> (flat dict path->array, header dict)."""
-    with np.load(path, allow_pickle=False) as z:
-        header = json.loads(bytes(z["__meta__"].tobytes()).decode())
-        flat = {k.replace("%2F", "/"): z[k] for k in z.files
-                if k != "__meta__"}
+def _fsync_dir(dirpath):
+    """Make a rename durable: fsync the containing directory (best
+    effort — not all filesystems support directory fds)."""
+    try:
+        fd = os.open(dirpath or ".", os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def load_file(path, verify=True):
+    """-> (flat dict path->array, header dict). ``verify`` checks every
+    entry against the header's CRC32 manifest (files written before the
+    manifest existed — header version 1 — load unverified)."""
+    try:
+        with np.load(path, allow_pickle=False) as z:
+            header = json.loads(bytes(z["__meta__"].tobytes()).decode())
+            flat_raw = {k: z[k] for k in z.files if k != "__meta__"}
+    except (zipfile.BadZipFile, KeyError, EOFError, OSError,
+            ValueError) as e:
+        if isinstance(e, FileNotFoundError):
+            raise
+        raise CheckpointCorruptionError(
+            f"checkpoint shard {path} is unreadable "
+            f"(truncated or torn write?): {e}") from e
+    manifest = header.get("crc")
+    if verify and manifest is not None:
+        for key in manifest:
+            if key not in flat_raw:
+                raise CheckpointCorruptionError(
+                    f"checkpoint shard {path}: chunk entry {key!r} "
+                    f"listed in the CRC manifest but absent from the "
+                    f"archive — torn shard")
+        for key, arr in flat_raw.items():
+            want = manifest.get(key)
+            if want is None:
+                raise CheckpointCorruptionError(
+                    f"checkpoint shard {path}: entry {key!r} absent "
+                    f"from the CRC manifest — foreign or tampered data")
+            got = _crc(arr)
+            if got != want:
+                raise CheckpointCorruptionError(
+                    f"checkpoint shard {path}: CRC mismatch on "
+                    f"{key!r} (want {want:#010x}, got {got:#010x}) — "
+                    f"shard is corrupt")
+    flat = {k.replace("%2F", "/"): v for k, v in flat_raw.items()}
     return flat, header
 
 
@@ -189,3 +282,61 @@ def load_state(tag_dir):
     if os.path.exists(legacy):
         return load_file(legacy)
     return load_sharded(tag_dir)
+
+
+def verify_tag(tag_dir):
+    """Full integrity pass over one tag directory: every shard's zip
+    structure + CRC manifest, and (sharded layout) chunk coverage of
+    every leaf + the writer's recorded nprocs. Raises
+    CheckpointCorruptionError / ValueError / FileNotFoundError on any
+    defect; returns True when the generation is known-good. Retention
+    GC calls this on the NEWEST tag before deleting older ones, so
+    recovery always has a loadable generation.
+
+    Unlike load_sharded this never materializes the reassembled global
+    arrays — it holds one shard in memory at a time and checks coverage
+    arithmetically (sum of chunk sizes vs leaf size), so GC's per-save
+    verification costs a read pass, not a full-model host allocation."""
+    import glob
+    legacy = os.path.join(tag_dir, "state.npz")
+    if os.path.exists(legacy):
+        load_file(legacy)
+        return True
+    files = sorted(glob.glob(os.path.join(tag_dir, "shard-*.npz")))
+    if not files:
+        raise FileNotFoundError(f"no shard files under {tag_dir}")
+    chunk_sizes = {}
+    merged = {}
+    header0 = None
+    for f in files:
+        flat, header = load_file(f)   # zip structure + CRC manifest
+        for k, arr in flat.items():
+            chunk_sizes[k] = int(arr.size)
+        for k, e in (header["extra"].get("index") or {}).items():
+            cur = merged.setdefault(k, {"shape": e["shape"],
+                                        "chunks": []})
+            cur["chunks"].extend(c["key"] for c in e["chunks"])
+        if os.path.basename(f) == "shard-0.npz":
+            header0 = header
+        del flat
+    header0 = header0 or header
+    nprocs = (header0["extra"].get("user_extra") or {}).get("nprocs")
+    if nprocs is not None and len(files) != nprocs:
+        raise ValueError(
+            f"incomplete checkpoint {tag_dir}: found {len(files)} shard "
+            f"files but the writer recorded nprocs={nprocs}")
+    for k, e in merged.items():
+        total = int(np.prod(e["shape"], dtype=np.int64))
+        filled = 0
+        for ck in e["chunks"]:
+            if ck not in chunk_sizes:
+                raise ValueError(
+                    f"checkpoint {tag_dir}: leaf {k} chunk {ck} indexed "
+                    f"but absent from every shard file")
+            filled += chunk_sizes[ck]
+        if filled != total:
+            raise ValueError(
+                f"checkpoint {tag_dir}: leaf {k} covered by "
+                f"{filled}/{total} elements — shard files missing or "
+                f"written by a torn save")
+    return True

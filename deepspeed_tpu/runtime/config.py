@@ -137,11 +137,31 @@ class SchedulerConfig:
 class CheckpointEngineConfig:
     """Fork parity: reference runtime/config.py:909-926 registers
     datastates/async/none/torch_sn_async engine configs; we expose one
-    block with a type switch."""
+    block with a type switch, plus the crash-consistency knobs
+    (retry/degrade policy and retention)."""
     type: str = "sync"                # sync | async | native | none
     host_cache_bytes: int = 1 << 30   # pinned-host staging budget (async/native)
     writer_threads: int = 2
     max_inflight: int = 2
+    # retry/degrade policy: each shard write gets save_retries retries
+    # with capped exponential backoff, then the engine's degraded writer
+    # (native -> python; async pool dead -> in-caller sync write)
+    save_retries: int = 2
+    retry_backoff_s: float = 0.05
+    retry_backoff_cap_s: float = 2.0
+    # retention: keep the newest keep_last durable tags, GC older ones
+    # only after the newest verifies (CRC + chunk coverage). 0 = keep all.
+    keep_last: int = 0
+
+    def __post_init__(self):
+        if self.save_retries < 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint_engine.save_retries must be >= 0, got "
+                f"{self.save_retries}")
+        if self.keep_last < 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint_engine.keep_last must be >= 0 (0 disables "
+                f"retention GC), got {self.keep_last}")
 
 
 @dataclass

@@ -682,6 +682,11 @@ class DeepSpeedEngine:
         self.tput_timer.stop(global_step=True,
                              sync_arrays=metrics["loss"])
         self._maybe_print(metrics)
+        # liveness beat for the elastic agent: a worker that stops
+        # completing steps (hung collective, wedged host) is killed and
+        # restarted from 'latest' just like one that died outright
+        from ..utils import touch_heartbeat
+        touch_heartbeat()
         return metrics["loss"]
 
     def _collect_local_shards(self, tree, record_meta=False):
@@ -851,6 +856,24 @@ class DeepSpeedEngine:
                            float(metrics["loss_scale"]), self.global_step))
         self.monitor.write_events(events)
 
+    def _write_ckpt_monitor_events(self, kind, latency_ms):
+        """Checkpoint health counters -> monitor fan-out (save/load
+        latency plus the cumulative retry/fallback/GC counters the
+        chaos acceptance criteria track)."""
+        if not self.monitor.enabled:
+            return
+        c = self.checkpoint_engine.counters
+        step = self.global_step
+        self.monitor.write_events([
+            (f"Train/Checkpoint/{kind}_latency_ms", latency_ms, step),
+            ("Train/Checkpoint/retries", c["retries"], step),
+            ("Train/Checkpoint/fallbacks", c["fallbacks"], step),
+            ("Train/Checkpoint/save_errors", c["save_errors"], step),
+            ("Train/Checkpoint/load_fallbacks", c["load_fallbacks"],
+             step),
+            ("Train/Checkpoint/gc_removed", c["gc_removed"], step),
+        ])
+
     def _maybe_print(self, metrics):
         self._write_monitor_events(metrics)
         if (self.config.steps_per_print and
@@ -937,12 +960,16 @@ class DeepSpeedEngine:
         in flight.
         """
         import os
+        import time
+        from ..utils import fault_injection
         from .checkpoint_engine import serialization as ser
+        t_start = time.perf_counter()
         tag = tag or f"global_step{self.global_step}"
         self.checkpoint_engine.create(tag)
         # D2H staging of LOCAL shards only (the VELOC _d2h_trf analogue;
         # synchronous, bandwidth-bound), then the engine writes async if
         # configured.
+        fault_injection.fire("d2h")
         chunks, index, meta = ser.extract_local_chunks(self._ckpt_tree())
         extra = {
             "index": index,
@@ -960,56 +987,99 @@ class DeepSpeedEngine:
         path = os.path.join(save_dir, tag,
                             f"shard-{jax.process_index()}.npz")
 
+        from .checkpoint_engine import manager as ckpt_manager
+        keep_last = getattr(self.config.checkpoint_engine, "keep_last", 0)
+        seq = self.global_step   # captured NOW: with async engines two
+        # in-flight saves can reach durability out of order; the seq
+        # guard keeps 'latest' from regressing to the older one
+
         def mark_latest():
-            os.makedirs(save_dir, exist_ok=True)
-            tmp = os.path.join(save_dir, ".latest.tmp")
-            with open(tmp, "w") as f:
-                f.write(tag)
-            os.replace(tmp, os.path.join(save_dir, "latest"))
+            ckpt_manager.publish_latest(save_dir, tag, seq=seq)
+            # retention GC rides the durability path (the writer thread
+            # for async engines), so it can never run before the new
+            # generation is durable; gc_tags itself re-verifies the
+            # newest tag before deleting anything and never raises
+            ckpt_manager.gc_tags(save_dir, keep_last,
+                                 counters=self.checkpoint_engine.counters)
 
         rank0 = jax.process_index() == 0
         if save_latest and jax.process_count() > 1:
             # 'latest' must only ever name a checkpoint whose EVERY shard
             # is durable. on_durable fires when THIS process's shard is
             # down; other ranks may still be writing (especially async) —
-            # so drain local writes, barrier, then let rank 0 publish.
-            self.checkpoint_engine.save((chunks, extra), path)
-            self.checkpoint_engine.wait()
+            # so drain local writes, then agree cross-process before
+            # rank 0 publishes. The agreement is an allgather of per-rank
+            # success flags (itself the barrier): a rank whose save
+            # failed must still REACH the collective — raising before it
+            # would deadlock every surviving rank — and a failure on ANY
+            # rank vetoes publication, so 'latest' cannot name a
+            # generation with a missing shard.
+            err = None
+            try:
+                self.checkpoint_engine.save((chunks, extra), path)
+                self.checkpoint_engine.wait()
+            except Exception as e:  # noqa: BLE001 - re-raised after sync
+                err = e
             from jax.experimental import multihost_utils
-            multihost_utils.sync_global_devices(f"ckpt-durable-{tag}")
+            flags = multihost_utils.process_allgather(
+                np.asarray([0.0 if err is not None else 1.0],
+                           np.float32))
+            all_ok = bool(np.asarray(flags).min() >= 1.0)
             # a no-op engine (checkpoint=none) writes nothing: publishing
             # 'latest' would dangle at an empty tag directory
-            if rank0 and os.path.exists(path):
+            if rank0 and all_ok and os.path.exists(path):
                 mark_latest()
+            elif rank0 and not all_ok:
+                log_dist(
+                    f"not publishing 'latest' for tag {tag!r}: a rank's "
+                    f"shard write failed; the previous durable "
+                    f"generation remains the recovery point", ranks=[0])
+            if err is not None:
+                raise err
         else:
             self.checkpoint_engine.save(
                 (chunks, extra), path,
                 on_durable=(mark_latest if save_latest and rank0
                             else None))
         self.checkpoint_engine.commit(tag)
+        self._write_ckpt_monitor_events(
+            "save", (time.perf_counter() - t_start) * 1e3)
         return tag
 
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True,
                         load_lr_scheduler_states=True):
-        """reference engine.py:2750. Returns (path, client_state)."""
+        """reference engine.py:2750. Returns (path, client_state).
+
+        Recovery semantics: with no explicit ``tag``, candidates are the
+        'latest'-named generation first, then every other durable tag
+        newest-first — a corrupt or truncated shard (CRC mismatch, torn
+        zip, missing chunks) makes the loader FALL BACK to the previous
+        durable generation instead of crashing the restart. Only when a
+        checkpoint exists but NO generation is loadable does it raise
+        (resuming silently from scratch would be worse). An explicit
+        ``tag`` is never substituted."""
         import os
+        import time
         from .checkpoint_engine import serialization as ser
-        if tag is None:
-            latest = os.path.join(load_dir, "latest")
-            if not os.path.exists(latest):
-                return None, {}
-            with open(latest) as f:
-                tag = f.read().strip()
-        path = os.path.join(load_dir, tag)
-        legacy = os.path.join(path, "state.npz")
-        if os.path.exists(legacy):
-            flat, header = self.checkpoint_engine.load(legacy)
-        elif os.path.isdir(path):
-            self.checkpoint_engine.wait()
-            flat, header = ser.load_sharded(path)
-        else:
+        from .checkpoint_engine import manager as ckpt_manager
+        t_start = time.perf_counter()
+        # drain, not wait: a previously FAILED async save must not block
+        # reading the durable generations that did land
+        self.checkpoint_engine.drain()
+
+        def loader(tag_dir):
+            legacy = os.path.join(tag_dir, "state.npz")
+            if os.path.exists(legacy):
+                return self.checkpoint_engine.load(legacy)
+            return ser.load_sharded(tag_dir)
+
+        cand, flat, header = ckpt_manager.load_best(
+            load_dir, tag, loader=loader,
+            counters=self.checkpoint_engine.counters)
+        if cand is None:
             return None, {}
+        path = os.path.join(load_dir, cand)
         # structural template only — no device transfer
         template = jax.eval_shape(self._ckpt_tree)
         tree = ser.unflatten_into(template, flat, header.get("meta"))
@@ -1054,6 +1124,8 @@ class DeepSpeedEngine:
         if (load_lr_scheduler_states and self.lr_scheduler is not None
                 and extra.get("lr_scheduler") is not None):
             self.lr_scheduler.load_state_dict(extra["lr_scheduler"])
+        self._write_ckpt_monitor_events(
+            "load", (time.perf_counter() - t_start) * 1e3)
         return path, extra.get("client_state", {})
 
     def save_checkpoint_terminate(self):

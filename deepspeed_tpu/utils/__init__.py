@@ -1,3 +1,20 @@
 from .logging import logger, log_dist, print_rank_0
 from .timer import SynchronizedWallClockTimer, ThroughputTimer
 from . import groups
+
+
+def touch_heartbeat():
+    """Liveness beat consumed by DSElasticAgent's hang detector. The
+    agent (or launcher) sets DSTPU_HEARTBEAT_FILE in the worker env; the
+    engine touches it once per completed train_batch. Unset = no-op, so
+    standalone runs pay one dict lookup."""
+    import os
+    path = os.environ.get("DSTPU_HEARTBEAT_FILE")
+    if not path:
+        return
+    try:
+        with open(path, "a"):
+            pass
+        os.utime(path, None)
+    except OSError:
+        pass

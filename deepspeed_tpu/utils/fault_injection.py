@@ -1,0 +1,163 @@
+"""Deterministic fault injection for the checkpoint/recovery subsystem.
+
+The chaos tests (tests/unit/test_chaos_checkpoint.py) need to prove that
+a torn shard, a dying writer thread, or a crash between "bytes written"
+and "latest published" never costs a resumable run. Random fault
+injection cannot prove that — it proves "we got lucky this run". This
+module provides NAMED, COUNTED injection points threaded through the
+save pipeline, so a test can say "the 2nd byte-write of this save
+fails" and get exactly that, every run.
+
+Injection points currently wired (grep for ``fault_injection.fire``):
+
+  ==============  =====================================================
+  point           fires in
+  ==============  =====================================================
+  d2h             runtime/engine.py save_checkpoint, after the local
+                  shard extraction (the VELOC D2H stage)
+  serialize       serialization.save_file, before the pytree is encoded
+  write           serialization.save_file byte write, and
+                  ops/native/ckpt_writer.py Writer.write (C++ path)
+  rename          serialization.save_file, before the atomic
+                  tmp -> final os.replace
+  commit          checkpoint_engine manager publish_latest, before the
+                  'latest' pointer is replaced
+  kill            any of the above via ``kill=True`` — raises
+                  SimulatedKill (BaseException) which NO layer retries,
+                  modeling SIGKILL mid-save
+  ==============  =====================================================
+
+Faults are armed per-point with a countdown (skip the first N fires)
+and a failure budget (fail the next M fires, then heal) — enough to
+express "fail once then succeed" (retry coverage), "always fail"
+(degrade coverage), and "die at the commit boundary" (crash-consistency
+coverage) deterministically.
+
+Arming is process-local via :func:`arm` / :func:`reset`, or via the
+``DSTPU_FAULT_INJECT`` env var for subprocess tests:
+``DSTPU_FAULT_INJECT="write:2,rename:1:skip=1"`` arms two write
+failures and one rename failure after one clean rename.
+"""
+
+import os
+import threading
+
+
+class FaultError(OSError):
+    """The injected failure for retryable points (an IO-shaped error,
+    so the production retry path treats it like a real EIO)."""
+
+    def __init__(self, point, fire_index):
+        super().__init__(5, f"injected fault at '{point}' "
+                            f"(fire #{fire_index})")
+        self.point = point
+        self.fire_index = fire_index
+
+
+class SimulatedKill(BaseException):
+    """Process death mid-save. Deliberately a BaseException: no retry
+    loop, ``except Exception`` recovery path, or engine fallback may
+    swallow it — exactly like SIGKILL. Tests catch it at top level and
+    then assert on-disk state."""
+
+    def __init__(self, point):
+        super().__init__(f"simulated process kill at '{point}'")
+        self.point = point
+
+
+class _Arm:
+    __slots__ = ("skip", "fails", "kill")
+
+    def __init__(self, fails, skip=0, kill=False):
+        self.fails = int(fails)
+        self.skip = int(skip)
+        self.kill = bool(kill)
+
+
+class FaultInjector:
+    """Registry of armed faults + a fire log. Thread-safe: writer
+    threads in the async engines fire points concurrently."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._arms = {}
+        self._fired = {}     # point -> total fire() calls (hit or not)
+        self._hits = {}      # point -> injected-failure count
+        self._load_env()
+
+    # ------------------------------------------------------------- arming
+    def arm(self, point, fails=1, skip=0, kill=False):
+        """Arm ``point``: after ``skip`` clean passes, the next
+        ``fails`` fires raise (FaultError, or SimulatedKill when
+        ``kill``), then the point heals."""
+        with self._lock:
+            self._arms[point] = _Arm(fails, skip=skip, kill=kill)
+
+    def reset(self):
+        with self._lock:
+            self._arms.clear()
+            self._fired.clear()
+            self._hits.clear()
+
+    def _load_env(self):
+        spec = os.environ.get("DSTPU_FAULT_INJECT", "")
+        for part in filter(None, (p.strip() for p in spec.split(","))):
+            fields = part.split(":")
+            point, fails = fields[0], 1
+            skip, kill = 0, False
+            if len(fields) > 1 and fields[1]:
+                fails = int(fields[1])
+            for extra in fields[2:]:
+                if extra.startswith("skip="):
+                    skip = int(extra[5:])
+                elif extra == "kill":
+                    kill = True
+            self._arms[point] = _Arm(fails, skip=skip, kill=kill)
+
+    # ------------------------------------------------------------- firing
+    def fire(self, point):
+        """Called at an injection point. No-op (beyond counting) unless
+        the point is armed."""
+        with self._lock:
+            n = self._fired.get(point, 0) + 1
+            self._fired[point] = n
+            arm = self._arms.get(point)
+            if arm is None:
+                return
+            if arm.skip > 0:
+                arm.skip -= 1
+                return
+            if arm.fails <= 0:
+                return
+            arm.fails -= 1
+            self._hits[point] = self._hits.get(point, 0) + 1
+            kill = arm.kill
+        if kill:
+            raise SimulatedKill(point)
+        raise FaultError(point, n)
+
+    # ---------------------------------------------------------- inspection
+    def fired(self, point):
+        """Total fire() calls seen at ``point`` (hit or clean)."""
+        with self._lock:
+            return self._fired.get(point, 0)
+
+    def hits(self, point):
+        """Injected failures actually raised at ``point``."""
+        with self._lock:
+            return self._hits.get(point, 0)
+
+    def armed(self, point):
+        with self._lock:
+            arm = self._arms.get(point)
+            return arm is not None and arm.fails > 0
+
+
+# Process-global injector: production code fires against this; tests
+# arm/reset it. fire() on an un-armed point is two dict ops under an
+# uncontended lock — cheap enough to leave in the hot save path.
+injector = FaultInjector()
+
+fire = injector.fire
+arm = injector.arm
+reset = injector.reset

@@ -100,10 +100,15 @@ class TestKillAHostResume:
         worker.write_text(textwrap.dedent(f"""
             import os, sys
             os.environ["JAX_PLATFORMS"] = "cpu"
+            os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                + " --xla_force_host_platform_device_count=2").strip()
             sys.path.insert(0, {str(os.getcwd())!r})
             import jax
             jax.config.update("jax_platforms", "cpu")
-            jax.config.update("jax_num_cpu_devices", 2)
+            try:    # newer-jax spelling; XLA_FLAGS above covers older
+                jax.config.update("jax_num_cpu_devices", 2)
+            except AttributeError:
+                pass
             import numpy as np
             import deepspeed_tpu
             from deepspeed_tpu.models import GPT2, GPT2Config
@@ -162,3 +167,80 @@ class TestKillAHostResume:
         first_resumed = int(gen1[0].split("step=")[1])
         assert first_resumed >= 2, lines   # resumed, not restarted at 1
         assert any("step=5" in ln for ln in gen1)
+
+
+class TestHungHostResume:
+    def test_hung_worker_triggers_restart_from_latest(self, tmp_path):
+        """ISSUE 2 tentpole (4): a worker that HANGS (stops completing
+        train_batches, so its DSTPU_HEARTBEAT_FILE goes stale) takes the
+        SAME recovery path as one that died — the agent kills it,
+        relaunches the survivors, and training resumes from the durable
+        'latest' checkpoint."""
+        ckpt_dir = tmp_path / "ckpt"
+        log = tmp_path / "steps.log"
+        worker = tmp_path / "worker.py"
+        worker.write_text(textwrap.dedent(f"""
+            import os, sys, time
+            os.environ["JAX_PLATFORMS"] = "cpu"
+            sys.path.insert(0, {str(os.getcwd())!r})
+            import jax
+            jax.config.update("jax_platforms", "cpu")
+            import numpy as np
+            import deepspeed_tpu
+            from deepspeed_tpu.models import GPT2, GPT2Config
+
+            gen = int(os.environ.get("ELASTIC_GENERATION", "0"))
+            host = os.environ["WORKER_HOST"]
+            cfg = GPT2Config(n_layer=1, n_head=2, d_model=32,
+                             max_seq_len=16, vocab_size=64, remat=False,
+                             dtype="float32")
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=GPT2(cfg),
+                config={{"train_micro_batch_size_per_gpu": 2,
+                         "steps_per_print": 0,
+                         "optimizer": {{"type": "Adam",
+                                        "params": {{"lr": 1e-3}}}},
+                         "zero_optimization": {{"stage": 0}}}})
+            engine.load_checkpoint({str(ckpt_dir)!r})
+            rng = np.random.RandomState(0)
+            batch = {{"input_ids": rng.randint(
+                0, 64, (engine.config.train_batch_size, 16)).astype(
+                np.int32)}}
+            while engine.global_step < 4:
+                engine.train_batch(batch)   # beats the heartbeat file
+                if host == "h0":
+                    engine.save_checkpoint({str(ckpt_dir)!r})
+                with open({str(log)!r}, "a") as f:
+                    f.write(f"{{host}} gen={{gen}} "
+                            f"step={{engine.global_step}}\\n")
+                if gen == 0 and engine.global_step >= 2:
+                    if host == "h1":
+                        time.sleep(3600)    # HUNG: alive, never beats
+                    break   # h0: clean exit; gen 1 must RESUME from 2
+        """))
+
+        def launch(hosts):
+            procs = []
+            for h in hosts:
+                env = dict(os.environ)
+                env["WORKER_HOST"] = h
+                env["ELASTIC_GENERATION"] = str(agent.restart_count)
+                env["DSTPU_HEARTBEAT_FILE"] = agent.heartbeat_path(h)
+                procs.append((h, subprocess.Popen(
+                    [sys.executable, str(worker)], env=env)))
+            return procs
+
+        agent = DSElasticAgent(
+            launch, ["h0", "h1"], poll_s=0.2,
+            # generous vs. compile time: the FIRST beat lands only after
+            # jit compilation; stale detection matters per-beat after
+            heartbeat_timeout_s=30,
+            heartbeat_dir=str(tmp_path / "hb"))
+        final = agent.run()
+        assert final == ["h0"]
+        assert agent.restart_count == 1
+        gen1 = [ln for ln in log.read_text().strip().splitlines()
+                if "gen=1" in ln]
+        assert gen1
+        assert int(gen1[0].split("step=")[1]) >= 2   # resumed
+        assert any("step=4" in ln for ln in gen1)

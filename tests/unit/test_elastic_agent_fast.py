@@ -1,0 +1,191 @@
+"""Fast DSElasticAgent coverage (satellite of ISSUE 2): restart-budget
+exhaustion, shrink below min_hosts, inadmissible-world rejection, and
+the new heartbeat/hang detector — all against stub processes so the
+suite is deterministic and runs inside tier-1 (the subprocess-based
+end-to-end resume test stays in test_elastic_agent.py's slow set)."""
+
+import os
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                    WorldFailure)
+
+
+class StubProc:
+    """Popen-shaped test double. rc=None means 'runs forever' until
+    kill()/terminate()."""
+
+    def __init__(self, rc=0, exit_after_polls=1):
+        self._rc = rc
+        self._polls_left = exit_after_polls
+
+    def poll(self):
+        if self._rc is None:
+            return None
+        if self._polls_left > 0:
+            self._polls_left -= 1
+            return None
+        return self._rc
+
+    def kill(self):
+        self._rc = -9
+        self._polls_left = 0
+
+    def terminate(self):
+        self._rc = -15
+        self._polls_left = 0
+
+    def wait(self, timeout=None):
+        return self._rc
+
+
+def _launcher(rc_for):
+    """rc_for(host, gen_hosts) -> rc (None = hang forever)."""
+    def launch(hosts):
+        return [(h, StubProc(rc=rc_for(h, hosts))) for h in hosts]
+    return launch
+
+
+class TestRestartBudget:
+    def test_budget_exhaustion_raises(self):
+        # the first host of every generation dies -> one restart per
+        # generation until the budget runs out
+        agent = DSElasticAgent(
+            _launcher(lambda h, hosts: 1 if h == hosts[0] else 0),
+            ["a", "b", "c", "d", "e"], poll_s=0.001, max_restarts=2)
+        with pytest.raises(WorldFailure, match="budget"):
+            agent.run()
+        assert agent.restart_count == 3          # the one over budget
+
+    def test_budget_counts_across_generations(self):
+        events = []
+        died = {"a": False}
+
+        def rc_for(h, hosts):
+            if h == "a" and not died["a"]:
+                died["a"] = True
+                return 1
+            return 0
+
+        agent = DSElasticAgent(
+            _launcher(rc_for), ["a", "b", "c"], poll_s=0.001,
+            max_restarts=5,
+            on_restart=lambda gen, hosts: events.append((gen, hosts)))
+        final = agent.run()
+        assert final == ["b", "c"]
+        assert events == [(1, ["b", "c"])]
+
+
+class TestShrinkLimits:
+    def test_shrink_below_min_hosts_raises(self):
+        agent = DSElasticAgent(
+            _launcher(lambda h, hosts: 1 if h == "b" else 0),
+            ["a", "b"], poll_s=0.001, min_hosts=2)
+        with pytest.raises(WorldFailure, match="min_hosts"):
+            agent.run()
+
+    def test_initial_world_below_min_hosts_rejected_before_launch(self):
+        launched = []
+
+        def launch(hosts):
+            launched.append(hosts)
+            return []
+
+        agent = DSElasticAgent(launch, ["a"], min_hosts=3, poll_s=0.001)
+        with pytest.raises(WorldFailure, match="min_hosts"):
+            agent.run()
+        assert launched == []                    # never launched
+
+
+class TestAdmissibility:
+    DS_CONFIG = {"elasticity": {
+        "enabled": True, "max_train_batch_size": 64,
+        "micro_batch_sizes": [4], "min_gpus": 1, "max_gpus": 16,
+        "version": 0.2, "num_gpus_per_node": 2}}
+
+    def test_inadmissible_shrunken_world_rejected(self):
+        # 2 hosts x 3 chips = 6 admissible; 1 host x 3 = 3 chips is not
+        # a multiple of num_gpus_per_node=2 -> WorldFailure on shrink
+        agent = DSElasticAgent(
+            _launcher(lambda h, hosts: 1 if h == "b" else 0),
+            ["a", "b"], ds_config=self.DS_CONFIG, chips_per_host=3,
+            poll_s=0.001)
+        with pytest.raises(WorldFailure, match="admissible"):
+            agent.run()
+
+    def test_admissible_shrink_restarts(self):
+        died = {"b": False}
+
+        def rc_for(h, hosts):
+            if h == "b" and not died["b"]:
+                died["b"] = True
+                return 1
+            return 0
+
+        # 2 hosts x 4 = 8 admissible, and the shrunken 1 host x 4 = 4
+        # is still in the valid set -> restart instead of abort
+        agent = DSElasticAgent(
+            _launcher(rc_for), ["a", "b"],
+            ds_config=self.DS_CONFIG, chips_per_host=4, poll_s=0.001)
+        assert agent.run() == ["a"]
+        assert agent.restart_count == 1
+
+
+class TestHeartbeatLiveness:
+    def test_hung_worker_is_killed_and_world_restarts(self, tmp_path):
+        """A worker that neither exits nor beats is treated exactly like
+        a dead one: killed, dropped, world restarted."""
+        restarts = []
+
+        def rc_for(h, hosts):
+            if h == "b" and len(hosts) == 2:
+                return None                      # hangs in generation 0
+            return 0
+
+        agent = DSElasticAgent(
+            _launcher(rc_for), ["a", "b"], poll_s=0.01,
+            heartbeat_timeout_s=0.15, heartbeat_dir=str(tmp_path),
+            on_restart=lambda gen, hosts: restarts.append((gen, hosts)))
+        t0 = time.time()
+        final = agent.run()
+        assert final == ["a"]
+        assert agent.restart_count == 1
+        assert restarts == [(1, ["a"])]
+        assert time.time() - t0 < 10             # detector, not a hang
+
+    def test_beating_worker_is_not_killed(self, tmp_path):
+        """A slow-but-alive worker (fresh heartbeat) survives a timeout
+        window several times shorter than its runtime."""
+        agent = DSElasticAgent(
+            lambda hosts: [], ["w1"], heartbeat_timeout_s=0.2,
+            heartbeat_dir=str(tmp_path))
+        hb = agent.heartbeat_path("w1")
+        launched_at = time.time() - 10           # launched long ago
+        with open(hb, "w"):
+            pass                                 # fresh beat
+        assert agent._hung("w1", launched_at) is False
+        # stale beat -> hung
+        old = time.time() - 5
+        os.utime(hb, (old, old))
+        assert agent._hung("w1", launched_at) is True
+        # no beat at all: measured from launch time
+        os.remove(hb)
+        assert agent._hung("w1", time.time()) is False
+        assert agent._hung("w1", launched_at) is True
+
+    def test_clear_heartbeats_between_generations(self, tmp_path):
+        agent = DSElasticAgent(
+            lambda hosts: [], ["h/0", "h/1"], heartbeat_timeout_s=1.0,
+            heartbeat_dir=str(tmp_path))
+        for h in ("h/0", "h/1"):
+            with open(agent.heartbeat_path(h), "w"):
+                pass
+        assert len(os.listdir(str(tmp_path))) == 2
+        agent._clear_heartbeats(["h/0", "h/1"])
+        assert os.listdir(str(tmp_path)) == []
+
+    def test_disabled_by_default(self):
+        agent = DSElasticAgent(lambda hosts: [], ["a"])
+        assert agent._hung("a", 0.0) is False    # even 'launched' at epoch
