@@ -22,8 +22,11 @@ variant can never cost the headline number:
   gpt2_1.3B_zero3  the BASELINE.md row-3 model point (ZeRO-3, bf16
                    moments+grad accumulation to fit one 16 GB chip),
                    where per-step fixed costs amortize
+  comm_overlap_on/off  the comm-overlap program annotations
+                   (BENCH_COMM_OVERLAP=1/0; runtime/zero/overlap.py)
+                   A/B'd at whatever dp the driver exposes
 Disable with BENCH_VARIANTS=none, or pick a subset
-(BENCH_VARIANTS=mlp_down,bwd_qmajor,1.3B).
+(BENCH_VARIANTS=mlp_down,bwd_qmajor,1.3B,overlap).
 """
 
 import gc
@@ -116,6 +119,17 @@ _VARIANTS = {
                         "BENCH_FLASH_BK_BWD": "512"}),
     "1.3B": ("gpt2_1.3B_zero3", {"BENCH_PRESET": "1.3B",
                                  "BENCH_ZERO_STAGE": "3"}),
+    # comm-overlap A/B at whatever dp the driver exposes (the BENCH_DP
+    # pair): 'overlap' forces the program-level annotations on (per-layer
+    # in-scan grad reduction + ZeRO-3 gather prefetch; at dp=1 this
+    # measures their pure overhead), 'overlap_off' pins them off (== the
+    # headline at default 'auto', a drift sentinel at dp>1). XLA flags
+    # only land when the driver also sets BENCH_COMM_OVERLAP=1 /
+    # DSTPU_COMM_OVERLAP=1 before the process starts — in-process
+    # variants inherit the headline's flags; the full-flag A/B lives in
+    # the multichip artifact (__graft_entry__.measured_multichip).
+    "overlap": ("comm_overlap_on", {"BENCH_COMM_OVERLAP": "1"}),
+    "overlap_off": ("comm_overlap_off", {"BENCH_COMM_OVERLAP": "0"}),
 }
 
 
@@ -173,8 +187,9 @@ def main():
             kernels_parity = f"FAILED: {type(e).__name__}: {e}"[:300]
 
     variants = {}
-    vnames = os.environ.get("BENCH_VARIANTS",
-                            "mlp_down,bwd_qmajor,bwd_qmajor_512,1.3B")
+    vnames = os.environ.get(
+        "BENCH_VARIANTS",
+        "mlp_down,bwd_qmajor,bwd_qmajor_512,1.3B,overlap,overlap_off")
     if vnames and vnames != "none":
         variants = _run_variants(
             [v for v in vnames.split(",") if v],

@@ -2,30 +2,53 @@
 
 Counterpart of reference ``bin/ds_bench`` + ``benchmarks/communication``
 (all_reduce/all_gather/all_to_all sweeps): times each collective over the
-current mesh's data axes across a size sweep and prints algorithmic
-bandwidth. Run on any topology:
+current mesh's data axes across a size sweep and prints both payload
+bandwidth and the ALGORITHMIC bus bandwidth (the ``2(W-1)/W`` ring factor
+for all-reduce, ``(W-1)/W`` for gather/scatter ops — the number NCCL
+tables and the reference's busbw column report). Run on any topology:
 
     python benchmarks/comm_bench.py [--sizes-mb 1 16 64] [--trials 10]
+                                    [--axis data] [--json]
 
-On a single chip the numbers are loopback; on a pod they measure ICI/DCN.
+``--json`` prints one machine-readable line to stdout (the driver
+archives it) and moves the human table to stderr.
+
+The overlap probe (--overlap-mb) times a collective issued concurrently
+with an independent matmul chain inside one jitted program and reports
+how much of the collective's wall time the chain hides — the
+latency-hiding-scheduler acceptance number. On a single chip the
+collectives are loopback; on a pod they measure ICI/DCN.
 """
 
 import argparse
+import json
+import sys
 import time
 
 import os
-import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 import numpy as np
 import jax
 import jax.numpy as jnp
-from jax import shard_map
-from jax.sharding import PartitionSpec as P
 
-from deepspeed_tpu import comm as dist
-from deepspeed_tpu.utils import groups
+from deepspeed_tpu import comm as dist       # noqa: F401 (installs the
+from deepspeed_tpu.utils import groups       # older-jax shard_map shim)
+
+from jax import shard_map  # noqa: E402
+from jax.sharding import PartitionSpec as P  # noqa: E402
+
+# algorithmic bus-bandwidth factor vs raw payload/time: a ring all-reduce
+# moves 2(W-1)/W x payload per rank; gather/scatter/alltoall move
+# (W-1)/W. (The factor the old comment named but the code never applied.)
+_BUS_FACTOR = {
+    "all_reduce": lambda w: 2 * (w - 1) / w,
+    "all_gather": lambda w: (w - 1) / w,
+    "reduce_scatter": lambda w: (w - 1) / w,
+    "all_to_all": lambda w: (w - 1) / w,
+    "quantized_reduce_scatter": lambda w: (w - 1) / w,
+}
 
 
 def _timeit(fn, x, trials):
@@ -38,7 +61,17 @@ def _timeit(fn, x, trials):
     return (time.perf_counter() - t0) / trials
 
 
-def bench(sizes_mb, trials=10, axis="data"):
+def _wire_bytes(name, x):
+    """Bytes a rank actually puts on the wire per call: fp32 payload for
+    the plain ops; int8 + one fp32 scale per 2048-block for the
+    quantized reduce-scatter."""
+    if name == "quantized_reduce_scatter":
+        n = int(x.size)
+        return n + 4 * (-(-n // 2048))
+    return x.nbytes
+
+
+def bench(sizes_mb, trials=10, axis="data", out=sys.stdout):
     topo = groups.get_topology()
     mesh = topo.mesh
     W = mesh.shape[axis]
@@ -71,16 +104,76 @@ def bench(sizes_mb, trials=10, axis="data"):
         for name, fn in ops:
             try:
                 dt = _timeit(fn, x, trials)
-                # algorithmic bandwidth: bytes moved per rank ~ 2(W-1)/W
-                # x payload for ring allreduce; report payload/s (simple,
-                # comparable across ops like the reference does)
-                gbps = x.nbytes / dt / 1e9
-                results.append((name, mb, dt * 1e3, gbps))
+                wire = _wire_bytes(name, x)
+                gbps = wire / dt / 1e9
+                busbw = gbps * _BUS_FACTOR[name](W)
+                results.append({
+                    "op": name, "mb": mb, "ms": round(dt * 1e3, 3),
+                    "gbps": round(gbps, 3), "busbw_gbps": round(busbw, 3),
+                })
                 print(f"{name:28s} {mb:6.1f}MB  {dt * 1e3:8.3f}ms "
-                      f"{gbps:8.2f} GB/s")
+                      f"{gbps:8.2f} GB/s  bus {busbw:8.2f} GB/s",
+                      file=out)
             except Exception as e:  # noqa: BLE001
-                print(f"{name:28s} {mb:6.1f}MB  FAIL {e}")
+                results.append({"op": name, "mb": mb,
+                                "error": f"{type(e).__name__}: {e}"[:200]})
+                print(f"{name:28s} {mb:6.1f}MB  FAIL {e}", file=out)
     return results
+
+
+def overlap_probe(mb=16, trials=10, axis="data", chain=16, dim=1024,
+                  out=sys.stdout):
+    """Hidden-vs-exposed collective time: time (a) a matmul chain alone,
+    (b) an all-reduce alone, (c) one jitted program running both on
+    INDEPENDENT data. With a working latency-hiding schedule the
+    combined time approaches max(a, b): ``exposed = t_both - t_compute``
+    is the serialized remainder, ``hidden = t_comm - exposed`` the part
+    the chain absorbed."""
+    topo = groups.get_topology()
+    mesh = topo.mesh
+    W = mesh.shape[axis]
+    n = max(W * 2048, int(mb * 1e6 / 4) // (W * 2048) * (W * 2048))
+    x = jnp.asarray(np.random.RandomState(0).randn(W, n // W), jnp.float32)
+    a = jnp.asarray(np.random.RandomState(1).randn(dim, dim), jnp.float32)
+
+    def chain_fn(a):
+        y = a
+        for _ in range(chain):
+            y = jnp.tanh(y @ a)
+        return y
+
+    reduce_fn = shard_map(lambda t: dist.all_reduce(t, axis), mesh=mesh,
+                          in_specs=P(axis), out_specs=P(axis),
+                          check_vma=False)
+    f_comp = jax.jit(chain_fn)
+    f_comm = jax.jit(reduce_fn)
+    f_both = jax.jit(lambda x, a: (reduce_fn(x), chain_fn(a)))
+
+    t_comp = _timeit(f_comp, a, trials)
+    t_comm = _timeit(f_comm, x, trials)
+    jax.block_until_ready(f_both(x, a))
+    t0 = time.perf_counter()
+    for _ in range(trials):
+        o = f_both(x, a)
+    jax.block_until_ready(o)
+    t_both = (time.perf_counter() - t0) / trials
+
+    exposed = max(0.0, t_both - t_comp)
+    hidden = max(0.0, t_comm - exposed)
+    rep = {
+        "mb": mb, "chain": chain, "dim": dim,
+        "t_compute_ms": round(t_comp * 1e3, 3),
+        "t_comm_ms": round(t_comm * 1e3, 3),
+        "t_both_ms": round(t_both * 1e3, 3),
+        "exposed_ms": round(exposed * 1e3, 3),
+        "hidden_ms": round(hidden * 1e3, 3),
+        "hidden_frac": round(hidden / t_comm, 3) if t_comm > 0 else 0.0,
+    }
+    print(f"overlap probe  {mb:.1f}MB all_reduce || {chain}x{dim} matmul: "
+          f"comm {rep['t_comm_ms']}ms comp {rep['t_compute_ms']}ms "
+          f"both {rep['t_both_ms']}ms -> hidden {rep['hidden_ms']}ms "
+          f"({rep['hidden_frac'] * 100:.0f}%)", file=out)
+    return rep
 
 
 def main():
@@ -89,11 +182,32 @@ def main():
                     default=[1, 16, 64])
     ap.add_argument("--trials", type=int, default=10)
     ap.add_argument("--axis", default="data")
+    ap.add_argument("--json", action="store_true",
+                    help="one JSON line on stdout (table -> stderr)")
+    ap.add_argument("--overlap-mb", type=float, default=16,
+                    help="overlap probe payload (0 disables the probe)")
     args = ap.parse_args()
     dist.init_distributed()
     groups.initialize()
-    print(f"mesh: {dict(groups.get_mesh().shape)}")
-    bench(args.sizes_mb, args.trials, args.axis)
+    out = sys.stderr if args.json else sys.stdout
+    print(f"mesh: {dict(groups.get_mesh().shape)}", file=out)
+    results = bench(args.sizes_mb, args.trials, args.axis, out=out)
+    overlap = None
+    if args.overlap_mb:
+        try:
+            overlap = overlap_probe(args.overlap_mb, args.trials,
+                                    args.axis, out=out)
+        except Exception as e:  # noqa: BLE001
+            overlap = {"error": f"{type(e).__name__}: {e}"[:200]}
+            print(f"overlap probe FAIL {e}", file=out)
+    if args.json:
+        print(json.dumps({
+            "mesh": dict(groups.get_mesh().shape),
+            "axis": args.axis,
+            "trials": args.trials,
+            "results": results,
+            "overlap": overlap,
+        }))
 
 
 if __name__ == "__main__":

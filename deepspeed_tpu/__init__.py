@@ -10,6 +10,14 @@ reference's ``deepspeed/__init__.py``: ``initialize`` (:69),
 __version__ = "0.1.0"
 
 from .utils import compat as _compat  # noqa: F401  (older-jax shims)
+
+# DSTPU_COMM_OVERLAP=1: apply the comm-overlap XLA flag set (latency-
+# hiding scheduler + async collectives; runtime/zero/overlap.py) NOW,
+# before anything can initialize the backend — the only reliable point
+# for launcher/bench subprocesses. No-op without the env var.
+from .runtime.zero import overlap as _overlap
+_overlap.apply_env_overlap_flags()
+
 from . import comm
 from .accelerator import get_accelerator
 from .comm import init_distributed

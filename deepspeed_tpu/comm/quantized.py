@@ -68,6 +68,25 @@ def quantized_all_gather(x, axis_name, block=q8.QUANT_BLOCK,
                                    use_pallas=_resolve_pallas(use_pallas))
 
 
+def dcn_precision_clamp(x, block=q8.QUANT_BLOCK, use_pallas=None):
+    """int8 block quantize->dequantize round trip — the ZeRO++ qgZ
+    gradient numerics (reference csrc/quantization swizzled_quant before
+    the inter-node hop). Used by the comm-overlap layer BETWEEN the two
+    hierarchical stages — on the inner-(ICI-)reduced shard feeding the
+    data_outer/DCN hop: under GSPMD the cross-slice collective itself is
+    compiler-emitted, so this clamps the gradient VALUES crossing DCN to
+    what an int8 wire would carry; byte-level int8 transport for
+    explicitly-piped collectives is ``all_to_all_quant_reduce`` below."""
+    if x.dtype == jnp.int8 or x.size == 0:
+        return x
+    _record_wire("dcn_precision_clamp", int(x.size), block, "data_outer")
+    pallas = _resolve_pallas(use_pallas)
+    q, s, meta = q8.quantize_blockwise(x.astype(jnp.float32), block=block,
+                                       use_pallas=pallas)
+    out = q8.dequantize_blockwise(q, s, meta, use_pallas=pallas)
+    return out.astype(x.dtype)
+
+
 def all_to_all_quant_reduce(x, inner_axis="data", outer_axis="data_outer",
                             average=False, block=q8.QUANT_BLOCK,
                             use_pallas=None):

@@ -393,6 +393,13 @@ class GPT2:
         layer_rngs = jax.random.split(
             rng if rng is not None else jax.random.key(0), cfg.n_layer)
 
+        # comm-overlap prefetch hint (engine-installed): unroll >= 2 puts
+        # consecutive layers in one scan body so layer i+1's param gather
+        # has layer i's matmuls to hide under (the explicit double buffer
+        # XLA's ag-pipelining pass then rotates across iterations)
+        unroll = max(cfg.scan_unroll,
+                     getattr(self, "_scan_unroll_min", 0) or 0)
+
         if cfg.attn_layer_windows:
             # per-layer local windows ride the scan as an operand (not a
             # param: the optimizer never sees them)
@@ -405,7 +412,7 @@ class GPT2:
 
             x, auxs = lax.scan(scan_body, x,
                                (params["blocks"], layer_rngs, windows),
-                               unroll=cfg.scan_unroll)
+                               unroll=unroll)
         else:
             def scan_body(carry, xs):
                 layer, lrng = xs
@@ -413,7 +420,7 @@ class GPT2:
                 return x, aux
 
             x, auxs = lax.scan(scan_body, x, (params["blocks"], layer_rngs),
-                               unroll=cfg.scan_unroll)
+                               unroll=unroll)
         if return_hidden:
             return x, jnp.sum(auxs)
         return self.head(params, x), jnp.sum(auxs)
@@ -606,6 +613,12 @@ class GPT2:
         """One transformer block: (B, T, D) -> (B, T, D), plus aux loss.
         Shared by the dense scan path and the pipelined executor
         (models/gpt2_pipe.py)."""
+        # engine-installed comm-overlap annotation (runtime/zero/
+        # overlap.py): explicit ZeRO-3 gather of this layer's shard in
+        # forward, per-scan-iteration grad reduce-scatter in backward
+        hook = getattr(self, "_layer_comm_hook", None)
+        if hook is not None:
+            layer = hook(layer)
         from ..ops.int8_weights import dequant_tree
         layer = dequant_tree(layer, _dtype(self.config))
         # dense path for: random-LTD gathered masks and per-layer local

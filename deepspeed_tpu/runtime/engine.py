@@ -22,6 +22,7 @@ pipeline SURVEY §3.1, fwd/bwd/step §3.2). TPU-first redesign:
 """
 
 import functools
+import os
 
 import numpy as np
 import jax
@@ -35,10 +36,11 @@ from ..utils.groups import TopologyConfig, BATCH_AXES
 from ..utils.logging import logger, log_dist
 from ..utils.timer import (SynchronizedWallClockTimer, ThroughputTimer,
                            TRAIN_BATCH_TIMER)
-from .config import DeepSpeedConfig
+from .config import DeepSpeedConfig, _take, CommOverlapConfig
 from .fp16.loss_scaler import create_loss_scaler, grads_finite
 from .lr_schedules import build_scheduler
 from .zero.partitioning import ZeroShardingPlan
+from .zero import overlap as comm_overlap
 
 
 def _tree_cast(tree, dtype):
@@ -54,13 +56,38 @@ class DeepSpeedEngine:
                  topology=None, seed=0):
         # --- topology & config (reference engine.py:1112
         #     _configure_distributed_model) ---
+        if isinstance(config, dict):
+            raw = config
+        elif isinstance(config, DeepSpeedConfig):
+            raw = config._raw
+        else:
+            # str path: read the json directly — batch-triad validation
+            # belongs to the dp-aware DeepSpeedConfig built below
+            import json as _json
+            with open(config) as _f:
+                raw = _json.load(_f)
+        # comm-overlap XLA flags (zero/overlap.py) must land before the
+        # backend initializes — which happens at the first jax.devices()
+        # call when this engine builds its own topology below — so the
+        # block is parsed ahead of the full config. "auto" only applies
+        # flags when a multi-process/overlap env hint exists: flags at
+        # dp=1 would perturb the measured single-chip headline.
+        co_early = _take(raw, CommOverlapConfig, "comm_overlap")
+        want_flags = co_early.set_xla_flags and (
+            co_early.enabled is True
+            or (co_early.enabled == "auto"
+                and (os.environ.get("COORDINATOR_ADDRESS")
+                     or os.environ.get("DSTPU_COMM_OVERLAP") == "1")))
+        if want_flags:
+            platform = comm_overlap.platform_guess()
+            self._overlap_flags = comm_overlap.apply_xla_flags(
+                comm_overlap.xla_overlap_flags(
+                    platform, prefetch=co_early.prefetch,
+                    bucket_mb=co_early.bucket_mb),
+                comm_overlap.overlap_env_var(platform))
+        else:
+            self._overlap_flags = (False, "not requested")
         if topology is None:
-            if isinstance(config, dict):
-                raw = config
-            elif isinstance(config, DeepSpeedConfig):
-                raw = config._raw
-            else:
-                raw = DeepSpeedConfig(config, dp_world_size=1)._raw
             zero_raw = raw.get("zero_optimization", {})
             shard = int(zero_raw.get("mics_shard_size", -1))
             if shard in (-1, 0):
@@ -76,9 +103,19 @@ class DeepSpeedEngine:
         self.topology = topology
         self.mesh = topology.mesh
         dp_world = topology.get_data_parallel_world_size()
+        # `raw` is the parsed dict in every non-DeepSpeedConfig branch —
+        # no second read of a json path
         self.config = (config if isinstance(config, DeepSpeedConfig)
-                       else DeepSpeedConfig(config, dp_world_size=dp_world))
+                       else DeepSpeedConfig(raw, dp_world_size=dp_world))
         dist.configure(self.config)
+
+        # comm-overlap resolution (the XLA flags were handled above,
+        # pre-backend; this decides the program-level annotations)
+        co = self.config.comm_overlap
+        self._overlap_on = co.resolve_enabled(dp_world)
+        self._overlap_hier = self._overlap_on and co.resolve_hierarchical(
+            topology.axis_size("data_outer"))
+        self.comm_overlap_report = None
 
         self.model = model
         self.zero_stage = self.config.zero.stage
@@ -159,7 +196,8 @@ class DeepSpeedEngine:
             f"sp={topology.get_sequence_parallel_world_size()} "
             f"ep={topology.get_expert_parallel_world_size()} "
             f"micro_bs={self.config.train_micro_batch_size_per_gpu} "
-            f"gas={self.config.gradient_accumulation_steps}", ranks=[0])
+            f"gas={self.config.gradient_accumulation_steps} "
+            f"overlap={self._overlap_on}", ranks=[0])
 
     # ------------------------------------------------------------------ state
     def _build_state(self, seed):
@@ -167,6 +205,7 @@ class DeepSpeedEngine:
         abstract = jax.eval_shape(self.model.init, rng)
         shapes = jax.tree.map(lambda l: l.shape, abstract)
         tp_specs = self.model.partition_specs(self.topology)
+        self._tp_specs = tp_specs
         # MiCS: everything shards over the inner group, replicates over
         # data_outer (zero/mics.py:64). hpZ/ZeRO++: only the stage-3 bf16
         # param shard is intra-slice; optimizer state stays global-DP
@@ -349,6 +388,10 @@ class DeepSpeedEngine:
         gdtype = jnp.dtype({"fp32": "float32", "bf16": "bfloat16",
                             "fp16": "float16", None: "float32"}.get(
             self.config.grad_accum_dtype, self.config.grad_accum_dtype))
+
+        # per-layer comm annotations consumed by the model's block scan
+        # (must precede tracing, which happens at the first jitted call)
+        self._install_comm_overlap(gdtype)
 
         def micro_loss_and_grads(params, micro_batch, rng, scale,
                                  step=None, ltd_keep=None):
@@ -563,6 +606,82 @@ class DeepSpeedEngine:
                 apply_update, donate_argnums=(0, 1),
                 in_shardings=(st_sh(), self.grad_shardings, None),
                 out_shardings=(st_sh(), None))
+
+    # ------------------------------------------------------- comm overlap
+    def _install_comm_overlap(self, gdtype):
+        """Install the per-layer comm hook on the model (zero/overlap.py):
+        forward gathers the ZeRO-3 layer shard explicitly (the prefetch
+        target), backward constrains the layer cotangent to its per-layer
+        grad sharding so the reduce-scatter lands INSIDE the backward
+        scan — grad comm for layer i overlapping compute of layer i-1 —
+        optionally staged hierarchically over ('data','expert') then
+        'data_outer'."""
+        co = self.config.comm_overlap
+        if not self._overlap_on:
+            return
+        blocks_grad = (self.plan.grad_specs.get("blocks")
+                       if isinstance(self.plan.grad_specs, dict) else None)
+        blocks_tp = (self._tp_specs.get("blocks")
+                     if isinstance(self._tp_specs, dict) else None)
+        if blocks_grad is None or blocks_tp is None or \
+                not hasattr(self.model, "block_forward"):
+            log_dist(
+                "comm_overlap: model has no scanned 'blocks' params; "
+                "per-layer annotations skipped (XLA flags unaffected)",
+                ranks=[0])
+            return
+        is_spec = lambda x: isinstance(x, P)
+        grad_layer = jax.tree.map(comm_overlap.drop_layer_dim, blocks_grad,
+                                  is_leaf=is_spec)
+        gather_layer = None
+        prefetch_on = (co.prefetch and self.zero_stage >= 3
+                       and not self.offload_param_cfg.enabled)
+        if prefetch_on:
+            gather_layer = jax.tree.map(comm_overlap.drop_layer_dim,
+                                        blocks_tp, is_leaf=is_spec)
+            # two consecutive layers per scan body: the i+1 gather has
+            # layer i's matmuls to hide under
+            self.model._scan_unroll_min = 2
+        self.model._layer_comm_hook = comm_overlap.make_layer_comm_hook(
+            grad_layer, gather_specs=gather_layer,
+            hierarchical=self._overlap_hier,
+            dcn_quantize=co.dcn_quantize,
+            bucket_bytes=co.bucket_mb << 20, gdtype=gdtype)
+        log_dist(
+            f"comm_overlap on: bucket_mb={co.bucket_mb} "
+            f"prefetch={prefetch_on} hierarchical={self._overlap_hier} "
+            f"dcn_quantize={co.dcn_quantize} "
+            f"xla_flags={self._overlap_flags[1]}", ranks=[0])
+
+    def verify_comm_overlap(self, batch, require_async=False):
+        """Compile the train-step program on ``batch`` and report the
+        collective schedule XLA ACTUALLY emitted (``compiled.as_text()``
+        through zero/overlap.overlap_report): collective count, async
+        start/done pairs, in-scan-loop placement, and the mesh axes each
+        collective's replica groups map to. ``require_async`` raises if a
+        dp>=2 step carries no async pairs — the overlap flags did not
+        take effect (TPU/GPU only: CPU lowers collectives synchronously
+        in HLO)."""
+        batch = jax.tree.map(self._add_gas_dim, batch)
+        batch = self._shard_batch(batch, with_gas_dim=True)
+        with jax.set_mesh(self.mesh):
+            if self.offload_enabled:
+                compiled = self._grad_step_jit.lower(
+                    self.state, batch, None).compile()
+            else:
+                compiled = self._train_step_jit.lower(
+                    self.state, batch, self._current_lr(), None).compile()
+        report = comm_overlap.overlap_report(compiled.as_text(),
+                                             mesh=self.mesh)
+        self.comm_overlap_report = report
+        if require_async and report["n_collectives"] \
+                and not report["async_pairs"]:
+            raise RuntimeError(
+                f"comm_overlap: step has {report['n_collectives']} "
+                f"collectives but no async start/done pairs — overlap "
+                f"flags did not take effect (set DSTPU_COMM_OVERLAP=1 "
+                f"in the environment before the backend initializes)")
+        return report
 
     # ----------------------------------------------------------------- batch
     def deepspeed_io(self, dataset, batch_size=None, shuffle=True,

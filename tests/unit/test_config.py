@@ -73,3 +73,49 @@ def test_defaults_and_blocks():
     assert cfg.gradient_clipping == 1.0
     import jax.numpy as jnp
     assert cfg.precision_dtype == jnp.bfloat16
+
+
+def test_comm_overlap_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
+    co = cfg.comm_overlap
+    assert co.enabled == "auto"
+    assert co.bucket_mb == 32
+    assert co.prefetch is True
+    assert co.hierarchical == "auto"
+    assert co.dcn_quantize is False
+    # auto resolution: program annotations only when dp > 1 / a real
+    # data_outer split exists
+    assert not co.resolve_enabled(1)
+    assert co.resolve_enabled(8)
+    assert not co.resolve_hierarchical(1)
+    assert co.resolve_hierarchical(2)
+
+
+def test_comm_overlap_block_parses():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "comm_overlap": {"enabled": True, "bucket_mb": 8,
+                         "prefetch": False, "hierarchical": False,
+                         "dcn_quantize": True},
+    }, dp_world_size=8)
+    co = cfg.comm_overlap
+    assert co.enabled is True and co.resolve_enabled(1)
+    assert co.bucket_mb == 8
+    assert co.prefetch is False
+    assert not co.resolve_hierarchical(4)
+    assert co.dcn_quantize is True
+
+
+def test_comm_overlap_validation():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "comm_overlap": {"enabled": "yes"}},
+                        dp_world_size=8)
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "comm_overlap": {"hierarchical": "always"}},
+                        dp_world_size=8)
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "comm_overlap": {"bucket_mb": -1}},
+                        dp_world_size=8)
