@@ -29,20 +29,34 @@ def build_bench_config():
 
     preset = os.environ.get("BENCH_PRESET", "350M")
     seq_len = int(os.environ.get("BENCH_SEQ", "1024"))
+
+    # BENCH_AUTOTUNE=1: every tunable kernel knob goes to "auto" so the
+    # measured-dispatch winner cache picks variants (the engine's
+    # autotune block below sets the mode); any explicitly-set BENCH_*
+    # knob still wins over "auto". BENCH_AUTOTUNE=0 pins the r05
+    # defaults AND autotune mode off (the drift sentinel).
+    tune = os.environ.get("BENCH_AUTOTUNE", "") == "1"
+
+    def knob(env, default, parse=int):
+        v = os.environ.get(env)
+        if v is None:
+            return "auto" if tune else parse(default)
+        return parse(v)
+
     return replace(
         PRESETS[preset], max_seq_len=seq_len,
         use_flash_attention=os.environ.get("BENCH_FLASH", "1") == "1",
-        flash_block_q=int(os.environ.get("BENCH_FLASH_BQ", "1024")),
-        flash_block_k=int(os.environ.get("BENCH_FLASH_BK", "1024")),
-        flash_block_h=int(os.environ.get("BENCH_FLASH_BH", "1")),
-        flash_block_q_bwd=int(os.environ.get("BENCH_FLASH_BQ_BWD", "0")),
-        flash_block_k_bwd=int(os.environ.get("BENCH_FLASH_BK_BWD", "0")),
+        flash_block_q=knob("BENCH_FLASH_BQ", "1024"),
+        flash_block_k=knob("BENCH_FLASH_BK", "1024"),
+        flash_block_h=knob("BENCH_FLASH_BH", "1"),
+        flash_block_q_bwd=knob("BENCH_FLASH_BQ_BWD", "0"),
+        flash_block_k_bwd=knob("BENCH_FLASH_BK_BWD", "0"),
         remat=os.environ.get("BENCH_REMAT", "1") == "1",
         remat_policy=os.environ.get("BENCH_REMAT_POLICY", "save_flash"),
         scan_unroll=int(os.environ.get("BENCH_SCAN_UNROLL", "1")),
         fused_layernorm={"0": False, "1": True, "bwd": "bwd",
                          "auto": "auto"}.get(
-            os.environ.get("BENCH_FUSED_LN", "0"), False),
+            knob("BENCH_FUSED_LN", "0", parse=str), False),
         loss_chunk=int(os.environ.get("BENCH_LOSS_CHUNK", "512")),
         fused_loss=os.environ.get("BENCH_FUSED_LOSS", "1") == "1",
         fused_loss_kernel=os.environ.get("BENCH_FUSED_LOSS_KERNEL",
@@ -51,11 +65,12 @@ def build_bench_config():
         # mlp_matmul.py): 0 (XLA, default) | down | both | auto
         mlp_kernel={"0": False, "auto": "auto", "down": "down",
                     "both": "both"}.get(
-            os.environ.get("BENCH_MLP_KERNEL", "0"), False),
+            knob("BENCH_MLP_KERNEL", "0", parse=str), False),
         mlp_kernel_fuse_dw=os.environ.get("BENCH_MLP_FUSE_DW", "1") == "1",
         # query-major fused flash backward (dkv VMEM-resident retune)
-        flash_bwd_qmajor=os.environ.get("BENCH_FLASH_BWD_QMAJOR",
-                                        "0") == "1")
+        flash_bwd_qmajor=(
+            "auto" if tune and "BENCH_FLASH_BWD_QMAJOR" not in os.environ
+            else os.environ.get("BENCH_FLASH_BWD_QMAJOR", "0") == "1"))
 
 
 def build_bench_engine():
@@ -98,6 +113,18 @@ def build_bench_engine():
         overlap_cfg["bucket_mb"] = int(os.environ["BENCH_COMM_BUCKET_MB"])
     if os.environ.get("BENCH_COMM_PREFETCH"):
         overlap_cfg["prefetch"] = os.environ["BENCH_COMM_PREFETCH"] == "1"
+    # measured kernel dispatch (autotuning/kernel_dispatch.py):
+    # BENCH_AUTOTUNE=1 searches cold keys at first trace (inside warmup,
+    # so search compiles never land in the timed section) and persists
+    # winners; =0 pins dispatch off (the r05-default drift sentinel);
+    # unset inherits the env default (cache_only)
+    at = os.environ.get("BENCH_AUTOTUNE", "")
+    autotune_cfg = {}
+    if at == "1":
+        autotune_cfg["mode"] = os.environ.get("BENCH_AUTOTUNE_MODE",
+                                              "on_first_use")
+    elif at == "0":
+        autotune_cfg["mode"] = "off"
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         config={
@@ -118,6 +145,7 @@ def build_bench_engine():
                      if offload == "nvme" else {"device": "cpu"})}
                 if offload else {"stage": stage}),
             **({"comm_overlap": overlap_cfg} if overlap_cfg else {}),
+            **({"autotune": autotune_cfg} if autotune_cfg else {}),
         })
     bsz = engine.config.train_batch_size
     rng = np.random.RandomState(0)

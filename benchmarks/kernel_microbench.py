@@ -10,6 +10,13 @@ removes dispatch latency and jit constants, which dominate on the axon
 tunnel). Prints one JSON line per comparison.
 
 Run: python benchmarks/kernel_microbench.py
+
+``--from-cache [path]``: instead of the serving-tier sweep, re-time
+exactly the cached autotune winners (autotuning/kernel_cache.py) for
+THIS chip with the same slope harness the search used — a one-command
+verification that a shipped cache's timings still hold (after a
+toolchain bump, on a new chip batch, ...). Prints one JSON row per
+entry with the fresh measurement next to the cached one.
 """
 
 import json
@@ -54,7 +61,50 @@ def per_op_ms(op, x, k1=64, k2=512):
     return 1e3 * (t2 - t1) / (k2 - k1)
 
 
+def retime_from_cache(path=None, chain_lengths=(8, 24), reps=3):
+    """Re-measure every cached winner for the current device; returns
+    the printed rows. A winner whose step can no longer build/run is
+    reported with an error instead of aborting the sweep."""
+    from deepspeed_tpu.autotuning import (KernelCache, kernel_dispatch,
+                                          kernel_registry)
+    from deepspeed_tpu.autotuning.kernel_autotuner import time_step
+    path = path or kernel_dispatch.cache_path()
+    cache = KernelCache.load(path)
+    entries = cache.for_device(kernel_dispatch.device_kind())
+    rows = []
+    if not entries:
+        rows.append({"cache": path, "note": "no cached winners for "
+                     f"device {kernel_dispatch.device_kind()!r}"})
+    for key, e in sorted(entries.items()):
+        row = {"op": e.get("op"), "bucket": e.get("bucket"),
+               "dtype": e.get("dtype"), "params": e.get("params"),
+               "cached_ms": e.get("measured_ms"),
+               "cached_default_ms": e.get("default_ms")}
+        spec = kernel_registry.REGISTRY.get(e.get("op"))
+        if spec is None:
+            row["error"] = f"unknown op {e.get('op')!r}"
+        else:
+            try:
+                step, args = spec["make_step"](
+                    kernel_registry.parse_bucket(e["bucket"]),
+                    e["dtype"], e["params"])
+                row["retimed_ms"] = round(
+                    time_step(step, args, chain_lengths, reps), 4)
+            except Exception as ex:  # noqa: BLE001 — sweep must finish
+                row["error"] = f"{type(ex).__name__}: {ex}"[:200]
+        rows.append(row)
+    for r in rows:
+        print(json.dumps(r))
+    return rows
+
+
 def main():
+    if "--from-cache" in sys.argv:
+        i = sys.argv.index("--from-cache")
+        path = sys.argv[i + 1] if len(sys.argv) > i + 1 \
+            and not sys.argv[i + 1].startswith("-") else None
+        retime_from_cache(path)
+        return
     B, T, H, hd = 8, 1024, 16, 64
     D = H * hd
     rng = np.random.RandomState(0)

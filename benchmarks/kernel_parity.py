@@ -14,7 +14,9 @@ status ("ok" or the failure string), so the bench JSON's
 its transposed-operand and q-major-backward variants, the bias family
 (ALiBi, learned pair bias incl. d_bias cotangents, sliding window), the
 evoformer fold, the SplitFuse fused chunk program, the paged/
-block-sparse/quant/fused-CE kernels, and the layout-owning MLP matmul.
+block-sparse/quant/fused-CE kernels, the layout-owning MLP matmul, and
+every cached autotune winner (tuned-vs-reference rows, so a stale or
+wrong winner cache fails numerically instead of silently).
 
 Budget: a few seconds of device time; tens of seconds of compiles.
 Tolerances are bf16-scale — on TPU both the kernels and the dense
@@ -307,6 +309,36 @@ def _fused_ce(rng):
            "fused-ce gold")
 
 
+def _tuned_winners(rng):
+    """Tuned-vs-reference parity for every cached autotune winner on
+    THIS chip: a stale or wrong cache entry (edited file, toolchain
+    bump that changed kernel numerics, foreign shapes) fails here
+    numerically instead of silently steering the training step. Raises
+    with a per-entry breakdown on any failure."""
+    from deepspeed_tpu.autotuning import KernelCache, kernel_dispatch
+    from deepspeed_tpu.autotuning import kernel_registry
+    cache = KernelCache.load(kernel_dispatch.cache_path())
+    entries = cache.for_device(kernel_dispatch.device_kind())
+    if not entries:
+        return                       # "ok": nothing cached, nothing stale
+    failures = []
+    for key, e in sorted(entries.items()):
+        op = e.get("op")
+        spec = kernel_registry.REGISTRY.get(op)
+        if spec is None:
+            failures.append(f"{key}: unknown op {op!r}")
+            continue
+        try:
+            spec["parity"](kernel_registry.parse_bucket(e["bucket"]),
+                           e["dtype"], e["params"])
+        except Exception as ex:  # noqa: BLE001 — collect all entries
+            failures.append(f"{key}: {type(ex).__name__}: {ex}"[:200])
+    if failures:
+        raise AssertionError(
+            f"{len(failures)}/{len(entries)} cached winners failed "
+            f"parity: " + "; ".join(failures))
+
+
 def _quant(rng):
     from deepspeed_tpu.ops.pallas.quantization import (
         dequantize_blockwise, quantize_blockwise)
@@ -337,6 +369,9 @@ _GATES = (
     ("block_sparse", _block_sparse),
     ("quant", _quant),
     ("fused_ce", _fused_ce),
+    # every cached autotune winner re-proved against the dense
+    # references (ok when the cache is empty)
+    ("autotune_winners", _tuned_winners),
 )
 
 

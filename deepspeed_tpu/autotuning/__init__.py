@@ -1,3 +1,5 @@
 from .autotuner import Autotuner, ModelInfo
 from .scheduler import Node, Reservation, ResourceManager, SubprocessRunner
 from .tuner import CostModel, GridSearchTuner, ModelBasedTuner, RandomTuner
+from . import kernel_dispatch
+from .kernel_cache import KernelCache, default_cache_path

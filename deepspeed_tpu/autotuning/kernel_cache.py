@@ -1,0 +1,153 @@
+"""Persistent winner cache for the measured kernel dispatch.
+
+Counterpart of the reference autotuner's ``autotuning_results/`` json
+artifacts, but at kernel granularity: one entry per
+(device_kind, op, shape-bucket, dtype) holding the measured winner's
+tunable parameters. The cache is consulted at TRACE time by
+``ops/pallas/_common.dispatch`` — once a program is jitted the choice is
+baked into the HLO and costs zero per-step host work.
+
+Hard rule (the interpret-mode trap): entries record the ``device_kind``
+they were measured on (``jax.devices()[0].device_kind``) and ``lookup``
+REFUSES entries measured on a different chip — a cache produced in
+Pallas interpreter mode on CPU must never steer a real TPU (interpreter
+timings order candidates by host emulation cost, not MXU/VPU cost), and
+a v5e cache must not steer a v4. A refused entry is a miss, so dispatch
+falls back to the proven defaults instead of applying foreign timings.
+
+File format (versioned, deterministically serialized so a round trip is
+byte-identical — tested):
+
+    {"version": 1,
+     "entries": {
+       "<device_kind>|<op>|<bucket>|<dtype>": {
+         "device_kind": ..., "op": ..., "bucket": ..., "dtype": ...,
+         "params": {...}, "measured_ms": ..., "default_ms": ...,
+         "candidates": N}}}
+
+Writes are atomic (tmp + fsync + rename, the serialization.py rule): a
+crash mid-save never corrupts the previous cache generation.
+"""
+
+import json
+import os
+
+from ..utils.logging import logger
+
+CACHE_VERSION = 1
+
+# env overrides consulted by default_cache_path(); the config block's
+# cache_path wins over both
+CACHE_PATH_ENV = "DSTPU_AUTOTUNE_CACHE"
+_DEFAULT_DIRNAME = os.path.join("~", ".cache", "deepspeed_tpu")
+_DEFAULT_BASENAME = "kernel_autotune.json"
+
+
+def default_cache_path():
+    """Resolved default cache file location: $DSTPU_AUTOTUNE_CACHE if
+    set, else ~/.cache/deepspeed_tpu/kernel_autotune.json."""
+    env = os.environ.get(CACHE_PATH_ENV)
+    if env:
+        return env
+    return os.path.join(os.path.expanduser(_DEFAULT_DIRNAME),
+                        _DEFAULT_BASENAME)
+
+
+def entry_key(device_kind, op, bucket, dtype):
+    return f"{device_kind}|{op}|{bucket}|{dtype}"
+
+
+class KernelCache:
+    """In-memory view of one cache file; load/save are explicit."""
+
+    def __init__(self, entries=None):
+        self.entries = dict(entries or {})
+
+    # ------------------------------------------------------------- io
+    @classmethod
+    def load(cls, path):
+        """Read ``path``; a missing/corrupt/foreign-version file is an
+        EMPTY cache (every lookup then falls back to defaults) — a bad
+        cache must degrade, never crash a training run."""
+        try:
+            with open(path) as f:
+                raw = json.load(f)
+        except FileNotFoundError:
+            return cls()
+        except (OSError, ValueError) as e:
+            logger.warning(f"autotune cache {path!r} unreadable "
+                           f"({type(e).__name__}: {e}); ignoring it")
+            return cls()
+        if not isinstance(raw, dict) or raw.get("version") != CACHE_VERSION:
+            logger.warning(
+                f"autotune cache {path!r} has version "
+                f"{raw.get('version') if isinstance(raw, dict) else '?'} "
+                f"(want {CACHE_VERSION}); ignoring it")
+            return cls()
+        entries = raw.get("entries", {})
+        if not isinstance(entries, dict):
+            return cls()
+        return cls(entries)
+
+    def to_json(self):
+        """Deterministic serialization: sorted keys, fixed indent — the
+        same entries always produce the same bytes (round-trip test)."""
+        return json.dumps({"version": CACHE_VERSION,
+                           "entries": self.entries},
+                          indent=2, sort_keys=True) + "\n"
+
+    def save(self, path):
+        """Atomic write: tmp + fsync + rename (a crash mid-save leaves
+        the previous cache intact — the serialization.py shard rule)."""
+        d = os.path.dirname(os.path.abspath(path))
+        os.makedirs(d, exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(self.to_json())
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    # ------------------------------------------------------- accessors
+    def lookup(self, device_kind, op, bucket, dtype):
+        """Winner params for the key, or None. Entries whose recorded
+        device_kind disagrees with the requested one are REFUSED (an
+        interpret-mode/CPU cache applied on device would steer kernels
+        by emulation timings) — the caller sees a plain miss."""
+        e = self.entries.get(entry_key(device_kind, op, bucket, dtype))
+        if e is None:
+            return None
+        if e.get("device_kind") != device_kind:
+            logger.warning(
+                f"autotune cache: refusing entry for op={op!r} "
+                f"bucket={bucket!r}: measured on "
+                f"{e.get('device_kind')!r}, running on {device_kind!r}")
+            return None
+        params = e.get("params")
+        return dict(params) if isinstance(params, dict) else None
+
+    def put(self, device_kind, op, bucket, dtype, params,
+            measured_ms=None, default_ms=None, candidates=None):
+        def fin(v):
+            # non-finite floats would serialize as the non-standard
+            # 'Infinity'/'NaN' tokens and break every strict-JSON
+            # consumer of the cache/bench artifacts
+            import math
+            return v if v is None or (isinstance(v, (int, float))
+                                      and math.isfinite(v)) else None
+
+        self.entries[entry_key(device_kind, op, bucket, dtype)] = {
+            "device_kind": device_kind, "op": op, "bucket": bucket,
+            "dtype": dtype, "params": dict(params),
+            "measured_ms": fin(measured_ms), "default_ms": fin(default_ms),
+            "candidates": candidates,
+        }
+
+    def for_device(self, device_kind):
+        """All entries measured on ``device_kind`` (the bench artifact's
+        tuned table)."""
+        return {k: v for k, v in self.entries.items()
+                if v.get("device_kind") == device_kind}
+
+    def __len__(self):
+        return len(self.entries)

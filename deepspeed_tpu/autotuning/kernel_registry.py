@@ -1,0 +1,440 @@
+"""Tunable-parameter registry over the Pallas kernels.
+
+One entry per autotunable op. Each entry owns, for a given shape
+bucket (the strings built by ``ops/pallas/_common``):
+
+  ``defaults(b)``     the r05-proven hand-set parameters — what dispatch
+                      falls back to on a cache miss, and the baseline
+                      candidate every search times first
+  ``candidates(b)``   the measured search space (curated, not a full
+                      grid: each candidate is a lever PERF_NOTES has
+                      named, so a search run doubles as a lever A/B)
+  ``make_step(b, dtype, params)``
+                      -> (step_fn, args): a data-dependent train-shaped
+                      step (forward AND backward where the kernel has
+                      one) suitable for lax.scan chaining inside ONE
+                      jit — the round-2 dispatch-latency lesson
+                      (~3.3 ms/dispatch on the axon tunnel) means
+                      per-candidate timing must amortize dispatch or it
+                      measures the transport, not the kernel
+  ``parity(b, dtype, params)``
+                      numerics check of the candidate against the dense
+                      reference (raises on mismatch) — run on every
+                      winner before it is cached, and re-run by
+                      ``benchmarks/kernel_parity.py`` for every cached
+                      winner so a stale/wrong cache entry fails loudly
+
+Buckets are exact in variant-gating dims (feature/head/vocab) and
+power-of-two in data-volume dims; ``parse_bucket`` recovers the dict.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Single source of truth for each op's r05 KERNEL-level defaults is the
+# kernel module itself (its TUNE_DEFAULTS — what dispatch falls back to
+# on a cache miss); the registry re-exports and extends them with the
+# MODEL-level knobs it alone owns (layernorm variant, mlp path), so
+# flipping a proven default in ops/ flips the search baseline too.
+from ..ops.pallas.flash_attention import TUNE_DEFAULTS as FLASH_DEFAULTS
+from ..ops.pallas.fused_ce import TUNE_DEFAULTS as CE_DEFAULTS
+from ..ops.pallas.layernorm import TUNE_DEFAULTS as _LN_KERNEL_DEFAULTS
+
+# small perturbation chaining step i's gradients into step i+1's inputs:
+# keeps the scan body data-dependent (XLA cannot DCE or reorder the
+# repetitions) without drifting activations out of a realistic range
+_EPS = 1e-3
+
+_TOL = dict(rtol=5e-2, atol=5e-2)
+
+
+def parse_bucket(bucket):
+    """'T1024,d64,c1,q1' -> {'T': 1024, 'd': 64, 'c': 1, 'q': 1}."""
+    out = {}
+    for part in bucket.split(","):
+        i = 1
+        while i < len(part) and not (part[i].isdigit() or part[i] == "-"):
+            i += 1
+        out[part[:i]] = int(part[i:])
+    return out
+
+
+def _close(a, b, what, tol=_TOL):
+    np.testing.assert_allclose(np.asarray(a, np.float32),
+                               np.asarray(b, np.float32),
+                               err_msg=what, **tol)
+
+
+def _dedup(cands):
+    seen, out = set(), []
+    for c in cands:
+        key = tuple(sorted((k, repr(v)) for k, v in c.items()))
+        if key not in seen:
+            seen.add(key)
+            out.append(dict(c))
+    return out
+
+
+# ------------------------------------------------------------------ flash
+
+
+def _flash_defaults(b):
+    return dict(FLASH_DEFAULTS)
+
+
+def _flash_candidates(b):
+    """The round-6 lever set: full-T blocks + block_h=1 (the measured
+    r05 headline config), the 128/256 tilings, 512-wide backward
+    blocks, and the q-major fused backward on qkv_t layouts."""
+    T, qkv_t = b["T"], bool(b["q"])
+    full = min(T, 1024)
+    cands = [dict(FLASH_DEFAULTS)]
+    cands.append(dict(FLASH_DEFAULTS, block_q=full, block_k=full,
+                      block_h=1))
+    cands.append(dict(FLASH_DEFAULTS, block_q=min(256, T),
+                      block_k=min(256, T), block_h=1))
+    if T > 512:
+        cands.append(dict(FLASH_DEFAULTS, block_q=full, block_k=full,
+                          block_h=1, block_q_bwd=512, block_k_bwd=512))
+    if qkv_t:
+        cands.append(dict(FLASH_DEFAULTS, block_q=full, block_k=full,
+                          block_h=1, bwd_qmajor=True))
+        if T > 512:
+            cands.append(dict(FLASH_DEFAULTS, block_q=full, block_k=full,
+                              block_h=1, block_q_bwd=512,
+                              block_k_bwd=512, bwd_qmajor=True))
+    return _dedup(cands)
+
+
+def _flash_shapes(b):
+    # representative (batch, heads): enough instances that block_h=2
+    # divides, small enough that a search step stays cheap
+    B, H = 2, 2
+    return B, H, b["T"], b["d"]
+
+
+def _flash_fn(b, params):
+    from ..ops.pallas.flash_attention import flash_attention
+    causal, qkv_t = bool(b["c"]), bool(b["q"])
+
+    def f(q, k, v):
+        return flash_attention(
+            q, k, v, causal=causal, qkv_t=qkv_t,
+            heads_major=not qkv_t,
+            block_q=int(params["block_q"]),
+            block_k=int(params["block_k"]),
+            block_h=int(params["block_h"]),
+            block_q_bwd=int(params["block_q_bwd"]) or None,
+            block_k_bwd=int(params["block_k_bwd"]) or None,
+            bwd_qmajor=bool(params["bwd_qmajor"]))
+    return f
+
+
+def _flash_args(b, dtype, rng):
+    B, H, T, d = _flash_shapes(b)
+    shape = (B, H, d, T) if b["q"] else (B, H, T, d)
+    ks = jax.random.split(rng, 3)
+    return tuple(jax.random.normal(k, shape, dtype) for k in ks)
+
+
+def _flash_step(b, dtype, params):
+    f = _flash_fn(b, params)
+
+    def loss(q, k, v):
+        return jnp.sum(f(q, k, v).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, (0, 1, 2))
+
+    def step(carry):
+        q, k, v = carry
+        dq, dk, dv = g(q, k, v)
+        return (q + _EPS * dq.astype(q.dtype),
+                k + _EPS * dk.astype(k.dtype),
+                v + _EPS * dv.astype(v.dtype))
+
+    return step, _flash_args(b, dtype, jax.random.key(0))
+
+
+def _flash_parity(b, dtype, params):
+    from ..ops.pallas.flash_attention import attention_reference
+    bp = dict(b, T=min(b["T"], 1024))    # cap parity cost; blocks clamp
+    q, k, v = _flash_args(bp, dtype, jax.random.key(1))
+    f = _flash_fn(bp, params)
+    causal = bool(bp["c"])
+
+    if bp["q"]:
+        to_std = lambda x: x.transpose(0, 3, 1, 2)   # (B,H,d,T)->(B,T,H,d)
+        from_std = lambda x: x.transpose(0, 2, 1, 3)  # ->(B,H,T,d)
+    else:
+        to_std = lambda x: x.swapaxes(1, 2)
+        from_std = lambda x: x.swapaxes(1, 2)
+
+    def ref(q, k, v):
+        return from_std(attention_reference(
+            to_std(q), to_std(k), to_std(v), causal=causal))
+
+    do = jax.random.normal(jax.random.key(2),
+                           jax.eval_shape(ref, q, k, v).shape, dtype)
+    of, pull_f = jax.vjp(f, q, k, v)
+    orf, pull_r = jax.vjp(ref, q, k, v)
+    _close(of, orf, f"flash tuned fwd {params}")
+    for a, bb, n in zip(pull_f(do), pull_r(do), "qkv"):
+        _close(a, bb, f"flash tuned d{n} {params}")
+
+
+# ------------------------------------------------------------------- mlp
+MLP_DEFAULTS = {"mode": "xla", "fuse_dw": True,
+                "block_t": 256, "block_o": 256, "block_k": 512}
+
+
+def _mlp_defaults(b):
+    return dict(MLP_DEFAULTS)
+
+
+def _mlp_candidates(b):
+    """Layout/epilogue choice for the MLP projections: XLA einsums
+    (r05 default), the layout-owning down-projection kernel, both
+    projections kernel-owned, and the fused-vs-XLA dw epilogue."""
+    cands = [dict(MLP_DEFAULTS)]
+    for mode in ("down", "both"):
+        cands.append(dict(MLP_DEFAULTS, mode=mode))
+        cands.append(dict(MLP_DEFAULTS, mode=mode, fuse_dw=False))
+    cands.append(dict(MLP_DEFAULTS, mode="down", block_t=512,
+                      block_o=512))
+    return _dedup(cands)
+
+
+def _mlp_fn(b, params):
+    mode = params["mode"]
+
+    def f(h, wu, wd):
+        if mode == "xla":
+            u = h @ wu
+            out = jax.nn.gelu(u) @ wd
+            return out
+        from ..ops.pallas.mlp_matmul import mlp_matmul
+        kw = dict(fuse_dw=bool(params["fuse_dw"]),
+                  block_t=int(params["block_t"]),
+                  block_o=int(params["block_o"]),
+                  block_k=int(params["block_k"]))
+        if mode == "both":
+            u = mlp_matmul(h, wu, out_t=True, **kw)
+        else:
+            u = jnp.einsum("btd,df->bft", h, wu)
+        up = jax.nn.gelu(u)
+        return mlp_matmul(up, wd, x_t=True, **kw)
+    return f
+
+
+def _mlp_args(b, dtype, rng):
+    T, D, F = min(b["T"], 512), b["D"], b["F"]
+    ks = jax.random.split(rng, 3)
+    h = jax.random.normal(ks[0], (2, T, D), dtype)
+    wu = jax.random.normal(ks[1], (D, F), dtype) * (1 / math.sqrt(D))
+    wd = jax.random.normal(ks[2], (F, D), dtype) * (1 / math.sqrt(F))
+    return h, wu, wd
+
+
+def _mlp_step(b, dtype, params):
+    f = _mlp_fn(b, params)
+
+    def loss(h, wu, wd):
+        return jnp.sum(f(h, wu, wd).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, (0, 1, 2))
+
+    def step(carry):
+        h, wu, wd = carry
+        dh, dwu, dwd = g(h, wu, wd)
+        return (h + _EPS * dh.astype(h.dtype),
+                wu + _EPS * dwu.astype(wu.dtype),
+                wd + _EPS * dwd.astype(wd.dtype))
+
+    return step, _mlp_args(b, dtype, jax.random.key(0))
+
+
+def _mlp_parity(b, dtype, params):
+    h, wu, wd = _mlp_args(b, dtype, jax.random.key(1))
+    f = _mlp_fn(b, params)
+    ref = _mlp_fn(b, dict(params, mode="xla"))
+    _close(f(h, wu, wd), ref(h, wu, wd), f"mlp tuned fwd {params}")
+
+    def lf(fn):
+        return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    ga = jax.grad(lf(f), (0, 1, 2))(h, wu, wd)
+    gr = jax.grad(lf(ref), (0, 1, 2))(h, wu, wd)
+    for a, bb, n in zip(ga, gr, ("dh", "dwu", "dwd")):
+        _close(a, bb, f"mlp tuned {n} {params}",
+               dict(rtol=5e-2, atol=5e-1 if n != "dh" else 5e-2))
+
+
+# ------------------------------------------------------------- layernorm
+# 'jnp' is the r05-proven model-level choice (fused_layernorm=False:
+# XLA's fused form wins inside real programs on v5e)
+LN_DEFAULTS = {"variant": "jnp", **_LN_KERNEL_DEFAULTS}
+
+
+def _ln_defaults(b):
+    return dict(LN_DEFAULTS)
+
+
+def _ln_candidates(b):
+    """jnp (XLA-fused, the measured r05 winner inside real programs) vs
+    the fully fused Pallas kernel vs the hybrid jnp-fwd/Pallas-bwd, at
+    the row tilings the row-blocked scaffold accepts."""
+    cands = [dict(LN_DEFAULTS)]
+    if b["D"] % 128 == 0:
+        for br in (128, 256, 512):
+            cands.append({"variant": "fused", "block_rows": br})
+        cands.append({"variant": "bwd", "block_rows": 256})
+    return _dedup(cands)
+
+
+def _ln_fn(b, params):
+    variant = params["variant"]
+
+    def f(x, s, bias):
+        if variant == "fused":
+            from ..ops.pallas.layernorm import fused_layernorm
+            return fused_layernorm(x, s, bias,
+                                   block_rows=int(params["block_rows"]))
+        if variant == "bwd":
+            from ..ops.pallas.layernorm import layernorm_fused_bwd
+            return layernorm_fused_bwd(
+                x, s, bias, block_rows=int(params["block_rows"]))
+        from ..ops.pallas.layernorm import _ln_jnp
+        return _ln_jnp(x, s, bias, 1e-5)
+    return f
+
+
+def _ln_args(b, dtype, rng):
+    R, D = min(b["R"], 4096), b["D"]
+    ks = jax.random.split(rng, 3)
+    x = jax.random.normal(ks[0], (R, D), dtype)
+    s = 1 + 0.1 * jax.random.normal(ks[1], (D,), dtype)
+    bias = 0.1 * jax.random.normal(ks[2], (D,), dtype)
+    return x, s.astype(dtype), bias.astype(dtype)
+
+
+def _ln_step(b, dtype, params):
+    f = _ln_fn(b, params)
+
+    def loss(x, s, bias):
+        return jnp.sum(f(x, s, bias).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, (0, 1, 2))
+
+    def step(carry):
+        x, s, bias = carry
+        dx, ds, db = g(x, s, bias)
+        return (x + _EPS * dx.astype(x.dtype),
+                s + _EPS * ds.astype(s.dtype),
+                bias + _EPS * db.astype(bias.dtype))
+
+    return step, _ln_args(b, dtype, jax.random.key(0))
+
+
+def _ln_parity(b, dtype, params):
+    from ..ops.pallas.layernorm import _ln_jnp
+    x, s, bias = _ln_args(b, dtype, jax.random.key(1))
+    f = _ln_fn(b, params)
+    _close(f(x, s, bias), _ln_jnp(x, s, bias, 1e-5),
+           f"layernorm tuned fwd {params}")
+
+    def lf(fn):
+        return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    ga = jax.grad(lf(f), (0, 1, 2))(x, s, bias)
+    gr = jax.grad(lf(lambda x, s, b_: _ln_jnp(x, s, b_, 1e-5)),
+                  (0, 1, 2))(x, s, bias)
+    for a, bb, n in zip(ga, gr, ("dx", "dscale", "dbias")):
+        _close(a, bb, f"layernorm tuned {n} {params}")
+
+
+# -------------------------------------------------------------- fused_ce
+
+
+def _ce_defaults(b):
+    return dict(CE_DEFAULTS)
+
+
+def _ce_candidates(b):
+    cands = [dict(CE_DEFAULTS)]
+    for bm, bn in ((256, 512), (512, 1024), (1024, 512), (256, 256)):
+        cands.append({"block_m": bm, "block_n": bn})
+    return _dedup(cands)
+
+
+def _ce_args(b, dtype, rng):
+    N, D, V = min(b["N"], 2048), b["D"], b["V"]
+    ks = jax.random.split(rng, 3)
+    h = jax.random.normal(ks[0], (N, D), dtype)
+    w = jax.random.normal(ks[1], (V, D), dtype) * (1 / math.sqrt(D))
+    t = jax.random.randint(ks[2], (N,), 0, V, jnp.int32)
+    return h, w, t
+
+
+def _ce_step(b, dtype, params):
+    from ..ops.pallas.fused_ce import unembed_logits_stats
+
+    def step(carry):
+        h, w, t = carry
+        # forward-only op (the grad-in-forward CE forms d_logits outside
+        # the kernel): chain logz back into h for data dependence
+        _, logz, gold = unembed_logits_stats(
+            h, w, t, block_m=int(params["block_m"]),
+            block_n=int(params["block_n"]))
+        h = h + _EPS * (logz - gold)[:, None].astype(h.dtype)
+        return (h, w, t)
+
+    return step, _ce_args(b, dtype, jax.random.key(0))
+
+
+def _ce_parity(b, dtype, params):
+    from deepspeed_tpu.ops.pallas.fused_ce import unembed_logits_stats
+    h, w, t = _ce_args(dict(b, N=min(b["N"], 512)), dtype,
+                       jax.random.key(1))
+    logits, logz, gold = unembed_logits_stats(
+        h, w, t, block_m=int(params["block_m"]),
+        block_n=int(params["block_n"]))
+    ref = jnp.einsum("nd,vd->nv", h, w,
+                     preferred_element_type=jnp.float32)
+    _close(logits, ref.astype(logits.dtype), f"fused_ce logits {params}",
+           dict(rtol=2e-2, atol=2e-2))
+    _close(logz, jax.nn.logsumexp(ref, axis=-1),
+           f"fused_ce logz {params}", dict(rtol=2e-2, atol=2e-2))
+    _close(gold, jnp.take_along_axis(ref, t[:, None], axis=1)[:, 0],
+           f"fused_ce gold {params}", dict(rtol=2e-2, atol=2e-2))
+
+
+# ---------------------------------------------------------------- table
+REGISTRY = {
+    "flash_attention": {
+        "defaults": _flash_defaults,
+        "candidates": _flash_candidates,
+        "make_step": _flash_step,
+        "parity": _flash_parity,
+    },
+    "mlp_matmul": {
+        "defaults": _mlp_defaults,
+        "candidates": _mlp_candidates,
+        "make_step": _mlp_step,
+        "parity": _mlp_parity,
+    },
+    "layernorm": {
+        "defaults": _ln_defaults,
+        "candidates": _ln_candidates,
+        "make_step": _ln_step,
+        "parity": _ln_parity,
+    },
+    "fused_ce": {
+        "defaults": _ce_defaults,
+        "candidates": _ce_candidates,
+        "make_step": _ce_step,
+        "parity": _ce_parity,
+    },
+}

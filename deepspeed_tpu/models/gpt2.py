@@ -53,11 +53,17 @@ class GPT2Config:
     # dense path elsewhere; True/False force. The benchmarked fast path
     # is the default — users no longer opt in via env/config.
     use_flash_attention: object = "auto"
-    flash_block_q: int = 128           # pallas attention tile sizes
-    flash_block_k: int = 128
-    flash_block_h: int = 2             # (batch*head) instances per grid step
-    flash_block_q_bwd: int = 0         # 0 = same as flash_block_q/_k; the
-    flash_block_k_bwd: int = 0         # fused bwd pass may prefer smaller
+    # pallas attention tile sizes. Each block knob (and flash_bwd_qmajor
+    # below) also accepts "auto": the kernel then resolves it at trace
+    # time against the persistent autotune winner cache for this
+    # (device_kind, seq-bucket, head_dim, dtype) — falling back to the
+    # r05-proven values below on a cache miss (ops/pallas/_common.
+    # dispatch; see the README "Kernel autotuning" section)
+    flash_block_q: object = 128
+    flash_block_k: object = 128
+    flash_block_h: object = 2          # (batch*head) instances per grid step
+    flash_block_q_bwd: object = 0      # 0 = same as flash_block_q/_k; the
+    flash_block_k_bwd: object = 0      # fused bwd pass may prefer smaller
     # feed the flash kernel (B, H, hd, T) operands (T in lanes) — the qkv
     # einsum's natural output layout, eliminating the relayout copies XLA
     # otherwise inserts at every kernel boundary (~46 ms/step at 350M)
@@ -109,7 +115,9 @@ class GPT2Config:
     # einsum's natural T-minor activation and emits the residual-add
     # layout directly, with the backward dx emitted in the activation's
     # own orientation and dw's fp32-accumulate + weight-dtype cast
-    # fused. Values: False (XLA, default) | 'auto' (kernel on TPU) |
+    # fused. Values: False (XLA, default) | 'auto' (the autotune winner
+    # cache's measured choice of path + tiles + epilogue for this
+    # device/shape/dtype; r05-proven XLA einsums on a cache miss) |
     # 'down' (down projection only) | 'both' (up emits T-minor via the
     # kernel too). Not used when seq-sharded (Ulysses keeps the XLA
     # path).
@@ -123,15 +131,18 @@ class GPT2Config:
     # dtype (no fp32 HBM round trip + cast copy) and dk/dv accumulated
     # VMEM-resident across the sequential grid — the trick that won
     # -38 ms on dq, applied to the dkv side. qkv_t layouts only;
-    # biased/ALiBi paths keep the k-major kernel.
-    flash_bwd_qmajor: bool = False
+    # biased/ALiBi paths keep the k-major kernel. Accepts "auto"
+    # (autotune winner cache, False on a miss).
+    flash_bwd_qmajor: object = False
     # fused one-pass LayerNorm Pallas kernel (ops/pallas/layernorm.py;
     # reference csrc/transformer/normalize_kernels.cu). Measured SLOWER
     # than XLA's fused jnp layernorm inside the 350M training step (the
     # custom-call boundary breaks surrounding elementwise fusions and
     # pins layouts XLA wants freedom over: 727 -> 785 ms/step), so the
     # default is off; the kernel stays available for standalone use.
-    # 'auto' = on TPU when d_model is lane-tileable; True forces.
+    # 'auto' = the autotune winner cache's measured jnp/fused/hybrid
+    # choice (+ row tiling) for this device/shape/dtype, r05-proven jnp
+    # on a cache miss; True forces the fused kernel.
     fused_layernorm: object = False
 
     @property
@@ -430,18 +441,36 @@ class GPT2:
 
     def _ln(self, x, scale, bias):
         """LayerNorm dispatch: 'bwd' = jnp forward + one-pass Pallas
-        backward (layernorm_fused_bwd); True/'auto' = fully fused Pallas
-        kernel; False = jnp."""
+        backward (layernorm_fused_bwd); True = fully fused Pallas
+        kernel; False = jnp; 'auto' = the autotune winner cache's
+        measured choice for this (device, rows, D) — falling back to
+        the r05-proven jnp form on a cache miss (XLA's fused layernorm
+        measured faster inside real programs on v5e)."""
         use = self.config.fused_layernorm
+        block_rows = "auto"
         if use == "auto":
-            use = (jax.default_backend() == "tpu"
-                   and x.shape[-1] % 128 == 0)
+            import math as _math
+            from ..autotuning.kernel_registry import LN_DEFAULTS
+            from ..ops.pallas._common import dispatch, dtype_name, \
+                ln_bucket
+            win = dispatch(
+                "layernorm",
+                ln_bucket(_math.prod(x.shape[:-1]), x.shape[-1]),
+                dtype_name(x.dtype), LN_DEFAULTS)
+            variant = win["variant"]
+            if x.shape[-1] % 128:
+                variant = "jnp"     # Pallas row-blocked kernels need
+            use = {"jnp": False,    # a lane-tileable feature dim
+                   "fused": True, "bwd": "bwd"}.get(variant, False)
+            block_rows = int(win["block_rows"])
         if use == "bwd":
             from ..ops.pallas.layernorm import layernorm_fused_bwd
-            return layernorm_fused_bwd(x, scale, bias)
+            return layernorm_fused_bwd(x, scale, bias,
+                                       block_rows=block_rows)
         if use:
             from ..ops.pallas.layernorm import fused_layernorm
-            return fused_layernorm(x, scale, bias)
+            return fused_layernorm(x, scale, bias,
+                                   block_rows=block_rows)
         return _layernorm(x, scale, bias)
 
     def embed(self, params, input_ids, *, rng, train, constrain, act_spec):
@@ -642,12 +671,15 @@ class GPT2:
         return self.config.dropout > 0
 
     def _mlp_kernel_mode(self):
-        """Resolved cfg.mlp_kernel: None (XLA path) | 'down' | 'both'."""
+        """Resolved cfg.mlp_kernel: None (XLA path) | 'down' | 'both' |
+        'auto' (= consult the autotune winner cache in _mlp, where the
+        activation shape that keys the cache bucket is known; a miss
+        falls back to the r05-proven XLA path)."""
         v = self.config.mlp_kernel
         if not v:
             return None
         if v == "auto":
-            return "down" if jax.default_backend() == "tpu" else None
+            return "auto"
         return "down" if v is True else v
 
     def _mlp(self, h, layer, rng, *, train, seq_sharded, constrain):
@@ -660,6 +692,25 @@ class GPT2:
                 f"unknown activation {self.config.activation!r}; "
                 f"expected one of {sorted(acts)}")
         mode = self._mlp_kernel_mode() if not seq_sharded else None
+        mm_kw = dict(fuse_dw=self.config.mlp_kernel_fuse_dw)
+        if mode == "auto":
+            # measured dispatch: the cached winner for this (device,
+            # tokens, D, F) picks the projection path AND its tile/
+            # epilogue knobs; a miss keeps the r05-proven XLA einsums
+            from ..autotuning.kernel_registry import MLP_DEFAULTS
+            from ..ops.pallas._common import dispatch, dtype_name, \
+                mlp_bucket
+            D, F = layer["wup"].shape
+            win = dispatch(
+                "mlp_matmul", mlp_bucket(h.shape[1], D, F),
+                dtype_name(h.dtype),
+                {**MLP_DEFAULTS,
+                 "fuse_dw": self.config.mlp_kernel_fuse_dw})
+            mode = None if win["mode"] == "xla" else win["mode"]
+            mm_kw = dict(fuse_dw=bool(win["fuse_dw"]),
+                         block_t=int(win["block_t"]),
+                         block_o=int(win["block_o"]),
+                         block_k=int(win["block_k"]))
         if mode:
             # layout-owning projection kernels: the pre-activation is
             # carried (B, F, T) — the up einsum's NATURAL T-minor output
@@ -669,15 +720,13 @@ class GPT2:
             # backward relayout copies exist on this path
             from ..ops.pallas.mlp_matmul import mlp_matmul
             if mode == "both":
-                u = mlp_matmul(h, layer["wup"], out_t=True,
-                               fuse_dw=self.config.mlp_kernel_fuse_dw)
+                u = mlp_matmul(h, layer["wup"], out_t=True, **mm_kw)
             else:
                 u = jnp.einsum("btd,df->bft", h, layer["wup"])
             u = checkpoint_name(u + layer["bup"][None, :, None], "mlp_up")
             up = acts[self.config.activation](u)
             up = constrain(up, P(BATCH_AXES, "tensor", None))
-            out = mlp_matmul(up, layer["wdown"], x_t=True,
-                             fuse_dw=self.config.mlp_kernel_fuse_dw)
+            out = mlp_matmul(up, layer["wdown"], x_t=True, **mm_kw)
             return out + layer["bdown"], jnp.zeros((), jnp.float32)
         # named pre-activation: saving it skips the wup matmul recompute in
         # backward (gelu' needs this tensor; gelu_out is one VPU op away)

@@ -1,7 +1,67 @@
 """Shared Pallas kernel helpers (counterpart of reference
-``csrc/includes/`` — the template library every CUDA kernel includes)."""
+``csrc/includes/`` — the template library every CUDA kernel includes),
+plus the measured-dispatch layer: kernel wrappers whose tunable
+parameters are set to ``"auto"`` resolve them here against the
+persistent autotune winner cache (autotuning/kernel_dispatch.py) at
+TRACE time — the chosen variant is baked into the jitted program, so a
+warm cache costs zero per-step host work.
+"""
 
 import jax
+
+# sentinel a kernel tunable takes to mean "resolve via the autotune
+# winner cache" (models pass their config knobs through verbatim)
+AUTO = "auto"
+
+
+def dispatch(op, bucket, dtype, defaults):
+    """Trace-time tunable resolution for kernel ``op``.
+
+    Consults the autotune winner cache for
+    (device_kind, op, shape-bucket, dtype) under the active autotune
+    mode (runtime config ``autotune`` block / DSTPU_AUTOTUNE env):
+    returns the cached winner's params merged over ``defaults``, runs a
+    measured search first in the search modes, and falls back to
+    ``defaults`` (the r05-proven hand-set values) on any miss/refusal.
+    Pure Python at trace time — nothing here survives into the compiled
+    program but the chosen constants."""
+    from ...autotuning import kernel_dispatch
+    return kernel_dispatch.resolve(op, bucket, dtype, defaults)
+
+
+def dtype_name(dtype):
+    """Canonical dtype string for cache keys ('bfloat16', 'float32')."""
+    import jax.numpy as jnp
+    return jnp.dtype(dtype).name
+
+
+# ----------------------------------------------------- shape buckets
+# One bucket string per op keys the winner cache: exact in the dims
+# that pick kernel variants (feature/head/vocab dims — they gate block
+# validity), power-of-two-rounded in the data-volume dims (tokens,
+# rows) so nearby batch shapes share a winner instead of each paying a
+# search.
+
+def pow2_bucket(n):
+    """Round ``n`` up to the next power of two (>= 1)."""
+    return 1 << max(0, int(n) - 1).bit_length()
+
+
+def flash_bucket(T, d, causal, qkv_t):
+    return f"T{pow2_bucket(T)},d{int(d)},c{int(bool(causal))}," \
+           f"q{int(bool(qkv_t))}"
+
+
+def mlp_bucket(T, D, F):
+    return f"T{pow2_bucket(T)},D{int(D)},F{int(F)}"
+
+
+def ln_bucket(rows, D):
+    return f"R{pow2_bucket(rows)},D{int(D)}"
+
+
+def ce_bucket(N, D, V):
+    return f"N{pow2_bucket(N)},D{int(D)},V{int(V)}"
 
 
 def interpret_default():

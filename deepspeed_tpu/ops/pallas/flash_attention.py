@@ -32,9 +32,19 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import AUTO as _AUTO
+from ._common import dispatch as _dispatch
+from ._common import dtype_name as _dtype_name
+from ._common import flash_bucket as _flash_bucket
 from ._common import interpret_default as _interpret_default
 from ._common import round_up as _round_up
 from ._common import sds as _sds
+
+# the r05-proven hand-set tile/variant defaults — what an "auto" tunable
+# resolves to when the autotune winner cache has no entry for this
+# (device_kind, shape-bucket, dtype)
+TUNE_DEFAULTS = {"block_q": 128, "block_k": 128, "block_h": 2,
+                 "block_q_bwd": 0, "block_k_bwd": 0, "bwd_qmajor": False}
 
 
 def _block_sizes(T, block_q, block_k):
@@ -1194,6 +1204,27 @@ def flash_attention_with_lse(q, k, v, *, causal=True, scale=None,
         B, H, T, d = q.shape
     else:
         B, T, H, d = q.shape
+    if _AUTO in (block_q, block_k, block_h, block_q_bwd, block_k_bwd,
+                 bwd_qmajor):
+        # measured dispatch: tunables set to "auto" take the cached
+        # winner for this (device_kind, shape-bucket, dtype); explicit
+        # values always win over the cache, and a miss falls back to
+        # the r05-proven defaults. Trace-time only.
+        win = _dispatch("flash_attention",
+                        _flash_bucket(T, d, causal, qkv_t),
+                        _dtype_name(q.dtype), TUNE_DEFAULTS)
+        if block_q == _AUTO:
+            block_q = int(win["block_q"])
+        if block_k == _AUTO:
+            block_k = int(win["block_k"])
+        if block_h == _AUTO:
+            block_h = int(win["block_h"])
+        if block_q_bwd == _AUTO:
+            block_q_bwd = int(win["block_q_bwd"]) or None
+        if block_k_bwd == _AUTO:
+            block_k_bwd = int(win["block_k_bwd"]) or None
+        if bwd_qmajor == _AUTO:
+            bwd_qmajor = bool(win["bwd_qmajor"])
     if scale is None:
         scale = 1.0 / math.sqrt(d)
     if interpret is None:

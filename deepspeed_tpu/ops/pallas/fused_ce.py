@@ -25,12 +25,20 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ._common import AUTO as _AUTO
+from ._common import ce_bucket as _ce_bucket
+from ._common import dispatch as _dispatch
+from ._common import dtype_name as _dtype_name
 from ._common import interpret_default as _interpret_default
 from ._common import round_up as _round_up
 from ._common import sds as _sds
 
 NEG_INF = -1e30
 STAT_LANES = 8
+
+# r05-proven hand-set vocab-walk tiles; overridden by the autotune
+# winner cache when callers leave block_m/block_n at "auto"
+TUNE_DEFAULTS = {"block_m": 512, "block_n": 512}
 
 
 def _ce_kernel(x_ref, w_ref, t_ref, logits_ref, logz_ref, gold_ref,
@@ -75,16 +83,25 @@ def _ce_kernel(x_ref, w_ref, t_ref, logits_ref, logz_ref, gold_ref,
         gold_ref[...] = g_scr[...]
 
 
-def unembed_logits_stats(h, w, targets, *, block_m=512, block_n=512,
+def unembed_logits_stats(h, w, targets, *, block_m=_AUTO, block_n=_AUTO,
                          interpret=None):
     """h: (N, D) bf16 rows; w: (V, D); targets: (N,) int32.
 
     Returns (logits (N, V) in h.dtype, logz (N,) f32, gold (N,) f32) —
     logz and gold computed from the pre-round fp32 block scores.
     Rows of ``targets`` outside [0, V) contribute gold = 0.
+    ``block_m``/``block_n`` left at "auto" (the default) resolve via the
+    autotune winner cache at trace time, falling back to 512/512.
     """
     N, D = h.shape
     V = w.shape[0]
+    if _AUTO in (block_m, block_n):
+        win = _dispatch("fused_ce", _ce_bucket(N, D, V),
+                        _dtype_name(h.dtype), TUNE_DEFAULTS)
+        if block_m == _AUTO:
+            block_m = int(win["block_m"])
+        if block_n == _AUTO:
+            block_n = int(win["block_n"])
     if interpret is None:
         interpret = _interpret_default()
     bm = min(block_m, N)

@@ -21,13 +21,33 @@ Off-TPU the kernels run in Pallas interpreter mode (parity tests).
 """
 
 import functools
+import math
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ._common import AUTO as _AUTO
+from ._common import dispatch as _dispatch
+from ._common import dtype_name as _dtype_name
 from ._common import interpret_default as _interpret_default
+from ._common import ln_bucket as _ln_bucket
 from ._common import round_up as _round_up
+
+# r05-proven hand-set row tiling; the autotune winner cache can override
+# it when callers pass block_rows="auto" (the default)
+TUNE_DEFAULTS = {"block_rows": 256}
+
+
+def _resolve_block_rows(block_rows, x):
+    """block_rows="auto" -> cached winner for this (rows, D) bucket,
+    else the 256 default; explicit ints pass through untouched."""
+    if block_rows != _AUTO:
+        return block_rows
+    win = _dispatch("layernorm",
+                    _ln_bucket(math.prod(x.shape[:-1]), x.shape[-1]),
+                    _dtype_name(x.dtype), TUNE_DEFAULTS)
+    return int(win["block_rows"])
 
 
 def _ln_fwd_kernel(x_ref, s_ref, b_ref, y_ref, *, eps):
@@ -169,12 +189,15 @@ def _row_blocked(x, run, block_rows):
     return y.reshape(*lead, D)
 
 
-def layernorm_fused_bwd(x, scale, bias, *, eps=1e-5, block_rows=256,
+def layernorm_fused_bwd(x, scale, bias, *, eps=1e-5, block_rows=_AUTO,
                         interpret=None):
     """Hybrid LayerNorm: plain-jnp forward (stays fusable with XLA's
     surrounding elementwise ops, leaves layout choices free) + the
     one-pass Pallas backward (dx + VMEM-accumulated dscale/dbias in a
-    single read of x/dy). Same numerics as :func:`fused_layernorm`."""
+    single read of x/dy). Same numerics as :func:`fused_layernorm`.
+    ``block_rows="auto"`` (default) resolves via the autotune winner
+    cache, falling back to 256."""
+    block_rows = _resolve_block_rows(block_rows, x)
     if interpret is None:
         interpret = _interpret_default()
     return _row_blocked(
@@ -182,12 +205,15 @@ def layernorm_fused_bwd(x, scale, bias, *, eps=1e-5, block_rows=256,
                                      bool(interpret)), block_rows)
 
 
-def fused_layernorm(x, scale, bias, *, eps=1e-5, block_rows=256,
+def fused_layernorm(x, scale, bias, *, eps=1e-5, block_rows=_AUTO,
                     interpret=None):
     """LayerNorm over the last dim of ``x`` (any leading shape), fp32
     statistics, output in ``x.dtype``. Differentiable (fused one-pass
     backward). Requires the feature dim to be a multiple of 128 (TPU lane
-    tiling); callers should fall back to a jnp layernorm otherwise."""
+    tiling); callers should fall back to a jnp layernorm otherwise.
+    ``block_rows="auto"`` (default) resolves via the autotune winner
+    cache, falling back to 256."""
+    block_rows = _resolve_block_rows(block_rows, x)
     if interpret is None:
         interpret = _interpret_default()
     return _row_blocked(

@@ -29,6 +29,14 @@ These kernels own both boundaries end to end:
 Off-TPU the kernels run in Pallas interpreter mode (unit tests); shapes
 whose blocks cannot satisfy the TPU tiling rules fall back to a jnp
 einsum with identical math (fp32 accumulation, output-dtype round).
+
+The layout/epilogue choice itself (XLA einsums vs 'down' vs 'both',
+fused-vs-XLA dw, tile sizes) is a MODEL-level decision and is
+autotunable: ``models/gpt2.py`` resolves ``cfg.mlp_kernel="auto"``
+against the persistent winner cache via the measured-dispatch layer
+(``_common.dispatch``, registry op ``"mlp_matmul"`` in
+``autotuning/kernel_registry.py``) and passes the winning mode and
+block sizes into this module explicitly.
 """
 
 import functools

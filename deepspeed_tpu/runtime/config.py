@@ -231,6 +231,57 @@ class CommOverlapConfig:
 
 
 @dataclass
+class AutotuneConfig:
+    """Measured kernel dispatch (autotuning/kernel_dispatch.py): kernel
+    tunables set to "auto" (flash blocks / mlp_kernel / fused_layernorm
+    / fused-CE tiles) resolve against a persistent winner cache keyed by
+    (device_kind, op, shape-bucket, dtype).
+
+      mode         "" = inherit the DSTPU_AUTOTUNE env (default
+                   cache_only) | off | cache_only | on_first_use |
+                   search. cache_only never measures — a cold key falls
+                   back to the r05-proven defaults; on_first_use runs a
+                   measured search per missing key at first trace and
+                   persists the winner; search re-measures every key
+                   once per process (cache pre-warming/re-validation).
+      cache_path   winner cache file ("" = DSTPU_AUTOTUNE_CACHE env or
+                   ~/.cache/deepspeed_tpu/kernel_autotune.json). Entries
+                   record the chip they were measured on; a cache from
+                   another device_kind (e.g. interpret-mode CPU) is
+                   refused, not applied.
+      chain_lengths / reps
+                   search timing knobs: candidates are timed as the
+                   slope between two lax.scan chain lengths inside one
+                   jit (dispatch-latency cancellation), best-of-reps.
+    """
+    mode: str = ""
+    cache_path: str = ""
+    chain_lengths: object = (8, 24)
+    reps: int = 3
+
+    def __post_init__(self):
+        if self.mode not in ("", "off", "cache_only", "on_first_use",
+                             "search"):
+            raise DeepSpeedConfigError(
+                f"autotune.mode must be ''|off|cache_only|on_first_use|"
+                f"search, got {self.mode!r}")
+        try:
+            k1, k2 = (int(v) for v in self.chain_lengths)
+        except (TypeError, ValueError):
+            raise DeepSpeedConfigError(
+                f"autotune.chain_lengths must be two ints, got "
+                f"{self.chain_lengths!r}")
+        if not 0 < k1 < k2:
+            raise DeepSpeedConfigError(
+                f"autotune.chain_lengths needs 0 < k1 < k2, got "
+                f"{(k1, k2)}")
+        self.chain_lengths = (k1, k2)
+        if not isinstance(self.reps, int) or self.reps < 1:
+            raise DeepSpeedConfigError(
+                f"autotune.reps must be an int >= 1, got {self.reps!r}")
+
+
+@dataclass
 class ActivationCheckpointingConfig:
     partition_activations: bool = False   # accepted for parity; XLA shards
     contiguous_memory_optimization: bool = False
@@ -320,6 +371,7 @@ class DeepSpeedConfig:
         self.checkpoint_engine = _take(config, CheckpointEngineConfig,
                                        C.CHECKPOINT_ENGINE)
         self.comm_overlap = _take(config, CommOverlapConfig, "comm_overlap")
+        self.autotune = _take(config, AutotuneConfig, "autotune")
         self.activation_checkpointing = _take(
             config, ActivationCheckpointingConfig, C.ACTIVATION_CHECKPOINTING)
         self.comms_logger = _take(config, CommsLoggerConfig, C.COMMS_LOGGER)

@@ -109,6 +109,13 @@ class DeepSpeedEngine:
                        else DeepSpeedConfig(raw, dp_world_size=dp_world))
         dist.configure(self.config)
 
+        # measured kernel dispatch: the autotune mode/cache is process-
+        # global (kernel choice must agree across every trace), so the
+        # engine pushes its config block down BEFORE any program traces;
+        # empty fields inherit the DSTPU_AUTOTUNE* env defaults
+        from ..autotuning import kernel_dispatch
+        kernel_dispatch.configure_from_config(self.config.autotune)
+
         # comm-overlap resolution (the XLA flags were handled above,
         # pre-backend; this decides the program-level annotations)
         co = self.config.comm_overlap

@@ -59,6 +59,21 @@ def install():
 
         jax.lax.axis_size = _axis_size
 
+    if not hasattr(jax.lax, "pcast"):
+        # jax without vma typing (< 0.7) has no lax.pcast; there
+        # shard_map's check_rep machinery — the vma system's ancestor —
+        # inserts the replicated<->varying conversions pcast makes
+        # explicit, including the psum adjoint on the transpose path,
+        # so the closest older-API equivalent is an identity. The
+        # pipeline grad-parity tests (tests/unit/test_pipe.py, shard_map
+        # pipeline vs sequential model, fwd AND grads) gate this shim's
+        # numerics; it was the one seed tier-1-era failure the original
+        # shim set left unfixed.
+        def _pcast(x, axes=None, *, to=None, **kw):  # noqa: ARG001
+            return x
+
+        jax.lax.pcast = _pcast
+
     if not hasattr(jax.tree, "leaves_with_path"):
         from jax import tree_util as _tu
         jax.tree.leaves_with_path = _tu.tree_leaves_with_path

@@ -119,3 +119,36 @@ def test_comm_overlap_validation():
         DeepSpeedConfig({"train_batch_size": 8,
                          "comm_overlap": {"bucket_mb": -1}},
                         dp_world_size=8)
+
+
+def test_autotune_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
+    at = cfg.autotune
+    assert at.mode == ""                 # inherit DSTPU_AUTOTUNE env
+    assert at.cache_path == ""           # env / ~/.cache default
+    assert at.chain_lengths == (8, 24)
+    assert at.reps == 3
+
+
+def test_autotune_block_parses():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "autotune": {"mode": "on_first_use", "cache_path": "/tmp/x.json",
+                     "chain_lengths": [4, 12], "reps": 2},
+    }, dp_world_size=8)
+    at = cfg.autotune
+    assert at.mode == "on_first_use"
+    assert at.cache_path == "/tmp/x.json"
+    assert at.chain_lengths == (4, 12)   # normalized to a tuple
+    assert at.reps == 2
+
+
+def test_autotune_validation():
+    for bad in ({"mode": "always"},
+                {"chain_lengths": [8]},
+                {"chain_lengths": [24, 8]},
+                {"chain_lengths": ["a", "b"]},
+                {"reps": 0}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_batch_size": 8, "autotune": bad},
+                            dp_world_size=8)

@@ -156,10 +156,23 @@ class TestMlpKernelInModel:
             lambda p: m1.loss(p, batch, train=False))(params)
         assert abs(float(l1) - l0) < 3e-2
 
-    def test_auto_resolves_off_tpu(self):
+    def test_auto_defers_to_measured_dispatch(self):
+        """'auto' no longer hand-guesses by platform: it defers to the
+        autotune winner cache (resolved in _mlp where the activation
+        shape is known), and a cache miss keeps the r05-proven XLA
+        path — loss identical to mlp_kernel=False."""
         from dataclasses import replace
+        from deepspeed_tpu.autotuning import kernel_dispatch
         from deepspeed_tpu.models.gpt2 import GPT2
-        cfg, _, _, _ = self._setup()
+        cfg, m0, params, batch = self._setup()
         m = GPT2(replace(cfg, mlp_kernel="auto"))
-        assert m._mlp_kernel_mode() == (
-            "down" if jax.default_backend() == "tpu" else None)
+        assert m._mlp_kernel_mode() == "auto"
+        kernel_dispatch.reset()
+        kernel_dispatch.configure(mode="cache_only",
+                                  cache_path="/nonexistent/at.json")
+        try:
+            l_auto = float(m.loss(params, batch, train=False))
+            l_xla = float(m0.loss(params, batch, train=False))
+            assert l_auto == l_xla
+        finally:
+            kernel_dispatch.reset()

