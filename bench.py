@@ -30,8 +30,13 @@ variant can never cost the headline number:
                    keys at first trace and runs on the cached winners,
                    _off pins the r05 hand-set defaults; the winner
                    table lands in extras.autotune
+  ring_on/off      long-context A/B at seq 4096 (BENCH_ATTN_BACKEND=
+                   ring + BENCH_SP=auto vs the standard flash path;
+                   sequence/ring.py zigzag context parallelism — real
+                   ring numbers need >1 chip, at 1 chip the pair is a
+                   long-seq baseline)
 Disable with BENCH_VARIANTS=none, or pick a subset
-(BENCH_VARIANTS=mlp_down,bwd_qmajor,1.3B,overlap,autotune).
+(BENCH_VARIANTS=mlp_down,bwd_qmajor,1.3B,overlap,autotune,ring_on).
 
 The full report is also ALWAYS written into the tree as
 ``BENCH_local.json`` (the r06/r07 driver artifacts vanished; a lost
@@ -148,6 +153,22 @@ _VARIANTS = {
     # defaults finally travel with the measurements.
     "autotune": ("autotune_on", {"BENCH_AUTOTUNE": "1"}),
     "autotune_off": ("autotune_off", {"BENCH_AUTOTUNE": "0"}),
+    # long-context A/B at 4x the headline sequence (micro bs scaled down
+    # to fit): 'ring_on' routes attention through the zigzag ring
+    # (sequence/ring.py) with the seq axis spanning every visible device
+    # (BENCH_SP=auto; at 1 chip sp=1 and the ring path degrades to the
+    # flash kernel, making the pair a long-seq baseline — the real ring
+    # number needs the multichip driver), 'ring_off' the standard flash
+    # path at the same shape.
+    "ring_on": ("ring_on", {"BENCH_ATTN_BACKEND": "ring",
+                            "BENCH_SP": "auto", "BENCH_SEQ": "4096",
+                            "BENCH_MICRO_BS": "4"}),
+    # ring_off pins the baseline backend explicitly (like autotune_off /
+    # overlap_off) so an ambient BENCH_ATTN_BACKEND=ring can't silently
+    # turn the A/B into ring-vs-ring
+    "ring_off": ("ring_off", {"BENCH_ATTN_BACKEND": "dense",
+                              "BENCH_SP": "1", "BENCH_SEQ": "4096",
+                              "BENCH_MICRO_BS": "4"}),
 }
 
 
@@ -208,7 +229,7 @@ def main():
     vnames = os.environ.get(
         "BENCH_VARIANTS",
         "mlp_down,bwd_qmajor,bwd_qmajor_512,1.3B,overlap,overlap_off,"
-        "autotune,autotune_off")
+        "autotune,autotune_off,ring_on,ring_off")
     if vnames and vnames != "none":
         variants = _run_variants(
             [v for v in vnames.split(",") if v],

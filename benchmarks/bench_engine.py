@@ -70,7 +70,11 @@ def build_bench_config():
         # query-major fused flash backward (dkv VMEM-resident retune)
         flash_bwd_qmajor=(
             "auto" if tune and "BENCH_FLASH_BWD_QMAJOR" not in os.environ
-            else os.environ.get("BENCH_FLASH_BWD_QMAJOR", "0") == "1"))
+            else os.environ.get("BENCH_FLASH_BWD_QMAJOR", "0") == "1"),
+        # long-context backend: BENCH_ATTN_BACKEND=ring routes attention
+        # through sequence/ring.py (zigzag context parallelism) whenever
+        # the engine runs seq-sharded (BENCH_SP below); 'dense' default
+        attention_backend=os.environ.get("BENCH_ATTN_BACKEND", "dense"))
 
 
 def build_bench_engine():
@@ -99,6 +103,20 @@ def build_bench_engine():
                          f"got {offload!r}")
     model = GPT2(cfg)
     groups.reset()
+    # BENCH_SP: sequence-parallel (ring) axis size — 'auto' = all visible
+    # devices when the ring backend is selected (one chip -> sp=1, where
+    # the ring path degrades to the flash kernel: the ring_on/off A/B is
+    # then a long-seq baseline pair; on a pod it measures the real ring)
+    topo = None
+    sp = os.environ.get("BENCH_SP", "")
+    if sp in ("", "auto"):
+        sp_n = (len(jax.devices())
+                if cfg.attention_backend == "ring" else 1)
+    else:
+        sp_n = int(sp)
+    if sp_n > 1:
+        from deepspeed_tpu.utils.groups import TopologyConfig
+        topo = groups.initialize(TopologyConfig(seq_parallel_size=sp_n))
     opt_params = {"lr": 2e-4, "weight_decay": 0.01}
     if moments:
         opt_params["moments_dtype"] = moments
@@ -127,6 +145,7 @@ def build_bench_engine():
         autotune_cfg["mode"] = "off"
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
+        **({"topology": topo} if topo is not None else {}),
         config={
             "train_micro_batch_size_per_gpu": micro,
             "gradient_accumulation_steps": 1,

@@ -48,6 +48,11 @@ _BUS_FACTOR = {
     "reduce_scatter": lambda w: (w - 1) / w,
     "all_to_all": lambda w: (w - 1) / w,
     "quantized_reduce_scatter": lambda w: (w - 1) / w,
+    # neighbor exchange (ring shift): every rank wires its full payload
+    # exactly once — the ring-attention KV rotation primitive, so this
+    # row is the bandwidth bound on hiding one rotation under one ring
+    # step's compute
+    "ppermute": lambda w: 1.0,
 }
 
 
@@ -95,6 +100,8 @@ def bench(sizes_mb, trials=10, axis="data", out=sys.stdout):
         make("quantized_reduce_scatter",
              lambda x: dist.quantized_reduce_scatter(x.reshape(-1), axis),
              P(axis)),
+        make("ppermute",
+             lambda x: dist.send_forward(x, axis), P(axis)),
     ]
     for mb in sizes_mb:
         n = int(mb * 1e6 / 4)

@@ -309,6 +309,63 @@ def _fused_ce(rng):
            "fused-ce gold")
 
 
+def _ring_block(rng):
+    """The carry-state blockwise flash step (ring attention's chunk-pair
+    kernel): two chained pairs (diagonal-causal + full) with carried
+    (m, l, acc) state vs one dense softmax over the concatenated kv —
+    proving the ring's state algebra on real Mosaic, plus the per-pair
+    backward path via the fused bwd kernel with a GLOBAL lse."""
+    from deepspeed_tpu.ops.pallas.flash_attention import (
+        flash_block_bwd, flash_block_finalize, flash_block_fwd,
+        flash_block_state)
+    G, T, d = 4, 128, 64
+    ks = jax.random.split(rng, 5)
+    q, k1, v1, k2, v2 = (jax.random.normal(k, (G, T, d), jnp.bfloat16)
+                         for k in ks)
+    st = flash_block_state(G, T, d)
+    st = flash_block_fwd(q, k1, v1, st, causal=True, block_q=64,
+                         block_k=64, interpret=False)
+    st = flash_block_fwd(q, k2, v2, st, causal=False, block_q=64,
+                         block_k=64, interpret=False)
+    o, lse = flash_block_finalize(st)
+
+    kc = jnp.concatenate([k1, k2], axis=1)
+    vc = jnp.concatenate([v1, v2], axis=1)
+    s = jnp.einsum("gtd,gsd->gts", q, kc,
+                   preferred_element_type=jnp.float32)
+    mask = jnp.concatenate([jnp.tril(jnp.ones((T, T), jnp.bool_)),
+                            jnp.ones((T, T), jnp.bool_)], axis=1)
+    s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("gts,gsd->gtd", p, vc.astype(jnp.float32))
+    _close(o, ref, "ring_block chained fwd")
+    _close(lse, jax.nn.logsumexp(s, axis=-1), "ring_block lse")
+
+    # pair backward from the global lse/o (the ring bwd recompute) vs
+    # the dense vjp restricted to pair 1's kv
+    do = jax.random.normal(ks[0], (G, T, d), jnp.bfloat16)
+    ob = o.astype(jnp.bfloat16)
+    dq1, dk1, dv1 = flash_block_bwd(q, k1, v1, ob, lse, do, causal=True,
+                                    block_q=64, block_k=64,
+                                    interpret=False)
+
+    # dense pair-1 contribution with the global lse fixed, in the
+    # analytic ds = p * (dp - delta) form the flash backward computes
+    pa = jnp.exp(jnp.where(
+        jnp.tril(jnp.ones((T, T), jnp.bool_))[None],
+        jnp.einsum("gtd,gsd->gts", q.astype(jnp.float32),
+                   k1.astype(jnp.float32)), -1e30) - lse[..., None])
+    dof = do.astype(jnp.float32)
+    delta = jnp.sum(dof * ref, axis=-1)
+    dvr = jnp.einsum("gts,gtd->gsd", pa, dof)
+    dpr = jnp.einsum("gtd,gsd->gts", dof, v1.astype(jnp.float32))
+    dsr = pa * (dpr - delta[..., None])
+    dkr = jnp.einsum("gts,gtd->gsd", dsr, q.astype(jnp.float32))
+    dqr = jnp.einsum("gts,gsd->gtd", dsr, k1.astype(jnp.float32))
+    for a, b, n in ((dq1, dqr, "dq"), (dk1, dkr, "dk"), (dv1, dvr, "dv")):
+        _close(a, b, f"ring_block pair {n}", dict(rtol=5e-2, atol=5e-2))
+
+
 def _tuned_winners(rng):
     """Tuned-vs-reference parity for every cached autotune winner on
     THIS chip: a stale or wrong cache entry (edited file, toolchain
@@ -369,6 +426,9 @@ _GATES = (
     ("block_sparse", _block_sparse),
     ("quant", _quant),
     ("fused_ce", _fused_ce),
+    # the ring-attention carry-state blockwise flash step (chunk-pair
+    # chaining + pair backward from the global lse)
+    ("ring_block", _ring_block),
     # every cached autotune winner re-proved against the dense
     # references (ok when the cache is empty)
     ("autotune_winners", _tuned_winners),

@@ -39,6 +39,8 @@ import numpy as np
 # on a cache miss); the registry re-exports and extends them with the
 # MODEL-level knobs it alone owns (layernorm variant, mlp path), so
 # flipping a proven default in ops/ flips the search baseline too.
+from ..ops.pallas.flash_attention import RING_TUNE_DEFAULTS as \
+    _RING_KERNEL_DEFAULTS
 from ..ops.pallas.flash_attention import TUNE_DEFAULTS as FLASH_DEFAULTS
 from ..ops.pallas.fused_ce import TUNE_DEFAULTS as CE_DEFAULTS
 from ..ops.pallas.layernorm import TUNE_DEFAULTS as _LN_KERNEL_DEFAULTS
@@ -355,6 +357,86 @@ def _ln_parity(b, dtype, params):
         _close(a, bb, f"layernorm tuned {n} {params}")
 
 
+# ------------------------------------------------------------ ring_block
+# The carry-state blockwise flash step (ring attention's per-chunk-pair
+# kernel, ops/pallas/flash_attention.py flash_block_fwd). The bucket's T
+# is the ring CHUNK length (T_global / (2 * ring) under zigzag), so block
+# tiles resolve per chunk shape, not per global sequence.
+RING_DEFAULTS = dict(_RING_KERNEL_DEFAULTS)
+
+
+def _ring_defaults(b):
+    return dict(RING_DEFAULTS)
+
+
+def _ring_candidates(b):
+    T = b["T"]
+    full = min(T, 1024)
+    cands = [dict(RING_DEFAULTS)]
+    cands.append(dict(RING_DEFAULTS, block_q=full, block_k=full,
+                      block_h=1))
+    cands.append(dict(RING_DEFAULTS, block_q=min(256, T),
+                      block_k=min(256, T), block_h=1))
+    return _dedup(cands)
+
+
+def _ring_args(b, dtype, rng):
+    G, T, d = 4, b["T"], b["d"]
+    ks = jax.random.split(rng, 4)
+    q, k1, v1, k2 = (jax.random.normal(k, (G, T, d), dtype) for k in ks)
+    return q, k1, v1, k2
+
+
+def _ring_chain(b, params, q, k1, v1, k2):
+    """Two chained chunk pairs (diagonal-causal then full — one ring
+    step's worth of state carry) finalized to an output."""
+    from ..ops.pallas.flash_attention import (flash_block_finalize,
+                                              flash_block_fwd,
+                                              flash_block_state)
+    G, T, d = q.shape
+    kw = dict(block_q=int(params["block_q"]),
+              block_k=int(params["block_k"]),
+              block_h=int(params["block_h"]))
+    st = flash_block_state(G, T, d)
+    st = flash_block_fwd(q, k1, v1, st, causal=True, **kw)
+    st = flash_block_fwd(q, k2, v1, st, causal=False, **kw)
+    o, _ = flash_block_finalize(st)
+    return o
+
+
+def _ring_step(b, dtype, params):
+    def step(carry):
+        q, k1, v1, k2 = carry
+        o = _ring_chain(b, params, q, k1, v1, k2)
+        # fwd-only op (the ring backward reuses the tuned flash bwd):
+        # chain the output back into q for data dependence
+        return (q + _EPS * o.astype(q.dtype), k1, v1, k2)
+
+    return step, _ring_args(b, dtype, jax.random.key(0))
+
+
+def _ring_parity(b, dtype, params):
+    bp = dict(b, T=min(b["T"], 1024))
+    q, k1, v1, k2 = _ring_args(bp, dtype, jax.random.key(1))
+    o = _ring_chain(bp, params, q, k1, v1, k2)
+    # dense reference over the concatenated kv: causal on chunk 1 (the
+    # diagonal pair), fully visible chunk 2 — the carried-state algebra
+    # must reproduce one softmax over both
+    T = q.shape[1]
+    k = jnp.concatenate([k1, k2], axis=1)
+    v = jnp.concatenate([v1, v1], axis=1)
+    s = jnp.einsum("gtd,gsd->gts", q, k,
+                   preferred_element_type=jnp.float32)
+    mask = jnp.concatenate(
+        [jnp.tril(jnp.ones((T, T), jnp.bool_)),
+         jnp.ones((T, T), jnp.bool_)], axis=1)
+    s = jnp.where(mask[None], s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    ref = jnp.einsum("gts,gsd->gtd", p,
+                     v.astype(jnp.float32))
+    _close(o, ref, f"ring_block tuned chain {params}")
+
+
 # -------------------------------------------------------------- fused_ce
 
 
@@ -436,5 +518,11 @@ REGISTRY = {
         "candidates": _ce_candidates,
         "make_step": _ce_step,
         "parity": _ce_parity,
+    },
+    "ring_block": {
+        "defaults": _ring_defaults,
+        "candidates": _ring_candidates,
+        "make_step": _ring_step,
+        "parity": _ring_parity,
     },
 }

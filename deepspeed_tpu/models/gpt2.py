@@ -551,11 +551,18 @@ class GPT2:
                 raise ValueError(
                     "ring attention supports neither per-layer local "
                     "windows nor unscaled (gpt-neo) scores")
-            # context parallel: KV rotates the 'seq' ring (ppermute)
+            # context parallel: KV rotates the 'seq' ring (ppermute).
+            # Layout/kernel/overlap knobs come from the engine-installed
+            # runtime config 'sequence' block (zigzag + blockwise flash
+            # kernel + double-buffered rotation by default)
+            from ..runtime.config import SequenceConfig
             from ..sequence.ring import ring_attention_sharded
+            scfg = getattr(self, "_sequence_cfg", None) or SequenceConfig()
             attn = ring_attention_sharded(
                 q, kk, v, jax.sharding.get_abstract_mesh(),
-                batch_spec=P(BATCH_AXES), head_axis="tensor")
+                batch_spec=P(BATCH_AXES), head_axis="tensor",
+                layout=scfg.layout, block_kernel=scfg.block_kernel,
+                double_buffer=scfg.double_buffer)
         elif cfg.flash_on and not seq_sharded and not force_dense:
             # pallas fused attention: O(T) memory, fp32 accumulation
             # (ops/pallas/flash_attention.py). Heads shard over 'tensor'.

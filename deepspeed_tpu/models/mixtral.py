@@ -89,11 +89,25 @@ class Mixtral(Llama):
         return specs
 
     def _mlp(self, x, layer):
-        """Dropless top-k SwiGLU MoE over the flattened tokens."""
+        """Dropless top-k SwiGLU MoE over the flattened tokens.
+
+        With an expert mesh axis > 1 the FFN routes through the explicit
+        shard_map all_to_all path (moe/sharded_moe.py
+        ``moe_swiglu_ragged_ep``): GSPMD silently mis-partitions
+        ``lax.ragged_dot`` over expert-sharded weights (off-shard
+        experts' rows come back garbage), so EP must be manual. TP-only
+        ('tensor') sharding stays on the dense path — GSPMD handles it."""
         cfg = self.config
         B, T, D = x.shape
         E, k = cfg.num_experts, cfg.moe_top_k
         h = _rms_norm(x, layer["rms2"], cfg.rms_eps)
+        mesh = jax.sharding.get_abstract_mesh()
+        if not mesh.empty and mesh.shape.get("expert", 1) > 1:
+            from ..moe.sharded_moe import moe_swiglu_ragged_ep
+            y = moe_swiglu_ragged_ep(
+                h, layer["moe_gate"], layer["moe_w1"], layer["moe_w3"],
+                layer["moe_w2"], k=k)
+            return y.astype(x.dtype)
         xs = h.reshape(-1, D)
         S = xs.shape[0]
 

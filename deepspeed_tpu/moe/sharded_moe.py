@@ -276,6 +276,116 @@ def moe_layer(tokens, gate_w, wi, bi, wo, bo, gate: TopKGate, *, rng=None,
     return y, l_aux, exp_counts
 
 
+def moe_swiglu_ragged_ep(tokens, gate_w, w1, w3, w2, k=2, *,
+                         expert_axis="expert"):
+    """EXPERT-PARALLEL dropless SwiGLU MoE for the serving models
+    (mixtral): the same pack / all_to_all / per-shard ``ragged_dot`` /
+    exchange-back machinery as :func:`moe_layer_ragged_ep`, with the
+    SwiGLU expert FFN (w1 gate, w3 up, w2 down, no biases) and mixtral's
+    softmax-then-top-k renormalized combine weights.
+
+    Exists because GSPMD cannot partition ``lax.ragged_dot`` over the
+    expert (group) dim of the weights: with moe_w* sharded
+    P('expert', ...) under plain jit, rows routed to off-shard experts
+    silently come back as garbage (measured: identical shard-0 rows,
+    O(1)-wrong rows elsewhere) — the root cause of the EPxTP mixtral
+    serving mismatch. The expert axis must be MANUAL (shard_map) with an
+    explicit exchange; any 'tensor' sharding of the FFN dim stays
+    GSPMD-managed (that partitioning is sound — TP-only serving matched
+    exactly).
+
+    The region is FULL-manual (every mesh axis) rather than
+    expert-subgroup-manual: jaxlib < 0.6's partitioner check-fails on
+    manual subgroups (the SPMD-pipe limitation), and full manual also
+    makes the TP composition explicit — the FFN dim stays 'tensor'-
+    sharded inside the region and the down projection's partial sums
+    psum over 'tensor' (the Megatron row-parallel reduction).
+
+    tokens: (..., M); token count needn't divide the expert axis (zero
+    rows pad the shard split and are sliced off). Returns y like tokens.
+    """
+    mesh = jax.sharding.get_abstract_mesh()
+    ep = 1 if mesh.empty else mesh.shape.get(expert_axis, 1)
+    orig_shape = tokens.shape
+    M = orig_shape[-1]
+    flat = tokens.reshape(-1, M)
+    S = flat.shape[0]
+    E = gate_w.shape[-1]
+    if ep == 1:
+        raise ValueError("moe_swiglu_ragged_ep needs an expert mesh axis "
+                         "> 1; use the dense ragged_dot path otherwise")
+    assert E % ep == 0, f"experts {E} not divisible by expert axis {ep}"
+    E_loc = E // ep
+    pad = (-S) % ep
+    if pad:
+        # jnp.pad, NOT concatenate-with-zeros: on jaxlib < 0.6 a traced
+        # concatenate feeding a manual (shard_map) region gets its layout
+        # mis-propagated by the SPMD partitioner and the shards read
+        # transposed data (verified with an identity shard_map)
+        flat = jnp.pad(flat, ((0, pad), (0, 0)))
+    tn = "tensor" if "tensor" in mesh.shape else None
+
+    def shard_fn(x, gate_w, w1, w3, w2):
+        S_loc = x.shape[0]
+        cap = S_loc * k                                  # exact transport
+        logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
+        probs = jax.nn.softmax(logits, axis=-1)
+        weights, experts = jax.lax.top_k(probs, k)
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+
+        flat_exp = experts.reshape(-1).astype(jnp.int32)
+        flat_w = weights.reshape(-1).astype(x.dtype)
+        dest = flat_exp // E_loc
+        local_e = flat_exp % E_loc
+        x_rep = jnp.repeat(x, k, axis=0)
+
+        order = jnp.argsort(dest, stable=True)
+        dest_s = dest[order]
+        pos_in_bucket = jnp.arange(cap) - jnp.searchsorted(
+            dest_s, dest_s, side="left")
+        send_x = jnp.zeros((ep, cap, M), x.dtype)
+        send_e = jnp.full((ep, cap), E_loc, jnp.int32)   # E_loc = invalid
+        send_x = send_x.at[dest_s, pos_in_bucket].set(x_rep[order])
+        send_e = send_e.at[dest_s, pos_in_bucket].set(local_e[order])
+
+        recv_x = lax.all_to_all(send_x, expert_axis, 0, 0, tiled=False)
+        recv_e = lax.all_to_all(send_e, expert_axis, 0, 0, tiled=False)
+        rx = recv_x.reshape(ep * cap, M)
+        re = recv_e.reshape(ep * cap)
+
+        g_order = jnp.argsort(re, stable=True)
+        xs = rx[g_order]
+        es = re[g_order]
+        group_sizes = jnp.bincount(re, length=E_loc).astype(jnp.int32)
+        g = lax.ragged_dot(xs, w1, group_sizes)
+        u = lax.ragged_dot(xs, w3, group_sizes)
+        out = lax.ragged_dot(jax.nn.silu(g) * u, w2, group_sizes)
+        if tn is not None:
+            # row-parallel down projection: F is 'tensor'-sharded, so
+            # the local ragged_dot holds partial sums (no-op at tp=1)
+            out = lax.psum(out, tn)
+        out = jnp.where((es < E_loc)[:, None], out, 0.0)
+
+        back = jnp.zeros_like(out).at[g_order].set(out)
+        back = back.reshape(ep, cap, M)
+        ret = lax.all_to_all(back, expert_axis, 0, 0, tiled=False)
+        ret_flat = ret[dest_s, pos_in_bucket]
+        unsorted = jnp.zeros_like(ret_flat).at[order].set(ret_flat)
+        y = jnp.sum(
+            (unsorted * flat_w[:, None]).reshape(S_loc, k, M), axis=1)
+        return y.astype(tokens.dtype)
+
+    y = jax.shard_map(
+        shard_fn,
+        in_specs=(P(expert_axis), P(), P(expert_axis, None, tn),
+                  P(expert_axis, None, tn), P(expert_axis, tn, None)),
+        out_specs=P(expert_axis), check_vma=False,
+    )(flat, gate_w, w1, w3, w2)
+    if pad:
+        y = y[:S]
+    return y.reshape(orig_shape)
+
+
 def moe_layer_ragged_ep(tokens, gate_w, wi, bi, wo, bo, k=1, *,
                         activation=jax.nn.gelu, expert_axis="expert",
                         batch_axes=BATCH_AXES, seq_sharded=False):

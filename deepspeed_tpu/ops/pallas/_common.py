@@ -60,6 +60,12 @@ def ln_bucket(rows, D):
     return f"R{pow2_bucket(rows)},D{int(D)}"
 
 
+def ring_bucket(T, d):
+    """Ring-attention chunk-pair bucket: T is the per-step CHUNK length
+    (T_global / (2 * ring) under zigzag), not the full sequence."""
+    return f"T{pow2_bucket(T)},d{int(d)}"
+
+
 def ce_bucket(N, D, V):
     return f"N{pow2_bucket(N)},D{int(D)},V{int(V)}"
 

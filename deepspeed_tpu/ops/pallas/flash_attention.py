@@ -1016,6 +1016,176 @@ def _bwd_t(q, k, v, o, lse_t, do, scale, causal, bq, bk, bh, t_real,
     return dq.astype(q.dtype), dk, dv, dbiases
 
 
+# ------------------------------------------------- blockwise (ring) variant
+# Carry-in/carry-out blockwise flash step: one (q-chunk, kv-chunk) pair of a
+# ring-attention schedule, chaining the running online-softmax state
+# (m, l, acc) across chunk pairs instead of combining normalized partial
+# outputs outside. The mask mode is STATIC per call — ``causal=True`` is the
+# diagonal-causal pair (q and kv chunks share the same global offset),
+# ``causal=False`` the fully-visible pair; fully-masked pairs are simply
+# never called (sequence/ring.py computes the static schedule). The ring
+# backward reuses the existing fused backward kernel per pair with the
+# GLOBAL lse/o (``flash_block_bwd``), the standard flash-bwd recompute.
+
+RING_TUNE_DEFAULTS = {"block_q": 128, "block_k": 128, "block_h": 2}
+
+
+def _fwd_block_kernel(q_ref, k_ref, v_ref, mi_ref, li_ref, acci_ref,
+                      mo_ref, lo_ref, acco_ref, *, bq, bk, causal, t_real):
+    """_fwd_kernel with the softmax state as operands/results instead of
+    locally initialized + finalized: m/l ride (G, bq, LSE_LANES) blocks
+    (lane-replicated like lse), acc a (G, bq, d) fp32 block."""
+    qi = pl.program_id(1)
+    q = q_ref[...]                                        # (G, bq, d)
+    G = q.shape[0]
+    T = k_ref.shape[1]
+    nk = T // bk
+    kmax = pl.cdiv((qi + 1) * bq, bk) if causal else nk
+    kfull = (qi * bq) // bk if (causal and t_real >= T) else (
+        nk if (not causal and t_real >= T) else 0)
+    m = mi_ref[...][..., 0]                               # (G, bq) f32
+    l = li_ref[...][..., 0]
+    acc = acci_ref[...]                                   # (G, bq, d) f32
+
+    def make_body(masked):
+        def body(j, carry):
+            acc, m, l = carry
+            kb = k_ref[:, pl.ds(j * bk, bk), :]
+            vb = v_ref[:, pl.ds(j * bk, bk), :]
+            s = jax.lax.dot_general(q, kb, _DN_QK,
+                                    preferred_element_type=jnp.float32)
+            if masked:
+                s = _apply_mask(s, _mask_block(qi * bq, j * bk, bq, bk,
+                                               causal, t_real, T))
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+            p = jnp.exp(s - m_new[..., None])
+            alpha = jnp.exp(m - m_new)
+            l = l * alpha + jnp.sum(p, axis=-1)
+            acc = acc * alpha[..., None] + jax.lax.dot_general(
+                p.astype(vb.dtype), vb, _DN_PV,
+                preferred_element_type=jnp.float32)
+            return acc, m_new, l
+        return body
+
+    carry = jax.lax.fori_loop(0, kfull, make_body(False), (acc, m, l))
+    acc, m, l = jax.lax.fori_loop(kfull, kmax, make_body(True), carry)
+    acco_ref[...] = acc
+    mo_ref[...] = jnp.broadcast_to(m[..., None], (G, bq, mo_ref.shape[-1]))
+    lo_ref[...] = jnp.broadcast_to(l[..., None], (G, bq, lo_ref.shape[-1]))
+
+
+def _block_bh(block_h, BH):
+    bh = max(1, min(block_h, BH))
+    while BH % bh:
+        bh -= 1
+    return bh
+
+
+def _block_pads(T, d, block_q, block_k):
+    bq, bk, T_pad = _block_sizes(T, block_q, block_k)
+    d_pad = _round_up(d, 64) if d <= 64 else _round_up(d, 128)
+    return bq, bk, T_pad, d_pad
+
+
+def flash_block_state(BH, T, d):
+    """Fresh (m, l, acc) carry for ``flash_block_fwd``: per-query running
+    max/sum-exp ((BH, T) fp32) and the unnormalized output accumulator
+    ((BH, T, d) fp32)."""
+    return (jnp.full((BH, T), NEG_INF, jnp.float32),
+            jnp.zeros((BH, T), jnp.float32),
+            jnp.zeros((BH, T, d), jnp.float32))
+
+
+def flash_block_finalize(state):
+    """(m, l, acc) -> (o fp32, lse fp32); call after the last chunk pair."""
+    m, l, acc = state
+    ls = jnp.clip(l, 1e-30, None)
+    return acc / ls[..., None], m + jnp.log(ls)
+
+
+def flash_block_fwd(q, k, v, state, *, causal=False, block_q=128,
+                    block_k=128, block_h=2, interpret=None):
+    """One ring chunk pair: q/k/v (BH, T, d) folded operands (q PRE-SCALED
+    by the caller — the ring folds the softmax scale once), ``state`` from
+    :func:`flash_block_state` (or a previous pair). Returns the updated
+    state. ``causal=True`` = the diagonal-causal pair (equal chunk
+    lengths, shared offset); fully-masked pairs must be skipped by the
+    caller, that is the schedule's job."""
+    BH, T, d = q.shape
+    if k.shape[1] != T:
+        raise ValueError(
+            f"flash_block_fwd needs equal chunk lengths, got q {T} vs "
+            f"kv {k.shape[1]} (the ring schedule pairs equal chunks)")
+    if interpret is None:
+        interpret = _interpret_default()
+    m, l, acc = state
+    bq, bk, T_pad, d_pad = _block_pads(T, d, block_q, block_k)
+    bh = _block_bh(block_h, BH)
+
+    def pad3(x):
+        if T_pad == T and d_pad == d:
+            return x
+        return jnp.pad(x, ((0, 0), (0, T_pad - T), (0, d_pad - d)))
+
+    def padl(x, fill):
+        x = x if T_pad == T else jnp.pad(
+            x, ((0, 0), (0, T_pad - T)), constant_values=fill)
+        return jnp.broadcast_to(x[..., None], (BH, T_pad, LSE_LANES))
+
+    grid = (BH // bh, T_pad // bq)
+    mo, lo, acco = pl.pallas_call(
+        functools.partial(_fwd_block_kernel, bq=bq, bk=bk, causal=causal,
+                          t_real=T),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((bh, bq, d_pad), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, T_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, T_pad, d_pad), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((bh, bq, LSE_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, LSE_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, d_pad), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((bh, bq, LSE_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, LSE_LANES), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((bh, bq, d_pad), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            _sds((BH, T_pad, LSE_LANES), jnp.float32, q),
+            _sds((BH, T_pad, LSE_LANES), jnp.float32, q),
+            _sds((BH, T_pad, d_pad), jnp.float32, q),
+        ],
+        interpret=interpret,
+    )(pad3(q), pad3(k), pad3(v), padl(m, NEG_INF), padl(l, 0.0),
+      pad3(acc))
+    return (mo[..., 0][:, :T], lo[..., 0][:, :T], acco[:, :T, :d])
+
+
+def flash_block_bwd(q, k, v, o, lse, do, *, causal=False, block_q=128,
+                    block_k=128, block_h=2, interpret=None):
+    """Ring chunk-pair backward via the existing fused backward kernel:
+    given the GLOBAL per-query ``lse`` ((BH, T) fp32) and final ``o``, the
+    kernel recomputes this pair's probabilities as exp(s - lse) and its
+    in-VMEM delta = rowsum(do * o) IS the global delta, so (dq, dk, dv)
+    are this pair's exact contributions. q pre-scaled like the forward."""
+    BH, T, d = q.shape
+    if interpret is None:
+        interpret = _interpret_default()
+    bq, bk, T_pad, d_pad = _block_pads(T, d, block_q, block_k)
+    bh = _block_bh(block_h, BH)
+
+    def pad3(x):
+        if T_pad == T and d_pad == d:
+            return x.astype(q.dtype) if x.dtype != q.dtype else x
+        x = jnp.pad(x, ((0, 0), (0, T_pad - T), (0, d_pad - d)))
+        return x.astype(q.dtype) if x.dtype != q.dtype else x
+
+    lse_p = lse if T_pad == T else jnp.pad(lse, ((0, 0), (0, T_pad - T)))
+    dq, dk, dv, _ = _bwd(pad3(q), pad3(k), pad3(v), pad3(o), lse_p[..., None],
+                         pad3(do), 1.0, causal, bq, bk, bh, T, interpret)
+    return dq[:, :T, :d], dk[:, :T, :d], dv[:, :T, :d]
+
+
 # --------------------------------------------------------------- public API
 @functools.partial(jax.custom_vjp,
                    nondiff_argnums=(4, 5, 6, 7, 8, 9, 10, 11, 12, 13, 14,

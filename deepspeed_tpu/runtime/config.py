@@ -231,6 +231,43 @@ class CommOverlapConfig:
 
 
 @dataclass
+class SequenceConfig:
+    """Sequence/context-parallelism block (sequence/ring.py — consumed by
+    models whose ``attention_backend='ring'`` when the mesh has seq > 1):
+
+      layout        'zigzag' (default): each rank holds one early + one
+                    mirrored late sequence chunk, so causal work is
+                    identical across ranks and fully-masked chunk pairs
+                    are statically skipped (~2x causal FLOPs saved vs
+                    computing-then-masking). 'contiguous': the naive
+                    layout (every pair computed, positionally masked) —
+                    the A/B fallback.
+      block_kernel  'auto' (default): ring steps run the carry-state
+                    blockwise Pallas flash kernel with tiles resolved
+                    from the autotune winner cache (op 'ring_block';
+                    r05 defaults on a miss) | true (kernel, r05 tiles) |
+                    false (dense einsum block steps — reference path).
+      double_buffer issue each step's KV ppermute BEFORE the step's
+                    kernels so the rotation hides under compute (the
+                    comm-overlap discipline); false serializes
+                    rotate-then-compute (A/B lever).
+    """
+    layout: str = "zigzag"
+    block_kernel: object = "auto"
+    double_buffer: bool = True
+
+    def __post_init__(self):
+        if self.layout not in ("zigzag", "contiguous"):
+            raise DeepSpeedConfigError(
+                f"sequence.layout must be 'zigzag'|'contiguous', got "
+                f"{self.layout!r}")
+        if self.block_kernel not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"sequence.block_kernel must be true|false|'auto', got "
+                f"{self.block_kernel!r}")
+
+
+@dataclass
 class AutotuneConfig:
     """Measured kernel dispatch (autotuning/kernel_dispatch.py): kernel
     tunables set to "auto" (flash blocks / mlp_kernel / fused_layernorm
@@ -371,6 +408,7 @@ class DeepSpeedConfig:
         self.checkpoint_engine = _take(config, CheckpointEngineConfig,
                                        C.CHECKPOINT_ENGINE)
         self.comm_overlap = _take(config, CommOverlapConfig, "comm_overlap")
+        self.sequence = _take(config, SequenceConfig, "sequence")
         self.autotune = _take(config, AutotuneConfig, "autotune")
         self.activation_checkpointing = _take(
             config, ActivationCheckpointingConfig, C.ACTIVATION_CHECKPOINTING)

@@ -125,6 +125,16 @@ class DeepSpeedEngine:
         self.comm_overlap_report = None
 
         self.model = model
+        # sequence/context-parallel knobs (config 'sequence' block):
+        # models with attention_backend='ring' read this when seq-sharded
+        # (gpt2.block_attn -> sequence/ring.py layout/kernel/overlap)
+        try:
+            self.model._sequence_cfg = self.config.sequence
+        except (AttributeError, TypeError):   # frozen/slotted models
+            log_dist(
+                "sequence config block could not be installed on the "
+                "model (attribute assignment rejected); ring attention "
+                "will use the module defaults", ranks=[0])
         self.zero_stage = self.config.zero.stage
         self.param_dtype = self.config.precision_dtype
         model_dtype = getattr(getattr(model, "config", None), "dtype",
@@ -664,11 +674,13 @@ class DeepSpeedEngine:
         """Compile the train-step program on ``batch`` and report the
         collective schedule XLA ACTUALLY emitted (``compiled.as_text()``
         through zero/overlap.overlap_report): collective count, async
-        start/done pairs, in-scan-loop placement, and the mesh axes each
-        collective's replica groups map to. ``require_async`` raises if a
-        dp>=2 step carries no async pairs — the overlap flags did not
-        take effect (TPU/GPU only: CPU lowers collectives synchronously
-        in HLO)."""
+        start/done pairs, in-scan-loop placement — broken down per op in
+        ``in_loop_by_op``, so a seq-parallel ring step shows its KV
+        ``collective-permute`` rotation INSIDE the scan body — and the
+        mesh axes each collective's replica groups map to.
+        ``require_async`` raises if a dp>=2 step carries no async pairs —
+        the overlap flags did not take effect (TPU/GPU only: CPU lowers
+        collectives synchronously in HLO)."""
         batch = jax.tree.map(self._add_gas_dim, batch)
         batch = self._shard_batch(batch, with_gas_dim=True)
         with jax.set_mesh(self.mesh):

@@ -437,10 +437,18 @@ def overlap_report(hlo_text, mesh=None):
                      if mesh is not None else None)
         if c["axes"]:
             axes.append(c["axes"])
+    in_loop_by_op = {}
+    for c in colls:
+        if c["in_loop"]:
+            in_loop_by_op[c["op"]] = in_loop_by_op.get(c["op"], 0) + 1
     return {
         "n_collectives": len(colls),
         "async_pairs": count_async_pairs(colls),
         "in_loop": sum(1 for c in colls if c["in_loop"]),
+        # per-op in-(scan)-loop counts: a ring-attention step reports its
+        # KV rotation here as 'collective-permute' (engine
+        # verify_comm_overlap's acceptance signal for the overlap)
+        "in_loop_by_op": in_loop_by_op,
         "ops": sorted({c["op"] for c in colls}),
         "axes": sorted({tuple(a) for a in axes}),
         "collectives": colls,

@@ -1,18 +1,47 @@
-"""Ring attention: context parallelism for arbitrarily long sequences.
+"""Zigzag ring attention: flash-kernel blockwise context parallelism.
 
 NOT in the reference (SURVEY §2.5/§5.7: this DeepSpeed version's only long
 -sequence tool is Ulysses + sparse attention) — built here because ring/
-blockwise attention is the natural TPU extension: KV blocks rotate around
-the 'seq' axis ring via ``ppermute`` (ICI neighbor traffic, fully
-overlappable with the per-block attention compute), and softmax is
-accumulated online flash-style, so no device ever materializes the full
-(T, T) score matrix OR the full KV — sequence length scales linearly with
-ring size at constant memory per chip.
+blockwise attention is the natural TPU extension: KV chunks rotate around
+the 'seq' axis ring via ``ppermute`` (ICI neighbor traffic, overlapped
+with the per-chunk attention compute), and softmax state is carried
+flash-style, so no device ever materializes the full (T, T) score matrix
+OR the full KV — sequence length scales linearly with ring size at
+constant memory per chip.
+
+Three fixes over the round-1 naive ring (dense per-step einsum over every
+block pair, masked after the fact):
+
+1. **Zigzag layout** (Ring Attention, Liu et al. 2023; Striped/zigzag,
+   Brandon et al. 2023): each rank holds one EARLY chunk and its MIRRORED
+   late chunk (rank r owns chunks r and 2R-1-r of 2R). Under causal
+   attention this makes every rank's per-step work identical — with the
+   contiguous layout rank 0 attends almost nothing while the last rank
+   pays the full triangle — and, crucially for SPMD, makes the per-step
+   mask mode STATIC: step 0 is exactly plain causal attention on the
+   local [early|late] buffer, and every later step is two fully-visible
+   (unmasked) equal-size chunk pairs. Fully-masked pairs are never
+   computed at all (``ring_flops_info`` accounts them; the naive ring
+   paid ~2x the causal FLOPs).
+2. **Flash-kernel chunk pairs**: each surviving pair runs through the
+   carry-in/carry-out blockwise Pallas kernel
+   (ops/pallas/flash_attention.py ``flash_block_fwd``) chaining the
+   running (m, l, acc) online-softmax state; the backward replays each
+   pair through the existing fused flash backward with the global lse
+   (``flash_block_bwd``). ``block_kernel=False`` keeps a dense-einsum
+   block step with the identical state algebra (parity/reference path).
+3. **Overlapped, fused KV rotation**: k and v travel as ONE stacked
+   buffer (one collective per rotation, not two), the rotation for step
+   i+1 is issued before step i's kernels so XLA's latency-hiding
+   scheduler slides it under the compute (``double_buffer=True``), and
+   the final step issues no dead rotation. In the backward, the dk/dv
+   accumulators travel with the kv buffer and one extra rotation delivers
+   them home.
 
 Ulysses vs ring trade-off (why both exist): Ulysses needs head_count >=
 ring size and moves activations twice through all-to-all; ring moves KV
 P-1 times through neighbor exchange but has no head-count constraint and
-composes with any per-block kernel (e.g. the Pallas flash kernel).
+composes with TP (``head_axis``) and any per-chunk kernel.
 """
 
 import functools
@@ -28,18 +57,391 @@ from ..utils.groups import BATCH_AXES
 NEG_INF = -1e30
 
 
-def ring_attention(q, k, v, axis_name="seq", causal=True):
-    """Blockwise ring attention over an axis group; call inside shard_map.
+# ------------------------------------------------------------ zigzag layout
 
-    q, k, v: (B, T_local, H, D) — this device's sequence block.
-    Returns (B, T_local, H, D) attention output, exact (not approximate):
-    online-softmax accumulation is algebraically identical to dense
-    softmax attention.
-    """
-    ring = lax.psum(1, axis_name)
+def _zig_owner(c, R):
+    """Rank owning global chunk c (of 2R) under the zigzag layout."""
+    return c if c < R else 2 * R - 1 - c
+
+
+def zigzag_perms(R):
+    """ppermute perms routing the contiguous layout's (2r, 2r+1) chunk
+    pair to the zigzag owners: perm_even carries the even chunk 2r,
+    perm_odd the odd chunk 2r+1. Both are rank bijections (an even chunk
+    lands early on an even rank, late on an odd one — and vice versa)."""
+    perm_even = [(r, _zig_owner(2 * r, R)) for r in range(R)]
+    perm_odd = [(r, _zig_owner(2 * r + 1, R)) for r in range(R)]
+    return perm_even, perm_odd
+
+
+def _to_zigzag(x, axis_name, R, axis=1):
+    """Contiguous-sharded local chunk (global [2r*C, (2r+2)*C)) ->
+    zigzag local [chunk r | chunk 2R-1-r]. Two chunk-sized ppermutes;
+    differentiable (ppermute transposes to the inverse permute)."""
+    C = x.shape[axis] // 2
+    pe, po = zigzag_perms(R)
+    a = lax.ppermute(lax.slice_in_dim(x, 0, C, axis=axis), axis_name, pe)
+    b = lax.ppermute(lax.slice_in_dim(x, C, 2 * C, axis=axis),
+                     axis_name, po)
+    even = (lax.axis_index(axis_name) % 2) == 0
+    return jnp.where(even, jnp.concatenate([a, b], axis=axis),
+                     jnp.concatenate([b, a], axis=axis))
+
+
+def _from_zigzag(x, axis_name, R, axis=1):
+    """Inverse of :func:`_to_zigzag`."""
+    C = x.shape[axis] // 2
+    pe, po = zigzag_perms(R)
+    inv_e = [(d, s) for (s, d) in pe]
+    inv_o = [(d, s) for (s, d) in po]
+    early = lax.slice_in_dim(x, 0, C, axis=axis)
+    late = lax.slice_in_dim(x, C, 2 * C, axis=axis)
+    even = (lax.axis_index(axis_name) % 2) == 0
+    a = lax.ppermute(jnp.where(even, early, late), axis_name, inv_e)
+    b = lax.ppermute(jnp.where(even, late, early), axis_name, inv_o)
+    return jnp.concatenate([a, b], axis=axis)
+
+
+# ------------------------------------------------------------- block steps
+# The per-chunk-pair step in two interchangeable backends sharing the
+# exact (m, l, acc) state algebra: the Pallas carry-state flash kernel
+# (the measured hot path) and a dense einsum reference.
+
+def _fold(x):
+    """(B, t, H, D) -> (B*H, t, D)."""
+    B, t, H, D = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(B * H, t, D)
+
+
+def _unfold(x, B, H):
+    BH, t, D = x.shape
+    return x.reshape(B, H, t, D).transpose(0, 2, 1, 3)
+
+
+def _step_einsum(q, k, v, state, causal):
+    """Dense-einsum block step, algebraically identical to the kernel:
+    q (BH, T, d) pre-scaled; state (m, l, acc) fp32."""
+    m, l, acc = state
+    s = jnp.einsum("gtd,gsd->gts", q, k,
+                   preferred_element_type=jnp.float32)
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        s = jnp.where(mask[None], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+    p = jnp.exp(s - m_new[..., None])
+    alpha = jnp.exp(m - m_new)
+    l = l * alpha + jnp.sum(p, axis=-1)
+    acc = acc * alpha[..., None] + jnp.einsum(
+        "gts,gsd->gtd", p, v.astype(jnp.float32))
+    return m_new, l, acc
+
+
+def _bwd_einsum(q, k, v, o, lse, do, causal):
+    """Dense-einsum pair backward from the GLOBAL lse/o (the flash-bwd
+    recompute): exact contributions, fp32 throughout."""
+    s = jnp.einsum("gtd,gsd->gts", q.astype(jnp.float32),
+                   k.astype(jnp.float32))
+    if causal:
+        T = q.shape[1]
+        mask = jnp.tril(jnp.ones((T, T), jnp.bool_))
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])
+    dof = do.astype(jnp.float32)
+    of = o.astype(jnp.float32)
+    delta = jnp.sum(dof * of, axis=-1)
+    dv = jnp.einsum("gts,gtd->gsd", p, dof)
+    dp = jnp.einsum("gtd,gsd->gts", dof, v.astype(jnp.float32))
+    ds = p * (dp - delta[..., None])
+    dk = jnp.einsum("gts,gtd->gsd", ds, q.astype(jnp.float32))
+    dq = jnp.einsum("gts,gsd->gtd", ds, k.astype(jnp.float32))
+    return dq, dk, dv
+
+
+def _make_steps(use_kernel, bq, bk, bh, interpret):
+    if not use_kernel:
+        return _step_einsum, _bwd_einsum
+    from ..ops.pallas.flash_attention import (flash_block_bwd,
+                                              flash_block_fwd)
+
+    def fwd(q, k, v, st, causal):
+        return flash_block_fwd(q, k, v, st, causal=causal, block_q=bq,
+                               block_k=bk, block_h=bh,
+                               interpret=interpret)
+
+    def bwd(q, k, v, o, lse, do, causal):
+        return flash_block_bwd(q, k, v, o, lse, do, causal=causal,
+                               block_q=bq, block_k=bk, block_h=bh,
+                               interpret=interpret)
+    return fwd, bwd
+
+
+def _tree_where(pred, a, b):
+    return tuple(jnp.where(pred, x, y) for x, y in zip(a, b))
+
+
+# --------------------------------------------------------- rotation driver
+
+def _ring_scan(kv, state, step0_fn, step_fn, axis_name, R, double_buffer):
+    """R compute steps, R-1 KV rotations, no dead last rotation.
+
+    ``double_buffer=True`` issues each rotation BEFORE the compute it
+    overlaps (the compute reads the previous buffer, so XLA's latency-
+    hiding scheduler slides the collective-permute under the kernels);
+    ``False`` is the serialized rotate-then-compute order (A/B lever).
+    The rotation lives INSIDE the scan body either way — the placement
+    ``engine.verify_comm_overlap`` reports."""
+    if R == 1:
+        return step0_fn(state, kv)
+    perm = [(j, (j + 1) % R) for j in range(R)]
+    if double_buffer:
+        kv_nxt = lax.ppermute(kv, axis_name, perm)   # overlaps step 0
+        state = step0_fn(state, kv)
+
+        def body(carry, s):
+            st, kvb = carry
+            kvn = lax.ppermute(kvb, axis_name, perm)
+            st = step_fn(st, kvb, s)
+            return (st, kvn), None
+
+        if R > 2:
+            (state, kv_last), _ = lax.scan(
+                body, (state, kv_nxt), jnp.arange(1, R - 1))
+        else:
+            kv_last = kv_nxt
+        return step_fn(state, kv_last, R - 1)
+
+    state = step0_fn(state, kv)
+
+    def body(carry, s):
+        st, kvb = carry
+        kvb = lax.ppermute(kvb, axis_name, perm)
+        st = step_fn(st, kvb, s)
+        return (st, kvb), None
+
+    (state, _), _ = lax.scan(body, (state, kv), jnp.arange(1, R))
+    return state
+
+
+def _ring_bwd_scan(kv, dq0, dkv0, step_bwd, axis_name, R):
+    """Backward rotation driver: the dk/dv accumulators travel WITH the
+    kv buffer (each rank adds its contribution to whatever kv it holds),
+    and ONE extra rotation after the last step delivers them home."""
+    if R == 1:
+        return dq0, dkv0
+    perm = [(j, (j + 1) % R) for j in range(R)]
+
+    def body(carry, s):
+        dq, kvb, dkvb = carry
+        kvb = lax.ppermute(kvb, axis_name, perm)
+        dkvb = lax.ppermute(dkvb, axis_name, perm)
+        dq, dkvb = step_bwd(dq, kvb, dkvb, s)
+        return (dq, kvb, dkvb), None
+
+    (dq, _, dkv), _ = lax.scan(body, (dq0, kv, dkv0), jnp.arange(1, R))
+    return dq, lax.ppermute(dkv, axis_name, perm)
+
+
+# ------------------------------------------------------ zigzag causal core
+
+def _zig_step(st, kvb, s, *, qf, r, C, step):
+    """One zigzag ring step s >= 1: always the (q_late x kv_early) full
+    pair, plus ONE more full pair selected by the traced wrap predicate
+    (s <= r: q_early x kv_early; else q_late x kv_late) — both branches
+    identical in shape/cost, so SPMD stays a single static program and
+    every rank does exactly two C x C unmasked pairs per step."""
+    kf, vf = kvb[0], kvb[1]
+    q_late = qf[:, C:]
+    ke, ve = kf[:, :C], vf[:, :C]
+    m, l, acc = st
+    st_e = (m[:, :C], l[:, :C], acc[:, :C])
+    st_l = (m[:, C:], l[:, C:], acc[:, C:])
+    st_l = step(q_late, ke, ve, st_l, False)
+    pred = s <= r
+    qc = jnp.where(pred, qf[:, :C], q_late)
+    kc = jnp.where(pred, ke, kf[:, C:])
+    vc = jnp.where(pred, ve, vf[:, C:])
+    st_out = step(qc, kc, vc, _tree_where(pred, st_e, st_l), False)
+    st_e = _tree_where(pred, st_out, st_e)
+    st_l = _tree_where(pred, st_l, st_out)
+    return tuple(jnp.concatenate([a, b], axis=1)
+                 for a, b in zip(st_e, st_l))
+
+
+def _zig_step_bwd(dq, kvb, dkvb, s, *, qf, of, lsef, dof, r, C, bstep):
+    kf, vf = kvb[0], kvb[1]
+    dqa, dka, dva = bstep(qf[:, C:], kf[:, :C], vf[:, :C], of[:, C:],
+                          lsef[:, C:], dof[:, C:], False)
+    dq = dq.at[:, C:].add(dqa.astype(jnp.float32))
+    dkvb = dkvb.at[:, :, :C].add(
+        jnp.stack([dka, dva]).astype(jnp.float32))
+    pred = s <= r
+    qc = jnp.where(pred, qf[:, :C], qf[:, C:])
+    kc = jnp.where(pred, kf[:, :C], kf[:, C:])
+    vc = jnp.where(pred, vf[:, :C], vf[:, C:])
+    oc = jnp.where(pred, of[:, :C], of[:, C:])
+    lc = jnp.where(pred, lsef[:, :C], lsef[:, C:])
+    dc = jnp.where(pred, dof[:, :C], dof[:, C:])
+    dqc, dkc, dvc = bstep(qc, kc, vc, oc, lc, dc, False)
+    dqc = dqc.astype(jnp.float32)
+    z = jnp.zeros_like(dqc)
+    dq = dq.at[:, :C].add(jnp.where(pred, dqc, z))
+    dq = dq.at[:, C:].add(jnp.where(pred, z, dqc))
+    dkv_c = jnp.stack([dkc, dvc]).astype(jnp.float32)
+    z2 = jnp.zeros_like(dkv_c)
+    dkvb = dkvb.at[:, :, :C].add(jnp.where(pred, dkv_c, z2))
+    dkvb = dkvb.at[:, :, C:].add(jnp.where(pred, z2, dkv_c))
+    return dq, dkvb
+
+
+def _zig_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
+                  interpret, double_buffer):
+    """Zigzag-local (B, 2C, H, D) q/k/v -> (o, lse folded). Step 0 is
+    plain causal attention on the local buffer (the zigzag pair's local
+    order IS the global causal order), later steps unmasked pairs."""
+    from ..ops.pallas.flash_attention import (flash_block_finalize,
+                                              flash_block_state)
+    B, Tl, H, D = q.shape
+    C = Tl // 2
+    r = lax.axis_index(axis_name)
+    step, _ = _make_steps(use_kernel, bq, bk, bh, interpret)
+    qf = _fold(q) * jnp.asarray(scale, q.dtype)
+    kv = jnp.stack([_fold(k), _fold(v)])         # fused rotation buffer
+    state = flash_block_state(B * H, Tl, D)
+
+    def step0(st, kvb):
+        return step(qf, kvb[0], kvb[1], st, True)
+
+    state = _ring_scan(
+        kv, state, step0,
+        functools.partial(_zig_step, qf=qf, r=r, C=C, step=step),
+        axis_name, R, double_buffer)
+    of, lse = flash_block_finalize(state)
+    o = of.astype(q.dtype)
+    return _unfold(o, B, H), (o, lse)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _ring_zigzag(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
+                 interpret, double_buffer):
+    o, _ = _zig_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq,
+                         bk, bh, interpret, double_buffer)
+    return o
+
+
+def _ring_zigzag_fwd(q, k, v, axis_name, R, scale, use_kernel, bq, bk,
+                     bh, interpret, double_buffer):
+    o, (of, lsef) = _zig_fwd_impl(q, k, v, axis_name, R, scale,
+                                  use_kernel, bq, bk, bh, interpret,
+                                  double_buffer)
+    return o, (q, k, v, of, lsef)
+
+
+def _ring_zigzag_bwd(axis_name, R, scale, use_kernel, bq, bk, bh,
+                     interpret, double_buffer, res, do):
+    q, k, v, of, lsef = res
+    B, Tl, H, D = q.shape
+    C = Tl // 2
+    r = lax.axis_index(axis_name)
+    _, bstep = _make_steps(use_kernel, bq, bk, bh, interpret)
+    qf = _fold(q) * jnp.asarray(scale, q.dtype)
+    dof = _fold(do)
+    kv = jnp.stack([_fold(k), _fold(v)])
+
+    dq0a, dk0, dv0 = bstep(qf, kv[0], kv[1], of, lsef, dof, True)
+    dq0 = dq0a.astype(jnp.float32)
+    dkv0 = jnp.stack([dk0, dv0]).astype(jnp.float32)
+    dq, dkv = _ring_bwd_scan(
+        kv, dq0, dkv0,
+        functools.partial(_zig_step_bwd, qf=qf, of=of, lsef=lsef,
+                          dof=dof, r=r, C=C, bstep=bstep),
+        axis_name, R)
+    dq = dq * scale                   # q was pre-scaled into the kernels
+    return (_unfold(dq, B, H).astype(q.dtype),
+            _unfold(dkv[0], B, H).astype(k.dtype),
+            _unfold(dkv[1], B, H).astype(v.dtype))
+
+
+_ring_zigzag.defvjp(_ring_zigzag_fwd, _ring_zigzag_bwd)
+
+
+# -------------------------------------------------- non-causal (full) core
+
+def _full_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
+                   interpret, double_buffer):
+    from ..ops.pallas.flash_attention import (flash_block_finalize,
+                                              flash_block_state)
+    B, Tl, H, D = q.shape
+    step, _ = _make_steps(use_kernel, bq, bk, bh, interpret)
+    qf = _fold(q) * jnp.asarray(scale, q.dtype)
+    kv = jnp.stack([_fold(k), _fold(v)])
+    state = flash_block_state(B * H, Tl, D)
+
+    def pair(st, kvb):
+        return step(qf, kvb[0], kvb[1], st, False)
+
+    state = _ring_scan(kv, state, pair, lambda st, kvb, s: pair(st, kvb),
+                       axis_name, R, double_buffer)
+    of, lse = flash_block_finalize(state)
+    o = of.astype(q.dtype)
+    return _unfold(o, B, H), (o, lse)
+
+
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+def _ring_full(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
+               interpret, double_buffer):
+    o, _ = _full_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq,
+                          bk, bh, interpret, double_buffer)
+    return o
+
+
+def _ring_full_fwd(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
+                   interpret, double_buffer):
+    o, (of, lsef) = _full_fwd_impl(q, k, v, axis_name, R, scale,
+                                   use_kernel, bq, bk, bh, interpret,
+                                   double_buffer)
+    return o, (q, k, v, of, lsef)
+
+
+def _ring_full_bwd(axis_name, R, scale, use_kernel, bq, bk, bh, interpret,
+                   double_buffer, res, do):
+    q, k, v, of, lsef = res
+    B, Tl, H, D = q.shape
+    _, bstep = _make_steps(use_kernel, bq, bk, bh, interpret)
+    qf = _fold(q) * jnp.asarray(scale, q.dtype)
+    dof = _fold(do)
+    kv = jnp.stack([_fold(k), _fold(v)])
+
+    def pair_bwd(dq, kvb, dkvb, s):
+        dqs, dks, dvs = bstep(qf, kvb[0], kvb[1], of, lsef, dof, False)
+        dq = dq + dqs.astype(jnp.float32)
+        dkvb = dkvb + jnp.stack([dks, dvs]).astype(jnp.float32)
+        return dq, dkvb
+
+    dq0, dkv0 = pair_bwd(jnp.zeros(qf.shape, jnp.float32), kv,
+                         jnp.zeros(kv.shape, jnp.float32), 0)
+    dq, dkv = _ring_bwd_scan(kv, dq0, dkv0, pair_bwd, axis_name, R)
+    dq = dq * scale
+    return (_unfold(dq, B, H).astype(q.dtype),
+            _unfold(dkv[0], B, H).astype(k.dtype),
+            _unfold(dkv[1], B, H).astype(v.dtype))
+
+
+_ring_full.defvjp(_ring_full_fwd, _ring_full_bwd)
+
+
+# -------------------------------------------- contiguous causal (fallback)
+
+def _ring_contiguous(q, k, v, axis_name, ring, scale):
+    """The pre-zigzag dense path, kept for ``layout='contiguous'``: every
+    block pair is computed and then positionally masked (the mask depends
+    on the traced rank, so no pair can be statically skipped — the ~2x
+    causal FLOPs overhead zigzag exists to remove). KV rotates as one
+    fused stacked buffer."""
     my_block = lax.axis_index(axis_name)
     B, T, H, D = q.shape
-    scale = 1.0 / math.sqrt(D)
 
     m0 = jnp.full((B, H, T), NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, T), jnp.float32)
@@ -52,11 +454,10 @@ def ring_attention(q, k, v, axis_name="seq", causal=True):
         src = (my_block - i) % ring
         scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                             preferred_element_type=jnp.float32) * scale
-        if causal:
-            q_pos = my_block * T + jnp.arange(T)
-            kv_pos = src * T + jnp.arange(T)
-            mask = q_pos[:, None] >= kv_pos[None, :]
-            scores = jnp.where(mask[None, None], scores, NEG_INF)
+        q_pos = my_block * T + jnp.arange(T)
+        kv_pos = src * T + jnp.arange(T)
+        mask = q_pos[:, None] >= kv_pos[None, :]
+        scores = jnp.where(mask[None, None], scores, NEG_INF)
         s_max = jnp.max(scores, axis=-1)
         m_new = jnp.maximum(m, s_max)
         p = jnp.exp(scores - m_new[..., None])          # (B,H,T,S) fp32
@@ -67,33 +468,152 @@ def ring_attention(q, k, v, axis_name="seq", causal=True):
         return m_new, l, acc
 
     def step(carry, i):
-        m, l, acc, kk, vv = carry
-        m, l, acc = accumulate(m, l, acc, kk, vv, i)
-        kk = lax.ppermute(kk, axis_name, perm)
-        vv = lax.ppermute(vv, axis_name, perm)
-        return (m, l, acc, kk, vv), None
+        m, l, acc, kv = carry
+        m, l, acc = accumulate(m, l, acc, kv[0], kv[1], i)
+        kv = lax.ppermute(kv, axis_name, perm)
+        return (m, l, acc, kv), None
 
-    carry = (m0, l0, acc0, k, v)
+    carry = (m0, l0, acc0, jnp.stack([k, v]))
     if ring > 1:
         # scan the first ring-1 blocks (rotation at step end); the final
         # block accumulates outside so no dead last rotation is issued
         carry, _ = lax.scan(step, carry, jnp.arange(ring - 1))
-    m, l, acc, kk, vv = carry
-    m, l, acc = accumulate(m, l, acc, kk, vv, ring - 1)
+    m, l, acc, kv = carry
+    m, l, acc = accumulate(m, l, acc, kv[0], kv[1], ring - 1)
     out = acc / jnp.clip(l, 1e-30, None).transpose(0, 2, 1)[..., None]
     return out.astype(q.dtype)
 
 
+# ------------------------------------------------------------- public API
+
+def _resolve_blocks(block_kernel, chunk, D, dtype):
+    """(use_kernel, bq, bk, bh): False -> einsum blocks; True -> the r05
+    ring-block defaults; 'auto' -> the autotune winner cache's measured
+    tiles for this (device, chunk-bucket, dtype) (kernel_registry op
+    'ring_block'; r05 defaults on a miss)."""
+    from ..ops.pallas.flash_attention import RING_TUNE_DEFAULTS
+    if block_kernel is False:
+        d = RING_TUNE_DEFAULTS
+        return False, int(d["block_q"]), int(d["block_k"]), \
+            int(d["block_h"])
+    if block_kernel == "auto":
+        from ..ops.pallas._common import dispatch, dtype_name, ring_bucket
+        win = dispatch("ring_block", ring_bucket(chunk, D),
+                       dtype_name(dtype), RING_TUNE_DEFAULTS)
+    else:
+        win = RING_TUNE_DEFAULTS
+    return True, int(win["block_q"]), int(win["block_k"]), \
+        int(win["block_h"])
+
+
+def ring_attention(q, k, v, axis_name="seq", causal=True, *,
+                   layout="zigzag", block_kernel="auto",
+                   double_buffer=True, interpret=None, scale=None):
+    """Blockwise ring attention over an axis group; call inside shard_map.
+
+    q, k, v: (B, T_local, H, D) — this device's sequence block(s).
+    Returns (B, T_local, H, D) attention output, exact (not approximate):
+    carried online-softmax state is algebraically identical to dense
+    softmax attention.
+
+    ``layout='zigzag'`` (causal only): rebalances the causal triangle so
+    every rank does identical work and fully-masked chunk pairs are
+    statically skipped; inputs/outputs stay CONTIGUOUS-sharded — the
+    zigzag redistribution is internal (two chunk ppermutes each way).
+    ``block_kernel``: 'auto' (Pallas blockwise flash kernel, tiles from
+    the autotune winner cache) | True (kernel, r05 tiles) | False (dense
+    einsum block steps — the reference/parity path).
+    """
+    ring = lax.psum(1, axis_name)
+    B, Tl, H, D = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(D)
+    if interpret is None:
+        from ..ops.pallas._common import interpret_default
+        interpret = interpret_default()
+    if not causal:
+        chunk = Tl
+        use_kernel, bq, bk, bh = _resolve_blocks(block_kernel, chunk, D,
+                                                 q.dtype)
+        return _ring_full(q, k, v, axis_name, int(ring), float(scale),
+                          use_kernel, bq, bk, bh, bool(interpret),
+                          bool(double_buffer))
+    if ring == 1:
+        use_kernel, bq, bk, bh = _resolve_blocks(block_kernel, Tl, D,
+                                                 q.dtype)
+        return _ring_zigzag(q, k, v, axis_name, 1, float(scale),
+                            use_kernel, bq, bk, bh, bool(interpret),
+                            bool(double_buffer))
+    if layout not in ("zigzag", "contiguous"):
+        raise ValueError(
+            f"ring layout must be 'zigzag'|'contiguous', got {layout!r}")
+    if layout == "zigzag" and Tl % 2 == 0:
+        C = Tl // 2
+        use_kernel, bq, bk, bh = _resolve_blocks(block_kernel, C, D,
+                                                 q.dtype)
+        qkv = _to_zigzag(jnp.stack([q, k, v]), axis_name, int(ring),
+                         axis=2)
+        o = _ring_zigzag(qkv[0], qkv[1], qkv[2], axis_name, int(ring),
+                         float(scale), use_kernel, bq, bk, bh,
+                         bool(interpret), bool(double_buffer))
+        return _from_zigzag(o, axis_name, int(ring), axis=1)
+    if layout == "zigzag":
+        # odd local chunk: the early/late split doesn't exist — loudly
+        # degrade to the compute-then-mask path (~2x causal FLOPs, dense
+        # fp32 score blocks) rather than silently, so an A/B that
+        # believes it measured zigzag can see the cliff in its logs
+        from ..utils.logging import logger
+        logger.warning(
+            f"ring zigzag needs an even per-rank chunk (got T_local="
+            f"{Tl}); falling back to the contiguous masked-einsum path")
+    return _ring_contiguous(q, k, v, axis_name, ring, scale)
+
+
+def ring_flops_info(ring, T_local, causal=True, layout="zigzag"):
+    """STATIC block-pair accounting for one rank, in C x C chunk-pair
+    units (C = T_local // 2 under zigzag). ``computed_pairs`` counts
+    kernel invocations' coverage (a diagonal-causal pair counts 1 unit
+    of coverage but ~1/2 the FLOPs), ``skipped_pairs`` the fully-masked
+    pairs the schedule never computes — the naive ring computed (then
+    masked) every one of them. The causal-FLOPs acceptance assertion
+    reads this alongside the lowered cost analysis."""
+    R = int(ring)
+    if R == 1 and causal:
+        # one 2C x 2C causal call covers all 4 units (upper triangle
+        # skipped in-kernel at block grain)
+        return {"computed_pairs": 4, "diagonal_pairs": 4,
+                "skipped_pairs": 0, "total_pairs": 4}
+    if not causal:
+        # every pair is live — nothing to skip
+        return {"computed_pairs": 4 * R, "diagonal_pairs": 0,
+                "skipped_pairs": 0, "total_pairs": 4 * R}
+    if layout != "zigzag":
+        return {"computed_pairs": 4 * R, "diagonal_pairs": 0,
+                "skipped_pairs": 0, "total_pairs": 4 * R}
+    # step 0: a 2C x 2C causal call covers 4 units (its upper triangle is
+    # in-kernel skipped at block grain); steps 1..R-1: two C x C pairs
+    computed = 4 + 2 * (R - 1)
+    total = 4 * R
+    return {"computed_pairs": computed, "diagonal_pairs": 4,
+            "skipped_pairs": total - computed, "total_pairs": total}
+
+
 def ring_attention_sharded(q, k, v, mesh, *, axis_name="seq", causal=True,
-                           batch_spec=P(BATCH_AXES),
-                           head_axis=None):
+                           batch_spec=P(BATCH_AXES), head_axis=None,
+                           layout="zigzag", block_kernel="auto",
+                           double_buffer=True, interpret=None):
     """Global-array entry: q/k/v (B, T, H, D) sequence-sharded on
     ``axis_name``; exact causal attention over the full sequence.
-    ``head_axis``: optionally shard heads too (ring-CP x TP composition)."""
+    ``head_axis``: optionally shard heads too (ring-CP x TP composition).
+    Layout/kernel knobs per the runtime config's ``sequence`` block
+    (see :func:`ring_attention`)."""
     spec = P(*batch_spec, axis_name, head_axis, None)
     fn = jax.shard_map(
         functools.partial(ring_attention, axis_name=axis_name,
-                          causal=causal),
+                          causal=causal, layout=layout,
+                          block_kernel=block_kernel,
+                          double_buffer=double_buffer,
+                          interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)
     return fn(q, k, v)

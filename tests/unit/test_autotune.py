@@ -39,12 +39,13 @@ _BUCKETS = {
     "mlp_matmul": "T128,D128,F512",
     "layernorm": "R256,D128",
     "fused_ce": "N128,D128,V384",
+    "ring_block": "T64,d32",
 }
 
 
 class TestRegistry:
     def test_every_tunable_kernel_has_candidates(self):
-        """Registry completeness: the four tunable Pallas kernel ops
+        """Registry completeness: the five tunable Pallas kernel ops
         each expose defaults + a non-empty candidate set whose params
         all share the defaults' key set (a winner can always be merged
         over the defaults)."""

@@ -121,6 +121,34 @@ def test_comm_overlap_validation():
                         dp_world_size=8)
 
 
+def test_sequence_block_defaults_and_parses():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
+    sq = cfg.sequence
+    assert sq.layout == "zigzag"
+    assert sq.block_kernel == "auto"
+    assert sq.double_buffer is True
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "sequence": {"layout": "contiguous", "block_kernel": False,
+                     "double_buffer": False},
+    }, dp_world_size=8)
+    sq = cfg.sequence
+    assert sq.layout == "contiguous"
+    assert sq.block_kernel is False
+    assert sq.double_buffer is False
+
+
+def test_sequence_block_validation():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "sequence": {"layout": "striped"}},
+                        dp_world_size=8)
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "sequence": {"block_kernel": "maybe"}},
+                        dp_world_size=8)
+
+
 def test_autotune_defaults():
     cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
     at = cfg.autotune

@@ -366,3 +366,47 @@ class TestRaggedEP:
                 lambda p, x: moe.apply(p, x, train=False))(params, x)
         assert y.shape == x.shape
         assert float(jnp.sum(counts)) == 4 * 16 * 2  # k=2, dropless
+
+
+class TestSwigluEP:
+    """Expert-parallel SwiGLU MoE (moe_swiglu_ragged_ep) — the mixtral
+    serving FFN. Exists because GSPMD silently mis-partitions
+    lax.ragged_dot over expert-sharded weights (off-shard experts' rows
+    come back garbage), so EP must be an explicit shard_map exchange.
+    Fast tier: this guards the ep_sharded_mixtral serving path."""
+
+    def _params(self, M=16, F=32, E=4, seed=0):
+        rng = np.random.RandomState(seed)
+        return (jnp.asarray(rng.randn(M, E) * 0.1, jnp.float32),
+                jnp.asarray(rng.randn(E, M, F) * 0.1, jnp.float32),
+                jnp.asarray(rng.randn(E, M, F) * 0.1, jnp.float32),
+                jnp.asarray(rng.randn(E, F, M) * 0.1, jnp.float32))
+
+    def _dense(self, x, gate_w, w1, w3, w2, k=2):
+        logits = x.astype(jnp.float32) @ gate_w
+        probs = jax.nn.softmax(logits, axis=-1)
+        weights, experts = jax.lax.top_k(probs, k)
+        weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
+        y = jnp.zeros_like(x)
+        for e in range(gate_w.shape[-1]):
+            o = (jax.nn.silu(x @ w1[e]) * (x @ w3[e])) @ w2[e]
+            w = jnp.sum(jnp.where(experts == e, weights, 0.0), axis=-1)
+            y = y + o * w[:, None]
+        return y
+
+    @pytest.mark.parametrize("odd_tokens", [False, True])
+    def test_matches_dense_reference(self, odd_tokens):
+        from deepspeed_tpu.moe.sharded_moe import moe_swiglu_ragged_ep
+        gate_w, w1, w3, w2 = self._params()
+        rng = np.random.RandomState(1)
+        S = 15 if odd_tokens else 16    # odd: the pad-to-divisible path
+        x = jnp.asarray(rng.randn(S, 16) * 0.3, jnp.float32)
+        ref = self._dense(x, gate_w, w1, w3, w2)
+        groups.reset()
+        topo = groups.initialize(TopologyConfig(expert_parallel_size=2,
+                                                tensor_parallel_size=2))
+        with jax.set_mesh(topo.mesh):
+            y = jax.jit(lambda *a: moe_swiglu_ragged_ep(*a, k=2))(
+                x, gate_w, w1, w3, w2)
+        np.testing.assert_allclose(np.asarray(y), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-4)
