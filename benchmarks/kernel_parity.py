@@ -264,6 +264,47 @@ def _paged(rng):
     _close(out, ref, "paged decode")
 
 
+def _paged_chunk(rng):
+    """The SplitFuse chunked-prefill paged kernel vs the dense-gather
+    reference on real Mosaic: a GQA chunk straddling block boundaries
+    mid-sequence, plus a sliding-window case."""
+    from deepspeed_tpu.ops.pallas.paged_attention import (
+        paged_chunk_attention, paged_chunk_attention_reference)
+    C, H, KVH, d = 32, 8, 4, 64
+    NB, BS, MB = 12, 32, 4
+    ks = jax.random.split(rng, 4)
+    q = jax.random.normal(ks[0], (C, H, d), jnp.bfloat16)
+    kc = jax.random.normal(ks[1], (NB, KVH, BS, d), jnp.bfloat16)
+    vc = jax.random.normal(ks[2], (NB, KVH, BS, d), jnp.bfloat16)
+    table = jax.random.randint(ks[3], (MB,), 0, NB, jnp.int32)
+    for start, true_len, window in ((45, 32, 0), (70, 20, 48)):
+        out = jax.jit(lambda *a: paged_chunk_attention(
+            *a, window=window, block_c=16, interpret=False))(
+            q, kc, vc, table, jnp.int32(start), jnp.int32(true_len))
+        ref = jax.jit(lambda *a: paged_chunk_attention_reference(
+            *a, window=window))(
+            q, kc, vc, table, jnp.int32(start), jnp.int32(true_len))
+        _close(out[:true_len], ref[:true_len],
+               f"paged chunk w={window}")
+
+
+def _paged_tuned(rng, op):
+    """Tuned-winner gate for the serving autotune ops: whatever config
+    dispatch resolves for this chip's decode-shape bucket (cached
+    winner or the cold-cache default) must reproduce the dense
+    reference — the same winner-re-proving contract as the
+    autotune_winners gate, but exercised for the engine's own ops even
+    when the cache is cold."""
+    from deepspeed_tpu.autotuning import kernel_dispatch, kernel_registry
+    spec = kernel_registry.REGISTRY[op]
+    bucket = {"paged_decode": "B8,MB8,BS32,kh4,g2,d64",
+              "paged_chunk": "C32,MB8,BS32,kh4,g2,d64"}[op]
+    b = kernel_registry.parse_bucket(bucket)
+    params = kernel_dispatch.resolve(op, bucket, "bfloat16",
+                                     spec["defaults"](b))
+    spec["parity"](b, "bfloat16", params)
+
+
 def _block_sparse(rng):
     from deepspeed_tpu.ops.pallas.block_sparse_attention import (
         block_sparse_attention)
@@ -423,6 +464,12 @@ _GATES = (
     ("splitfuse", _splitfuse),
     ("mlp_matmul", _mlp_matmul),
     ("paged", _paged),
+    # the SplitFuse chunked-prefill paged kernel + the tuned-winner
+    # gates for the two serving autotune ops (cached winner — or the
+    # cold-cache default — vs the dense reference)
+    ("paged_chunk", _paged_chunk),
+    ("paged_decode_tuned", lambda r: _paged_tuned(r, "paged_decode")),
+    ("paged_chunk_tuned", lambda r: _paged_tuned(r, "paged_chunk")),
     ("block_sparse", _block_sparse),
     ("quant", _quant),
     ("fused_ce", _fused_ce),

@@ -4,14 +4,24 @@ The reference's FastGen identity is measured serving throughput
 (BASELINE.md rows 3-5: effective throughput under SLA). This bench drives
 the v2 continuous-batching engine end to end — prefill a batch of
 prompts, then timed decode steps over the paged KV cache (the Pallas
-paged-attention kernel) — and prints one JSON line per configuration:
+paged-attention kernels) — and prints one JSON line per configuration.
 
-    {"model": ..., "batch": N, "prompt_len": P, "decode_tokens_per_sec":
-     ..., "ms_per_token": ...}
+The headline scenario is ``bench_mixed_traffic``: Poisson arrivals of
+mixed long-prefill + decode-heavy requests, p50/p99 **TTFT** (submit to
+first token) and **TPOT** (steady-state inter-token) reported
+separately per engine variant (paged kernel on/off x SplitFuse on/off)
+— the FastGen demonstration that split-fuse holds p99 TPOT flat while
+long prompts stream through.
+
+EVERY row also lands in ``SERVE_local.json`` at the repo root — written
+even when a run is interrupted mid-sweep (the same lost-artifact lesson
+as ``bench.py``'s BENCH_local.json: three rounds of driver artifacts
+vanished).
 
 Run on the chip:  python benchmarks/serve_bench.py
 Env: SERVE_MODELS=gpt2-350M,llama-1b  SERVE_BATCHES=1,8
-     SERVE_PROMPT=1024  SERVE_DECODE=128
+     SERVE_PROMPT=1024  SERVE_DECODE=128  SERVE_MIXED=1
+     SERVE_MIXED_MODEL=gpt2-350M  SERVE_EP_MOE=1
 """
 
 import json
@@ -31,8 +41,86 @@ from deepspeed_tpu.models import GPT2, PRESETS  # noqa: E402
 from deepspeed_tpu.models.llama import Llama, LlamaConfig  # noqa: E402
 from deepspeed_tpu.utils import groups  # noqa: E402
 
+# every bench row accumulates here; write_local_report() flushes the
+# tree-local artifact (also mid-run on interruption — see main())
+RESULTS = []
+
+
+def _record(row):
+    RESULTS.append(row)
+    print(json.dumps(row))
+    return row
+
+
+def write_local_report(error=None):
+    """Write SERVE_local.json at the repo root with whatever rows exist
+    so far. Never raises (an unwritable tree must not mask the bench's
+    own output)."""
+    report = {
+        "metric": "v2 serving suite (throughput + TTFT/TPOT percentiles)",
+        "rows": RESULTS,
+        "devices": len(jax.devices()),
+        "backend": jax.default_backend(),
+    }
+    if error:
+        report["interrupted"] = error
+    try:
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "SERVE_local.json")
+        with open(path, "w") as f:
+            json.dump(report, f, indent=2)
+            f.write("\n")
+    except OSError as e:
+        print(json.dumps({"local_artifact_error": str(e)[:200]}))
+    return report
+
+
+def _pct(arr, p, nd=1):
+    """Guarded percentile: None instead of a crash/NaN when no request
+    produced the statistic (e.g. every request finished inside its
+    first dispatch, leaving no inter-token gaps)."""
+    if arr is None or len(arr) == 0:
+        return None
+    return round(float(np.percentile(np.asarray(arr, np.float64), p)), nd)
+
+
+def _poisson_drive(engine, prompts, arrivals, decode_tokens):
+    """Shared open-loop driver (bench_sla + bench_mixed_traffic):
+    submit ``prompts[i]`` once ``arrivals[i]`` seconds have elapsed,
+    run the scheduler until drained. Returns (tok_times: uid -> [t0,
+    t1, ...] per-token wall timestamps, submit: uid -> arrival_s,
+    wall_s)."""
+    tok_times, submit = {}, {}
+    n = len(prompts)
+    start = time.perf_counter()
+    i = 0
+    while i < n or engine.has_work:
+        now = time.perf_counter() - start
+        while i < n and arrivals[i] <= now:
+            uid = engine.put(prompts[i], max_new_tokens=decode_tokens,
+                             eos_token_id=-1)
+            submit[uid] = arrivals[i]
+            tok_times[uid] = []
+            i += 1
+        if not engine.has_work:
+            time.sleep(min(0.005, max(0.0, arrivals[i] - now)))
+            continue
+        out = engine.step()
+        t = time.perf_counter() - start
+        for uid, _tok in out:
+            tok_times[uid].append(t)
+    return tok_times, submit, time.perf_counter() - start
+
 
 def build_model(name):
+    if name == "tiny":
+        # smoke-test point (CPU / CI): exercises every serving program
+        # in seconds; not a measurement target
+        from deepspeed_tpu.models import GPT2Config
+        return GPT2(GPT2Config(n_layer=2, n_head=4, d_model=64,
+                               max_seq_len=1024, vocab_size=512,
+                               remat=False, dtype="float32"))
     if name == "gpt2-350M":
         from dataclasses import replace
         return GPT2(replace(PRESETS["350M"], max_seq_len=2048))
@@ -114,8 +202,7 @@ def bench_one(name, batch, prompt_len, decode_tokens, block_size=128):
         "prefill_s": round(t_prefill, 3),
         "devices": len(jax.devices()),
     }
-    print(json.dumps(out))
-    return out
+    return _record(out)
 
 
 def bench_splitfuse(name, prompt_len, chunk, decode_tokens,
@@ -165,8 +252,7 @@ def bench_splitfuse(name, prompt_len, chunk, decode_tokens,
             round(during / t_during, 1) if t_during else None),
         "devices": len(jax.devices()),
     }
-    print(json.dumps(out))
-    return out
+    return _record(out)
 
 
 def bench_quant(name="llama2-7b", decode_tokens=32, block_size=128):
@@ -210,8 +296,7 @@ def bench_quant(name="llama2-7b", decode_tokens=32, block_size=128):
                  "weight-only serving fits"),
         "devices": len(jax.devices()),
     }
-    print(json.dumps(out))
-    return out
+    return _record(out)
 
 
 def bench_kv_offload(name="gpt2-350M", batch=4, prompt_len=512,
@@ -293,8 +378,7 @@ def bench_kv_offload(name="gpt2-350M", batch=4, prompt_len=512,
                           "(~60 MB/s) on this rig; see docstring",
         "devices": len(jax.devices()),
     }
-    print(json.dumps(out))
-    return out
+    return _record(out)
 
 
 def bench_sla(name="gpt2-350M", rates=(1.0, 2.0, 4.0), n_requests=24,
@@ -345,27 +429,8 @@ def bench_sla(name="gpt2-350M", rates=(1.0, 2.0, 4.0), n_requests=24,
             engine.step()
         engine.get(w1), engine.get(w2)
 
-        tok_times = {}          # uid -> [t_first, ..., t_last]
-        submit = {}
-        start = time.perf_counter()
-        i = 0
-        while i < n_requests or engine.has_work:
-            now = time.perf_counter() - start
-            while i < n_requests and arrivals[i] <= now:
-                uid = engine.put(prompts[i],
-                                 max_new_tokens=decode_tokens,
-                                 eos_token_id=-1)
-                submit[uid] = arrivals[i]
-                tok_times[uid] = []
-                i += 1
-            if not engine.has_work:
-                time.sleep(min(0.005, max(0.0, arrivals[i] - now)))
-                continue
-            out = engine.step()
-            t = time.perf_counter() - start
-            for uid, _tok in out:
-                tok_times[uid].append(t)
-        wall = time.perf_counter() - start
+        tok_times, submit, wall = _poisson_drive(
+            engine, prompts[:n_requests], arrivals, decode_tokens)
 
         per_tok = []
         e2e = []
@@ -380,35 +445,181 @@ def bench_sla(name="gpt2-350M", rates=(1.0, 2.0, 4.0), n_requests=24,
             e2e.append(ts[-1] - submit[uid])
             if mean_tok_ms <= sla_ms:
                 met += 1
-        per_tok = np.asarray(per_tok)
-
-        def pct(arr, p, nd):
-            # a run where no request produced tokens (all failed /
-            # killed early) must report None fields, not NaN or a
-            # percentile-of-empty crash
-            if len(arr) == 0:
-                return None
-            return round(float(np.percentile(arr, p)), nd)
-
         row = {
             "model": name, "mode": "sla",
             "splitfuse_tokens": splitfuse,
             "arrival_rate_qps": rate,
             "n_requests": n_requests,
             "prompt_len": prompt_len, "decode_tokens": decode_tokens,
-            "token_latency_ms_p50": pct(per_tok, 50, 1),
-            "token_latency_ms_p95": pct(per_tok, 95, 1),
-            "e2e_s_p50": pct(e2e, 50, 2),
-            "e2e_s_p95": pct(e2e, 95, 2),
+            "token_latency_ms_p50": _pct(per_tok, 50, 1),
+            "token_latency_ms_p95": _pct(per_tok, 95, 1),
+            "e2e_s_p50": _pct(e2e, 50, 2),
+            "e2e_s_p95": _pct(e2e, 95, 2),
             "sla_ms_per_token": sla_ms,
             "goodput_qps": round(met / wall, 2),
             "offered_qps": round(n_requests / wall, 2),
             "dispatch_overhead_ms": round(dispatch_ms, 1),
             "devices": len(jax.devices()),
         }
-        print(json.dumps(row))
-        results.append(row)
+        results.append(_record(row))
     return results
+
+
+def _mixed_one(name, rate, n_requests, long_prompt, short_prompt,
+               long_every, decode_tokens, splitfuse, paged_kernel,
+               block_size, max_batch, seed):
+    """One mixed-traffic run; returns the TTFT/TPOT percentile row."""
+    groups.reset()
+    model = build_model(name)
+    # the long prompt + its decode budget (and the 64-token warm-up
+    # budget) must fit the model's context, whatever model/env combo
+    # was asked for — clamp instead of erroring every variant
+    long_prompt = min(long_prompt,
+                      model.config.max_seq_len - max(decode_tokens, 64))
+    short_prompt = min(short_prompt, long_prompt)
+    engine = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            max_batch_size=max_batch, kv_block_size=block_size,
+            prompt_bucket=min(long_prompt, 512),
+            splitfuse_tokens=splitfuse, paged_kernel=paged_kernel))
+    r = np.random.RandomState(seed)
+    V = model.config.vocab_size
+    arrivals = np.cumsum(r.exponential(1.0 / rate, n_requests))
+    prompts = [r.randint(0, V, (long_prompt if i % long_every == 0
+                                else short_prompt,))
+               for i in range(n_requests)]
+
+    # warm EVERY program the mix will hit: the short and long prefill
+    # shapes (bucketed path) / the chunk + FUSED chunk-while-decoding
+    # programs (SplitFuse path), and the decode loop — a mid-run XLA
+    # compile would land inside some request's TTFT
+    w1 = engine.put(r.randint(0, V, (short_prompt,)),
+                    max_new_tokens=64, eos_token_id=-1)
+    for _ in range(2 + short_prompt // max(1, splitfuse or short_prompt)):
+        engine.step()                  # w1 prefilled + decoding
+    w2 = engine.put(r.randint(0, V, (long_prompt,)), max_new_tokens=4,
+                    eos_token_id=-1)
+    while not (engine.is_done(w1) and engine.is_done(w2)):
+        engine.step()
+    engine.get(w1), engine.get(w2)
+
+    tok_times, submit, wall = _poisson_drive(engine, prompts, arrivals,
+                                             decode_tokens)
+
+    ttft, tpot = [], []
+    first_dispatch_finishers = 0
+    for uid, ts in tok_times.items():
+        if not ts:
+            continue
+        ttft.append(1e3 * (ts[0] - submit[uid]))
+        if len(ts) < 2 or ts[-1] == ts[0]:
+            # the whole budget arrived in one dispatch: there is no
+            # inter-token gap to measure — counted, not divided by zero
+            first_dispatch_finishers += 1
+            continue
+        tpot.append(1e3 * (ts[-1] - ts[0]) / (len(ts) - 1))
+    return {
+        "model": name, "mode": "mixed-traffic",
+        "variant": {"paged_kernel": "on" if paged_kernel else "off",
+                    "splitfuse": "on" if splitfuse else "off"},
+        "arrival_rate_qps": rate, "n_requests": n_requests,
+        "long_prompt": long_prompt, "short_prompt": short_prompt,
+        "long_every": long_every, "decode_tokens": decode_tokens,
+        "splitfuse_tokens": splitfuse,
+        "ttft_ms_p50": _pct(ttft, 50), "ttft_ms_p99": _pct(ttft, 99),
+        "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p99": _pct(tpot, 99),
+        "first_dispatch_finishers": first_dispatch_finishers,
+        "completed": len([1 for ts in tok_times.values() if ts]),
+        "wall_s": round(wall, 2),
+        "devices": len(jax.devices()),
+    }
+
+
+def bench_mixed_traffic(name="gpt2-350M", rate=2.0, n_requests=24,
+                        long_prompt=1024, short_prompt=64, long_every=4,
+                        decode_tokens=64, chunk=256, block_size=64,
+                        max_batch=8, seed=0):
+    """Sustained mixed traffic (ROADMAP item 1's harness): Poisson
+    arrivals where every ``long_every``-th request carries a
+    ``long_prompt``-token prompt and the rest are short decode-heavy
+    requests. Reports p50/p99 TTFT and TPOT SEPARATELY for the 2x2 of
+    paged kernel on/off x SplitFuse on/off — split-fuse holding p99
+    TPOT flat while long prefills stream is the FastGen headline
+    property; the paged-kernel pair isolates the blocked-flash chunk
+    kernel's effect on both tails. A variant that crashes records its
+    error and the sweep continues (partial artifacts beat lost ones)."""
+    rows = []
+    for splitfuse in (chunk, 0):
+        for paged in (True, False):
+            try:
+                rows.append(_record(_mixed_one(
+                    name, rate, n_requests, long_prompt, short_prompt,
+                    long_every, decode_tokens, splitfuse, paged,
+                    block_size, max_batch, seed)))
+            except Exception as e:  # noqa: BLE001 — keep sweeping
+                rows.append(_record({
+                    "model": name, "mode": "mixed-traffic",
+                    "variant": {"paged_kernel": "on" if paged else "off",
+                                "splitfuse": "on" if splitfuse
+                                else "off"},
+                    "error": f"{type(e).__name__}: {e}"[:300]}))
+            write_local_report()       # partial sweep already durable
+    return rows
+
+
+def bench_ep_moe(decode_tokens=16, block_size=16, chunk=16,
+                 expert_parallel=2):
+    """EP Mixtral serving: experts sharded over the 'expert' mesh axis,
+    the FFN routed through the ragged EP all_to_all path
+    (moe/sharded_moe.py moe_swiglu_ragged_ep — the PR-5 fix for
+    GSPMD's silent lax.ragged_dot mis-partition). Asserts greedy
+    parity vs the single-shard engine and reports both decode rates;
+    SplitFuse on, so the chunk program serves through EP too."""
+    if len(jax.devices()) < expert_parallel:
+        return _record({
+            "mode": "ep-moe-serving",
+            "skipped": f"needs >= {expert_parallel} devices, have "
+                       f"{len(jax.devices())}"})
+    from deepspeed_tpu.models.mixtral import Mixtral, MixtralConfig
+    mcfg = MixtralConfig(n_layer=2, n_head=8, n_kv_heads=4, d_model=128,
+                         max_seq_len=256, vocab_size=1024, remat=False,
+                         num_experts=4, moe_top_k=2, dtype="float32")
+    params = Mixtral(mcfg).init(jax.random.key(7))
+    r = np.random.RandomState(0)
+    prompts = [r.randint(0, mcfg.vocab_size, (n,))
+               for n in (24, 40, 9, 33)]
+
+    def run(ep):
+        groups.reset()
+        # float32 serving: the row's point is EXACT greedy parity
+        # through the EP exchange; bf16 reduction reordering across the
+        # all_to_all would turn rounding noise into token flips
+        engine = InferenceEngineV2(
+            Mixtral(mcfg), params=params,
+            config=RaggedInferenceEngineConfig(
+                dtype="float32", max_batch_size=4,
+                kv_block_size=block_size, splitfuse_tokens=chunk,
+                expert_parallel=ep))
+        outs = engine.generate_all(prompts, max_new_tokens=4)  # warm
+        t0 = time.perf_counter()
+        outs = engine.generate_all(prompts,
+                                   max_new_tokens=decode_tokens)
+        dt = time.perf_counter() - t0
+        produced = sum(len(o) for o in outs)
+        return outs, produced / dt
+
+    ref, rate1 = run(1)
+    got, rate_ep = run(expert_parallel)
+    parity = all(np.array_equal(a, b) for a, b in zip(ref, got))
+    return _record({
+        "mode": "ep-moe-serving", "model": "mixtral(2x128,E4)",
+        "expert_parallel": expert_parallel,
+        "splitfuse_tokens": chunk,
+        "greedy_parity_vs_single": parity,
+        "decode_tok_s_ep1": round(rate1, 1),
+        "decode_tok_s_ep": round(rate_ep, 1),
+        "devices": len(jax.devices()),
+    })
 
 
 def main():
@@ -427,6 +638,25 @@ def main():
                             chunk=int(os.environ.get("SERVE_CHUNK",
                                                      "256")),
                             decode_tokens=16)
+    if os.environ.get("SERVE_MIXED", "1") == "1":
+        # off-TPU the paged_kernel=on variants run interpret-mode
+        # Pallas — minutes per token at 350M; default to the tiny
+        # smoke model AND smoke-scale traffic there so a CPU run still
+        # produces all 4 percentile rows in minutes, not hours
+        on_tpu = jax.default_backend() == "tpu"
+        mixed_kw = {} if on_tpu else dict(
+            long_prompt=96, short_prompt=16, decode_tokens=16,
+            chunk=16, block_size=8, max_batch=4, rate=8.0)
+        if "SERVE_MIXED_RATE" in os.environ:
+            mixed_kw["rate"] = float(os.environ["SERVE_MIXED_RATE"])
+        bench_mixed_traffic(
+            name=os.environ.get("SERVE_MIXED_MODEL",
+                                "gpt2-350M" if on_tpu else "tiny"),
+            n_requests=int(os.environ.get("SERVE_MIXED_N",
+                                          "24" if on_tpu else "12")),
+            **mixed_kw)
+    if os.environ.get("SERVE_EP_MOE", "1") == "1":
+        bench_ep_moe()
     if os.environ.get("SERVE_QUANT", ""):
         bench_quant(os.environ["SERVE_QUANT"])
     if os.environ.get("SERVE_KV_OFFLOAD", "") == "1":
@@ -445,4 +675,10 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    try:
+        main()
+    except BaseException as e:       # incl. KeyboardInterrupt/SystemExit
+        write_local_report(error=f"{type(e).__name__}: {e}"[:300])
+        raise
+    else:
+        write_local_report()

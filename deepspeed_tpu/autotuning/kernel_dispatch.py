@@ -86,6 +86,32 @@ def configure_from_config(cfg):
     _STATE["resolved"] = {}
 
 
+def configure_serving(mode="", cache_path=""):
+    """v2-engine hook: apply mode + cache path as the COMPLETE new
+    state (empty string = revert that field to env/default resolution),
+    preserving the search timing knobs — the serving counterpart of
+    ``configure_from_config``, with the same complete-state contract:
+    each engine's construction (and, for the v2 engine, each of its
+    program traces) owns the process dispatch state; explicit modes or
+    cache paths never leak between engines.
+
+    No-op when the target state is already installed, so the v2
+    engine's per-trace re-install keeps the resolution memo and the
+    loaded cache — search mode still measures once per process, and
+    the cache file is not re-read per trace."""
+    if mode and mode not in MODES:
+        raise ValueError(
+            f"autotune mode must be one of {MODES}, got {mode!r}")
+    new_mode, new_path = mode or None, cache_path or None
+    if (_STATE["mode"] == new_mode
+            and _STATE["cache_path"] == new_path):
+        return
+    _STATE["mode"] = new_mode
+    _STATE["cache_path"] = new_path
+    _STATE["cache"] = None
+    _STATE["resolved"] = {}
+
+
 def reset():
     """Back to pristine env-driven state (tests)."""
     _STATE.update(mode=None, cache_path=None, cache=None, resolved={},

@@ -493,6 +493,152 @@ def _ce_parity(b, dtype, params):
            f"fused_ce gold {params}", dict(rtol=2e-2, atol=2e-2))
 
 
+# ------------------------------------------------- paged serving kernels
+# The v2 engine's decode step and SplitFuse chunk program (ops/pallas/
+# paged_attention.py). Buckets are the engine's decode shapes — (batch
+# slots | chunk tokens, blocks-per-seq, block size, kv-heads, GQA
+# group, head dim) — so each compiled per-bucket serving program
+# resolves its own winner. Both are forward-only serving ops: steps
+# chain the attention output back into q for data dependence.
+
+
+def _pgd_defaults(b):
+    from ..ops.pallas.paged_attention import PAGED_DECODE_DEFAULTS
+    return dict(PAGED_DECODE_DEFAULTS)
+
+
+def _pgd_candidates(b):
+    """The serving lever: blocked-stream Pallas kernel vs the
+    dense-gather program (the measured choice the engine's
+    paged_kernel="auto" takes per decode-shape bucket)."""
+    return _dedup([_pgd_defaults(b), {"mode": "dense"}])
+
+
+def _pgd_args(b, dtype, rng):
+    B, MB, BS = b["B"], b["MB"], b["BS"]
+    KVH, G, d = b["kh"], b["g"], b["d"]
+    NB = 2 * MB + 1
+    ks = jax.random.split(rng, 5)
+    q = jax.random.normal(ks[0], (B, KVH * G, d), dtype)
+    kc = jax.random.normal(ks[1], (NB, KVH, BS, d), dtype)
+    vc = jax.random.normal(ks[2], (NB, KVH, BS, d), dtype)
+    tables = jax.random.randint(ks[3], (B, MB), 0, NB, jnp.int32)
+    lengths = jax.random.randint(ks[4], (B,), 0, MB * BS, jnp.int32)
+    return q, kc, vc, tables, lengths
+
+
+def _pgd_fn(params):
+    from ..ops.pallas.paged_attention import (
+        paged_decode_attention, paged_decode_attention_reference)
+    return paged_decode_attention_reference if params["mode"] == "dense" \
+        else paged_decode_attention
+
+
+def _pgd_step(b, dtype, params):
+    f = _pgd_fn(params)
+
+    def step(carry):
+        q, kc, vc, tables, lengths = carry
+        o = f(q, kc, vc, tables, lengths)
+        return (q + _EPS * o.astype(q.dtype), kc, vc, tables, lengths)
+
+    return step, _pgd_args(b, dtype, jax.random.key(0))
+
+
+def _pgd_parity(b, dtype, params):
+    from ..ops.pallas.paged_attention import (
+        paged_decode_attention_reference)
+    q, kc, vc, tables, lengths = _pgd_args(b, dtype, jax.random.key(1))
+    got = _pgd_fn(params)(q, kc, vc, tables, lengths)
+    ref = paged_decode_attention_reference(q, kc, vc, tables, lengths)
+    _close(got, ref, f"paged_decode tuned {params}")
+
+
+def _pgc_defaults(b):
+    from ..ops.pallas.paged_attention import paged_chunk_tune_defaults
+    return paged_chunk_tune_defaults()
+
+
+def _pgc_candidates(b):
+    """Kernel-vs-dense plus the chunk-token tile sweep. Sweep entries
+    carry the CLAMPED tile (min(bc, C) — what the wrapper executes), so
+    two nominal tiles that clamp to one program are never both timed
+    and the cached winner records the tile that actually ran."""
+    from ..ops.pallas.paged_attention import PAGED_CHUNK_BLOCK_C
+    C = b["C"]
+    d = _pgc_defaults(b)
+    cands = [d, {"mode": "dense", "block_c": PAGED_CHUNK_BLOCK_C}]
+    eff_seen = {min(int(d["block_c"]), C)} if d["mode"] == "kernel" \
+        else set()
+    for bc in (64, 128, 256):
+        eff = min(bc, C)
+        if eff not in eff_seen:
+            eff_seen.add(eff)
+            cands.append({"mode": "kernel", "block_c": eff})
+    return _dedup(cands)
+
+
+def _pgc_shapes(b):
+    C, MB, BS = b["C"], b["MB"], b["BS"]
+    # a mid-sequence chunk straddling block boundaries, partially real
+    S = MB * BS
+    start = min(max(S // 2, 1), max(S - C, 0))
+    true_len = max(1, min(C - 1, S - start))
+    return start, true_len
+
+
+def _pgc_args(b, dtype, rng):
+    C, MB, BS = b["C"], b["MB"], b["BS"]
+    KVH, G, d = b["kh"], b["g"], b["d"]
+    NB = 2 * MB + 1
+    ks = jax.random.split(rng, 4)
+    q = jax.random.normal(ks[0], (C, KVH * G, d), dtype)
+    kc = jax.random.normal(ks[1], (NB, KVH, BS, d), dtype)
+    vc = jax.random.normal(ks[2], (NB, KVH, BS, d), dtype)
+    table = jax.random.randint(ks[3], (MB,), 0, NB, jnp.int32)
+    return q, kc, vc, table
+
+
+def _pgc_fn(b, params):
+    from ..ops.pallas.paged_attention import (
+        paged_chunk_attention, paged_chunk_attention_reference)
+    start, true_len = _pgc_shapes(b)
+
+    def f(q, kc, vc, table):
+        if params["mode"] == "dense":
+            return paged_chunk_attention_reference(
+                q, kc, vc, table, jnp.int32(start), jnp.int32(true_len))
+        return paged_chunk_attention(
+            q, kc, vc, table, jnp.int32(start), jnp.int32(true_len),
+            block_c=int(params["block_c"]))
+    return f
+
+
+def _pgc_step(b, dtype, params):
+    f = _pgc_fn(b, params)
+
+    def step(carry):
+        q, kc, vc, table = carry
+        o = f(q, kc, vc, table)
+        return (q + _EPS * o.astype(q.dtype), kc, vc, table)
+
+    return step, _pgc_args(b, dtype, jax.random.key(0))
+
+
+def _pgc_parity(b, dtype, params):
+    from ..ops.pallas.paged_attention import (
+        paged_chunk_attention_reference)
+    start, true_len = _pgc_shapes(b)
+    q, kc, vc, table = _pgc_args(b, dtype, jax.random.key(1))
+    got = _pgc_fn(b, params)(q, kc, vc, table)
+    ref = paged_chunk_attention_reference(
+        q, kc, vc, table, jnp.int32(start), jnp.int32(true_len))
+    # pad q rows (>= true_len) attend partly-garbage positions by
+    # design; their outputs are discarded by the chunk program
+    _close(got[:true_len], ref[:true_len],
+           f"paged_chunk tuned {params}")
+
+
 # ---------------------------------------------------------------- table
 REGISTRY = {
     "flash_attention": {
@@ -524,5 +670,17 @@ REGISTRY = {
         "candidates": _ring_candidates,
         "make_step": _ring_step,
         "parity": _ring_parity,
+    },
+    "paged_decode": {
+        "defaults": _pgd_defaults,
+        "candidates": _pgd_candidates,
+        "make_step": _pgd_step,
+        "parity": _pgd_parity,
+    },
+    "paged_chunk": {
+        "defaults": _pgc_defaults,
+        "candidates": _pgc_candidates,
+        "make_step": _pgc_step,
+        "parity": _pgc_parity,
     },
 }

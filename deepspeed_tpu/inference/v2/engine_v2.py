@@ -76,6 +76,54 @@ class RaggedInferenceEngineConfig:
     # group's compute (inference/v2/kv_offload.py)
     kv_host_offload: bool = False
     device_kv_blocks: int = 0        # required > 1 when kv_host_offload
+    # Pallas paged-attention kernels on the serving hot path (the
+    # reference's ragged_ops blocked_flash role): governs BOTH the
+    # decode step and the SplitFuse chunk/prefill programs.
+    #   "auto" (default): the autotune winner cache's measured choice
+    #     per decode-shape bucket; a cold cache keeps the proven
+    #     defaults (decode kernel everywhere; chunk kernel on TPU,
+    #     dense-gather elsewhere).
+    #   True/False force the kernel / the dense-gather parity fallback.
+    # ALiBi model families keep the decode kernel regardless (the dense
+    # fallback lacks the falcon bf16-quantized bias variant).
+    paged_kernel: object = "auto"
+    # chunk-kernel q-tile (tokens per grid step): "auto" = the winner
+    # cache's tile for this (chunk, blocks, kv-heads, dtype) bucket,
+    # int forces
+    paged_block_c: object = "auto"
+    # serving-side autotune dispatch state, applied COMPLETE at engine
+    # construction and at this engine's program traces ("" = env/default
+    # resolution — DSTPU_AUTOTUNE, default cache_only; an earlier
+    # engine's explicit setting never leaks in): off | cache_only |
+    # on_first_use | search, and the winner-cache file path
+    # ("" = DSTPU_AUTOTUNE_CACHE / default path). Same convention as
+    # the training engine's ``autotune`` config block: dispatch state
+    # is process-global and the last engine to construct (or, for v2,
+    # to trace) owns it — a process mixing engines with DIFFERENT
+    # explicit autotune settings should give each its own process.
+    autotune_mode: str = ""
+    autotune_cache: str = ""
+
+    def __post_init__(self):
+        if self.paged_kernel not in (True, False, "auto"):
+            raise ValueError(
+                f"paged_kernel must be true|false|'auto', got "
+                f"{self.paged_kernel!r}")
+        if self.paged_block_c != "auto" and (
+                not isinstance(self.paged_block_c, int)
+                or self.paged_block_c < 1):
+            raise ValueError(
+                f"paged_block_c must be 'auto' or a positive int, got "
+                f"{self.paged_block_c!r}")
+        if self.autotune_mode not in ("", "off", "cache_only",
+                                      "on_first_use", "search"):
+            raise ValueError(
+                f"autotune_mode must be ''|off|cache_only|on_first_use|"
+                f"search, got {self.autotune_mode!r}")
+        if self.splitfuse_tokens < 0:
+            raise ValueError(
+                f"splitfuse_tokens must be >= 0, got "
+                f"{self.splitfuse_tokens}")
 
 
 @dataclass
@@ -102,6 +150,15 @@ class InferenceEngineV2:
         self.model = model
         mcfg = model.config
         self.max_seq_len = mcfg.max_seq_len
+
+        # serving-side measured dispatch: apply the engine's autotune
+        # fields + paged-kernel knobs once now, and again at the top of
+        # every program TRACE (_install_trace_state) — the knobs live
+        # on the (possibly shared) model object and in process-global
+        # dispatch state, and traces are lazy, so without the re-install
+        # a later-constructed engine sharing this model would silently
+        # steer this engine's (re-)traces
+        self._install_trace_state()
 
         if topology is None:
             topology = groups.initialize(TopologyConfig(
@@ -225,6 +282,22 @@ class InferenceEngineV2:
         return bool(self._pending) or self.state_mgr.n_active > 0
 
     # ------------------------------------------------------------- programs
+    def _install_trace_state(self):
+        """(Re)apply THIS engine's kernel/autotune knobs: the model
+        attributes the paged paths read and the process dispatch state
+        ("" = env/default; an earlier engine's explicit mode or cache
+        path never leaks in). Called in __init__ and — because jax
+        re-traces lazily per shape bucket — at trace time inside every
+        program, so engines sharing one model object each trace under
+        their own config (pure python side effect; nothing lands in
+        the compiled program)."""
+        from ...autotuning import kernel_dispatch
+        kernel_dispatch.configure_serving(
+            mode=self.config.autotune_mode,
+            cache_path=self.config.autotune_cache)
+        self.model._paged_kernel = self.config.paged_kernel
+        self.model._paged_block_c = self.config.paged_block_c
+
     @staticmethod
     def _sample_per_slot(logits, rng, temps, top_ks, all_greedy=False):
         """Vectorized per-request sampling (FastGen carries sampling
@@ -255,6 +328,7 @@ class InferenceEngineV2:
 
             def prefill(params, cache, ids, tb, to, length, rng, temp,
                         top_k, all_greedy):
+                self._install_trace_state()
                 logits, cache = model.apply_paged_prefill(
                     params, ids, cache, tb, to, length)
                 tok = self._sample_per_slot(logits, rng, temp, top_k,
@@ -275,6 +349,7 @@ class InferenceEngineV2:
 
             def decode(params, cache, tokens, lengths, tables, rng,
                        temps, top_ks, all_greedy):
+                self._install_trace_state()
                 # n decode steps in ONE program: the sampled token feeds
                 # the next step in-trace, so the host round trip (token
                 # sync + batch re-upload + dispatch latency) amortizes
@@ -313,6 +388,7 @@ class InferenceEngineV2:
             def fused(params, cache, c_ids, c_tb, c_to, c_start, c_len,
                       c_table, c_temp, c_topk, d_tokens, d_lengths,
                       d_tables, rng, d_temps, d_topks, all_greedy):
+                self._install_trace_state()
                 c_logits, cache = model.apply_paged_chunk(
                     params, c_ids, cache, c_tb, c_to, c_start, c_len,
                     c_table)
@@ -346,6 +422,7 @@ class InferenceEngineV2:
 
             def chunk(params, cache, c_ids, c_tb, c_to, c_start, c_len,
                       c_table, c_temp, c_topk, rng, all_greedy):
+                self._install_trace_state()
                 c_logits, cache = model.apply_paged_chunk(
                     params, c_ids, cache, c_tb, c_to, c_start, c_len,
                     c_table)

@@ -898,6 +898,12 @@ class GPT2:
         token_offsets: (T_pad,) destination block / in-block slot per
         position (pads point at scratch block 0); length: scalar true
         prompt length. Returns (logits (1, V) at position length-1, cache).
+
+        The kernel path (engine ``paged_kernel``) runs the chunked
+        paged kernel with ``start=0`` over the prompt's own blocks
+        (table derived from the per-token destinations): causally-dead
+        and beyond-length blocks are skipped instead of masked after a
+        full (T, T) score matrix.
         """
         cfg = self.config
         dt = _dtype(cfg)
@@ -909,6 +915,17 @@ class GPT2:
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
         mask = causal & valid[None, :]
         qp, kp = jnp.arange(T)[:, None], jnp.arange(T)[None, :]
+        BS = cache["k"][0].shape[2]
+        # every block the prompt touches, from its per-token placement
+        # (tokens are laid contiguously from position 0, so position
+        # m*BS's destination block IS table entry m; pads are scratch 0)
+        prefill_table = token_blocks[::BS]
+        from ..ops.pallas.paged_attention import (paged_chunk_attention,
+                                                  resolve_paged_chunk)
+        use_kernel, block_c = resolve_paged_chunk(
+            getattr(self, "_paged_kernel", "auto"),
+            getattr(self, "_paged_block_c", "auto"),
+            T, prefill_table.shape[0], BS, cfg.n_head, 1, hd, dt)
 
         ks_out, vs_out = [], []
         for i in range(cfg.n_layer):
@@ -917,12 +934,18 @@ class GPT2:
             w = cfg.attn_layer_windows[i] if cfg.attn_layer_windows else 0
             m = mask & (qp - kp < w) if w else mask
 
-            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0, m=m):
+            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0, m=m, w=w):
                 # in-place scatter on this layer's own donated pool buffer
                 kc = kc0.at[token_blocks, :, token_offsets].set(
                     kk[0].astype(kc0.dtype))
                 vc = vc0.at[token_blocks, :, token_offsets].set(
                     v[0].astype(vc0.dtype))
+                if use_kernel:
+                    attn = paged_chunk_attention(
+                        q[0], kc, vc, prefill_table, jnp.int32(0),
+                        length, scale=None if cfg.scale_attn else 1.0,
+                        window=w, block_c=block_c)
+                    return attn[None], (kc, vc)
                 scores = jnp.einsum("bthd,bshd->bhts", q, kk,
                                     preferred_element_type=jnp.float32)
                 if cfg.scale_attn:
@@ -942,7 +965,13 @@ class GPT2:
                           token_offsets, start, true_len, table):
         """Prefill ONE CHUNK of one sequence into the paged cache (the
         Dynamic SplitFuse chunk program; see Llama.apply_paged_chunk —
-        same contract, GPT-2's learned positions and full-head cache)."""
+        same contract, GPT-2's learned positions and full-head cache).
+
+        On the kernel path (engine ``paged_kernel``; "auto" = the
+        autotune winner cache's choice, kernel on TPU / dense-gather
+        elsewhere on a cold cache) attention runs the Pallas
+        chunked-prefill paged kernel reading K/V straight through the
+        block table — the full (S, H, hd) gather never materializes."""
         cfg = self.config
         dt = _dtype(cfg)
         C = input_ids.shape[1]
@@ -955,6 +984,12 @@ class GPT2:
         q_pos = (start + jnp.arange(C))[:, None]
         k_pos = jnp.arange(S)[None, :]
         mask = (k_pos <= q_pos) & (k_pos < start + true_len)
+        from ..ops.pallas.paged_attention import (paged_chunk_attention,
+                                                  resolve_paged_chunk)
+        use_kernel, block_c = resolve_paged_chunk(
+            getattr(self, "_paged_kernel", "auto"),
+            getattr(self, "_paged_block_c", "auto"),
+            C, table.shape[0], BS, H, 1, hd, dt)
 
         ks_out, vs_out = [], []
         for i in range(cfg.n_layer):
@@ -963,11 +998,17 @@ class GPT2:
             w = cfg.attn_layer_windows[i] if cfg.attn_layer_windows else 0
             m = mask & (q_pos - k_pos < w) if w else mask
 
-            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0, m=m):
+            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0, m=m, w=w):
                 kc = kc0.at[token_blocks, :, token_offsets].set(
                     kk[0].astype(kc0.dtype))
                 vc = vc0.at[token_blocks, :, token_offsets].set(
                     v[0].astype(vc0.dtype))
+                if use_kernel:
+                    attn = paged_chunk_attention(
+                        q[0], kc, vc, table, start, true_len,
+                        scale=None if cfg.scale_attn else 1.0,
+                        window=w, block_c=block_c)
+                    return attn[None], (kc, vc)
                 gk = kc[table].transpose(0, 2, 1, 3).reshape(S, H, hd)
                 gv = vc[table].transpose(0, 2, 1, 3).reshape(S, H, hd)
                 scores = jnp.einsum("bthd,shd->bhts", q, gk,
@@ -1004,6 +1045,11 @@ class GPT2:
         dst_block = jnp.take_along_axis(
             block_tables, (lengths // BS)[:, None], axis=1)[:, 0]
         dst_off = lengths % BS
+        from ..ops.pallas.paged_attention import resolve_paged_decode
+        use_kernel = resolve_paged_decode(
+            getattr(self, "_paged_kernel", "auto"), B,
+            block_tables.shape[1], BS, cfg.n_head, 1, cfg.d_head,
+            _dtype(cfg))
 
         ks_out, vs_out = [], []
         for i in range(cfg.n_layer):
@@ -1016,14 +1062,19 @@ class GPT2:
                 # In-place write into this layer's donated pool, then the
                 # Pallas paged kernel reads K/V straight through the block
                 # table (no dense gather; reference
-                # inference/v2/kernels/ragged_ops blocked_flash)
+                # inference/v2/kernels/ragged_ops blocked_flash). The
+                # dense-gather reference stays behind paged_kernel=False
+                # as the parity/A-B fallback.
                 from ..ops.pallas.paged_attention import (
-                    paged_decode_attention)
+                    paged_decode_attention,
+                    paged_decode_attention_reference)
                 kc = kc0.at[dst_block, :, dst_off].set(
                     kk[:, 0].astype(kc0.dtype))
                 vc = vc0.at[dst_block, :, dst_off].set(
                     v[:, 0].astype(vc0.dtype))
-                attn = paged_decode_attention(
+                fn = paged_decode_attention if use_kernel \
+                    else paged_decode_attention_reference
+                attn = fn(
                     q[:, 0], kc, vc, block_tables, lengths,
                     scale=None if cfg.scale_attn else 1.0, window=w)
                 return attn[:, None], (kc, vc)

@@ -662,6 +662,18 @@ class Llama:
         mask = jnp.tril(jnp.ones((T, T), jnp.bool_)) & valid[None, :]
         mask = self._window_mask(mask, jnp.arange(T)[:, None],
                                  jnp.arange(T)[None, :])
+        BS = cache["k"][0].shape[2]
+        prefill_table = token_blocks[::BS]   # see GPT2.apply_paged_prefill
+        from ..ops.pallas.paged_attention import (paged_chunk_attention,
+                                                  resolve_paged_chunk)
+        # ALiBi stays dense: the chunk kernel has no per-head bias
+        # input (forced-off BEFORE dispatch, so no search is paid for
+        # a kernel tile the model can never use)
+        use_kernel, block_c = resolve_paged_chunk(
+            False if cfg.alibi else getattr(self, "_paged_kernel",
+                                            "auto"),
+            getattr(self, "_paged_block_c", "auto"),
+            T, prefill_table.shape[0], BS, KVH, H // KVH, hd, dt)
 
         ks_out, vs_out = [], []
         for i in range(cfg.n_layer):
@@ -675,17 +687,24 @@ class Llama:
                 kk[0].astype(kc0.dtype))
             vc = vc0.at[token_blocks, :, token_offsets].set(
                 v[0].astype(vc0.dtype))
-            ku = _repeat_kv(kk, H // KVH)
-            vu = _repeat_kv(v, H // KVH)
-            scores = jnp.einsum("bthd,bshd->bhts", q, ku,
-                                preferred_element_type=jnp.float32)
-            scores = scores / math.sqrt(hd)
-            if cfg.alibi:
-                scores = scores + self._alibi_bias(
-                    jnp.arange(T))[None, :, None, :]
-            scores = jnp.where(mask[None, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-            attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
+            if use_kernel:
+                # GQA-native blocked stream over the prompt's own
+                # blocks (no repeat_kv, no (T, T) full-score pass)
+                attn = paged_chunk_attention(
+                    q[0], kc, vc, prefill_table, jnp.int32(0), length,
+                    window=cfg.sliding_window, block_c=block_c)[None]
+            else:
+                ku = _repeat_kv(kk, H // KVH)
+                vu = _repeat_kv(v, H // KVH)
+                scores = jnp.einsum("bthd,bshd->bhts", q, ku,
+                                    preferred_element_type=jnp.float32)
+                scores = scores / math.sqrt(hd)
+                if cfg.alibi:
+                    scores = scores + self._alibi_bias(
+                        jnp.arange(T))[None, :, None, :]
+                scores = jnp.where(mask[None, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+                attn = jnp.einsum("bhts,bshd->bthd", probs, vu)
             attn_out = self._wo(attn.reshape(1, T, H * hd), layer)
             if cfg.parallel_block:
                 x = x + attn_out + self._mlp(x, layer)
@@ -731,6 +750,13 @@ class Llama:
         k_pos = jnp.arange(S)[None, :]                 # (1, S)
         mask = (k_pos <= q_pos) & (k_pos < start + true_len)
         mask = self._window_mask(mask, q_pos, k_pos)
+        from ..ops.pallas.paged_attention import (paged_chunk_attention,
+                                                  resolve_paged_chunk)
+        use_kernel, block_c = resolve_paged_chunk(
+            False if cfg.alibi else getattr(self, "_paged_kernel",
+                                            "auto"),   # no bias input
+            getattr(self, "_paged_block_c", "auto"),
+            C, table.shape[0], BS, KVH, H // KVH, hd, dt)
 
         ks_out, vs_out = [], []
         for i in range(cfg.n_layer):
@@ -743,22 +769,30 @@ class Llama:
                 kk[0].astype(kc0.dtype))
             vc = vc0.at[token_blocks, :, token_offsets].set(
                 v[0].astype(vc0.dtype))
-            # gather the sequence's full K/V range through its table:
-            # (MB, KVH, BS, hd) -> (S, KVH, hd); in-cache layout is
-            # heads-major, so one transpose per gathered block row
-            gk = kc[table].transpose(0, 2, 1, 3).reshape(S, KVH, hd)
-            gv = vc[table].transpose(0, 2, 1, 3).reshape(S, KVH, hd)
-            gk = _repeat_kv(gk[None], H // KVH)[0]
-            gv = _repeat_kv(gv[None], H // KVH)[0]
-            scores = jnp.einsum("bthd,shd->bhts", q, gk,
-                                preferred_element_type=jnp.float32)
-            scores = scores / math.sqrt(hd)
-            if cfg.alibi:
-                scores = scores + self._alibi_bias(
-                    jnp.arange(S))[None, :, None, :]
-            scores = jnp.where(mask[None, None], scores, -1e30)
-            probs = jax.nn.softmax(scores, axis=-1).astype(dt)
-            attn = jnp.einsum("bhts,shd->bthd", probs, gv)
+            if use_kernel:
+                # blocked-flash chunk kernel: each KV block streams
+                # through VMEM once, located via the block table; the
+                # (S, H, hd) gather + repeat_kv copies never exist
+                attn = paged_chunk_attention(
+                    q[0], kc, vc, table, start, true_len,
+                    window=cfg.sliding_window, block_c=block_c)[None]
+            else:
+                # gather the sequence's full K/V range through its
+                # table: (MB, KVH, BS, hd) -> (S, KVH, hd); in-cache
+                # layout is heads-major, so one transpose per row
+                gk = kc[table].transpose(0, 2, 1, 3).reshape(S, KVH, hd)
+                gv = vc[table].transpose(0, 2, 1, 3).reshape(S, KVH, hd)
+                gk = _repeat_kv(gk[None], H // KVH)[0]
+                gv = _repeat_kv(gv[None], H // KVH)[0]
+                scores = jnp.einsum("bthd,shd->bhts", q, gk,
+                                    preferred_element_type=jnp.float32)
+                scores = scores / math.sqrt(hd)
+                if cfg.alibi:
+                    scores = scores + self._alibi_bias(
+                        jnp.arange(S))[None, :, None, :]
+                scores = jnp.where(mask[None, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+                attn = jnp.einsum("bhts,shd->bthd", probs, gv)
             attn_out = self._wo(attn.reshape(1, C, H * hd), layer)
             if cfg.parallel_block:
                 x = x + attn_out + self._mlp(x, layer)
@@ -786,6 +820,13 @@ class Llama:
         dst_block = jnp.take_along_axis(
             block_tables, (lengths // BS)[:, None], axis=1)[:, 0]
         dst_off = lengths % BS
+        from ..ops.pallas.paged_attention import resolve_paged_decode
+        # ALiBi families keep the kernel regardless of the mode switch
+        # (the dense fallback lacks the falcon bf16-quantized variant)
+        use_kernel = cfg.alibi or resolve_paged_decode(
+            getattr(self, "_paged_kernel", "auto"), tokens.shape[0],
+            block_tables.shape[1], BS, cfg.n_kv_heads,
+            H // cfg.n_kv_heads, hd, dt)
 
         ks_out, vs_out = [], []
         for i in range(cfg.n_layer):
@@ -800,16 +841,24 @@ class Llama:
                 v[:, 0].astype(vc0.dtype))
             # Pallas paged kernel: GQA-native (no repeat_kv copies), K/V
             # read straight through the block table (reference
-            # inference/v2/kernels/ragged_ops blocked_flash)
-            from ..ops.pallas.paged_attention import (alibi_slopes,
-                                                      paged_decode_attention)
-            attn = paged_decode_attention(
-                q[:, 0], kc, vc, block_tables, lengths,
-                window=cfg.sliding_window,
-                alibi_slopes=(alibi_slopes(H) if cfg.alibi else None),
-                alibi_scale=(1.0 / math.sqrt(hd)
-                             if cfg.alibi_inv_norm else 1.0),
-                alibi_bf16=cfg.alibi_inv_norm)
+            # inference/v2/kernels/ragged_ops blocked_flash); dense
+            # gather behind paged_kernel=False as the parity fallback
+            from ..ops.pallas.paged_attention import (
+                alibi_slopes, paged_decode_attention,
+                paged_decode_attention_reference)
+            if use_kernel:
+                attn = paged_decode_attention(
+                    q[:, 0], kc, vc, block_tables, lengths,
+                    window=cfg.sliding_window,
+                    alibi_slopes=(alibi_slopes(H) if cfg.alibi
+                                  else None),
+                    alibi_scale=(1.0 / math.sqrt(hd)
+                                 if cfg.alibi_inv_norm else 1.0),
+                    alibi_bf16=cfg.alibi_inv_norm)
+            else:
+                attn = paged_decode_attention_reference(
+                    q[:, 0], kc, vc, block_tables, lengths,
+                    window=cfg.sliding_window)
             attn_out = self._wo(attn.reshape(B, 1, H * hd), layer)
             if cfg.parallel_block:
                 x = x + attn_out + self._mlp(x, layer)

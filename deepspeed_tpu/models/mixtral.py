@@ -7,9 +7,12 @@ moe_gemm). Here the expert FFN is the dropless grouped-GEMM pattern
 (``lax.ragged_dot`` — the moe_gemm role): tokens sort by routed expert,
 each expert multiplies exactly its contiguous group, outputs unsort and
 combine by the top-k router weights. The same ``_mlp`` serves training,
-the contiguous-cache decode, and the v2 paged serving path (all inherited
-from Llama — apply_paged_prefill/apply_paged_decode call ``_mlp``
-per layer).
+the contiguous-cache decode, and ALL THREE v2 paged serving programs
+(inherited from Llama — apply_paged_prefill/apply_paged_chunk/
+apply_paged_decode call ``_mlp`` per layer, so the engine's
+``expert_parallel > 1`` mesh routes every serving dispatch through the
+ragged EP all_to_all below; attention rides Llama's paged Pallas
+kernels under the same engine ``paged_kernel`` knob).
 
 Training note: the router's load-balance aux loss is not threaded through
 Llama's apply (serving-first model); use GPT2MoE for aux-loss-supervised

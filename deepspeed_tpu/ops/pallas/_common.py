@@ -70,6 +70,23 @@ def ce_bucket(N, D, V):
     return f"N{pow2_bucket(N)},D{int(D)},V{int(V)}"
 
 
+def paged_decode_bucket(B, MB, BS, KVH, G, d):
+    """Serving decode-shape bucket: batch slots and blocks-per-seq
+    pow2-rounded (nearby batch mixes share a winner); block size,
+    kv-head count, GQA group and head dim exact (they gate kernel-block
+    validity and the GQA fold)."""
+    return f"B{pow2_bucket(B)},MB{pow2_bucket(MB)},BS{int(BS)}," \
+           f"kh{int(KVH)},g{int(G)},d{int(d)}"
+
+
+def paged_chunk_bucket(C, MB, BS, KVH, G, d):
+    """SplitFuse chunk-shape bucket: the chunk length C is exact (it
+    gates block_c validity — one compiled chunk program per engine
+    config anyway), blocks-per-seq pow2-rounded."""
+    return f"C{int(C)},MB{pow2_bucket(MB)},BS{int(BS)}," \
+           f"kh{int(KVH)},g{int(G)},d{int(d)}"
+
+
 def interpret_default():
     """Kernels run in Pallas interpreter mode off-TPU (unit tests, the
     virtual CPU mesh)."""

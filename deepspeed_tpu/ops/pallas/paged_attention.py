@@ -38,6 +38,72 @@ from ._common import interpret_default as _interpret_default
 
 NEG_INF = -1e30
 
+# ---------------------------------------------------------------- tunables
+# Cold-cache (r05-style proven) parameters for the two serving autotune
+# ops (autotuning/kernel_registry.py registers the search spaces):
+#   paged_decode  mode: 'kernel' everywhere — the decode kernel has been
+#                 the shipped path since it landed (interpret mode off-TPU)
+#   paged_chunk   mode: 'kernel' on TPU (the blocked-flash chunk program),
+#                 'dense' elsewhere — emulating the blocked stream in the
+#                 Pallas interpreter is slower than one dense gather on
+#                 CPU, and the dense path is the proven parity fallback
+PAGED_DECODE_DEFAULTS = {"mode": "kernel"}
+PAGED_CHUNK_BLOCK_C = 128
+
+
+def paged_chunk_tune_defaults():
+    """Cold-cache defaults for the 'paged_chunk' autotune op (the mode
+    is backend-dependent; the winner cache is keyed by device_kind, so
+    the split can never leak across chips)."""
+    on_tpu = jax.default_backend() == "tpu"
+    return {"mode": "kernel" if on_tpu else "dense",
+            "block_c": PAGED_CHUNK_BLOCK_C}
+
+
+def resolve_paged_decode(setting, B, MB, BS, KVH, G, d, dtype):
+    """Resolve an engine/model ``paged_kernel`` setting for the decode
+    step: "auto" consults the autotune winner cache for this
+    decode-shape bucket (batch slots, blocks-per-seq, block size,
+    kv-heads, GQA group, head dim); True/False force. Returns whether
+    the Pallas kernel path is used."""
+    if setting == "auto":
+        from ._common import dispatch, dtype_name, paged_decode_bucket
+        win = dispatch("paged_decode",
+                       paged_decode_bucket(B, MB, BS, KVH, G, d),
+                       dtype_name(dtype), dict(PAGED_DECODE_DEFAULTS))
+        return win["mode"] == "kernel"
+    return bool(setting)
+
+
+def resolve_paged_chunk(setting, block_c, C, MB, BS, KVH, G, d, dtype):
+    """Resolve the chunk-program kernel choice + its q-tile size.
+
+    ``setting``: "auto" | True | False (engine ``paged_kernel``;
+    callers pass False when the kernel path is statically impossible,
+    e.g. ALiBi models); ``block_c``: "auto" | int (engine
+    ``paged_block_c``). "auto" fields resolve against the winner cache
+    for this chunk-shape bucket; cold-cache defaults come from
+    :func:`paged_chunk_tune_defaults`. Returns (use_kernel, block_c).
+
+    The dispatch (which may run a measured search under
+    on_first_use/search) is only consulted when its answer can matter
+    — a forced-off kernel never pays a search for a tile it will
+    discard."""
+    use = None if setting == "auto" else bool(setting)
+    if use is False:
+        return False, (PAGED_CHUNK_BLOCK_C if block_c == "auto"
+                       else int(block_c))
+    win = None
+    if use is None or block_c == "auto":
+        from ._common import dispatch, dtype_name, paged_chunk_bucket
+        win = dispatch("paged_chunk",
+                       paged_chunk_bucket(C, MB, BS, KVH, G, d),
+                       dtype_name(dtype), paged_chunk_tune_defaults())
+    if use is None:
+        use = win["mode"] == "kernel"
+    bc = int(win["block_c"]) if block_c == "auto" else int(block_c)
+    return use, bc
+
 
 def alibi_slopes(n_head):
     """Per-head ALiBi slopes (the bloom formula): for the leading
@@ -236,3 +302,201 @@ def paged_decode_attention_reference(q, k_cache, v_cache, block_tables,
     s = jnp.where(mask[:, None, :], s, NEG_INF)
     p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
     return jnp.einsum("bhs,bshd->bhd", p, gv)
+
+
+# ------------------------------------------------- chunked-prefill kernel
+
+
+def _chunk_kernel(tbl_ref, meta_ref, q_ref, k_ref, v_ref, o_ref,
+                  m_ref, l_ref, acc_ref, *, BS, KVH, G, BC, scale,
+                  window):
+    """One (q-tile, table-entry) grid step of the SplitFuse chunk
+    program: q tile i (BC chunk tokens x G query heads per kv head,
+    folded rows) against the KV block the table's j-th entry names.
+    Causal masking is structural: a block entirely before the tile's
+    first query (and inside the valid-key range) takes the mask-free
+    fast path; only diagonal/limit-straddling blocks build the
+    per-element mask."""
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    start = meta_ref[0]
+    limit = meta_ref[0] + meta_ref[1]            # keys < limit are real
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q_lo = start + i * BC                        # tile's first q position
+    q_hi = q_lo + BC - 1                         # tile's last q position
+    k_lo = j * BS
+    k_hi = k_lo + BS - 1
+    # block liveness (mirrors the KV index map EXACTLY — a clamped
+    # block must never be computed on): some key is real and causally
+    # visible to some query of the tile
+    live = (k_lo < limit) & (k_lo <= q_hi)
+    if window:
+        live = live & (k_hi > q_lo - window)
+    # mask-free fast path: every key visible to every query
+    full = (k_hi <= q_lo) & (k_hi < limit)
+    if window:
+        full = full & (k_lo > q_hi - window)
+
+    def _accumulate(s, vb):
+        """Online-softmax state update from scaled+masked scores
+        s (KVH, BC*G, BS) fp32."""
+        m_prev = m_ref[..., 0]                   # (KVH, BC*G)
+        l_prev = l_ref[..., 0]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new[..., None])
+        l_new = l_prev * alpha + jnp.sum(p, axis=-1)
+        pv = jax.lax.dot_general(
+            p.astype(vb.dtype), vb, (((2,), (1,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32)  # (KVH, BC*G, d)
+        acc_ref[...] = acc_ref[...] * alpha[..., None] + pv
+        m_ref[...] = jnp.broadcast_to(m_new[..., None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[..., None], l_ref.shape)
+
+    def _scores():
+        kb = k_ref[0]                            # (KVH, BS, d)
+        return jax.lax.dot_general(
+            q_ref[...], kb, (((2,), (2,)), ((0,), (0,))),
+            preferred_element_type=jnp.float32) * scale
+
+    @pl.when(live & full)
+    def _full_block():
+        _accumulate(_scores(), v_ref[0])
+
+    @pl.when(live & jnp.logical_not(full))
+    def _masked_block():
+        s = _scores()
+        shape = s.shape                          # (KVH, BC*G, BS)
+        # row r of the folded q dim is chunk token r // G
+        qpos = q_lo + jax.lax.broadcasted_iota(jnp.int32, shape, 1) // G
+        kpos = k_lo + jax.lax.broadcasted_iota(jnp.int32, shape, 2)
+        ok = (kpos <= qpos) & (kpos < limit)
+        if window:
+            ok = ok & (kpos > qpos - window)
+        _accumulate(jnp.where(ok, s, NEG_INF), v_ref[0])
+
+    l = jnp.maximum(l_ref[..., 0], 1e-30)
+    o_ref[...] = (acc_ref[...] / l[..., None]).astype(o_ref.dtype)
+
+
+def paged_chunk_attention(q, k_cache, v_cache, table, start, true_len, *,
+                          scale=None, window=0, block_c="auto",
+                          interpret=None):
+    """A C-token query chunk of ONE sequence attends over that
+    sequence's paged KV blocks — the blocked-flash role of the
+    reference's ragged_ops for the Dynamic SplitFuse chunk program.
+
+    q: (C, H, d) chunk queries (positions start..start+C-1, right-pad
+    rows are don't-care); k_cache/v_cache: (NB, KVH, BS, d) pools that
+    ALREADY hold the chunk's own K/V (callers scatter first, exactly
+    like the decode path); table: (MB,) int32 — the sequence's block
+    table, scratch-padded; start/true_len: scalar int32. Returns
+    (C, H, d) in q's dtype.
+
+    Each KV block is located through the block table via a
+    scalar-prefetch index map and streamed through VMEM once; blocks
+    past ``start + true_len`` (and blocks causally dead for the whole
+    q tile) are clamped to the tile's first table entry in the index
+    map — consecutive repeats of one block id cost no fresh DMA — and
+    skipped in-kernel. Blocks fully before the diagonal take a
+    mask-free path; only straddling blocks build the per-element mask.
+    ``window`` > 0 restricts attention to the trailing window
+    (mistral). GQA is native: q folds to (KVH, C*G, d) and both dots
+    batch over KVH — the dense path's repeat_kv copies never exist.
+    ``block_c``: chunk-token tile ("auto" = the autotune winner cache's
+    choice for this shape bucket; see autotuning/kernel_registry.py
+    'paged_chunk').
+    """
+    C, H, d = q.shape
+    NB, KVH, BS, _ = k_cache.shape
+    MB = table.shape[0]
+    G = H // KVH
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    if interpret is None:
+        interpret = _interpret_default()
+    if block_c == "auto":
+        block_c = resolve_paged_chunk(
+            True, "auto", C, MB, BS, KVH, G, d, q.dtype)[1]
+    BC = max(1, min(int(block_c), C))
+    NC = -(-C // BC)
+    C_pad = NC * BC
+    if C_pad != C:
+        q = jnp.pad(q, ((0, C_pad - C), (0, 0), (0, 0)))
+    # fold (chunk, group) query rows: (C_pad, KVH, G, d) -> (KVH, C_pad*G, d)
+    qf = q.reshape(C_pad, KVH, G, d).transpose(1, 0, 2, 3) \
+        .reshape(KVH, C_pad * G, d)
+    meta = jnp.stack([jnp.asarray(start, jnp.int32),
+                      jnp.asarray(true_len, jnp.int32)])
+
+    def kv_index(i, j, tbl, meta):
+        s0 = meta[0]
+        limit = meta[0] + meta[1]
+        q_lo = s0 + i * BC
+        live = (j * BS < limit) & (j * BS <= q_lo + BC - 1)
+        if window:
+            live = live & (j * BS + BS - 1 > q_lo - window)
+        return (jnp.where(live, tbl[j], tbl[0]), 0, 0, 0)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(NC, MB),
+        in_specs=[
+            pl.BlockSpec((KVH, BC * G, d),
+                         lambda i, j, tbl, meta: (0, i, 0)),
+            pl.BlockSpec((1, KVH, BS, d), kv_index),
+            pl.BlockSpec((1, KVH, BS, d), kv_index),
+        ],
+        out_specs=pl.BlockSpec((KVH, BC * G, d),
+                               lambda i, j, tbl, meta: (0, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((KVH, BC * G, 128), jnp.float32),  # running max
+            pltpu.VMEM((KVH, BC * G, 128), jnp.float32),  # running denom
+            pltpu.VMEM((KVH, BC * G, d), jnp.float32),    # out accumulator
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_chunk_kernel, BS=BS, KVH=KVH, G=G, BC=BC,
+                          scale=float(scale), window=int(window)),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((KVH, C_pad * G, d), q.dtype),
+        interpret=interpret,
+    )(table, meta, qf, k_cache, v_cache)
+    out = out.reshape(KVH, C_pad, G, d).transpose(1, 0, 2, 3) \
+        .reshape(C_pad, H, d)
+    return out[:C]
+
+
+def paged_chunk_attention_reference(q, k_cache, v_cache, table, start,
+                                    true_len, *, scale=None, window=0):
+    """Dense-gather fallback (the pre-kernel chunk path): gather the
+    sequence's whole key range through its table into one (S, H, d)
+    array and run masked dense attention. Parity reference for the
+    kernel and the registry's 'dense' mode."""
+    C, H, d = q.shape
+    NB, KVH, BS, _ = k_cache.shape
+    MB = table.shape[0]
+    S = MB * BS
+    G = H // KVH
+    if scale is None:
+        scale = 1.0 / math.sqrt(d)
+    gk = k_cache[table].transpose(0, 2, 1, 3).reshape(S, KVH, d)
+    gv = v_cache[table].transpose(0, 2, 1, 3).reshape(S, KVH, d)
+    gk = jnp.repeat(gk, G, axis=1)
+    gv = jnp.repeat(gv, G, axis=1)
+    s = jnp.einsum("thd,shd->hts", q, gk,
+                   preferred_element_type=jnp.float32) * scale
+    q_pos = (start + jnp.arange(C))[:, None]
+    k_pos = jnp.arange(S)[None, :]
+    ok = (k_pos <= q_pos) & (k_pos < start + true_len)
+    if window:
+        ok = ok & (q_pos - k_pos < window)
+    s = jnp.where(ok[None], s, NEG_INF)
+    p = jax.nn.softmax(s, axis=-1).astype(q.dtype)
+    return jnp.einsum("hts,shd->thd", p, gv)

@@ -40,12 +40,14 @@ _BUCKETS = {
     "layernorm": "R256,D128",
     "fused_ce": "N128,D128,V384",
     "ring_block": "T64,d32",
+    "paged_decode": "B4,MB4,BS16,kh2,g2,d32",
+    "paged_chunk": "C16,MB4,BS16,kh2,g2,d32",
 }
 
 
 class TestRegistry:
     def test_every_tunable_kernel_has_candidates(self):
-        """Registry completeness: the five tunable Pallas kernel ops
+        """Registry completeness: the tunable Pallas kernel ops
         each expose defaults + a non-empty candidate set whose params
         all share the defaults' key set (a winner can always be merged
         over the defaults)."""

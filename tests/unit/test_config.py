@@ -180,3 +180,42 @@ def test_autotune_validation():
         with pytest.raises(DeepSpeedConfigError):
             DeepSpeedConfig({"train_batch_size": 8, "autotune": bad},
                             dp_world_size=8)
+
+
+# ----------------------------- inference-side serving config (v2 engine)
+
+
+def test_serving_paged_kernel_defaults():
+    from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig
+    cfg = RaggedInferenceEngineConfig()
+    assert cfg.paged_kernel == "auto"
+    assert cfg.paged_block_c == "auto"
+    assert cfg.autotune_mode == ""
+    assert cfg.autotune_cache == ""
+
+
+def test_serving_paged_kernel_validation():
+    from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig
+    for bad in ({"paged_kernel": "yes"},
+                {"paged_block_c": 0},
+                {"paged_block_c": "big"},
+                {"autotune_mode": "always"},
+                {"splitfuse_tokens": -1}):
+        with pytest.raises(ValueError):
+            RaggedInferenceEngineConfig(**bad)
+
+
+def test_serving_config_dict_roundtrip():
+    """The engine accepts plain dicts; the dataclass round-trips through
+    asdict with the new kernel/autotune fields preserved."""
+    from dataclasses import asdict
+    from deepspeed_tpu.inference.v2 import RaggedInferenceEngineConfig
+    d = {"paged_kernel": True, "paged_block_c": 64,
+         "autotune_mode": "cache_only", "autotune_cache": "/tmp/c.json",
+         "splitfuse_tokens": 256, "kv_block_size": 64}
+    cfg = RaggedInferenceEngineConfig(**d)
+    back = asdict(cfg)
+    for k, v in d.items():
+        assert back[k] == v
+    # and the dumped dict reconstructs the identical config
+    assert RaggedInferenceEngineConfig(**back) == cfg

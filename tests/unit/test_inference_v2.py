@@ -205,6 +205,35 @@ class TestEngineV2:
         for a, b in zip(ref, outs):
             np.testing.assert_array_equal(a, b)
 
+    def test_ep_splitfuse_mixtral_matches_single(self):
+        """EP serving through the SplitFuse chunk program: mixtral at
+        expert_parallel=2 with chunked prefill must reproduce the
+        single-shard greedy tokens — the chunk program's expert FFN
+        routes through the ragged EP all_to_all path too (the PR-5
+        GSPMD ragged_dot mis-partition fix)."""
+        from deepspeed_tpu.models.mixtral import Mixtral, MixtralConfig
+        mcfg = MixtralConfig(n_layer=2, n_head=4, n_kv_heads=2,
+                             d_model=64, max_seq_len=128, vocab_size=512,
+                             remat=False, num_experts=4, moe_top_k=2,
+                             dtype="float32")
+        model = Mixtral(mcfg)
+        params = model.init(jax.random.key(5))
+        prompts = [np.arange(20) % 500, (np.arange(7) + 41) % 500]
+        base = {"dtype": "float32", "kv_block_size": 16,
+                "max_batch_size": 2, "splitfuse_tokens": 16}
+
+        groups.reset()
+        single = InferenceEngineV2(model, params=params,
+                                   config=dict(base))
+        ref = single.generate_all(prompts, max_new_tokens=5)
+
+        groups.reset()
+        eng = InferenceEngineV2(model, params=params,
+                                config=dict(base, expert_parallel=2))
+        outs = eng.generate_all(prompts, max_new_tokens=5)
+        for a, b in zip(ref, outs):
+            np.testing.assert_array_equal(a, b)
+
 
 class TestPerRequestSampling:
     def test_mixed_greedy_and_sampled_batch(self):
