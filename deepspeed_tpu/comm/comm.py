@@ -171,6 +171,56 @@ def get_local_device_count():
     return jax.local_device_count()
 
 
+def ring_exchange_bytes(payload, shift=1):
+    """Host-level byte exchange around the PROCESS ring: send ``payload``
+    to process ``(pid + shift) % nprocs`` over the accelerator fabric
+    (ICI within a slice, DCN across slices — where a collective-permute
+    between hosts lands), receive the peer ``shift`` behind us.
+
+    -> (received_bytes, origin_process) — ``(None, None)`` in a
+    single-process world (there is no peer; callers use a local/fs
+    transport instead). Collective: every process must call with the
+    same ``shift`` at the same point, like any other collective. The
+    hot checkpoint tier (checkpoint_engine/hot_tier.py) uses this as
+    its ``dcn`` replica transport; payloads are length-prefixed and
+    padded to the ring-wide max so one permute moves everything.
+    """
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return None, None
+    from jax.experimental import multihost_utils
+    data = np.frombuffer(bytes(payload), dtype=np.uint8)
+    # one length allgather sizes the padded buffer identically everywhere
+    lengths = np.asarray(multihost_utils.process_allgather(
+        np.asarray([data.size], np.int64))).reshape(-1)
+    width = int(lengths.max())
+    buf = np.zeros((width,), np.uint8)
+    buf[:data.size] = data
+    # one device per process, mesh axis 'proc': the permute between
+    # devices of different hosts IS the DCN hop
+    per_proc = {}
+    for d in jax.devices():
+        per_proc.setdefault(d.process_index, d)
+    devices = [per_proc[i] for i in sorted(per_proc)]
+    mesh = jax.sharding.Mesh(np.asarray(devices), ("proc",))
+    perm = [(i, (i + shift) % nproc) for i in range(nproc)]
+
+    def body(x):
+        return lax.ppermute(x, "proc", perm)
+
+    sharding = jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec("proc"))
+    garr = jax.make_array_from_process_local_data(sharding, buf[None, :])
+    shifted = jax.shard_map(
+        body, mesh=mesh,
+        in_specs=jax.sharding.PartitionSpec("proc"),
+        out_specs=jax.sharding.PartitionSpec("proc"))(garr)
+    local = np.asarray(shifted.addressable_shards[0].data).reshape(-1)
+    origin = (jax.process_index() - shift) % nproc
+    n = int(lengths[origin])
+    return local[:n].tobytes(), origin
+
+
 def barrier(name="dstpu_barrier"):
     """Host-level barrier across all processes (works multi-host, where a
     naive jit over the global mesh would reject host-local inputs)."""

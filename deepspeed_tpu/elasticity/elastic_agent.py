@@ -7,18 +7,63 @@ realization supervises the launcher's worker processes directly:
 jax.distributed worlds cannot survive a member loss (the coordinator and
 every collective assume a fixed world), so the recovery unit is the WHOLE
 world — on any worker failure the agent tears the remaining workers down,
-recomputes the world from the surviving hosts (validated against the
-elastic config's admissible chip counts when one is given), and
-relaunches. Workers resume from the latest checkpoint (the engine's
-durable-`latest` pointer), which is the reference's recovery model too.
+recomputes the surviving admissible TOPOLOGY (not just a world size: dp
+is re-derived with the configured tp/ep/pp/sp factors held fixed, then
+validated against the elastic config's admissible chip counts), and
+relaunches. Workers resume from the newest checkpoint generation through
+the tiered load path — the in-memory hot tier's surviving peer replicas
+first (runtime/checkpoint_engine/hot_tier.py; the agent purges dead
+hosts' stores so replicas a lost host held can never serve a restore),
+then the durable 'latest' pointer.
+
+Failures are CLASSIFIED, with per-class restart backoff:
+
+  ``dead``          the worker process exited non-zero — the host is
+                    dropped and the world shrinks;
+  ``hung``          the worker stopped beating (heartbeat_timeout_s) —
+                    killed and dropped like a dead one;
+  ``corrupt_ckpt``  the worker exited with CORRUPT_CKPT_EXIT_CODE
+                    (the engine found generations but none loadable).
+                    The HOST is healthy — it is kept, and the same
+                    world relaunches after the (longer) corrupt-class
+                    backoff, giving shared storage time to settle.
 """
 
+import inspect
 import os
 import re
+import socket
 import time
 
+from ..utils import fault_injection
 from ..utils.logging import logger
 from .elasticity import compute_elastic_config, ElasticityError
+
+# Workers exit with this code when checkpoint generations exist but NONE
+# is loadable: engine.load_checkpoint translates its
+# CheckpointCorruptionError into SystemExit(44) whenever
+# ELASTIC_GENERATION is in the env (the launcher's elastic launch_fn
+# exports it), so any agent-supervised worker reaches this path without
+# writing translation code itself. Distinct from a crash: the host is
+# fine, the CHECKPOINT tier is not — the agent keeps the world and
+# backs off instead of shrinking it.
+CORRUPT_CKPT_EXIT_CODE = 44
+
+FAILURE_DEAD = "dead"
+FAILURE_HUNG = "hung"
+FAILURE_CORRUPT = "corrupt_ckpt"
+
+_LOCAL_HOST_NAMES = ("localhost", "127.0.0.1", "::1", "")
+
+
+def _host_is_local(host):
+    h = str(host)
+    if h in _LOCAL_HOST_NAMES:
+        return True
+    try:
+        return h in (socket.gethostname(), socket.getfqdn())
+    except OSError:
+        return False
 
 
 class WorldFailure(Exception):
@@ -33,15 +78,26 @@ class DSElasticAgent:
     Args:
       launch_fn: starts one worker per host for the CURRENT world and
         returns (host, proc) pairs. Each relaunch gets env/rendezvous for
-        the new world size (the launcher rebuilds worker commands).
+        the new world size (the launcher rebuilds worker commands). A
+        two-argument ``launch_fn(hosts, topology)`` also receives the
+        surviving topology dict computed by :meth:`compute_topology`.
       hosts: initial host list.
       ds_config: optional config dict with an 'elasticity' block — used to
         validate shrunken world sizes (reference compute_elastic_config).
       chips_per_host: multiplied into world size for validation.
+      tensor_parallel / expert_parallel / pipe_parallel / seq_parallel:
+        fixed model-sharding factors of the topology; the surviving dp is
+        ``world // (tp*ep*pp*sp)`` and a surviving world these do not
+        divide is inadmissible (a host loss cannot shrink tensor
+        parallelism — only dp shrinks).
       max_restarts: restart budget (reference torch-elastic semantics).
       min_hosts: refuse to shrink below this.
       poll_s: liveness poll interval.
       on_restart(gen, hosts): hook (tests observe membership changes).
+      restart_backoff_s: per-failure-class seconds to wait before the
+        relaunch, e.g. ``{"dead": 0, "hung": 0, "corrupt_ckpt": 5}``
+        (the defaults). Corrupt-checkpoint failures keep the SAME world;
+        dead/hung drop the failed hosts.
       heartbeat_timeout_s: when set, a worker whose heartbeat file
         (``heartbeat_path(host)``; workers beat via
         ``DSTPU_HEARTBEAT_FILE`` -> utils.touch_heartbeat, once per
@@ -56,29 +112,80 @@ class DSElasticAgent:
         these files on ITS host — with remote (e.g. ssh-launched)
         workers, heartbeat_dir must be on a filesystem shared between
         the agent and every worker (the same shared-FS assumption the
-        checkpoint 'latest' protocol already makes); the /tmp default
-        is only correct for local workers. A non-shared dir would make
-        every healthy remote worker look hung.
+        checkpoint 'latest' protocol already makes). The /tmp default
+        is only correct for local workers — a non-shared dir makes
+        every healthy remote worker look hung, so the agent REFUSES to
+        start when hang detection is on, any host is non-local, and
+        heartbeat_dir was left at its default (an explicitly-given dir
+        is trusted, with a one-time shared-FS warning).
+      hot_root: hot-tier store root (checkpoint_engine/hot_tier.py).
+        When set, the agent (a) exports the replica ring to workers via
+        ``DSTPU_HOT_TIER_ROOT`` / ``DSTPU_HOT_NODE`` / ``DSTPU_HOT_PEERS``
+        expectations (the launcher copies agent.worker_env(host) into
+        each worker's env) and (b) purges a failed host's store on
+        membership change — a dead host's RAM is gone; its replicas on
+        survivors are exactly what the relaunched world restores from.
     """
 
     def __init__(self, launch_fn, hosts, ds_config=None, chips_per_host=1,
                  max_restarts=10, min_hosts=1, poll_s=0.5,
                  on_restart=None, heartbeat_timeout_s=None,
-                 heartbeat_dir=None):
+                 heartbeat_dir=None, tensor_parallel=1, expert_parallel=1,
+                 pipe_parallel=1, seq_parallel=1, restart_backoff_s=None,
+                 hot_root=None):
         self.launch_fn = launch_fn
         self.hosts = list(hosts)
         self.ds_config = ds_config
         self.chips_per_host = chips_per_host
+        self.tensor_parallel = int(tensor_parallel)
+        self.expert_parallel = int(expert_parallel)
+        self.pipe_parallel = int(pipe_parallel)
+        self.seq_parallel = int(seq_parallel)
         self.max_restarts = max_restarts
         self.min_hosts = min_hosts
         self.poll_s = poll_s
         self.on_restart = on_restart
         self.restart_count = 0
         self.heartbeat_timeout_s = heartbeat_timeout_s
+        self._heartbeat_dir_defaulted = heartbeat_dir is None
         self.heartbeat_dir = heartbeat_dir or os.path.join(
             "/tmp", f"dstpu_heartbeats_{os.getpid()}")
+        backoff = {FAILURE_DEAD: 0.0, FAILURE_HUNG: 0.0,
+                   FAILURE_CORRUPT: 5.0}
+        backoff.update(restart_backoff_s or {})
+        self.restart_backoff_s = backoff
+        self.hot_root = hot_root
+        self.topology = self.compute_topology(self.hosts, validate=False)
+        # host -> failure class of the most recent membership change
+        self.last_failures = {}
+        self._check_heartbeat_dir()
 
     # ------------------------------------------------------------ heartbeat
+    def _check_heartbeat_dir(self):
+        """The documented /tmp pitfall, enforced: hang detection against
+        a non-shared heartbeat dir makes every healthy remote worker
+        look hung — fail fast instead of killing a healthy world."""
+        if self.heartbeat_timeout_s is None:
+            return
+        remote = [h for h in self.hosts if not _host_is_local(h)]
+        if not remote:
+            return
+        if self._heartbeat_dir_defaulted:
+            raise WorldFailure(
+                f"heartbeat hang detection is enabled with remote hosts "
+                f"{remote[:3]}{'...' if len(remote) > 3 else ''} but "
+                f"heartbeat_dir was left at its /tmp default "
+                f"({self.heartbeat_dir}), which is host-local: every "
+                f"healthy remote worker would look hung and be killed. "
+                f"Pass heartbeat_dir on a filesystem shared between the "
+                f"agent and every worker (the same shared-FS assumption "
+                f"the checkpoint 'latest' protocol makes)")
+        logger.warning(
+            f"heartbeat hang detection with remote hosts: "
+            f"heartbeat_dir={self.heartbeat_dir} must be on a filesystem "
+            f"shared between the agent and every worker, or healthy "
+            f"workers will be killed as hung")
+
     def heartbeat_path(self, host):
         """Heartbeat file for ``host`` — export as DSTPU_HEARTBEAT_FILE
         in that worker's env."""
@@ -109,12 +216,51 @@ class DSElasticAgent:
             pass
         return (time.time() - beat) > self.heartbeat_timeout_s
 
+    # ------------------------------------------------------------- topology
+    def compute_topology(self, hosts, validate=True):
+        """The surviving admissible topology for ``hosts`` — not just a
+        world size. The model-sharding factors (tp/ep/pp/sp) are FIXED
+        (a host loss cannot shrink tensor parallelism); what shrinks is
+        dp. -> dict(world, dp, tp, ep, pipe, seq, hosts). ``validate``
+        raises WorldFailure when the factors do not divide the world or
+        the elastic config rejects it."""
+        world = len(hosts) * self.chips_per_host
+        fixed = (self.tensor_parallel * self.expert_parallel
+                 * self.pipe_parallel * self.seq_parallel)
+        topo = {"world": world, "dp": world // fixed if fixed else 0,
+                "tp": self.tensor_parallel, "ep": self.expert_parallel,
+                "pipe": self.pipe_parallel, "seq": self.seq_parallel,
+                "hosts": list(hosts)}
+        if not validate:
+            return topo
+        if fixed <= 0 or world % fixed != 0 or world // fixed < 1:
+            raise WorldFailure(
+                f"surviving world size {world} ({len(hosts)} hosts x "
+                f"{self.chips_per_host} chips) is not divisible by the "
+                f"fixed model-sharding factors tp*ep*pp*sp={fixed}: no "
+                f"admissible topology")
+        return topo
+
+    def worker_env(self, host):
+        """Env the launcher should copy into ``host``'s worker so the
+        engine's hot tier and heartbeat line up with the agent's view
+        of the ring."""
+        env = {}
+        if self.heartbeat_timeout_s is not None:
+            env["DSTPU_HEARTBEAT_FILE"] = self.heartbeat_path(host)
+        if self.hot_root:
+            env["DSTPU_HOT_TIER_ROOT"] = self.hot_root
+            env["DSTPU_HOT_NODE"] = str(host)
+            env["DSTPU_HOT_PEERS"] = ",".join(str(h) for h in self.hosts)
+        return env
+
     # ------------------------------------------------------------ internals
     def _validate_world(self, hosts):
         if len(hosts) < max(1, self.min_hosts):
             raise WorldFailure(
                 f"only {len(hosts)} hosts left (< min_hosts="
                 f"{max(1, self.min_hosts)})")
+        self.topology = self.compute_topology(hosts)
         if self.ds_config and "elasticity" in self.ds_config:
             world = len(hosts) * self.chips_per_host
             try:
@@ -124,14 +270,22 @@ class DSElasticAgent:
                     f"world size {world} not admissible under the elastic "
                     f"config: {e}") from e
 
+    @staticmethod
+    def _classify(rc, hung):
+        if hung:
+            return FAILURE_HUNG
+        if rc == CORRUPT_CKPT_EXIT_CODE:
+            return FAILURE_CORRUPT
+        return FAILURE_DEAD
+
     def _supervise(self, procs):
         """Block until every worker exits. On the FIRST failure, terminate
         the rest (a jax.distributed world is all-or-nothing). A worker
         that HANGS (no heartbeat within heartbeat_timeout_s) is killed
         and counted as failed — same recovery path as a dead one.
-        Returns (ok, failed_hosts)."""
+        Returns (ok, failures) with failures a dict host -> class."""
         live = dict(procs)
-        failed = []
+        failures = {}
         launched_at = time.time()
         while live:
             for host, p in list(live.items()):
@@ -148,14 +302,16 @@ class DSElasticAgent:
                         except Exception:  # noqa: BLE001
                             pass
                         del live[host]
-                        failed.append(host)
+                        failures[host] = FAILURE_HUNG
                     continue
                 del live[host]
                 if rc != 0:
+                    kind = self._classify(rc, hung=False)
                     logger.warning(
-                        f"elastic agent: worker on {host} exited rc={rc}")
-                    failed.append(host)
-            if failed and live:
+                        f"elastic agent: worker on {host} exited "
+                        f"rc={rc} ({kind})")
+                    failures[host] = kind
+            if failures and live:
                 logger.warning(
                     f"elastic agent: tearing down {len(live)} surviving "
                     "workers for world restart")
@@ -170,7 +326,51 @@ class DSElasticAgent:
                 live.clear()
             if live:
                 time.sleep(self.poll_s)
-        return (not failed), failed
+        return (not failures), failures
+
+    def _handle_membership_change(self, failures):
+        """Classify, drop dead/hung hosts (keeping corrupt-checkpoint
+        ones — their HOST is healthy), purge the hot-tier stores of the
+        hosts whose RAM is gone, and apply the per-class backoff."""
+        self.last_failures = dict(failures)
+        lost = [h for h, kind in failures.items()
+                if kind in (FAILURE_DEAD, FAILURE_HUNG)]
+        for h in lost:
+            fault_injection.fire("host_loss")
+            if self.hot_root:
+                from ..runtime.checkpoint_engine import hot_tier
+                hot_tier.purge_node(self.hot_root, h)
+                logger.info(
+                    f"elastic agent: purged hot-tier store of lost host "
+                    f"{h} (its replicas on survivors are the restore "
+                    f"source)")
+        self.hosts = [h for h in self.hosts if h not in lost]
+        backoff = max((self.restart_backoff_s.get(kind, 0.0)
+                       for kind in failures.values()), default=0.0)
+        if backoff > 0:
+            kinds = sorted(set(failures.values()))
+            logger.warning(
+                f"elastic agent: backing off {backoff:.1f}s before "
+                f"relaunch (failure classes: {kinds})")
+            time.sleep(backoff)
+
+    def _launch(self, hosts):
+        """Call launch_fn with the surviving topology when it accepts a
+        second POSITIONAL argument (back-compat: single-argument
+        launchers — including ones with **kwargs or keyword-only extras
+        — are still called with hosts alone)."""
+        try:
+            params = inspect.signature(self.launch_fn).parameters
+            positional = [
+                p for p in params.values()
+                if p.kind in (inspect.Parameter.POSITIONAL_ONLY,
+                              inspect.Parameter.POSITIONAL_OR_KEYWORD)]
+            takes_topology = len(positional) >= 2
+        except (TypeError, ValueError):
+            takes_topology = False
+        if takes_topology:
+            return self.launch_fn(list(hosts), dict(self.topology))
+        return self.launch_fn(list(hosts))
 
     # ---------------------------------------------------------------- run
     def run(self):
@@ -181,14 +381,15 @@ class DSElasticAgent:
             gen = self.restart_count
             logger.info(
                 f"elastic agent: launching generation {gen} on "
-                f"{len(self.hosts)} hosts")
+                f"{len(self.hosts)} hosts "
+                f"(dp={self.topology['dp']} tp={self.topology['tp']} "
+                f"ep={self.topology['ep']})")
             self._clear_heartbeats(self.hosts)
-            procs = self.launch_fn(list(self.hosts))
-            ok, failed = self._supervise(procs)
+            procs = self._launch(self.hosts)
+            ok, failures = self._supervise(procs)
             if ok:
                 return list(self.hosts)
-            # membership change: drop the failed hosts, restart the rest
-            self.hosts = [h for h in self.hosts if h not in failed]
+            self._handle_membership_change(failures)
             self.restart_count += 1
             if self.restart_count > self.max_restarts:
                 raise WorldFailure(

@@ -79,9 +79,12 @@ def parse_inclusion_exclusion(resource_pool, include_str="",
 
 
 def build_worker_cmds(hosts, coordinator, script, script_args,
-                      env_passthrough=(), extra_env=None):
+                      env_passthrough=(), extra_env=None,
+                      per_host_env=None):
     """One (host, argv, env) per host. env carries the jax.distributed
-    rendezvous triplet."""
+    rendezvous triplet. ``per_host_env``: optional ``host -> dict``
+    (the elastic agent's ``worker_env`` — heartbeat file + hot-tier
+    ring — differs per host)."""
     cmds = []
     n = len(hosts)
     for pid, host in enumerate(hosts):
@@ -92,6 +95,8 @@ def build_worker_cmds(hosts, coordinator, script, script_args,
         }
         if extra_env:
             env.update(extra_env)
+        if per_host_env is not None:
+            env.update(per_host_env(host))
         for k in env_passthrough:
             if k in os.environ:
                 env[k] = os.environ[k]
@@ -215,6 +220,22 @@ def parse_args(argv=None):
                              "membership change (reference ds_elastic / "
                              "DSElasticAgent)")
     parser.add_argument("--max_elastic_restarts", type=int, default=10)
+    parser.add_argument("--elastic_hot_root", default="",
+                        help="hot-tier store root exported to workers "
+                             "(DSTPU_HOT_TIER_ROOT/NODE/PEERS; the "
+                             "agent purges a dead host's store on "
+                             "membership change). Empty = no hot-tier "
+                             "ring wiring")
+    parser.add_argument("--elastic_heartbeat_timeout", type=float,
+                        default=None,
+                        help="seconds without a worker heartbeat before "
+                             "it is killed as hung (default: hang "
+                             "detection off)")
+    parser.add_argument("--elastic_heartbeat_dir", default=None,
+                        help="heartbeat file dir — MUST be on a "
+                             "filesystem shared between the agent and "
+                             "every worker; the agent refuses the /tmp "
+                             "default with remote hosts")
     parser.add_argument("--min_hosts", type=int, default=1)
     parser.add_argument(
         "--autotuning", choices=["tune", "run"], default=None,
@@ -356,12 +377,24 @@ def main(argv=None):
                 world_hosts, coord, args.script, args.script_args,
                 env_passthrough=tuple(args.env) + (
                     "PYTHONPATH", "JAX_PLATFORMS", "XLA_FLAGS"),
-                extra_env={"ELASTIC_GENERATION": str(agent.restart_count)})
+                extra_env={"ELASTIC_GENERATION": str(agent.restart_count)},
+                # heartbeat file + hot-tier ring (DSTPU_HOT_*) — the
+                # agent-side contract its docstring promises
+                per_host_env=agent.worker_env)
             return list(zip(world_hosts, runner.launch(wc)))
 
+        # hostfile slots = chips per host (uniform pods; the agent
+        # validates the surviving world with them)
+        slots = {pool[h] for h in hosts}
         agent = DSElasticAgent(launch_fn, hosts,
                                max_restarts=args.max_elastic_restarts,
-                               min_hosts=args.min_hosts)
+                               min_hosts=args.min_hosts,
+                               chips_per_host=(slots.pop() if
+                                               len(slots) == 1 else 1),
+                               hot_root=args.elastic_hot_root or None,
+                               heartbeat_timeout_s=(
+                                   args.elastic_heartbeat_timeout),
+                               heartbeat_dir=args.elastic_heartbeat_dir)
         agent.run()
         return 0
     logger.info(f"launching on {len(hosts)} hosts via {args.launcher}; "

@@ -54,6 +54,12 @@ def _new_counters():
         "save_errors": 0,      # versions that failed even after fallback
         "load_fallbacks": 0,   # loads served by an older durable tag
         "gc_removed": 0,       # tags deleted by retention GC
+        # hot tier (checkpoint_engine/hot_tier.py)
+        "hot_pushes": 0,       # local+peer replications completed
+        "hot_push_errors": 0,  # advisory replica-push failures
+        "hot_restores": 0,     # loads served from in-memory replicas
+        "hot_fallbacks": 0,    # hot tier present but degraded to durable
+        "durable_restores": 0,  # loads that DID read persistent storage
     }
 
 

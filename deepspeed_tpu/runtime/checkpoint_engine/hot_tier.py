@@ -1,0 +1,375 @@
+"""Peer-replicated in-memory checkpoint hot tier.
+
+The reference fork's whole async-checkpointing layer (DataStates/VELOC,
+``csrc/veloc/``) exists so the COMMON failure — one host dies — restores
+from a fast in-memory tier instead of re-reading persistent storage.
+This module is that tier for the TPU runtime:
+
+  * after every save's D2H extraction, each node pushes its local shard
+    (the exact ``extract_local_chunks`` payload, CRC manifest included)
+    to K ring-neighbor peers;
+  * a node's store lives in host RAM (tmpfs — ``/dev/shm`` by default),
+    so it survives worker-process restarts but dies with the host,
+    exactly like the pinned host cache it models;
+  * on resume, ``manager.load_best_tiered`` tries the hot tier first:
+    a generation is loadable when the node's own shards plus surviving
+    peer replicas cover every writer — the common single-host loss
+    restores with ZERO persistent-storage reads, degrading to the
+    durable tier when replicas are insufficient or CRC-invalid.
+
+Store layout (one subtree per node under a shared root):
+
+    {root}/{node}/{tag}/own/shard-{p}.npz        this node's own save
+    {root}/{node}/{tag}/from-{origin}/shard-{p}.npz   received replicas
+
+Two transports own the peer push:
+
+  * ``fs`` — the pusher writes straight into the peer's subtree. On a
+    single machine (the chaos suites' multi-worker simulation, where
+    each "host" is a process and the shared tmpfs root stands in for
+    peer RAM) this IS the transfer; the elastic agent models the real
+    host-RAM loss by purging a dead host's subtree (purge_node).
+  * ``dcn`` — bytes ride the accelerator fabric via
+    ``comm.ring_exchange_bytes`` (a collective-permute over a
+    one-device-per-process mesh; DCN on a multi-slice pod) and the
+    RECEIVER writes them into its own subtree. Collective: every
+    process must push at the same save boundary — which the engine's
+    multi-process save barrier already guarantees.
+
+Fault points (utils/fault_injection): ``replica_push`` fires once per
+peer replica write, ``replica_fetch`` once per replica read during
+assembly (own-written shards read clean) — arming them makes pushes
+fail (advisory: the durable tier still lands) or poisons the replicas
+so loads degrade deterministically.
+"""
+
+import concurrent.futures as futures
+import glob
+import io
+import os
+import re
+import shutil
+import tempfile
+
+from ...utils import fault_injection
+from ...utils.logging import logger
+from . import serialization as ser
+
+
+def _safe(name):
+    return re.sub(r"[^A-Za-z0-9_.-]", "_", str(name))
+
+
+def default_root():
+    """Hot-store root: DSTPU_HOT_TIER_ROOT env, else tmpfs (/dev/shm —
+    host RAM, the point of the tier), else the system tempdir (still
+    node-local; documented degradation for hosts without tmpfs)."""
+    env = os.environ.get("DSTPU_HOT_TIER_ROOT")
+    if env:
+        return env
+    base = "/dev/shm" if os.path.isdir("/dev/shm") else tempfile.gettempdir()
+    return os.path.join(base, f"dstpu_hot_{os.getuid()}")
+
+
+def _step_key(name):
+    m = re.search(r"(\d+)$", name)
+    return int(m.group(1)) if m else -1
+
+
+def purge_node(root, node):
+    """Drop ``node``'s whole store — the host-RAM-loss boundary. The
+    elastic agent calls this for every failed host before relaunch, so
+    replicas a dead host held can never serve a restore they would not
+    survive in production."""
+    shutil.rmtree(os.path.join(root, _safe(node)), ignore_errors=True)
+
+
+class HotTierStore:
+    """One node's view of the peer-replicated hot tier.
+
+    Args:
+      root: shared hot-store root (see :func:`default_root`).
+      node: this node's id (string). Default: ``DSTPU_HOT_NODE`` env,
+        else the jax process index. The elastic launcher exports the
+        host name here so agent-side purge and store subtrees agree.
+      peers: ORDERED ring membership (list of node ids). Default:
+        ``DSTPU_HOT_PEERS`` (comma-separated), else one id per jax
+        process. Ring neighbors are computed from this order.
+      replicas: K — how many ring neighbors receive each shard.
+      keep_last: hot-tier retention (tags per node; the tier is a cache,
+        not an archive).
+      counters: optional engine counters dict (hot_pushes /
+        hot_push_errors bumped here).
+    """
+
+    def __init__(self, root=None, node=None, peers=None, replicas=1,
+                 keep_last=2, counters=None):
+        import jax
+        self.root = root or default_root()
+        if node is None:
+            node = os.environ.get("DSTPU_HOT_NODE") or \
+                str(jax.process_index())
+        self.node = _safe(node)
+        if peers is None:
+            env = os.environ.get("DSTPU_HOT_PEERS")
+            if env:
+                peers = [p for p in env.split(",") if p]
+            else:
+                peers = [str(i) for i in range(jax.process_count())]
+        self.peers = [_safe(p) for p in peers]
+        if self.node not in self.peers:
+            # a node outside the ring still stores locally (replicas
+            # have nowhere meaningful to go); keep membership explicit
+            self.peers = self.peers + [self.node]
+        self.replicas = max(0, int(replicas))
+        self.keep_last = int(keep_last)
+        self.counters = counters if counters is not None else {}
+        self._pool = futures.ThreadPoolExecutor(max_workers=1)
+        self._inflight = []
+
+    # ------------------------------------------------------------ topology
+    def ring_neighbors(self):
+        """The K distinct peers after this node in ring order."""
+        if len(self.peers) <= 1:
+            return []
+        i = self.peers.index(self.node)
+        out = []
+        for k in range(1, self.replicas + 1):
+            p = self.peers[(i + k) % len(self.peers)]
+            if p != self.node and p not in out:
+                out.append(p)
+        return out
+
+    def _node_dir(self, node):
+        return os.path.join(self.root, node)
+
+    def _tag_dir(self, node, tag, sub=None):
+        d = os.path.join(self._node_dir(node), tag)
+        return os.path.join(d, sub) if sub else d
+
+    # ---------------------------------------------------------------- push
+    def _serialize(self, chunks, extra):
+        bio = io.BytesIO()
+        ser.save_file(bio, chunks, extra_meta=extra)
+        return bio.getbuffer()
+
+    def _write_bytes(self, target_dir, fname, payload):
+        os.makedirs(target_dir, exist_ok=True)
+        tmp = os.path.join(target_dir, f".{fname}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(payload)
+        os.replace(tmp, os.path.join(target_dir, fname))
+
+    def push(self, tag, chunks, extra, shard_name=None):
+        """Store this node's shard for ``tag`` locally and replicate it
+        to the ring neighbors. Replica failures are ADVISORY (counted,
+        logged, never raised): the durable tier is still landing through
+        the normal save path, and a hot tier that could fail a save
+        would be worse than no hot tier. Returns the number of replicas
+        that landed."""
+        import jax
+        if shard_name is None:
+            shard_name = f"shard-{jax.process_index()}.npz"
+        payload = self._serialize(chunks, extra)
+        ok = 0
+        try:
+            self._write_bytes(self._tag_dir(self.node, tag, "own"),
+                              shard_name, payload)
+        except OSError as e:
+            self.counters["hot_push_errors"] = \
+                self.counters.get("hot_push_errors", 0) + 1
+            logger.warning(f"hot tier: local store of {tag} failed: {e}")
+            return 0
+        for peer in self.ring_neighbors():
+            try:
+                fault_injection.fire("replica_push")
+                self._write_bytes(
+                    self._tag_dir(peer, tag, f"from-{self.node}"),
+                    shard_name, payload)
+                ok += 1
+            except fault_injection.SimulatedKill:
+                raise
+            except Exception as e:  # noqa: BLE001 - advisory path
+                self.counters["hot_push_errors"] = \
+                    self.counters.get("hot_push_errors", 0) + 1
+                logger.warning(
+                    f"hot tier: replica push {tag} -> {peer} failed: {e}")
+        self.counters["hot_pushes"] = \
+            self.counters.get("hot_pushes", 0) + 1
+        self.gc()
+        return ok
+
+    def push_async(self, tag, chunks, extra, shard_name=None):
+        """Replicate off the training critical path (the PR-2 async-pool
+        discipline). Degrades to an in-caller push when the pool is
+        gone (interpreter teardown)."""
+        # prune finished futures so a long run that saves every N steps
+        # (and never loads) cannot grow the list unboundedly
+        self._inflight = [f for f in self._inflight if not f.done()]
+        try:
+            fut = self._pool.submit(self.push, tag, chunks, extra,
+                                    shard_name)
+        except RuntimeError:
+            self.push(tag, chunks, extra, shard_name)
+            return None
+        self._inflight.append(fut)
+        return fut
+
+    def push_collective(self, tag, chunks, extra, shard_name=None):
+        """DCN transport: exchange the serialized shard with each ring
+        neighbor over the comm layer (collective — every process in the
+        jax world must call this at the same save boundary), then store
+        what THIS node received from its upstream peers. Falls back to
+        the fs transport outside a multi-process world. Same ADVISORY
+        contract as :meth:`push`: a hot-tier failure (injected
+        replica_push fault, tmpfs ENOSPC, a wedged exchange) is counted
+        and logged, never raised — it must not cost the durable save
+        the engine is about to make."""
+        import jax
+        if jax.process_count() <= 1 or self.replicas < 1:
+            return self.push(tag, chunks, extra, shard_name)
+        try:
+            return self._push_collective_impl(tag, chunks, extra,
+                                              shard_name)
+        except fault_injection.SimulatedKill:
+            raise
+        except Exception as e:  # noqa: BLE001 - advisory path
+            self.counters["hot_push_errors"] = \
+                self.counters.get("hot_push_errors", 0) + 1
+            logger.warning(
+                f"hot tier: collective replica push of {tag} failed "
+                f"({e}); the durable tier is unaffected")
+            return 0
+
+    def _push_collective_impl(self, tag, chunks, extra, shard_name):
+        import jax
+        from ...comm.comm import ring_exchange_bytes
+        if shard_name is None:
+            shard_name = f"shard-{jax.process_index()}.npz"
+        payload = bytes(self._serialize(chunks, extra))
+        self._write_bytes(self._tag_dir(self.node, tag, "own"),
+                          shard_name, payload)
+        ok = 0
+        for k in range(1, self.replicas + 1):
+            fault_injection.fire("replica_push")
+            recv, origin = ring_exchange_bytes(payload, shift=k)
+            if recv is None:
+                continue
+            origin_node = self.peers[origin % len(self.peers)]
+            self._write_bytes(
+                self._tag_dir(self.node, tag, f"from-{origin_node}"),
+                f"shard-{origin}.npz", recv)
+            ok += 1
+        self.counters["hot_pushes"] = \
+            self.counters.get("hot_pushes", 0) + 1
+        self.gc()
+        return ok
+
+    def wait(self):
+        """Drain in-flight async pushes (advisory failures already
+        swallowed inside push)."""
+        pending, self._inflight = self._inflight, []
+        for fut in pending:
+            exc = fut.exception()
+            if exc is not None and not isinstance(exc, Exception):
+                raise exc          # SimulatedKill et al.
+        return True
+
+    def shutdown(self):
+        self.wait()
+        self._pool.shutdown(wait=True)
+
+    # ------------------------------------------------------------ assembly
+    def tags(self):
+        """Generations visible anywhere in the hot tier, newest first
+        (step-suffix order). A survivor may hold a tag only as replicas
+        pushed by a now-dead writer, so the scan covers every node
+        subtree, not just our own."""
+        seen = set()
+        try:
+            nodes = os.listdir(self.root)
+        except OSError:
+            return []
+        for node in nodes:
+            nd = self._node_dir(node)
+            try:
+                for t in os.listdir(nd):
+                    if os.path.isdir(os.path.join(nd, t)):
+                        seen.add(t)
+            except OSError:
+                continue
+        return sorted(seen, key=_step_key, reverse=True)
+
+    def _shard_sources(self, tag):
+        """-> {shard_name: (path, is_replica)} best source per shard
+        file: this node's own save first (a clean local read), then
+        replicas (our own received ones, then other nodes' subtrees) —
+        every replica read is a ``replica_fetch`` fire."""
+        sources = {}
+        own = glob.glob(os.path.join(self._tag_dir(self.node, tag, "own"),
+                                     "shard-*.npz"))
+        for p in own:
+            sources.setdefault(os.path.basename(p), (p, False))
+        try:
+            others = sorted(n for n in os.listdir(self.root)
+                            if n != self.node)
+        except OSError:
+            others = []
+        for node in [self.node] + others:
+            pattern = os.path.join(self._tag_dir(node, tag), "*",
+                                   "shard-*.npz")
+            for p in sorted(glob.glob(pattern)):
+                sources.setdefault(os.path.basename(p), (p, True))
+        return sources
+
+    def load(self, tag):
+        """Assemble ``tag`` from the best available sources. Raises
+        CheckpointCorruptionError/ValueError/OSError (the manager's
+        FALLBACK_ERRORS) when shards are missing, CRC-invalid, or a
+        replica fetch fails — callers degrade to the durable tier."""
+        sources = self._shard_sources(tag)
+        if not sources:
+            raise FileNotFoundError(
+                f"hot tier: no shards for tag {tag!r} under {self.root}")
+        files = []
+        for name in sorted(sources):
+            path, is_replica = sources[name]
+            if is_replica:
+                fault_injection.fire("replica_fetch")
+            files.append(path)
+        return ser.load_shard_files(files, where=f"hot:{tag}")
+
+    def load_best(self, tag=None):
+        """Try candidates (an explicit tag, or every visible generation
+        newest-first). -> (tag, flat, header) or (None, None, None)."""
+        from .manager import FALLBACK_ERRORS
+        candidates = [tag] if tag is not None else self.tags()
+        for cand in candidates:
+            try:
+                flat, header = self.load(cand)
+            except FALLBACK_ERRORS as e:
+                logger.warning(
+                    f"hot tier: generation {cand!r} not restorable "
+                    f"({e}); trying the next tier/candidate")
+                continue
+            return cand, flat, header
+        return None, None, None
+
+    # ----------------------------------------------------------- retention
+    def gc(self):
+        """Keep the newest ``keep_last`` tags in OUR subtree (the hot
+        tier is a bounded cache over host RAM, not an archive)."""
+        if self.keep_last <= 0:
+            return []
+        nd = self._node_dir(self.node)
+        try:
+            tags = sorted((t for t in os.listdir(nd)
+                           if os.path.isdir(os.path.join(nd, t))),
+                          key=_step_key, reverse=True)
+        except OSError:
+            return []
+        removed = []
+        for t in tags[self.keep_last:]:
+            shutil.rmtree(os.path.join(nd, t), ignore_errors=True)
+            removed.append(t)
+        return removed

@@ -116,19 +116,50 @@ def _step_key(name):
     return int(m.group(1)) if m else -1
 
 
-def load_candidates(load_dir, tag=None):
+def load_candidates(load_dir, tag=None, hot_store=None):
     """Generations to try loading, best first. An explicit ``tag`` is
     the only candidate (the caller asked for THAT generation — silently
     substituting another would be worse than failing). With no tag: the
     'latest' pointer first, then every other tag newest-first, so a
-    corrupt newest generation falls back to the previous durable one."""
+    corrupt newest generation falls back to the previous durable one.
+
+    With ``hot_store`` the candidate list grows a TIER dimension and the
+    return shape becomes ``[(tier, tag), ...]`` with the hot tier's
+    generations ordered before any durable one — the common single-host
+    loss restores from surviving in-memory replicas with zero
+    persistent-storage reads, degrading to the durable tier when
+    replicas are insufficient or CRC-invalid. Staleness guard: a hot
+    generation OLDER than the published durable 'latest' is dropped
+    (the advisory replica push can lag or fail without failing the
+    save, so the RAM tier may hold only step N-1 after step N durably
+    committed — serving it would silently roll a committed generation
+    back). A hot generation NEWER than 'latest' is kept: it is the
+    latest trained state even though its durable commit never landed.
+
+    This list is THE tier-order definition — :func:`load_best_tiered`
+    consumes it rather than re-deriving its own."""
     if tag is not None:
-        return [tag]
-    latest = read_latest(load_dir)
-    tags = list_tags(load_dir)
-    out = [latest] if latest else []
-    out.extend(t for t in tags if t != latest)
-    return out
+        durable = [tag]
+    else:
+        latest = read_latest(load_dir)
+        tags = list_tags(load_dir)
+        durable = [latest] if latest else []
+        durable.extend(t for t in tags if t != latest)
+    if hot_store is None:
+        return durable
+    if tag is not None:
+        # only a tag the tier actually holds is a hot candidate — a
+        # cold RAM tier after a full restart is routine, not a
+        # degradation, and must not fire the hot_fallbacks signal
+        hot = [tag] if tag in hot_store.tags() else []
+    else:
+        hot = hot_store.tags()
+        latest = durable[0] if durable else None
+        if latest is not None:
+            floor = _step_key(latest)
+            hot = [t for t in hot if _step_key(t) >= floor]
+    return ([("hot", t) for t in hot]
+            + [("durable", t) for t in durable])
 
 
 # Errors that mean "this generation is unloadable, try the previous
@@ -178,6 +209,51 @@ def load_best(load_dir, tag=None, loader=None, counters=None):
     raise ser.CheckpointCorruptionError(
         f"no loadable checkpoint generation under {load_dir} "
         f"(tried {tried} tag(s))") from last_err
+
+
+def load_best_tiered(load_dir, tag=None, hot_store=None, loader=None,
+                     counters=None):
+    """Tier-ordered load over the :func:`load_candidates` order: the
+    hot tier's surviving replicas first (minus stale generations — see
+    the staleness guard there), the durable generations second.
+    -> (tier, tag, flat, header); tier is 'hot' or 'durable' (None when
+    nothing exists anywhere). A hot candidate failing (missing shards,
+    CRC-invalid replica, poisoned ``replica_fetch``) degrades to the
+    durable tier — bumping ``counters['hot_fallbacks']`` — rather than
+    failing the resume."""
+    if hot_store is not None:
+        tiered = load_candidates(load_dir, tag, hot_store=hot_store)
+        attempted = 0
+        for tier, cand in tiered:
+            if tier != "hot":
+                break             # durable phase delegates to load_best
+            attempted += 1
+            try:
+                flat, header = hot_store.load(cand)
+            except FALLBACK_ERRORS as e:
+                logger.warning(
+                    f"hot tier: generation {cand!r} not restorable "
+                    f"({e}); trying the next tier/candidate")
+                continue
+            if counters is not None:
+                counters["hot_restores"] = \
+                    counters.get("hot_restores", 0) + 1
+            return "hot", cand, flat, header
+        if attempted:
+            if counters is not None:
+                counters["hot_fallbacks"] = \
+                    counters.get("hot_fallbacks", 0) + 1
+            logger.warning(
+                "hot tier: no generation restorable from surviving "
+                "replicas; degrading to the durable tier")
+    cand, flat, header = load_best(load_dir, tag, loader=loader,
+                                   counters=counters)
+    if cand is None:
+        return None, None, None, None
+    if counters is not None:
+        counters["durable_restores"] = \
+            counters.get("durable_restores", 0) + 1
+    return "durable", cand, flat, header
 
 
 def gc_tags(save_dir, keep_last, counters=None):

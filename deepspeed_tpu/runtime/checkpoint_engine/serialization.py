@@ -224,6 +224,19 @@ def load_sharded(dirpath):
     files = sorted(glob.glob(os.path.join(dirpath, "shard-*.npz")))
     if not files:
         raise FileNotFoundError(f"no shard-*.npz under {dirpath}")
+    return load_shard_files(files, where=dirpath)
+
+
+def load_shard_files(files, where=None):
+    """Reassemble the global logical arrays from an explicit list of
+    shard file paths (they need not share a directory — the hot tier
+    assembles a generation from shard replicas scattered across peer
+    stores). -> (flat dict path->array, normalized header)."""
+    where = where or (os.path.dirname(files[0]) if files else "<empty>")
+    return _load_shard_files(files, where)
+
+
+def _load_shard_files(files, dirpath):
     merged = {}
     all_chunks = {}
     header0 = None

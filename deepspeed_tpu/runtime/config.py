@@ -152,6 +152,28 @@ class CheckpointEngineConfig:
     # retention: keep the newest keep_last durable tags, GC older ones
     # only after the newest verifies (CRC + chunk coverage). 0 = keep all.
     keep_last: int = 0
+    # hot tier (checkpoint_engine/hot_tier.py): peer-replicated
+    # in-memory generations so the common single-host loss restores with
+    # zero persistent-storage reads.
+    #   hot_tier      "auto" (on iff an elastic launcher exported the
+    #                 ring env — DSTPU_HOT_PEERS/DSTPU_HOT_TIER_ROOT/
+    #                 DSTPU_HOT_TRANSPORT) | true | false. 'auto' is
+    #                 deliberately NOT on for a bare multi-process
+    #                 world: the default fs transport writes into
+    #                 node-local tmpfs, which only survives a host loss
+    #                 when the launcher wired the ring (or the dcn
+    #                 transport moves bytes between hosts) — pushing
+    #                 replicas nobody could ever restore from would be
+    #                 pure per-save overhead
+    #   hot_replicas  K: ring neighbors receiving each shard replica
+    #   hot_root      store root ("" = DSTPU_HOT_TIER_ROOT env, else
+    #                 tmpfs /dev/shm — host RAM, the point of the tier)
+    #   hot_keep_last hot-tier retention (a bounded RAM cache, not an
+    #                 archive)
+    hot_tier: object = "auto"
+    hot_replicas: int = 1
+    hot_root: str = ""
+    hot_keep_last: int = 2
 
     def __post_init__(self):
         if self.save_retries < 0:
@@ -162,6 +184,31 @@ class CheckpointEngineConfig:
             raise DeepSpeedConfigError(
                 f"checkpoint_engine.keep_last must be >= 0 (0 disables "
                 f"retention GC), got {self.keep_last}")
+        if self.hot_tier not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"checkpoint_engine.hot_tier must be true|false|'auto', "
+                f"got {self.hot_tier!r}")
+        if self.hot_replicas < 0:
+            raise DeepSpeedConfigError(
+                f"checkpoint_engine.hot_replicas must be >= 0, got "
+                f"{self.hot_replicas}")
+        if self.hot_keep_last < 1:
+            raise DeepSpeedConfigError(
+                f"checkpoint_engine.hot_keep_last must be >= 1 (the "
+                f"tier must hold at least the newest generation), got "
+                f"{self.hot_keep_last}")
+
+    def resolve_hot_tier(self, nprocs=1):
+        """'auto' turns the tier on iff an elastic launcher (or the
+        operator) exported the ring env. ``nprocs`` is accepted for
+        call-site symmetry but deliberately unused — see the hot_tier
+        field comment."""
+        import os
+        if self.hot_tier != "auto":
+            return bool(self.hot_tier)
+        return bool(os.environ.get("DSTPU_HOT_PEERS")
+                    or os.environ.get("DSTPU_HOT_TIER_ROOT")
+                    or os.environ.get("DSTPU_HOT_TRANSPORT"))
 
 
 @dataclass

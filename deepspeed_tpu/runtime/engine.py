@@ -177,6 +177,25 @@ class DeepSpeedEngine:
         self.checkpoint_engine = create_checkpoint_engine(
             self.config.checkpoint_engine)
 
+        # peer-replicated in-memory hot tier (checkpoint_engine/
+        # hot_tier.py): 'auto' is on iff an elastic launcher exported
+        # the ring env (DSTPU_HOT_PEERS/DSTPU_HOT_TIER_ROOT/
+        # DSTPU_HOT_TRANSPORT — deliberately NOT bare multi-process;
+        # see the config field comment); restores try it before any
+        # persistent-storage read
+        self.hot_store = None
+        ce_cfg = self.config.checkpoint_engine
+        if ce_cfg.resolve_hot_tier():
+            from .checkpoint_engine.hot_tier import HotTierStore
+            self.hot_store = HotTierStore(
+                root=ce_cfg.hot_root or None,
+                replicas=ce_cfg.hot_replicas,
+                keep_last=ce_cfg.hot_keep_last,
+                counters=self.checkpoint_engine.counters)
+        # which tier served the most recent load_checkpoint (None before
+        # any load / when nothing was found)
+        self.last_restore_tier = None
+
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
             batch_size=self.config.train_batch_size,
@@ -725,6 +744,13 @@ class DeepSpeedEngine:
                 gradient_accumulation_steps=1,
                 shuffle=shuffle, seed=seed,
                 curriculum_scheduler=self.curriculum_scheduler)
+            # a load_checkpoint that ran before the sampler existed
+            # stashed the saved position (global consumed samples —
+            # topology-independent); install it now
+            stash = getattr(self, "_resume_sampler_state", None)
+            if stash is not None:
+                sampler.load_state_dict(stash)
+                self._resume_sampler_state = None
             self.data_sampler = sampler
             return SamplerDataLoader(dataset, sampler)
         return DeepSpeedDataLoader(dataset, batch_size, shuffle=shuffle,
@@ -1010,6 +1036,14 @@ class DeepSpeedEngine:
             ("Train/Checkpoint/load_fallbacks", c["load_fallbacks"],
              step),
             ("Train/Checkpoint/gc_removed", c["gc_removed"], step),
+            ("Train/Checkpoint/hot_pushes", c["hot_pushes"], step),
+            ("Train/Checkpoint/hot_push_errors", c["hot_push_errors"],
+             step),
+            ("Train/Checkpoint/hot_restores", c["hot_restores"], step),
+            ("Train/Checkpoint/hot_fallbacks", c["hot_fallbacks"],
+             step),
+            ("Train/Checkpoint/durable_restores", c["durable_restores"],
+             step),
         ])
 
     def _maybe_print(self, metrics):
@@ -1109,6 +1143,7 @@ class DeepSpeedEngine:
         # configured.
         fault_injection.fire("d2h")
         chunks, index, meta = ser.extract_local_chunks(self._ckpt_tree())
+        sampler = getattr(self, "data_sampler", None)
         extra = {
             "index": index,
             "__tree_meta__": meta,
@@ -1120,10 +1155,36 @@ class DeepSpeedEngine:
                 "lr_scheduler": (self.lr_scheduler.state_dict()
                                  if self.lr_scheduler is not None else None),
                 "client_state": client_state or {},
+                # reshape-on-resume metadata: the topology/batch shape
+                # this generation was written under (diagnostic + the
+                # global-batch preservation rule) and the sampler
+                # position (topology-independent: consumed samples are
+                # global). Specs are NEVER loaded from here — resume
+                # re-derives them from the model + current mesh.
+                "topology": self._topology_desc(),
+                "batch": {
+                    "train_batch_size": self.config.train_batch_size,
+                    "micro": self.config.train_micro_batch_size_per_gpu,
+                    "gas": self.config.gradient_accumulation_steps,
+                },
+                "zero_plan": self.plan.describe(),
+                "sampler": (sampler.state_dict()
+                            if sampler is not None else None),
             },
         }
         path = os.path.join(save_dir, tag,
                             f"shard-{jax.process_index()}.npz")
+
+        # hot tier: replicate this shard to the ring neighbors off the
+        # critical path (advisory — a hot-tier failure can never cost
+        # the durable save). The dcn transport is collective, so it
+        # runs in-caller at this save boundary (every process is here).
+        if self.hot_store is not None:
+            if (os.environ.get("DSTPU_HOT_TRANSPORT") == "dcn"
+                    and jax.process_count() > 1):
+                self.hot_store.push_collective(tag, chunks, extra)
+            else:
+                self.hot_store.push_async(tag, chunks, extra)
 
         from .checkpoint_engine import manager as ckpt_manager
         keep_last = getattr(self.config.checkpoint_engine, "keep_last", 0)
@@ -1184,19 +1245,49 @@ class DeepSpeedEngine:
             "save", (time.perf_counter() - t_start) * 1e3)
         return tag
 
+    def _topology_desc(self):
+        t = self.topology
+        return {"world": int(self.mesh.size),
+                "dp": t.get_data_parallel_world_size(),
+                "tp": t.get_model_parallel_world_size(),
+                "ep": t.get_expert_parallel_world_size(),
+                "seq": t.get_sequence_parallel_world_size(),
+                "pipe": t.get_pipe_parallel_world_size()}
+
     def load_checkpoint(self, load_dir, tag=None,
                         load_optimizer_states=True,
-                        load_lr_scheduler_states=True):
+                        load_lr_scheduler_states=True,
+                        elastic_reshape=True):
         """reference engine.py:2750. Returns (path, client_state).
 
-        Recovery semantics: with no explicit ``tag``, candidates are the
-        'latest'-named generation first, then every other durable tag
-        newest-first — a corrupt or truncated shard (CRC mismatch, torn
-        zip, missing chunks) makes the loader FALL BACK to the previous
-        durable generation instead of crashing the restart. Only when a
-        checkpoint exists but NO generation is loadable does it raise
-        (resuming silently from scratch would be worse). An explicit
-        ``tag`` is never substituted."""
+        Recovery semantics: with no explicit ``tag``, the HOT TIER's
+        surviving in-memory replicas are tried first (the common
+        single-host loss restores with zero persistent-storage reads),
+        then the durable candidates: the 'latest'-named generation
+        first, then every other durable tag newest-first — a corrupt or
+        truncated shard (CRC mismatch, torn zip, missing chunks) makes
+        the loader FALL BACK to the previous durable generation instead
+        of crashing the restart. Only when a checkpoint exists but NO
+        generation is loadable does it raise (resuming silently from
+        scratch would be worse). An explicit ``tag`` is never
+        substituted. ``self.last_restore_tier`` records which tier
+        ('hot'/'durable') served the load; with ``'hot'`` the returned
+        path names the generation but may not exist on persistent
+        storage (a hot generation whose durable commit never landed is
+        deliberately restorable). Under an elastic agent
+        (``ELASTIC_GENERATION`` in the env), a checkpoint that exists
+        but has NO loadable generation exits with
+        ``CORRUPT_CKPT_EXIT_CODE`` so the agent classifies the failure
+        as corrupt-checkpoint (healthy host kept, backoff applied)
+        instead of dropping the host as dead.
+
+        Reshape-on-resume (``elastic_reshape``, default on): a
+        checkpoint written under a DIFFERENT dp×tp×ep topology or ZeRO
+        stage loads anyway — state re-partitions from the global logical
+        tensors onto the current plan, gradient-accumulation steps
+        rescale so the GLOBAL batch size is preserved, the sampler
+        position carries over (consumed samples are global), and the RNG
+        key is folded deterministically for the new mesh."""
         import os
         import time
         from .checkpoint_engine import serialization as ser
@@ -1205,6 +1296,8 @@ class DeepSpeedEngine:
         # drain, not wait: a previously FAILED async save must not block
         # reading the durable generations that did land
         self.checkpoint_engine.drain()
+        if self.hot_store is not None:
+            self.hot_store.wait()
 
         def loader(tag_dir):
             legacy = os.path.join(tag_dir, "state.npz")
@@ -1212,9 +1305,25 @@ class DeepSpeedEngine:
                 return self.checkpoint_engine.load(legacy)
             return ser.load_sharded(tag_dir)
 
-        cand, flat, header = ckpt_manager.load_best(
-            load_dir, tag, loader=loader,
-            counters=self.checkpoint_engine.counters)
+        try:
+            tier, cand, flat, header = ckpt_manager.load_best_tiered(
+                load_dir, tag, hot_store=self.hot_store, loader=loader,
+                counters=self.checkpoint_engine.counters)
+        except ser.CheckpointCorruptionError:
+            if os.environ.get("ELASTIC_GENERATION") is not None:
+                # supervised by an elastic agent: exit with the
+                # corrupt-checkpoint code so the agent keeps this
+                # (healthy) host and backs off instead of shrinking the
+                # world around a storage problem
+                from ..elasticity.elastic_agent import (
+                    CORRUPT_CKPT_EXIT_CODE)
+                logger.error(
+                    f"no loadable checkpoint generation under "
+                    f"{load_dir}; exiting {CORRUPT_CKPT_EXIT_CODE} for "
+                    f"the elastic agent's corrupt-checkpoint handling")
+                raise SystemExit(CORRUPT_CKPT_EXIT_CODE)
+            raise
+        self.last_restore_tier = tier
         if cand is None:
             return None, {}
         path = os.path.join(load_dir, cand)
@@ -1262,15 +1371,138 @@ class DeepSpeedEngine:
         if (load_lr_scheduler_states and self.lr_scheduler is not None
                 and extra.get("lr_scheduler") is not None):
             self.lr_scheduler.load_state_dict(extra["lr_scheduler"])
+        # sampler position: consumed samples are GLOBAL, so the position
+        # carries across any topology. Applied to a live sampler when
+        # one exists; stashed otherwise and installed by deepspeed_io
+        # when the sampler is built after the resume.
+        sampler_state = extra.get("sampler")
+        if sampler_state is not None:
+            live = getattr(self, "data_sampler", None)
+            if live is not None:
+                live.load_state_dict(sampler_state)
+            else:
+                self._resume_sampler_state = sampler_state
+        if elastic_reshape:
+            self._reshape_on_resume(extra)
         self._write_ckpt_monitor_events(
             "load", (time.perf_counter() - t_start) * 1e3)
         return path, extra.get("client_state", {})
+
+    def _preserve_saved_global_batch(self, extra):
+        """The global-batch preservation rule: the checkpoint's
+        train_batch_size wins over a batch DERIVED from a
+        micro-batch-only config (an EXPLICIT train_batch_size in the
+        user's raw config is their call and is respected, with a
+        warning). With the per-host micro batch fixed,
+        gradient-accumulation steps rescale to
+        ``saved_train_batch / (micro * dp)`` — an indivisible
+        combination raises instead of silently training at a different
+        effective batch. Returns True when the step programs were
+        rebuilt under the new gas."""
+        from .constants import TRAIN_BATCH_SIZE
+        saved_batch = extra.get("batch") or {}
+        target = saved_batch.get("train_batch_size")
+        if not target or target == self.config.train_batch_size:
+            return False
+        if TRAIN_BATCH_SIZE in getattr(self.config, "_raw", {}):
+            log_dist(
+                f"resume: checkpoint global batch {target} != the "
+                f"explicitly configured train_batch_size "
+                f"{self.config.train_batch_size}; the explicit config "
+                f"wins (drop train_batch_size from the config to "
+                f"preserve the checkpoint's batch across topologies)",
+                ranks=[0])
+            return False
+        micro = self.config.train_micro_batch_size_per_gpu
+        dp = self.topology.get_data_parallel_world_size()
+        new_gas = target // max(1, micro * dp)
+        if new_gas < 1 or new_gas * micro * dp != target:
+            raise ValueError(
+                f"reshape-on-resume: cannot preserve the global "
+                f"batch size {target} at dp={dp} with "
+                f"micro_batch={micro} (needs gradient_"
+                f"accumulation_steps={target}/{micro * dp}); "
+                f"pick a micro batch that divides it")
+        log_dist(
+            f"resume: preserving global batch {target}: "
+            f"gradient_accumulation_steps "
+            f"{self.config.gradient_accumulation_steps} -> {new_gas} "
+            f"at dp={dp}", ranks=[0])
+        self.config.gradient_accumulation_steps = new_gas
+        self.config.train_batch_size = target
+        self.tput_timer.batch_size = target
+        # gas is closed over by every jitted step program
+        self._build_programs()
+        return True
+
+    def _reshape_on_resume(self, extra):
+        """Adapt the resumed run to a topology change (runtime/zero/
+        partitioning.py reshape_diff documents what re-partitioned; the
+        device_put in load_checkpoint already re-sharded the global
+        logical tensors onto the current plan). Returns True when the
+        checkpoint was written under a different topology.
+
+        The global-batch preservation rule: the checkpoint's
+        train_batch_size wins. With the per-host micro batch fixed,
+        gradient-accumulation steps rescale to
+        ``saved_train_batch / (micro * new_dp)`` — an indivisible
+        combination raises instead of silently training at a different
+        effective batch. The RNG key folds with the new dp world so the
+        resumed world's per-microstep streams are deterministic (a
+        same-topology resume keeps the key bitwise)."""
+        from ..utils import fault_injection
+        from .zero.partitioning import reshape_diff
+        saved_topo = extra.get("topology") or {}
+        cur_topo = self._topology_desc()
+        stage_changed = ("zero_stage" in extra
+                        and extra["zero_stage"] != self.zero_stage)
+        topo_changed = bool(saved_topo) and saved_topo != cur_topo
+        # global-batch preservation runs REGARDLESS of a topology
+        # change: a run that was itself reshaped saves gas≠1 under its
+        # own topology, and a fresh same-topology engine built from the
+        # micro-batch-only config would silently shrink the effective
+        # batch on resume
+        rescaled = self._preserve_saved_global_batch(extra)
+        if rescaled:
+            # accumulation boundaries re-align to the new gas
+            self.micro_steps = self.global_step * \
+                self.config.gradient_accumulation_steps
+        if not topo_changed and not stage_changed:
+            return rescaled
+        fault_injection.fire("reshape")
+        diff = reshape_diff(extra.get("zero_plan"), self.plan)
+        log_dist(
+            f"reshape-on-resume: checkpoint topology {saved_topo} / "
+            f"stage {extra.get('zero_stage')} -> {cur_topo} / stage "
+            f"{self.zero_stage}; {len(diff['resharded'])} leaves "
+            f"re-partitioned (group {diff['old_partition_group']} -> "
+            f"{diff['new_partition_group']}), "
+            f"{len(diff['replicated'])} replicated on the new mesh",
+            ranks=[0])
+        if topo_changed:
+            self.micro_steps = self.global_step * \
+                self.config.gradient_accumulation_steps
+            # deterministic RNG fold for the new mesh: every surviving
+            # world derives the same key, distinct from the old world's
+            fold = int(cur_topo["dp"]) * 1000003 + int(cur_topo["world"])
+            rep = self.state_shardings["rng"]
+            with jax.set_mesh(self.mesh):
+                self.state["rng"] = jax.jit(
+                    lambda r: jax.random.fold_in(r, fold),
+                    out_shardings=rep)(self.state["rng"])
+        if self.monitor.enabled:
+            self.monitor.write_events([
+                ("Train/Checkpoint/reshape", 1, self.global_step),
+            ])
+        return True
 
     def save_checkpoint_terminate(self):
         """Fork parity (engine.py:3114): drain async checkpoint work."""
         dist.barrier()
         self.checkpoint_engine.wait()
         self.checkpoint_engine.shutdown()
+        if self.hot_store is not None:
+            self.hot_store.shutdown()
         dist.barrier()
 
     def save_16bit_model(self, save_dir, dtype=None):

@@ -127,3 +127,56 @@ class ZeroShardingPlan:
                  "grad": self.grad_specs}[which]
         return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
                             is_leaf=lambda x: isinstance(x, P))
+
+    def describe(self):
+        """JSON-able summary of the plan: stage, partition group sizes,
+        and the master-partition spec per leaf path. Saved into every
+        checkpoint's metadata — NOT consumed on load (specs are always
+        re-derived from the model + current mesh, the
+        ``match_partition_rules`` discipline: resume must be
+        topology-independent end to end) — but it lets
+        :func:`reshape_diff` report exactly which leaves re-partition
+        when a checkpoint lands on a different mesh."""
+        import jax
+        leaves = {}
+        for path, spec in jax.tree.leaves_with_path(
+                self.master_specs, is_leaf=lambda x: isinstance(x, P)):
+            key = "/".join(str(getattr(p, "key", getattr(p, "idx", p)))
+                           for p in path)
+            leaves[key] = [list(e) if isinstance(e, tuple) else e
+                           for e in spec]
+        return {
+            "stage": self.stage,
+            "partition_axes": list(self.partition_axes),
+            "partition_group": _axes_size(self.mesh, self.partition_axes),
+            "mesh_shape": {a: int(self.mesh.shape[a])
+                           for a in self.mesh.axis_names},
+            "master_specs": leaves,
+        }
+
+
+def reshape_diff(saved_desc, plan):
+    """Compare a checkpoint's recorded plan description against the plan
+    the CURRENT topology derived. -> dict with the leaves whose
+    partitioning changed ('resharded'), the leaves the new mesh cannot
+    partition and replicates instead ('replicated'), and the old/new
+    partition-group sizes. Purely diagnostic: the load path re-shards
+    from global logical tensors regardless; this tells the operator what
+    the reshape actually did (and a test what it MUST do)."""
+    new_desc = plan.describe()
+    old_specs = (saved_desc or {}).get("master_specs", {})
+    resharded, replicated = [], []
+    for key, new_spec in new_desc["master_specs"].items():
+        old_spec = old_specs.get(key)
+        if old_spec is not None and old_spec != new_spec:
+            resharded.append(key)
+        if plan.stage >= 1 and all(e is None for e in new_spec):
+            replicated.append(key)
+    return {
+        "resharded": sorted(resharded),
+        "replicated": sorted(replicated),
+        "old_partition_group": (saved_desc or {}).get("partition_group"),
+        "new_partition_group": new_desc["partition_group"],
+        "old_stage": (saved_desc or {}).get("stage"),
+        "new_stage": new_desc["stage"],
+    }

@@ -22,6 +22,18 @@ Injection points currently wired (grep for ``fault_injection.fire``):
                   tmp -> final os.replace
   commit          checkpoint_engine manager publish_latest, before the
                   'latest' pointer is replaced
+  replica_push    checkpoint_engine hot_tier, once per peer replica
+                  write (the in-memory hot tier's DCN push)
+  replica_fetch   checkpoint_engine hot_tier, once per remote-peer
+                  shard fetch during hot-tier assembly — arming it
+                  poisons the replicas so loads degrade to the durable
+                  tier
+  host_loss       elasticity/elastic_agent.py membership change, once
+                  per failed host (and hot_tier.purge_node) — the
+                  host-RAM-loss boundary of the hot tier
+  reshape         runtime/engine.py load_checkpoint, before the
+                  reshape-on-resume path re-partitions state onto a
+                  different topology
   kill            any of the above via ``kill=True`` — raises
                   SimulatedKill (BaseException) which NO layer retries,
                   modeling SIGKILL mid-save
@@ -41,6 +53,24 @@ failures and one rename failure after one clean rename.
 
 import os
 import threading
+
+# Canonical registry of every named injection point wired into
+# production code. tests/unit/test_fault_points_lint.py asserts (a)
+# each of these is fired somewhere in deepspeed_tpu/ and (b) each is
+# armed by at least one chaos test — so injection points cannot
+# silently rot when the code around them is refactored. Add the point
+# here WHEN you add its fire() call.
+KNOWN_POINTS = (
+    "d2h",
+    "serialize",
+    "write",
+    "rename",
+    "commit",
+    "replica_push",
+    "replica_fetch",
+    "host_loss",
+    "reshape",
+)
 
 
 class FaultError(OSError):

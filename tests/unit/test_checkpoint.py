@@ -294,3 +294,91 @@ def test_legacy_monolithic_layout_still_loads(tmp_path):
     np.testing.assert_allclose(
         float(e2.eval_loss(_batch(seed=5))),
         float(e.eval_loss(_batch(seed=5))), rtol=1e-6)
+
+
+def test_d2h_fault_fails_save_before_any_write(tmp_path):
+    """Chaos (d2h point): a failure during device->host staging aborts
+    the save BEFORE any byte lands — no tag dir, no 'latest', and the
+    previous durable generation still loads."""
+    from deepspeed_tpu.utils import fault_injection
+    from deepspeed_tpu.runtime.checkpoint_engine import manager
+    e = _engine(stage=1)
+    e.train_batch(_batch())
+    e.save_checkpoint(str(tmp_path))
+    e.train_batch(_batch())
+    fault_injection.arm("d2h", fails=1)
+    try:
+        with pytest.raises(fault_injection.FaultError):
+            e.save_checkpoint(str(tmp_path))
+    finally:
+        fault_injection.reset()
+    assert manager.read_latest(str(tmp_path)) == "global_step1"
+    assert not os.path.isdir(str(tmp_path / "global_step2"))
+    e2 = _engine(stage=1)
+    path, _ = e2.load_checkpoint(str(tmp_path))
+    assert path is not None and e2.global_step == 1
+
+
+def test_engine_hot_tier_roundtrip_and_purge(tmp_path):
+    """Engine-level hot tier: saves replicate into the store, a resume
+    with the durable dir GONE restores from the tier, and counters
+    record zero durable reads."""
+    import shutil
+    hot_root = str(tmp_path / "hot")
+    ckpt = str(tmp_path / "ckpt")
+
+    def eng():
+        groups.reset()
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2(CFG), config={
+                "train_micro_batch_size_per_gpu": 2,
+                "steps_per_print": 0,
+                "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+                "zero_optimization": {"stage": 2},
+                "checkpoint_engine": {"type": "async", "hot_tier": True,
+                                      "hot_root": hot_root}})
+        return engine
+
+    e1 = eng()
+    for _ in range(2):
+        e1.train_batch(_batch())
+    e1.save_checkpoint(ckpt)
+    e1.checkpoint_engine.wait()
+    e1.hot_store.wait()
+    assert e1.checkpoint_engine.counters["hot_pushes"] == 1
+    ref = float(e1.eval_loss(_batch(seed=5)))
+    shutil.rmtree(ckpt)                       # storage gone entirely
+
+    e2 = eng()
+    path, _ = e2.load_checkpoint(ckpt)
+    assert path is not None and e2.last_restore_tier == "hot"
+    assert e2.checkpoint_engine.counters["hot_restores"] == 1
+    assert e2.checkpoint_engine.counters["durable_restores"] == 0
+    np.testing.assert_allclose(float(e2.eval_loss(_batch(seed=5))),
+                               ref, rtol=1e-6)
+    e1.save_checkpoint_terminate()
+
+
+def test_all_corrupt_exits_corrupt_code_under_elastic_agent(
+        tmp_path, monkeypatch):
+    """Under an elastic agent (ELASTIC_GENERATION exported), a
+    checkpoint with generations but NO loadable one exits with
+    CORRUPT_CKPT_EXIT_CODE so the agent classifies corrupt_ckpt (host
+    kept, backoff) instead of dead (host dropped); unsupervised, the
+    CheckpointCorruptionError still raises."""
+    from deepspeed_tpu.elasticity.elastic_agent import (
+        CORRUPT_CKPT_EXIT_CODE)
+    e = _engine(stage=0)
+    e.train_batch(_batch())
+    tag = e.save_checkpoint(str(tmp_path))
+    shard = tmp_path / tag / "shard-0.npz"
+    with open(shard, "r+b") as f:
+        f.truncate(10)                       # every generation torn
+    e2 = _engine(stage=0)
+    with pytest.raises(ser.CheckpointCorruptionError):
+        e2.load_checkpoint(str(tmp_path))    # unsupervised: raises
+    monkeypatch.setenv("ELASTIC_GENERATION", "1")
+    e3 = _engine(stage=0)
+    with pytest.raises(SystemExit) as ei:
+        e3.load_checkpoint(str(tmp_path))
+    assert ei.value.code == CORRUPT_CKPT_EXIT_CODE

@@ -219,3 +219,46 @@ def test_serving_config_dict_roundtrip():
         assert back[k] == v
     # and the dumped dict reconstructs the identical config
     assert RaggedInferenceEngineConfig(**back) == cfg
+
+
+def test_checkpoint_hot_tier_defaults():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1})
+    ce = cfg.checkpoint_engine
+    assert ce.hot_tier == "auto"
+    assert ce.hot_replicas == 1
+    assert ce.hot_root == ""
+    assert ce.hot_keep_last == 2
+    # 'auto' without the launcher-exported ring env: off — even
+    # multi-process (the fs transport into node-local tmpfs can't serve
+    # a host-loss restore unless the ring/dcn env was wired)
+    import os
+    for k in ("DSTPU_HOT_PEERS", "DSTPU_HOT_TIER_ROOT",
+              "DSTPU_HOT_TRANSPORT"):
+        assert k not in os.environ
+    assert ce.resolve_hot_tier(1) is False
+    assert ce.resolve_hot_tier(4) is False
+
+
+def test_checkpoint_hot_tier_block_parses(monkeypatch):
+    cfg = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 1,
+         "checkpoint_engine": {"type": "async", "hot_tier": True,
+                               "hot_replicas": 2,
+                               "hot_root": "/dev/shm/x",
+                               "hot_keep_last": 3}})
+    ce = cfg.checkpoint_engine
+    assert (ce.hot_tier, ce.hot_replicas, ce.hot_root,
+            ce.hot_keep_last) == (True, 2, "/dev/shm/x", 3)
+    assert ce.resolve_hot_tier(1) is True
+    # env hint flips 'auto' on even single-process
+    monkeypatch.setenv("DSTPU_HOT_PEERS", "a,b")
+    cfg2 = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1})
+    assert cfg2.checkpoint_engine.resolve_hot_tier(1) is True
+
+
+def test_checkpoint_hot_tier_validation():
+    for bad in ({"hot_tier": "yes"}, {"hot_replicas": -1},
+                {"hot_keep_last": 0}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                             "checkpoint_engine": bad})

@@ -1,16 +1,19 @@
-"""Fast DSElasticAgent coverage (satellite of ISSUE 2): restart-budget
-exhaustion, shrink below min_hosts, inadmissible-world rejection, and
-the new heartbeat/hang detector — all against stub processes so the
-suite is deterministic and runs inside tier-1 (the subprocess-based
-end-to-end resume test stays in test_elastic_agent.py's slow set)."""
+"""Fast DSElasticAgent coverage (satellite of ISSUE 2; grown by ISSUE 7):
+restart-budget exhaustion, shrink below min_hosts, inadmissible-world
+rejection, the heartbeat/hang detector, surviving-topology computation,
+failure classification with per-class backoff, and hot-tier pointing —
+all against stub processes so the suite is deterministic and runs inside
+tier-1 (the subprocess-based end-to-end resume tests stay in
+test_elastic_agent.py's slow set)."""
 
 import os
 import time
 
 import pytest
 
-from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
-                                                    WorldFailure)
+from deepspeed_tpu.elasticity.elastic_agent import (
+    CORRUPT_CKPT_EXIT_CODE, DSElasticAgent, WorldFailure)
+from deepspeed_tpu.utils import fault_injection
 
 
 class StubProc:
@@ -189,3 +192,209 @@ class TestHeartbeatLiveness:
     def test_disabled_by_default(self):
         agent = DSElasticAgent(lambda hosts: [], ["a"])
         assert agent._hung("a", 0.0) is False    # even 'launched' at epoch
+
+
+class TestHeartbeatRemoteHosts:
+    """ISSUE 7 satellite: the /tmp default heartbeat_dir silently makes
+    every healthy ssh-launched remote worker look hung. The agent now
+    refuses that combination up front instead of killing a healthy
+    world."""
+
+    def test_default_tmp_dir_with_remote_hosts_fails_fast(self):
+        with pytest.raises(WorldFailure, match="shared"):
+            DSElasticAgent(lambda hosts: [], ["tpu-worker-0",
+                                              "tpu-worker-1"],
+                           heartbeat_timeout_s=10.0)
+
+    def test_explicit_dir_with_remote_hosts_is_trusted(self, tmp_path):
+        # warns about the shared-FS requirement but constructs
+        agent = DSElasticAgent(lambda hosts: [], ["tpu-worker-0"],
+                               heartbeat_timeout_s=10.0,
+                               heartbeat_dir=str(tmp_path))
+        assert agent.heartbeat_dir == str(tmp_path)
+
+    def test_local_hosts_keep_the_tmp_default(self):
+        import socket
+        for h in ("localhost", "127.0.0.1", socket.gethostname()):
+            agent = DSElasticAgent(lambda hosts: [], [h],
+                                   heartbeat_timeout_s=10.0)
+            assert "/tmp" in agent.heartbeat_dir
+
+    def test_no_hang_detection_means_no_check(self):
+        agent = DSElasticAgent(lambda hosts: [], ["tpu-worker-0"])
+        assert agent.heartbeat_timeout_s is None
+
+
+class TestSurvivingTopology:
+    def test_topology_not_just_world_size(self):
+        agent = DSElasticAgent(lambda hosts: [], ["a", "b", "c", "d"],
+                               chips_per_host=4, tensor_parallel=2,
+                               expert_parallel=2)
+        topo = agent.compute_topology(["a", "b", "c"])
+        assert topo == {"world": 12, "dp": 3, "tp": 2, "ep": 2,
+                        "pipe": 1, "seq": 1, "hosts": ["a", "b", "c"]}
+
+    def test_fixed_factors_gate_admissibility(self):
+        # tp*ep = 8 does not divide a 1-host x 4-chip survivor world
+        agent = DSElasticAgent(lambda hosts: [], ["a", "b"],
+                               chips_per_host=4, tensor_parallel=8)
+        with pytest.raises(WorldFailure, match="tp\\*ep"):
+            agent.compute_topology(["a"])
+
+    def test_shrink_to_inadmissible_topology_aborts_run(self):
+        # dp shrinks 2 -> ... but tp=4 with 2 chips/host: one surviving
+        # host gives world 2, not divisible by 4 -> WorldFailure
+        agent = DSElasticAgent(
+            _launcher(lambda h, hosts: 1 if h == "b" else 0),
+            ["a", "b"], chips_per_host=2, tensor_parallel=4,
+            poll_s=0.001)
+        with pytest.raises(WorldFailure, match="admissible topology"):
+            agent.run()
+
+    def test_two_arg_launcher_receives_topology(self):
+        seen = []
+
+        def launch(hosts, topology):
+            seen.append(topology)
+            return [(h, StubProc(rc=0)) for h in hosts]
+
+        agent = DSElasticAgent(launch, ["a", "b"], chips_per_host=2,
+                               poll_s=0.001)
+        agent.run()
+        assert seen and seen[0]["world"] == 4 and seen[0]["dp"] == 4
+
+    def test_worker_env_exports_ring(self, tmp_path):
+        agent = DSElasticAgent(lambda hosts: [], ["a", "b", "c"],
+                               hot_root=str(tmp_path),
+                               heartbeat_timeout_s=5.0,
+                               heartbeat_dir=str(tmp_path / "hb"))
+        env = agent.worker_env("b")
+        assert env["DSTPU_HOT_TIER_ROOT"] == str(tmp_path)
+        assert env["DSTPU_HOT_NODE"] == "b"
+        assert env["DSTPU_HOT_PEERS"] == "a,b,c"
+        assert env["DSTPU_HEARTBEAT_FILE"] == agent.heartbeat_path("b")
+
+
+class TestFailureClassification:
+    def test_dead_host_is_dropped_and_classified(self):
+        died = {"b": False}
+
+        def rc_for(h, hosts):
+            if h == "b" and not died["b"]:
+                died["b"] = True
+                return 1
+            return 0
+
+        agent = DSElasticAgent(_launcher(rc_for), ["a", "b"],
+                               poll_s=0.001)
+        assert agent.run() == ["a"]
+        assert agent.last_failures == {"b": "dead"}
+
+    def test_corrupt_ckpt_exit_keeps_the_host(self):
+        """A corrupt-checkpoint exit means the HOST is healthy: the
+        world relaunches unshrunk after the corrupt-class backoff."""
+        tries = {"n": 0}
+
+        def rc_for(h, hosts):
+            if h == "a" and tries["n"] == 0:
+                tries["n"] += 1
+                return CORRUPT_CKPT_EXIT_CODE
+            return 0
+
+        agent = DSElasticAgent(
+            _launcher(rc_for), ["a", "b"], poll_s=0.001,
+            restart_backoff_s={"corrupt_ckpt": 0.05})
+        t0 = time.time()
+        final = agent.run()
+        assert final == ["a", "b"]               # world NOT shrunk
+        assert agent.restart_count == 1
+        assert agent.last_failures == {"a": "corrupt_ckpt"}
+        assert time.time() - t0 >= 0.05          # backoff applied
+
+    def test_per_class_backoff_zero_for_dead(self):
+        died = {"b": False}
+
+        def rc_for(h, hosts):
+            if h == "b" and not died["b"]:
+                died["b"] = True
+                return 1
+            return 0
+
+        agent = DSElasticAgent(
+            _launcher(rc_for), ["a", "b"], poll_s=0.001,
+            restart_backoff_s={"dead": 0.0, "corrupt_ckpt": 30.0})
+        t0 = time.time()
+        agent.run()
+        assert time.time() - t0 < 5.0            # no corrupt backoff
+
+    def test_hung_worker_classified_hung(self, tmp_path):
+        def rc_for(h, hosts):
+            if h == "b" and len(hosts) == 2:
+                return None                      # hangs, never beats
+            return 0
+
+        agent = DSElasticAgent(
+            _launcher(rc_for), ["a", "b"], poll_s=0.01,
+            heartbeat_timeout_s=0.15, heartbeat_dir=str(tmp_path))
+        assert agent.run() == ["a"]
+        assert agent.last_failures == {"b": "hung"}
+
+
+class TestHotTierPointing:
+    def test_dead_host_store_purged_and_host_loss_fires(self, tmp_path):
+        """On membership change the agent drops the dead host's
+        hot-tier store (its RAM is gone) — survivors' replicas are the
+        restore source — and the host_loss fault point fires."""
+        from deepspeed_tpu.runtime.checkpoint_engine import hot_tier
+        root = str(tmp_path)
+        stores = {p: hot_tier.HotTierStore(root=root, node=p,
+                                           peers=["a", "b"], replicas=1)
+                  for p in ("a", "b")}
+        stores["b"].push("global_step1", {"w#0.0": __import__(
+            "numpy").zeros((2,), "float32")},
+            {"index": {"w": {"shape": [2], "dtype": "float32",
+                             "chunks": [{"key": "w#0.0",
+                                         "start": [0]}]}},
+             "__tree_meta__": {}, "user_extra": {"global_step": 1,
+                                                 "nprocs": 1}},
+            shard_name="shard-0.npz")
+        died = {"b": False}
+
+        def rc_for(h, hosts):
+            if h == "b" and not died["b"]:
+                died["b"] = True
+                return 1
+            return 0
+
+        fault_injection.reset()
+        agent = DSElasticAgent(_launcher(rc_for), ["a", "b"],
+                               poll_s=0.001, hot_root=root)
+        assert agent.run() == ["a"]
+        assert not os.path.isdir(os.path.join(root, "b"))   # purged
+        # the replica b pushed to a survives and is restorable
+        tag, _, _ = stores["a"].load_best()
+        assert tag == "global_step1"
+        assert fault_injection.injector.fired("host_loss") == 1
+        fault_injection.reset()
+
+    def test_armed_host_loss_aborts_recovery(self):
+        """Chaos: host_loss armed with kill models the agent itself
+        dying mid-recovery — the error must propagate (a supervisor
+        above owns that restart), never be swallowed."""
+        died = {"b": False}
+
+        def rc_for(h, hosts):
+            if h == "b" and not died["b"]:
+                died["b"] = True
+                return 1
+            return 0
+
+        fault_injection.reset()
+        fault_injection.arm("host_loss", kill=True)
+        agent = DSElasticAgent(_launcher(rc_for), ["a", "b"],
+                               poll_s=0.001)
+        try:
+            with pytest.raises(fault_injection.SimulatedKill):
+                agent.run()
+        finally:
+            fault_injection.reset()

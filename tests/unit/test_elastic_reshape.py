@@ -1,0 +1,253 @@
+"""Reshape-on-resume (ISSUE 7 tentpole b): a checkpoint saved under one
+dp×tp×ep topology loads onto a different one — optimizer state
+re-partitions from the global logical tensors, gradient-accumulation
+steps rescale to preserve the GLOBAL batch size, the sampler position
+carries over, and the RNG folds deterministically for the new mesh.
+
+Fast tests cover the pure plan/diff arithmetic (no jit); the engine
+parity runs (dp=4 save -> dp=2 / dp=1 load, zero-3 -> zero-1 cross-stage
+load, trajectories matching the same-topology resume) are compile-heavy
+and ride in the slow set.
+"""
+
+import os
+
+import numpy as np
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import deepspeed_tpu
+from deepspeed_tpu.models import GPT2, GPT2Config
+from deepspeed_tpu.utils import fault_injection, groups
+from deepspeed_tpu.utils.groups import TopologyConfig
+from deepspeed_tpu.runtime.zero.partitioning import (ZeroShardingPlan,
+                                                     reshape_diff)
+
+CFG = GPT2Config(n_layer=1, n_head=2, d_model=32, max_seq_len=16,
+                 vocab_size=64, remat=False, dtype="float32")
+
+
+def _plan(ndev, stage=1):
+    groups.reset()
+    topo = groups.initialize(TopologyConfig(),
+                             devices=jax.devices()[:ndev], force=True)
+    shapes = {"w": (8, 32), "b": (32,)}
+    tp_specs = {"w": P(), "b": P()}
+    return ZeroShardingPlan(stage, topo.mesh, tp_specs, shapes)
+
+
+def _engine(ndev, stage=1, micro=2, extra_cfg=None):
+    groups.reset()
+    topo = groups.initialize(TopologyConfig(),
+                             devices=jax.devices()[:ndev], force=True)
+    cfg = {"train_micro_batch_size_per_gpu": micro,
+           "steps_per_print": 0,
+           "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+           "zero_optimization": {"stage": stage}}
+    cfg.update(extra_cfg or {})
+    engine, _, _, _ = deepspeed_tpu.initialize(model=GPT2(CFG),
+                                               topology=topo, config=cfg)
+    return engine
+
+
+def _batch(bsz, seed=0):
+    rng = np.random.RandomState(seed)
+    return {"input_ids": rng.randint(
+        0, CFG.vocab_size, (bsz, CFG.max_seq_len)).astype(np.int32)}
+
+
+# ----------------------------------------------------------- fast: plans
+class TestPlanDescribe:
+    def test_describe_is_jsonable_and_names_leaves(self):
+        import json
+        plan = _plan(4, stage=2)
+        desc = plan.describe()
+        json.dumps(desc)                       # must serialize
+        assert desc["stage"] == 2
+        assert desc["partition_group"] == 4
+        assert set(desc["master_specs"]) == {"w", "b"}
+        # the 8x32 leaf partitions over DP; 32 % 4 == 0 on last dim
+        assert any(e is not None for e in desc["master_specs"]["w"])
+
+    def test_reshape_diff_reports_group_change(self):
+        old = _plan(4, stage=2).describe()
+        new_plan = _plan(2, stage=2)
+        diff = reshape_diff(old, new_plan)
+        assert diff["old_partition_group"] == 4
+        assert diff["new_partition_group"] == 2
+        assert diff["old_stage"] == diff["new_stage"] == 2
+
+    def test_reshape_diff_flags_replicated_leaves(self):
+        """A leaf no mesh dim divides is REPORTED as replicated on the
+        new mesh, not silently mis-sharded (specs always re-derive from
+        shapes — the match_partition_rules discipline)."""
+        groups.reset()
+        topo = groups.initialize(TopologyConfig(),
+                                 devices=jax.devices()[:8], force=True)
+        plan = ZeroShardingPlan(1, topo.mesh, {"odd": P()},
+                                {"odd": (7, 3)})
+        diff = reshape_diff(None, plan)
+        assert diff["replicated"] == ["odd"]
+
+    def test_reshape_diff_handles_missing_saved_desc(self):
+        plan = _plan(2, stage=1)
+        diff = reshape_diff(None, plan)
+        assert diff["old_partition_group"] is None
+        assert diff["new_partition_group"] == 2
+
+
+# --------------------------------------------- slow: engine parity runs
+@pytest.mark.slow
+class TestReshapeParity:
+    """Acceptance: save at dp=4, load at dp=2 and dp=1 (and a zero-3 ->
+    zero-1 cross-stage load), step both worlds — optimizer state,
+    grad-accum rescale, and RNG fold produce loss trajectories matching
+    the same-topology resume."""
+
+    def _save_dp4(self, tmp_path, stage=1, steps=2):
+        e = _engine(4, stage=stage)
+        assert e.config.train_batch_size == 8
+        b = _batch(8)
+        for _ in range(steps):
+            e.train_batch(b)
+        e.save_checkpoint(str(tmp_path))
+        return e
+
+    def _resume_trajectory(self, tmp_path, ndev, stage=1, steps=3):
+        e = _engine(ndev, stage=stage)
+        path, _ = e.load_checkpoint(str(tmp_path))
+        assert path is not None
+        # the global batch is PRESERVED: gas rescaled so
+        # micro * gas * dp == 8 everywhere
+        assert e.config.train_batch_size == 8
+        assert (e.config.train_micro_batch_size_per_gpu
+                * e.config.gradient_accumulation_steps
+                * e.topology.get_data_parallel_world_size()) == 8
+        b = _batch(8)
+        return e, [float(e.train_batch(b)) for _ in range(steps)]
+
+    @pytest.mark.parametrize("ndev,expect_gas", [(2, 2), (1, 4)])
+    def test_shrunk_world_matches_same_topology_resume(
+            self, tmp_path, ndev, expect_gas):
+        self._save_dp4(tmp_path)
+        ref_engine, ref = self._resume_trajectory(tmp_path, 4)
+        assert ref_engine.config.gradient_accumulation_steps == 1
+        eng, got = self._resume_trajectory(tmp_path, ndev)
+        assert eng.config.gradient_accumulation_steps == expect_gas
+        assert eng.global_step == ref_engine.global_step
+        np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+    def test_zero3_to_zero1_cross_stage_reshaped_world(self, tmp_path):
+        """Cross-STAGE and cross-TOPOLOGY at once: zero-3 dp=4 state
+        lands on a zero-1 dp=2 plan and the trajectory still matches
+        the same-topology resume."""
+        self._save_dp4(tmp_path, stage=3)
+        _, ref = self._resume_trajectory(tmp_path, 4, stage=3)
+        eng, got = self._resume_trajectory(tmp_path, 2, stage=1)
+        assert eng.zero_stage == 1
+        np.testing.assert_allclose(got, ref, rtol=2e-4)
+
+    def test_rng_fold_is_deterministic_per_topology(self, tmp_path):
+        """Two identical dp=2 resumes derive the SAME folded key; a
+        same-topology resume keeps the saved key bitwise."""
+        saver = self._save_dp4(tmp_path)
+        saved_key = np.asarray(jax.random.key_data(saver.state["rng"]))
+        e_a = _engine(2)
+        e_a.load_checkpoint(str(tmp_path))
+        e_b = _engine(2)
+        e_b.load_checkpoint(str(tmp_path))
+        ka = np.asarray(jax.random.key_data(e_a.state["rng"]))
+        kb = np.asarray(jax.random.key_data(e_b.state["rng"]))
+        np.testing.assert_array_equal(ka, kb)      # deterministic fold
+        assert not np.array_equal(ka, saved_key)   # folded, not reused
+        e_same = _engine(4)
+        e_same.load_checkpoint(str(tmp_path))
+        np.testing.assert_array_equal(
+            np.asarray(jax.random.key_data(e_same.state["rng"])),
+            saved_key)                             # bitwise on same topo
+
+    def test_micro_steps_realign_to_new_gas(self, tmp_path):
+        self._save_dp4(tmp_path, steps=3)
+        e, _ = self._resume_trajectory(tmp_path, 2, steps=1)
+        # after the resume step: boundaries aligned to the new gas
+        assert e.is_gradient_accumulation_boundary()
+
+    def test_reshaped_runs_checkpoint_resumes_same_topology(
+            self, tmp_path):
+        """Regression (found by driving the full disaster cycle): a run
+        that was itself reshaped saves gas=2 under its own topology; a
+        fresh SAME-topology engine built from the micro-batch-only
+        config must preserve that global batch instead of silently
+        halving it — while an EXPLICIT train_batch_size in the raw
+        config still wins."""
+        self._save_dp4(tmp_path)
+        e_shrunk, _ = self._resume_trajectory(tmp_path, 2)   # gas 1->2
+        e_shrunk.save_checkpoint(str(tmp_path))              # dp=2 ckpt
+        # same topology (dp=2), derived batch: preserved
+        e_again = _engine(2)
+        assert e_again.config.train_batch_size == 4          # derived
+        e_again.load_checkpoint(str(tmp_path))
+        assert e_again.config.train_batch_size == 8
+        assert e_again.config.gradient_accumulation_steps == 2
+        # explicit train_batch_size: the user's call, NOT overridden
+        e_explicit = _engine(2, extra_cfg={"train_batch_size": 4})
+        e_explicit.load_checkpoint(str(tmp_path))
+        assert e_explicit.config.train_batch_size == 4
+        assert e_explicit.config.gradient_accumulation_steps == 1
+
+    def test_indivisible_global_batch_raises(self, tmp_path):
+        """dp=3 cannot hold global batch 8 with micro=2 — the resume
+        refuses loudly instead of silently training at a different
+        effective batch."""
+        self._save_dp4(tmp_path)
+        e = _engine(3, micro=2)
+        with pytest.raises(ValueError, match="global batch"):
+            e.load_checkpoint(str(tmp_path))
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestReshapeChaos:
+    def test_kill_at_reshape_boundary_costs_nothing(self, tmp_path):
+        """SimulatedKill at the reshape fault point aborts the resume
+        mid-flight; the durable checkpoint stays fully loadable and a
+        clean retry succeeds."""
+        e = _engine(4)
+        e.train_batch(_batch(8))
+        e.save_checkpoint(str(tmp_path))
+        e2 = _engine(2)
+        fault_injection.arm("reshape", kill=True)
+        try:
+            with pytest.raises(fault_injection.SimulatedKill):
+                e2.load_checkpoint(str(tmp_path))
+        finally:
+            fault_injection.reset()
+        e3 = _engine(2)
+        path, _ = e3.load_checkpoint(str(tmp_path))
+        assert path is not None and e3.global_step == 1
+
+    def test_sampler_position_survives_reshape(self, tmp_path):
+        """The data-efficiency sampler's consumed-samples position is
+        GLOBAL: it carries to the shrunken world so no sample is
+        replayed or skipped."""
+        de = {"data_efficiency": {"enabled": True, "seed": 7}}
+        e = _engine(4, extra_cfg=de)
+        dataset = [{"input_ids": np.full((CFG.max_seq_len,), i % 64,
+                                         np.int32)} for i in range(64)]
+        loader = e.deepspeed_io(dataset, shuffle=False)
+        it = iter(loader)
+        for _ in range(2):
+            e.train_batch(next(it))
+        assert e.data_sampler.consumed_samples == 16
+        e.save_checkpoint(str(tmp_path))
+
+        e2 = _engine(2, extra_cfg=de)
+        e2.load_checkpoint(str(tmp_path))
+        # sampler built AFTER the resume picks the stashed position up
+        loader2 = e2.deepspeed_io(dataset, shuffle=False)
+        assert e2.data_sampler.consumed_samples == 16
+        nxt = next(iter(loader2))
+        # global batch preserved -> the next 8 samples are 16..23
+        np.testing.assert_array_equal(
+            nxt["input_ids"][:, 0], np.arange(16, 24) % 64)

@@ -1,0 +1,96 @@
+"""Fault-point lint (ISSUE 7 satellite): the named injection points in
+utils/fault_injection.py are only worth anything while (a) production
+code actually fires them and (b) some chaos test actually arms them.
+Both halves rot silently under refactors — a renamed fire() site or a
+deleted test leaves a point that LOOKS chaos-covered but never is. This
+lint pins both halves to the KNOWN_POINTS registry."""
+
+import os
+import re
+
+import pytest
+
+from deepspeed_tpu.utils import fault_injection
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+PKG = os.path.join(REPO, "deepspeed_tpu")
+TESTS = os.path.dirname(os.path.abspath(__file__))
+
+
+def _py_files(root):
+    for dirpath, _, names in os.walk(root):
+        if "__pycache__" in dirpath:
+            continue
+        for n in names:
+            if n.endswith(".py"):
+                yield os.path.join(dirpath, n)
+
+
+def _read(path):
+    with open(path, encoding="utf-8") as f:
+        return f.read()
+
+
+def test_registry_is_complete():
+    """Every fire('<literal>') in the package names a registered point —
+    a new injection point must be added to KNOWN_POINTS (where the lint
+    can see it) before it ships."""
+    fired = set()
+    for path in _py_files(PKG):
+        for m in re.finditer(r"""fire\(\s*["']([a-z_]+)["']\s*\)""",
+                             _read(path)):
+            fired.add(m.group(1))
+    unregistered = fired - set(fault_injection.KNOWN_POINTS)
+    assert not unregistered, (
+        f"injection points fired in production code but missing from "
+        f"fault_injection.KNOWN_POINTS: {sorted(unregistered)}")
+
+
+def test_every_registered_point_is_fired_in_production_code():
+    blob = "\n".join(_read(p) for p in _py_files(PKG))
+    dead = [p for p in fault_injection.KNOWN_POINTS
+            if not re.search(r"""fire\(\s*["']%s["']\s*\)""" % p, blob)]
+    assert not dead, (
+        f"KNOWN_POINTS entries no production code fires (stale "
+        f"registry or lost fire() site): {dead}")
+
+
+def test_every_registered_point_is_armed_by_a_chaos_test():
+    """Each point must appear, by name, in at least one test file that
+    arms faults (fault_injection.arm(...) or the DSTPU_FAULT_INJECT
+    env) — so a deleted/renamed chaos test cannot silently strand an
+    injection point with zero coverage."""
+    arming_blobs = []
+    for path in _py_files(TESTS):
+        if os.path.basename(path) == os.path.basename(__file__):
+            continue
+        text = _read(path)
+        if "fault_injection.arm" in text or "DSTPU_FAULT_INJECT" in text:
+            arming_blobs.append(text)
+    assert arming_blobs, "no arming test files found at all"
+    blob = "\n".join(arming_blobs)
+    unarmed = [p for p in fault_injection.KNOWN_POINTS
+               if f'"{p}"' not in blob and f"'{p}'" not in blob]
+    assert not unarmed, (
+        f"registered injection points no chaos test arms: {unarmed} — "
+        f"add an arm()/DSTPU_FAULT_INJECT test before shipping the "
+        f"point")
+
+
+@pytest.mark.chaos
+def test_new_points_exist_and_fire():
+    """The ISSUE-7 points are registered and behave like every other
+    point (countdown, budget, kill)."""
+    for p in ("replica_push", "replica_fetch", "host_loss", "reshape"):
+        assert p in fault_injection.KNOWN_POINTS
+    fault_injection.reset()
+    try:
+        fault_injection.arm("reshape", fails=1, skip=1)
+        fault_injection.fire("reshape")              # skipped
+        with pytest.raises(fault_injection.FaultError):
+            fault_injection.fire("reshape")
+        fault_injection.fire("reshape")              # healed
+        assert fault_injection.injector.hits("reshape") == 1
+    finally:
+        fault_injection.reset()

@@ -1,0 +1,382 @@
+"""Hot-tier chaos suite (ISSUE 7 tentpole a): peer-replicated in-memory
+checkpoints, tier-ordered restore, and deterministic degradation.
+
+Everything here runs at the store/manager layer (plain numpy trees, no
+model, no jit) so the matrix is fast and deterministic enough for
+tier-1; the real-process kill-a-host-and-resume-from-the-hot-tier runs
+ride in tests/unit/test_elastic_agent.py's slow set.
+
+The invariant under test: the common single-host loss restores from
+surviving replicas with ZERO persistent-storage reads, and ANY hot-tier
+defect (missing replicas, CRC-corrupt replica, poisoned replica_fetch)
+degrades to the durable tier instead of failing the resume.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.utils import fault_injection
+from deepspeed_tpu.runtime.checkpoint_engine import hot_tier
+from deepspeed_tpu.runtime.checkpoint_engine import manager
+from deepspeed_tpu.runtime.checkpoint_engine import serialization as ser
+from deepspeed_tpu.runtime.checkpoint_engine.engines import (
+    SyncCheckpointEngine)
+
+pytestmark = pytest.mark.chaos
+
+PEERS = ["h0", "h1", "h2", "h3"]
+
+
+@pytest.fixture(autouse=True)
+def _reset_faults():
+    fault_injection.reset()
+    yield
+    fault_injection.reset()
+
+
+def _tree(step):
+    return {"w": np.full((4, 3), float(step), np.float32),
+            "b": np.arange(5, dtype=np.int64) + step}
+
+
+def _payload(step, nprocs=1):
+    chunks, index, meta = ser.extract_local_chunks(_tree(step))
+    extra = {"index": index, "__tree_meta__": meta,
+             "user_extra": {"global_step": step, "nprocs": nprocs}}
+    return chunks, extra
+
+
+def _stores(root, peers=PEERS, replicas=1, **kw):
+    return {p: hot_tier.HotTierStore(root=str(root), node=p, peers=peers,
+                                     replicas=replicas, **kw)
+            for p in peers}
+
+
+def _durable_generation(save_dir, step):
+    """One durable generation via the engine-level save protocol."""
+    eng = SyncCheckpointEngine(None)
+    tag = f"global_step{step}"
+    chunks, extra = _payload(step)
+    eng.save((chunks, extra),
+             os.path.join(save_dir, tag, "shard-0.npz"),
+             on_durable=lambda: manager.publish_latest(save_dir, tag))
+    return tag
+
+
+class TestRingTopology:
+    def test_neighbors_k1(self):
+        s = hot_tier.HotTierStore(root="/nonexistent-unused", node="h1",
+                                  peers=PEERS, replicas=1)
+        assert s.ring_neighbors() == ["h2"]
+
+    def test_neighbors_k2_wraps(self):
+        s = hot_tier.HotTierStore(root="/nonexistent-unused", node="h3",
+                                  peers=PEERS, replicas=2)
+        assert s.ring_neighbors() == ["h0", "h1"]
+
+    def test_single_node_has_no_neighbors(self):
+        s = hot_tier.HotTierStore(root="/nonexistent-unused", node="h0",
+                                  peers=["h0"], replicas=3)
+        assert s.ring_neighbors() == []
+
+    def test_replicas_capped_by_ring_size(self):
+        s = hot_tier.HotTierStore(root="/nonexistent-unused", node="h0",
+                                  peers=["h0", "h1"], replicas=5)
+        assert s.ring_neighbors() == ["h1"]
+
+
+class TestPushRestore:
+    def test_roundtrip_from_own_store(self, tmp_path):
+        stores = _stores(tmp_path)
+        chunks, extra = _payload(3)
+        n = stores["h0"].push("global_step3", chunks, extra,
+                              shard_name="shard-0.npz")
+        assert n == 1                             # one ring replica
+        tag, flat, header = stores["h0"].load_best()
+        assert tag == "global_step3"
+        assert header["extra"]["global_step"] == 3
+        np.testing.assert_array_equal(flat["w"], _tree(3)["w"])
+        # own-store read: no replica fetch fired
+        assert fault_injection.injector.fired("replica_fetch") == 0
+        assert fault_injection.injector.fired("replica_push") == 1
+
+    def test_host_loss_restores_from_surviving_replica(self, tmp_path):
+        """THE common failure: the writer host dies; its ring neighbor
+        holds the replica and the resume never touches storage."""
+        stores = _stores(tmp_path)
+        chunks, extra = _payload(5)
+        stores["h0"].push("global_step5", chunks, extra,
+                          shard_name="shard-0.npz")
+        hot_tier.purge_node(str(tmp_path), "h0")   # host RAM gone
+        tag, flat, header = stores["h1"].load_best()
+        assert tag == "global_step5"
+        np.testing.assert_array_equal(flat["w"], _tree(5)["w"])
+        # the restore read a REPLICA (fired) — and nothing else existed
+        assert fault_injection.injector.fired("replica_fetch") >= 1
+
+    def test_non_neighbor_cannot_restore_after_purge(self, tmp_path):
+        """K=1: only the next ring neighbor holds the replica; a purge
+        of both writer and neighbor loses the generation (that's what
+        the durable tier is for)."""
+        stores = _stores(tmp_path)
+        chunks, extra = _payload(5)
+        stores["h0"].push("global_step5", chunks, extra,
+                          shard_name="shard-0.npz")
+        hot_tier.purge_node(str(tmp_path), "h0")
+        hot_tier.purge_node(str(tmp_path), "h1")
+        tag, _, _ = stores["h2"].load_best()
+        assert tag is None
+
+    def test_k2_survives_double_host_loss(self, tmp_path):
+        stores = _stores(tmp_path, replicas=2)
+        chunks, extra = _payload(7)
+        stores["h0"].push("global_step7", chunks, extra,
+                          shard_name="shard-0.npz")
+        hot_tier.purge_node(str(tmp_path), "h0")
+        hot_tier.purge_node(str(tmp_path), "h1")
+        tag, flat, _ = stores["h2"].load_best()
+        assert tag == "global_step7"
+        np.testing.assert_array_equal(flat["w"], _tree(7)["w"])
+
+    def test_newest_generation_wins(self, tmp_path):
+        stores = _stores(tmp_path)
+        for step in (1, 2, 10):
+            chunks, extra = _payload(step)
+            stores["h0"].push(f"global_step{step}", chunks, extra,
+                              shard_name="shard-0.npz")
+        tag, _, header = stores["h0"].load_best()
+        assert tag == "global_step10"              # step order, not lex
+        assert header["extra"]["global_step"] == 10
+
+    def test_multi_writer_assembly_across_stores(self, tmp_path):
+        """A 2-writer world: each writer pushes ITS shard; a reader
+        assembles the generation from shards scattered across stores
+        (h1's own + the replica h0 pushed to it)."""
+        stores = _stores(tmp_path, peers=["h0", "h1"], replicas=1)
+        t0, t1 = _tree(1), {"w": np.full((4, 3), 9.0, np.float32),
+                            "b": np.arange(5, dtype=np.int64)}
+        # writer 0: rows 0-1 of w; writer 1: rows 2-3 (chunked layout)
+        c0 = {"w#0.0": t0["w"][:2], "b#0.0": t0["b"]}
+        i0 = {"w": {"shape": [4, 3], "dtype": "float32",
+                    "chunks": [{"key": "w#0.0", "start": [0, 0]}]},
+              "b": {"shape": [5], "dtype": "int64",
+                    "chunks": [{"key": "b#0.0", "start": [0]}]}}
+        c1 = {"w#1.0": t1["w"][2:]}
+        i1 = {"w": {"shape": [4, 3], "dtype": "float32",
+                    "chunks": [{"key": "w#1.0", "start": [2, 0]}]},
+              "b": {"shape": [5], "dtype": "int64", "chunks": []}}
+        ex = {"__tree_meta__": {},
+              "user_extra": {"global_step": 1, "nprocs": 2}}
+        stores["h0"].push("global_step1", c0, dict(ex, index=i0),
+                          shard_name="shard-0.npz")
+        stores["h1"].push("global_step1", c1, dict(ex, index=i1),
+                          shard_name="shard-1.npz")
+        hot_tier.purge_node(str(tmp_path), "h0")   # writer 0 dies
+        tag, flat, _ = stores["h1"].load_best()
+        assert tag == "global_step1"
+        np.testing.assert_array_equal(flat["w"][:2], t0["w"][:2])
+        np.testing.assert_array_equal(flat["w"][2:], t1["w"][2:])
+
+
+class TestDegradation:
+    def test_poisoned_replica_fetch_degrades_to_durable(self, tmp_path):
+        """Acceptance variant: replicas CRC-poisoned via the
+        replica_fetch fault point — the tiered load degrades to the
+        durable tier and still resumes."""
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=4)
+        stores = _stores(hot_root)
+        chunks, extra = _payload(4)
+        stores["h0"].push("global_step4", chunks, extra,
+                          shard_name="shard-0.npz")
+        hot_tier.purge_node(str(hot_root), "h0")   # force replica reads
+        fault_injection.arm("replica_fetch", fails=100)
+        counters = {}
+        tier, tag, flat, header = manager.load_best_tiered(
+            durable, hot_store=stores["h1"], counters=counters)
+        assert tier == "durable"
+        assert tag == "global_step4"
+        np.testing.assert_array_equal(flat["w"], _tree(4)["w"])
+        assert counters["hot_fallbacks"] == 1
+        assert counters["durable_restores"] == 1
+
+    def test_crc_corrupt_replica_degrades(self, tmp_path):
+        """Bit-rot in a replica file (not just an injected fetch error)
+        is caught by the CRC manifest and degrades identically."""
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=6)
+        stores = _stores(hot_root)
+        chunks, extra = _payload(6)
+        stores["h0"].push("global_step6", chunks, extra,
+                          shard_name="shard-0.npz")
+        hot_tier.purge_node(str(hot_root), "h0")
+        replica = os.path.join(str(hot_root), "h1", "global_step6",
+                               "from-h0", "shard-0.npz")
+        size = os.path.getsize(replica)
+        with open(replica, "r+b") as f:
+            f.seek(size // 2)
+            chunk = f.read(4)
+            f.seek(size // 2)
+            f.write(bytes(b ^ 0xFF for b in chunk))
+        tier, tag, _, _ = manager.load_best_tiered(
+            durable, hot_store=stores["h1"])
+        assert (tier, tag) == ("durable", "global_step6")
+
+    def test_hot_restore_reads_zero_durable_files(self, tmp_path):
+        """The tentpole claim, asserted mechanically: when the hot tier
+        serves the restore, the durable loader is NEVER invoked."""
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=2)
+        stores = _stores(hot_root)
+        chunks, extra = _payload(2)
+        stores["h0"].push("global_step2", chunks, extra,
+                          shard_name="shard-0.npz")
+        durable_reads = []
+
+        def loader(tag_dir):
+            durable_reads.append(tag_dir)
+            return ser.load_state(tag_dir)
+
+        counters = {}
+        tier, tag, flat, _ = manager.load_best_tiered(
+            durable, hot_store=stores["h0"], loader=loader,
+            counters=counters)
+        assert tier == "hot" and tag == "global_step2"
+        assert durable_reads == []                 # ZERO storage reads
+        assert counters["hot_restores"] == 1
+        assert counters.get("durable_restores", 0) == 0
+
+    def test_empty_hot_tier_goes_straight_to_durable(self, tmp_path):
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=1)
+        store = hot_tier.HotTierStore(root=str(tmp_path / "hot"),
+                                      node="h0", peers=PEERS)
+        counters = {}
+        tier, tag, _, _ = manager.load_best_tiered(
+            durable, hot_store=store, counters=counters)
+        assert (tier, tag) == ("durable", "global_step1")
+        # an EMPTY hot tier is not a fallback (nothing was lost)
+        assert counters.get("hot_fallbacks", 0) == 0
+
+    def test_nothing_anywhere_returns_none(self, tmp_path):
+        store = hot_tier.HotTierStore(root=str(tmp_path / "hot"),
+                                      node="h0", peers=PEERS)
+        tier, tag, flat, header = manager.load_best_tiered(
+            str(tmp_path / "ckpt"), hot_store=store)
+        assert tier is None and tag is None
+
+
+class TestPushFaults:
+    def test_replica_push_failure_is_advisory(self, tmp_path):
+        """A failed peer push can never cost the save: the local entry
+        still lands, the error is counted, nothing raises."""
+        counters = {}
+        stores = _stores(tmp_path, counters=counters)
+        fault_injection.arm("replica_push", fails=100)
+        chunks, extra = _payload(3)
+        n = stores["h0"].push("global_step3", chunks, extra,
+                              shard_name="shard-0.npz")
+        assert n == 0                              # no replica landed
+        assert counters["hot_push_errors"] == 1
+        # own store still restorable
+        tag, _, _ = stores["h0"].load_best()
+        assert tag == "global_step3"
+        # ...but the ring neighbor holds nothing after the writer dies
+        hot_tier.purge_node(str(tmp_path), "h0")
+        assert stores["h1"].load_best()[0] is None
+
+    def test_push_async_swallows_advisory_failures(self, tmp_path):
+        stores = _stores(tmp_path)
+        fault_injection.arm("replica_push", fails=1)
+        chunks, extra = _payload(3)
+        fut = stores["h0"].push_async("global_step3", chunks, extra,
+                                      shard_name="shard-0.npz")
+        assert stores["h0"].wait() is True         # no raise
+        assert fut.exception() is None
+        stores["h0"].shutdown()
+
+    def test_kill_during_push_propagates(self, tmp_path):
+        """SimulatedKill models SIGKILL: no advisory swallow."""
+        stores = _stores(tmp_path)
+        fault_injection.arm("replica_push", kill=True)
+        chunks, extra = _payload(3)
+        with pytest.raises(fault_injection.SimulatedKill):
+            stores["h0"].push("global_step3", chunks, extra,
+                              shard_name="shard-0.npz")
+
+
+class TestRetentionAndCandidates:
+    def test_hot_gc_keeps_newest(self, tmp_path):
+        stores = _stores(tmp_path, keep_last=2)
+        for step in range(1, 6):
+            chunks, extra = _payload(step)
+            stores["h0"].push(f"global_step{step}", chunks, extra,
+                              shard_name="shard-0.npz")
+        own = sorted(os.listdir(os.path.join(str(tmp_path), "h0")))
+        assert own == ["global_step4", "global_step5"]
+
+    def test_tiered_candidates_order_hot_first(self, tmp_path):
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=1)
+        _durable_generation(durable, step=2)
+        stores = _stores(hot_root)
+        chunks, extra = _payload(2)
+        stores["h0"].push("global_step2", chunks, extra,
+                          shard_name="shard-0.npz")
+        cands = manager.load_candidates(durable,
+                                        hot_store=stores["h0"])
+        assert cands[0] == ("hot", "global_step2")
+        assert ("durable", "global_step2") in cands
+        assert ("durable", "global_step1") in cands
+        assert [t for t, _ in cands] == sorted(
+            [t for t, _ in cands], key=lambda t: t != "hot")
+
+    def test_legacy_candidates_shape_unchanged(self, tmp_path):
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=1)
+        assert manager.load_candidates(durable) == ["global_step1"]
+
+    def test_stale_hot_generation_never_rolls_back_durable(
+            self, tmp_path):
+        """The advisory push can lag the durable commit (async pool,
+        push failure): a hot tier holding only step 2 after step 3
+        durably committed must NOT serve step 2 — that would silently
+        roll a committed generation back."""
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=2)
+        _durable_generation(durable, step=3)     # committed, never pushed
+        stores = _stores(hot_root)
+        chunks, extra = _payload(2)
+        stores["h0"].push("global_step2", chunks, extra,
+                          shard_name="shard-0.npz")
+        cands = manager.load_candidates(durable, hot_store=stores["h0"])
+        assert ("hot", "global_step2") not in cands   # filtered as stale
+        counters = {}
+        tier, tag, _, header = manager.load_best_tiered(
+            durable, hot_store=stores["h0"], counters=counters)
+        assert (tier, tag) == ("durable", "global_step3")
+        assert header["extra"]["global_step"] == 3
+        # a filtered-out stale tier is not a DEGRADATION
+        assert counters.get("hot_fallbacks", 0) == 0
+
+    def test_hot_newer_than_durable_latest_is_served(self, tmp_path):
+        """The inverse: the durable commit of step 4 never landed but
+        the replicas did — the newest trained state wins."""
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=3)
+        stores = _stores(hot_root)
+        chunks, extra = _payload(4)
+        stores["h0"].push("global_step4", chunks, extra,
+                          shard_name="shard-0.npz")
+        tier, tag, _, header = manager.load_best_tiered(
+            durable, hot_store=stores["h0"])
+        assert (tier, tag) == ("hot", "global_step4")
+        assert header["extra"]["global_step"] == 4
