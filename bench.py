@@ -35,8 +35,13 @@ variant can never cost the headline number:
                    sequence/ring.py zigzag context parallelism — real
                    ring numbers need >1 chip, at 1 chip the pair is a
                    long-seq baseline)
+  moe_kernel_on/off  dropless-MoE expert-FFN A/B (BENCH_MODEL=moe +
+                   BENCH_MOE_KERNEL=1/0): GPT2MoE ragged routing with
+                   the Pallas grouped-GEMM kernel (ops/pallas/
+                   grouped_matmul.py) vs lax.ragged_dot
 Disable with BENCH_VARIANTS=none, or pick a subset
-(BENCH_VARIANTS=mlp_down,bwd_qmajor,1.3B,overlap,autotune,ring_on).
+(BENCH_VARIANTS=mlp_down,bwd_qmajor,1.3B,overlap,autotune,ring_on,
+moe_on,moe_off).
 
 The full report is also ALWAYS written into the tree as
 ``BENCH_local.json`` (the r06/r07 driver artifacts vanished; a lost
@@ -169,6 +174,33 @@ _VARIANTS = {
     "ring_off": ("ring_off", {"BENCH_ATTN_BACKEND": "dense",
                               "BENCH_SP": "1", "BENCH_SEQ": "4096",
                               "BENCH_MICRO_BS": "4"}),
+    # dropless-MoE expert-FFN A/B: GPT2MoE (preset dims, 4 experts,
+    # top-2, ragged dropless routing) with the expert product through
+    # the Pallas grouped-GEMM kernel (_on) vs lax.ragged_dot (_off) —
+    # the moe_grouped_mm lever measured in a real train step. ZeRO-3 +
+    # bf16 moments/grads because 4x-expert MLPs put the point near the
+    # 1.3B memory envelope on one 16 GB chip.
+    "moe_on": ("moe_kernel_on", {"BENCH_MODEL": "moe",
+                                 "BENCH_MOE_KERNEL": "1",
+                                 "BENCH_ZERO_STAGE": "3",
+                                 "BENCH_MICRO_BS": "8",
+                                 "BENCH_MOMENTS_DTYPE": "bfloat16",
+                                 "BENCH_GRAD_DTYPE": "bf16"}),
+    "moe_off": ("moe_kernel_off", {"BENCH_MODEL": "moe",
+                                   "BENCH_MOE_KERNEL": "0",
+                                   "BENCH_ZERO_STAGE": "3",
+                                   "BENCH_MICRO_BS": "8",
+                                   "BENCH_MOMENTS_DTYPE": "bfloat16",
+                                   "BENCH_GRAD_DTYPE": "bf16"}),
+    # measured-dispatch MoE: moe_grouped_kernel="auto" under
+    # on_first_use, so the moe_grouped_mm bucket gets a real search on
+    # this chip and its winner lands in the extras.autotune table
+    "moe_autotune": ("moe_autotune", {"BENCH_MODEL": "moe",
+                                      "BENCH_AUTOTUNE": "1",
+                                      "BENCH_ZERO_STAGE": "3",
+                                      "BENCH_MICRO_BS": "8",
+                                      "BENCH_MOMENTS_DTYPE": "bfloat16",
+                                      "BENCH_GRAD_DTYPE": "bf16"}),
 }
 
 
@@ -229,7 +261,8 @@ def main():
     vnames = os.environ.get(
         "BENCH_VARIANTS",
         "mlp_down,bwd_qmajor,bwd_qmajor_512,1.3B,overlap,overlap_off,"
-        "autotune,autotune_off,ring_on,ring_off")
+        "autotune,autotune_off,ring_on,ring_off,moe_on,moe_off,"
+        "moe_autotune")
     if vnames and vnames != "none":
         variants = _run_variants(
             [v for v in vnames.split(",") if v],

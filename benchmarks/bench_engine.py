@@ -43,7 +43,7 @@ def build_bench_config():
             return "auto" if tune else parse(default)
         return parse(v)
 
-    return replace(
+    cfg = replace(
         PRESETS[preset], max_seq_len=seq_len,
         use_flash_attention=os.environ.get("BENCH_FLASH", "1") == "1",
         flash_block_q=knob("BENCH_FLASH_BQ", "1024"),
@@ -75,6 +75,22 @@ def build_bench_config():
         # through sequence/ring.py (zigzag context parallelism) whenever
         # the engine runs seq-sharded (BENCH_SP below); 'dense' default
         attention_backend=os.environ.get("BENCH_ATTN_BACKEND", "dense"))
+    # BENCH_MODEL=moe: the dropless-MoE training point — GPT2MoE over
+    # the same preset dims with the ragged (grouped-GEMM) backend;
+    # BENCH_MOE_KERNEL picks the expert-product engine (1 = the Pallas
+    # grouped kernel, 0 = lax.ragged_dot, unset/auto = winner cache) —
+    # the moe_kernel_on/off A/B lever
+    if os.environ.get("BENCH_MODEL", "") == "moe":
+        import dataclasses
+        from deepspeed_tpu.models import GPT2MoEConfig
+        cfg = GPT2MoEConfig(
+            **dataclasses.asdict(cfg),
+            num_experts=int(os.environ.get("BENCH_MOE_EXPERTS", "4")),
+            moe_top_k=int(os.environ.get("BENCH_MOE_TOPK", "2")),
+            moe_backend="ragged",
+            moe_grouped_kernel={"1": True, "0": False}.get(
+                os.environ.get("BENCH_MOE_KERNEL", ""), "auto"))
+    return cfg
 
 
 def build_bench_engine():
@@ -83,7 +99,7 @@ def build_bench_engine():
     as bench.py."""
     import jax  # noqa: F401  (device init after LIBTPU_INIT_ARGS)
     import deepspeed_tpu
-    from deepspeed_tpu.models import GPT2
+    from deepspeed_tpu.models import GPT2, GPT2MoE, GPT2MoEConfig
     from deepspeed_tpu.utils import groups
 
     cfg = build_bench_config()
@@ -101,7 +117,8 @@ def build_bench_engine():
     if offload not in ("", "cpu", "nvme"):
         raise SystemExit(f"BENCH_OFFLOAD must be ''|cpu|nvme, "
                          f"got {offload!r}")
-    model = GPT2(cfg)
+    model = (GPT2MoE(cfg) if isinstance(cfg, GPT2MoEConfig)
+             else GPT2(cfg))
     groups.reset()
     # BENCH_SP: sequence-parallel (ring) axis size — 'auto' = all visible
     # devices when the ring backend is selected (one chip -> sp=1, where

@@ -22,6 +22,7 @@ collectives are loopback; on a pod they measure ICI/DCN.
 
 import argparse
 import json
+import math
 import sys
 import time
 
@@ -53,6 +54,16 @@ _BUS_FACTOR = {
     # row is the bandwidth bound on hiding one rotation under one ring
     # step's compute
     "ppermute": lambda w: 1.0,
+    # hierarchical expert dispatch (moe_swiglu_ragged_ep's staged
+    # exchange): ICI-local all_to_all over the inner axis, then ONE
+    # cross-slice hop over data_outer — vs the flat single-hop
+    # all_to_all row above over the same combined shard grid. The int8
+    # variant applies the qgZ clamp to the DCN leg (the MoE
+    # dcn_quantize numerics; wire stays fp32 in this emulation, so the
+    # row measures the clamp's compute cost, not a byte saving).
+    "all_to_all_flat": lambda w: (w - 1) / w,
+    "all_to_all_2stage": lambda w: (w - 1) / w,
+    "all_to_all_2stage_int8": lambda w: (w - 1) / w,
 }
 
 
@@ -76,16 +87,33 @@ def _wire_bytes(name, x):
     return x.nbytes
 
 
-def bench(sizes_mb, trials=10, axis="data", out=sys.stdout):
+def bench(sizes_mb, trials=10, axis="data", outer_axis="data_outer",
+          out=sys.stdout):
     topo = groups.get_topology()
     mesh = topo.mesh
     W = mesh.shape[axis]
+    Wo = dict(mesh.shape).get(outer_axis, 1)
     results = []
 
-    def make(op_name, body, out_specs):
+    def make(op_name, body, out_specs, in_specs=None):
         return op_name, jax.jit(lambda x: shard_map(
-            body, mesh=mesh, in_specs=P(axis),
+            body, mesh=mesh, in_specs=in_specs or P(axis),
             out_specs=out_specs, check_vma=False)(x))
+
+    def two_stage(quantize):
+        """The MoE staged dispatch: buckets keyed (inner rank, outer
+        slice), exchanged over the inner (ICI) axis then over
+        data_outer (DCN) — read against the flat single-hop
+        all_to_all_flat row over the same combined shard grid."""
+        def body(x):
+            xb = x.reshape(W, Wo, -1)
+            xb = dist.all_to_all(xb, axis, 0, 0)
+            if quantize:
+                from deepspeed_tpu.comm.quantized import \
+                    dcn_precision_clamp
+                xb = dcn_precision_clamp(xb)
+            return dist.all_to_all(xb, outer_axis, 1, 1)
+        return body
 
     ops = [
         make("all_reduce", lambda x: dist.all_reduce(x, axis), P(axis)),
@@ -103,17 +131,49 @@ def bench(sizes_mb, trials=10, axis="data", out=sys.stdout):
         make("ppermute",
              lambda x: dist.send_forward(x, axis), P(axis)),
     ]
+    # entries: (name, jitted fn, combined-grid shard count) — the hier
+    # pair exchanges over the (outer x inner) grid, so its payload
+    # reshapes to W*Wo rows and its busbw factor uses the combined size
+    ops = [(n, f, W) for n, f in ops]
+    if Wo > 1:
+        hier = P((outer_axis, axis))
+        ops += [
+            ("all_to_all_flat",
+             jax.jit(lambda x: shard_map(
+                 lambda x: dist.all_to_all(
+                     x.reshape(W * Wo, -1), (outer_axis, axis), 0, 0),
+                 mesh=mesh, in_specs=hier, out_specs=hier,
+                 check_vma=False)(x)), W * Wo),
+            ("all_to_all_2stage",
+             jax.jit(lambda x: shard_map(
+                 two_stage(False), mesh=mesh, in_specs=hier,
+                 out_specs=hier, check_vma=False)(x)), W * Wo),
+            ("all_to_all_2stage_int8",
+             jax.jit(lambda x: shard_map(
+                 two_stage(True), mesh=mesh, in_specs=hier,
+                 out_specs=hier, check_vma=False)(x)), W * Wo),
+        ]
+    else:
+        results.append({"op": "all_to_all_2stage",
+                        "skipped": f"{outer_axis} axis is 1 on this "
+                                   f"mesh (use --outer to carve one)"})
     for mb in sizes_mb:
         n = int(mb * 1e6 / 4)
-        n = max(W * 2048, n // (W * 2048) * (W * 2048))
+        # every row's reshape must divide: the quantized row needs
+        # W*2048 | n, the hierarchical rows need (W*Wo)^2 | n (local
+        # chunk n/(W*Wo) re-bucketed into W x Wo) — non-power-of-two
+        # worlds (6 devices, --outer 3) break the naive W*Wo*2048 round
+        blk = math.lcm(W * 2048, (W * Wo) ** 2)
+        n = max(blk, n // blk * blk)
         x = jnp.asarray(np.random.RandomState(0).randn(W, n // W),
                         jnp.float32)
-        for name, fn in ops:
+        for name, fn, wtot in ops:
             try:
-                dt = _timeit(fn, x, trials)
-                wire = _wire_bytes(name, x)
+                xi = x.reshape(wtot, -1) if wtot != W else x
+                dt = _timeit(fn, xi, trials)
+                wire = _wire_bytes(name, xi)
                 gbps = wire / dt / 1e9
-                busbw = gbps * _BUS_FACTOR[name](W)
+                busbw = gbps * _BUS_FACTOR[name](wtot)
                 results.append({
                     "op": name, "mb": mb, "ms": round(dt * 1e3, 3),
                     "gbps": round(gbps, 3), "busbw_gbps": round(busbw, 3),
@@ -189,13 +249,27 @@ def main():
                     default=[1, 16, 64])
     ap.add_argument("--trials", type=int, default=10)
     ap.add_argument("--axis", default="data")
+    ap.add_argument("--outer", type=int, default=0,
+                    help="carve a data_outer axis of this size out of "
+                         "DP (zero_shard_size) so the hierarchical "
+                         "all_to_all rows run — the staging decision "
+                         "probe for meshes without a real DCN axis")
     ap.add_argument("--json", action="store_true",
                     help="one JSON line on stdout (table -> stderr)")
     ap.add_argument("--overlap-mb", type=float, default=16,
                     help="overlap probe payload (0 disables the probe)")
     args = ap.parse_args()
     dist.init_distributed()
-    groups.initialize()
+    if args.outer > 1:
+        import jax as _jax
+        n = len(_jax.devices())
+        if n % args.outer:
+            raise SystemExit(f"--outer {args.outer} does not divide "
+                             f"world size {n}")
+        groups.initialize(groups.TopologyConfig(
+            zero_shard_size=n // args.outer))
+    else:
+        groups.initialize()
     out = sys.stderr if args.json else sys.stdout
     print(f"mesh: {dict(groups.get_mesh().shape)}", file=out)
     results = bench(args.sizes_mb, args.trials, args.axis, out=out)

@@ -407,6 +407,56 @@ def _ring_block(rng):
         _close(a, b, f"ring_block pair {n}", dict(rtol=5e-2, atol=5e-2))
 
 
+def _moe_grouped(rng):
+    """The dropless-MoE grouped-GEMM kernel vs lax.ragged_dot on real
+    Mosaic: uneven groups incl. an empty one, fwd + all four grads
+    through the fused SwiGLU chain, plus the plain grouped product."""
+    from deepspeed_tpu.ops.pallas.grouped_matmul import (grouped_matmul,
+                                                         grouped_swiglu)
+    S, K, F, E = 256, 128, 256, 4
+    ks = jax.random.split(rng, 4)
+    x = jax.random.normal(ks[0], (S, K), jnp.bfloat16) * 0.3
+    w1 = jax.random.normal(ks[1], (E, K, F), jnp.bfloat16) * 0.1
+    w3 = jax.random.normal(ks[2], (E, K, F), jnp.bfloat16) * 0.1
+    w2 = jax.random.normal(ks[3], (E, F, K), jnp.bfloat16) * 0.1
+    gs = jnp.asarray([100, 0, 37, 119], jnp.int32)
+
+    got = jax.jit(lambda x, w: grouped_matmul(
+        x, w, gs, block_m=64, interpret=False))(x, w1)
+    _close(got, jax.lax.ragged_dot(x, w1, gs), "moe grouped fwd")
+
+    def lk(x, w1, w3, w2):
+        return jnp.sum(grouped_swiglu(x, w1, w3, w2, gs, block_m=64,
+                                      interpret=False)
+                       .astype(jnp.float32) ** 2)
+
+    def lr(x, w1, w3, w2):
+        g = jax.lax.ragged_dot(x, w1, gs)
+        u = jax.lax.ragged_dot(x, w3, gs)
+        return jnp.sum(jax.lax.ragged_dot(jax.nn.silu(g) * u, w2, gs)
+                       .astype(jnp.float32) ** 2)
+
+    ga = jax.grad(lk, (0, 1, 2, 3))(x, w1, w3, w2)
+    gr = jax.grad(lr, (0, 1, 2, 3))(x, w1, w3, w2)
+    for a, b, n in zip(ga, gr, ("dx", "dw1", "dw3", "dw2")):
+        _close(a, b, f"moe grouped swiglu {n}",
+               dict(rtol=5e-2, atol=5e-1 if n != "dx" else 5e-2))
+
+
+def _moe_grouped_tuned(rng):
+    """Tuned-winner gate for the MoE grouped op: whatever dispatch
+    resolves for this chip's bucket (cached winner or the cold-cache
+    ragged default) must reproduce the ragged_dot reference — fwd and
+    grads (the registry parity)."""
+    from deepspeed_tpu.autotuning import kernel_dispatch, kernel_registry
+    spec = kernel_registry.REGISTRY["moe_grouped_mm"]
+    bucket = "S512,E8,M128,F256"
+    b = kernel_registry.parse_bucket(bucket)
+    params = kernel_dispatch.resolve("moe_grouped_mm", bucket, "bfloat16",
+                                     spec["defaults"](b))
+    spec["parity"](b, "bfloat16", params)
+
+
 def _tuned_winners(rng):
     """Tuned-vs-reference parity for every cached autotune winner on
     THIS chip: a stale or wrong cache entry (edited file, toolchain
@@ -473,6 +523,10 @@ _GATES = (
     ("block_sparse", _block_sparse),
     ("quant", _quant),
     ("fused_ce", _fused_ce),
+    # the dropless-MoE grouped-GEMM kernel (fused SwiGLU chain + plain
+    # grouped product, fwd + grads) and its tuned-winner re-prove
+    ("moe_grouped", _moe_grouped),
+    ("moe_grouped_tuned", _moe_grouped_tuned),
     # the ring-attention carry-state blockwise flash step (chunk-pair
     # chaining + pair backward from the global lse)
     ("ring_block", _ring_block),

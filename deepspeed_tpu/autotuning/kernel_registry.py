@@ -43,6 +43,8 @@ from ..ops.pallas.flash_attention import RING_TUNE_DEFAULTS as \
     _RING_KERNEL_DEFAULTS
 from ..ops.pallas.flash_attention import TUNE_DEFAULTS as FLASH_DEFAULTS
 from ..ops.pallas.fused_ce import TUNE_DEFAULTS as CE_DEFAULTS
+from ..ops.pallas.grouped_matmul import TUNE_DEFAULTS as \
+    MOE_GROUPED_DEFAULTS
 from ..ops.pallas.layernorm import TUNE_DEFAULTS as _LN_KERNEL_DEFAULTS
 
 # small perturbation chaining step i's gradients into step i+1's inputs:
@@ -493,6 +495,93 @@ def _ce_parity(b, dtype, params):
            f"fused_ce gold {params}", dict(rtol=2e-2, atol=2e-2))
 
 
+# ---------------------------------------------------- moe grouped gemm
+# The dropless-MoE expert FFN (ops/pallas/grouped_matmul.py routed
+# through moe/sharded_moe.py): one grouped product per projection with
+# per-group tile maps vs the generic lax.ragged_dot. The bucket's S is
+# the rows entering the grouped product on ONE shard (tokens * top-k,
+# incl. the EP transport capacity), E the LOCAL expert count, M/F the
+# model/FFN dims. The 'ragged' default IS the pre-kernel program, so a
+# cold cache changes nothing (the established cold-cache contract).
+
+
+def _moe_defaults(b):
+    return dict(MOE_GROUPED_DEFAULTS)
+
+
+def _moe_candidates(b):
+    """kernel-vs-ragged_dot plus the grouped tile sweep: the ragged
+    baseline (current behavior), the 128-cube kernel tiling, and wider
+    row/column tiles for the large-token buckets."""
+    cands = [dict(MOE_GROUPED_DEFAULTS)]
+    for bm, bn, bk in ((128, 128, 128), (256, 256, 128),
+                       (512, 256, 256)):
+        cands.append({"backend": "kernel", "block_m": bm, "block_n": bn,
+                      "block_k": bk})
+    return _dedup(cands)
+
+
+def _moe_args(b, dtype, rng):
+    S, E = min(b["S"], 2048), b["E"]
+    M, F = b["M"], b["F"]
+    ks = jax.random.split(rng, 4)
+    x = jax.random.normal(ks[0], (S, M), dtype) * 0.3
+    w1 = jax.random.normal(ks[1], (E, M, F), dtype) * (1 / math.sqrt(M))
+    w3 = jax.random.normal(ks[2], (E, M, F), dtype) * (1 / math.sqrt(M))
+    w2 = jax.random.normal(ks[3], (E, F, M), dtype) * (1 / math.sqrt(F))
+    # deterministic UNEVEN groups summing to S (the kernels only consult
+    # group_sizes; a balanced split would hide boundary-tile handling)
+    sizes = np.bincount(np.arange(S) * 7919 % E, minlength=E)
+    return x, w1, w3, w2, jnp.asarray(sizes, jnp.int32)
+
+
+def _moe_fn(params):
+    from ..moe.sharded_moe import _grouped_swiglu_ffn
+
+    def f(x, w1, w3, w2, group_sizes):
+        return _grouped_swiglu_ffn(x, w1, w3, w2, group_sizes,
+                                   dict(params))
+    return f
+
+
+def _moe_step(b, dtype, params):
+    f = _moe_fn(params)
+    x, w1, w3, w2, gs = _moe_args(b, dtype, jax.random.key(0))
+
+    def loss(x, w1, w3, w2):
+        return jnp.sum(f(x, w1, w3, w2, gs).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, (0, 1, 2, 3))
+
+    def step(carry):
+        x, w1, w3, w2 = carry
+        dx, d1, d3, d2 = g(x, w1, w3, w2)
+        return (x + _EPS * dx.astype(x.dtype),
+                w1 + _EPS * d1.astype(w1.dtype),
+                w3 + _EPS * d3.astype(w3.dtype),
+                w2 + _EPS * d2.astype(w2.dtype))
+
+    return step, (x, w1, w3, w2)
+
+
+def _moe_parity(b, dtype, params):
+    bp = dict(b, S=min(b["S"], 512))     # cap parity cost
+    x, w1, w3, w2, gs = _moe_args(bp, dtype, jax.random.key(1))
+    f = _moe_fn(params)
+    ref = _moe_fn(dict(MOE_GROUPED_DEFAULTS))   # backend 'ragged'
+    _close(f(x, w1, w3, w2, gs), ref(x, w1, w3, w2, gs),
+           f"moe_grouped fwd {params}")
+
+    def lf(fn):
+        return lambda *a: jnp.sum(fn(*a, gs).astype(jnp.float32) ** 2)
+
+    ga = jax.grad(lf(f), (0, 1, 2, 3))(x, w1, w3, w2)
+    gr = jax.grad(lf(ref), (0, 1, 2, 3))(x, w1, w3, w2)
+    for a, bb, n in zip(ga, gr, ("dx", "dw1", "dw3", "dw2")):
+        _close(a, bb, f"moe_grouped {n} {params}",
+               dict(rtol=5e-2, atol=5e-1 if n != "dx" else 5e-2))
+
+
 # ------------------------------------------------- paged serving kernels
 # The v2 engine's decode step and SplitFuse chunk program (ops/pallas/
 # paged_attention.py). Buckets are the engine's decode shapes — (batch
@@ -670,6 +759,12 @@ REGISTRY = {
         "candidates": _ring_candidates,
         "make_step": _ring_step,
         "parity": _ring_parity,
+    },
+    "moe_grouped_mm": {
+        "defaults": _moe_defaults,
+        "candidates": _moe_candidates,
+        "make_step": _moe_step,
+        "parity": _moe_parity,
     },
     "paged_decode": {
         "defaults": _pgd_defaults,

@@ -29,8 +29,12 @@ class GPT2MoEConfig(GPT2Config):
     moe_loss_coeff: float = 0.01
     moe_drop_tokens: bool = True
     # 'dense' = GShard capacity dispatch (EP-shaped); 'ragged' = dropless
-    # grouped GEMM (lax.ragged_dot) for DP/TP meshes
+    # grouped GEMM for DP/TP meshes (EP via the shard_map all_to_all)
     moe_backend: str = "dense"
+    # ragged backend's expert-product engine: "auto" (the
+    # 'moe_grouped_mm' autotune winner cache; cold cache = ragged_dot) |
+    # True (Pallas grouped-GEMM kernel) | False (lax.ragged_dot)
+    moe_grouped_kernel: object = "auto"
 
 
     def num_params(self):
@@ -53,7 +57,8 @@ class GPT2MoE(GPT2):
             min_capacity=config.min_capacity,
             noisy_gate_policy=config.noisy_gate_policy,
             drop_tokens=config.moe_drop_tokens,
-            dtype=jnp.dtype(config.dtype), backend=config.moe_backend)
+            dtype=jnp.dtype(config.dtype), backend=config.moe_backend,
+            grouped_kernel=config.moe_grouped_kernel)
 
     def init(self, rng):
         import math
@@ -88,6 +93,14 @@ class GPT2MoE(GPT2):
                     and self.moe.gate.top2_2nd_expert_sampling))
 
     def _mlp(self, h, layer, rng, *, train, seq_sharded, constrain):
+        # an EXPLICIT engine-config 'moe' block setting (non-"auto")
+        # overrides the model-config knob; otherwise the model config
+        # stands (both default "auto" — the winner cache decides)
+        moe_cfg = getattr(self, "_moe_cfg", None)
+        override = (moe_cfg.grouped_kernel
+                    if moe_cfg is not None
+                    and moe_cfg.grouped_kernel != "auto" else None)
         y, aux, _ = self.moe.apply(layer["moe"], h, rng=rng, train=train,
-                                   seq_sharded=seq_sharded)
+                                   seq_sharded=seq_sharded,
+                                   grouped_kernel=override)
         return y, aux

@@ -24,7 +24,6 @@ from dataclasses import dataclass
 
 import jax
 import jax.numpy as jnp
-from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from .llama import Llama, LlamaConfig, _rms_norm
@@ -79,16 +78,39 @@ class Mixtral(Llama):
         blocks["moe_w2"] = nrm(ks[3], (L, E, F, D), res_std)
         return params
 
+    def _moe_knobs(self):
+        """(grouped_kernel, hierarchical, dcn_quantize) from the
+        engine-installed ``moe`` config block; module defaults when no
+        engine installed one (direct model use)."""
+        cfg = getattr(self, "_moe_cfg", None)
+        if cfg is None:
+            return "auto", "auto", False
+        return cfg.grouped_kernel, cfg.hierarchical_a2a, cfg.dcn_quantize
+
     def partition_specs(self, topology=None):
         specs = super().partition_specs(topology)
         blocks = specs["blocks"]
         for k in ("wgate", "wup", "wdown"):
             del blocks[k]
         blocks["moe_gate"] = P(None, None, None)
-        # experts over 'expert', FFN dim over 'tensor' (EP x TP)
-        blocks["moe_w1"] = P(None, "expert", None, "tensor")
-        blocks["moe_w3"] = P(None, "expert", None, "tensor")
-        blocks["moe_w2"] = P(None, "expert", "tensor", None)
+        # experts over 'expert', FFN dim over 'tensor' (EP x TP); at pod
+        # scale — a data_outer (DCN) axis and the hierarchical a2a
+        # engaged — experts span the combined (outer, expert) shard grid
+        # so the weight layout matches the two-stage exchange's in_specs
+        # (the exchange reshards on mismatch, but then every serving
+        # dispatch would pay the gather)
+        eaxis = "expert"
+        if topology is not None:
+            from ..moe.sharded_moe import resolve_hierarchical_a2a
+            _, hier_knob, _ = self._moe_knobs()
+            if resolve_hierarchical_a2a(
+                    hier_knob, topology.axis_size("data_outer"),
+                    self.config.num_experts,
+                    topology.axis_size("expert")):
+                eaxis = ("data_outer", "expert")
+        blocks["moe_w1"] = P(None, eaxis, None, "tensor")
+        blocks["moe_w3"] = P(None, eaxis, None, "tensor")
+        blocks["moe_w2"] = P(None, eaxis, "tensor", None)
         return specs
 
     def _mlp(self, x, layer):
@@ -104,12 +126,14 @@ class Mixtral(Llama):
         B, T, D = x.shape
         E, k = cfg.num_experts, cfg.moe_top_k
         h = _rms_norm(x, layer["rms2"], cfg.rms_eps)
+        grouped, hier, dcn_q = self._moe_knobs()
         mesh = jax.sharding.get_abstract_mesh()
         if not mesh.empty and mesh.shape.get("expert", 1) > 1:
             from ..moe.sharded_moe import moe_swiglu_ragged_ep
             y = moe_swiglu_ragged_ep(
                 h, layer["moe_gate"], layer["moe_w1"], layer["moe_w3"],
-                layer["moe_w2"], k=k)
+                layer["moe_w2"], k=k, hierarchical=hier,
+                dcn_quantize=dcn_q, grouped_kernel=grouped)
             return y.astype(x.dtype)
         xs = h.reshape(-1, D)
         S = xs.shape[0]
@@ -126,10 +150,12 @@ class Mixtral(Llama):
         xr = x_rep[order]
         group_sizes = jnp.bincount(flat_exp, length=E).astype(jnp.int32)
 
-        g = lax.ragged_dot(xr, layer["moe_w1"], group_sizes)
-        u = lax.ragged_dot(xr, layer["moe_w3"], group_sizes)
-        o = lax.ragged_dot(jax.nn.silu(g) * u, layer["moe_w2"],
-                           group_sizes)
+        from ..moe.sharded_moe import (_grouped_swiglu_ffn,
+                                       resolve_grouped_params)
+        gp = resolve_grouped_params(grouped, S * k, E, D,
+                                    layer["moe_w1"].shape[-1], xr.dtype)
+        o = _grouped_swiglu_ffn(xr, layer["moe_w1"], layer["moe_w3"],
+                                layer["moe_w2"], group_sizes, gp)
         unsorted = jnp.zeros_like(o).at[order].set(o)
         y = jnp.sum((unsorted * flat_w[:, None]).reshape(S, k, D), axis=1)
         return y.astype(x.dtype).reshape(B, T, D)

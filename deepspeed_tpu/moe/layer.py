@@ -19,18 +19,29 @@ class MoE:
                  k=1, capacity_factor=1.0, eval_capacity_factor=1.0,
                  min_capacity=4, noisy_gate_policy=None, drop_tokens=True,
                  top2_2nd_expert_sampling=True, activation=jax.nn.gelu,
-                 dtype=jnp.bfloat16, backend="dense"):
+                 dtype=jnp.bfloat16, backend="dense",
+                 grouped_kernel="auto"):
         """backend: 'dense' = GShard static-capacity dispatch (the
         SPMD/EP-shaped path with token dropping at capacity); 'ragged' =
-        DROPLESS grouped GEMM via lax.ragged_dot (megablox / reference
-        cutlass moe_gemm) — under an expert-parallel mesh this routes
-        through moe_layer_ragged_ep (shard_map + all_to_all + per-shard
-        ragged_dot), single-shard otherwise."""
+        DROPLESS grouped GEMM (megablox / reference cutlass moe_gemm) —
+        under an expert-parallel mesh this routes through
+        moe_layer_ragged_ep (shard_map + all_to_all + per-shard grouped
+        product), single-shard otherwise.
+
+        grouped_kernel: the ragged backend's expert-product engine —
+        "auto" (default: the 'moe_grouped_mm' autotune winner cache; a
+        cold cache keeps lax.ragged_dot) | True (the Pallas grouped-GEMM
+        kernel, ops/pallas/grouped_matmul.py) | False (ragged_dot)."""
         self.hidden_size = hidden_size
         self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
         self.num_experts = num_experts
         self.k = k
         self.backend = backend
+        if grouped_kernel not in (True, False, "auto"):
+            raise ValueError(
+                f"grouped_kernel must be true|false|'auto', got "
+                f"{grouped_kernel!r}")
+        self.grouped_kernel = grouped_kernel
         if backend == "ragged":
             # dropless routing has no capacity knobs (vacuous) but noisy
             # gating would be silently ignored — reject, don't lie
@@ -82,12 +93,19 @@ class MoE:
             "bo": P(*lead, "expert", None),
         }
 
-    def apply(self, params, x, *, rng=None, train=True, seq_sharded=False):
+    def apply(self, params, x, *, rng=None, train=True, seq_sharded=False,
+              grouped_kernel=None):
+        """``grouped_kernel`` overrides the construction-time knob for
+        this dispatch (None = keep it) — how an engine-level ``moe``
+        config block reaches a layer built before the engine existed."""
         if self.backend == "ragged":
+            knob = self.grouped_kernel if grouped_kernel is None \
+                else grouped_kernel
             return moe_layer_ragged_ep(
                 x, params["gate_w"], params["wi"], params["bi"],
                 params["wo"], params["bo"], k=self.k,
-                activation=self.activation, seq_sharded=seq_sharded)
+                activation=self.activation, seq_sharded=seq_sharded,
+                grouped_kernel=knob)
         return moe_layer(x, params["gate_w"], params["wi"], params["bi"],
                          params["wo"], params["bo"], self.gate, rng=rng,
                          train=train, activation=self.activation,

@@ -38,6 +38,56 @@ def _constrain(x, spec):
     return lax.with_sharding_constraint(x, spec)
 
 
+# ------------------------------------------------- grouped expert FFNs
+# The expert-FFN grouped product has two backends: 'ragged' =
+# lax.ragged_dot (the generic-XLA path, and the parity reference) and
+# 'kernel' = the Pallas grouped-GEMM launch (ops/pallas/
+# grouped_matmul.py: per-group tile maps, each expert's weight tile
+# streamed through VMEM once, fused SwiGLU epilogue, per-group fp32 dw).
+# The choice and the tile sizes resolve per shape bucket through the
+# measured-dispatch winner cache (registry op 'moe_grouped_mm') when the
+# knob is "auto" — a cold cache is byte-identical to the ragged program.
+
+def resolve_grouped_params(knob, rows, E_loc, M, F, dtype):
+    """Trace-time backend/tile resolution for the grouped expert FFN.
+    ``knob``: "auto" (winner cache) | True (kernel, default tiles) |
+    False (ragged_dot) | dict (explicit params)."""
+    from ..ops.pallas.grouped_matmul import TUNE_DEFAULTS
+    if knob is False or knob is None:
+        return dict(TUNE_DEFAULTS)
+    if knob is True:
+        return dict(TUNE_DEFAULTS, backend="kernel")
+    if isinstance(knob, dict):
+        return {**TUNE_DEFAULTS, **knob}
+    from ..ops.pallas._common import (dispatch, dtype_name,
+                                      moe_grouped_bucket)
+    return dispatch("moe_grouped_mm",
+                    moe_grouped_bucket(rows, E_loc, M, F),
+                    dtype_name(dtype), TUNE_DEFAULTS)
+
+
+def _grouped_dot(xs, w, group_sizes, params):
+    if params.get("backend") == "kernel":
+        from ..ops.pallas.grouped_matmul import grouped_matmul
+        return grouped_matmul(xs, w, group_sizes,
+                              block_m=int(params["block_m"]),
+                              block_n=int(params["block_n"]),
+                              block_k=int(params["block_k"]))
+    return lax.ragged_dot(xs, w, group_sizes)
+
+
+def _grouped_swiglu_ffn(xs, w1, w3, w2, group_sizes, params):
+    if params.get("backend") == "kernel":
+        from ..ops.pallas.grouped_matmul import grouped_swiglu
+        return grouped_swiglu(xs, w1, w3, w2, group_sizes,
+                              block_m=int(params["block_m"]),
+                              block_n=int(params["block_n"]),
+                              block_k=int(params["block_k"]))
+    g = lax.ragged_dot(xs, w1, group_sizes)
+    u = lax.ragged_dot(xs, w3, group_sizes)
+    return lax.ragged_dot(jax.nn.silu(g) * u, w2, group_sizes)
+
+
 def _capacity(num_tokens, num_experts, capacity_factor, min_capacity):
     """Static per-expert capacity (reference sharded_moe.py:_capacity)."""
     cap = int(math.ceil(num_tokens / num_experts * capacity_factor))
@@ -190,7 +240,8 @@ def topk_routing(logits, k=1):
 
 
 def moe_layer_ragged(tokens, gate_w, wi, bi, wo, bo, k=1, *,
-                     activation=jax.nn.gelu, seq_sharded=False):
+                     activation=jax.nn.gelu, seq_sharded=False,
+                     grouped_kernel="auto"):
     """DROPLESS MoE via grouped GEMM (``lax.ragged_dot``) — the
     megablox pattern and the counterpart of the reference's CUTLASS
     ``moe_gemm`` (inference/v2/kernels/cutlass_ops): tokens sort by
@@ -221,9 +272,11 @@ def moe_layer_ragged(tokens, gate_w, wi, bi, wo, bo, k=1, *,
     group_sizes = jnp.bincount(flat_exp, length=E).astype(jnp.int32)
 
     exp_counts = group_sizes
-    h = jax.lax.ragged_dot(xs, wi, group_sizes)         # (S*k, F)
+    gp = resolve_grouped_params(grouped_kernel, S * k, E, M,
+                                wi.shape[-1], xs.dtype)
+    h = _grouped_dot(xs, wi, group_sizes, gp)           # (S*k, F)
     h = activation(h + bi[exp_sorted])
-    out = jax.lax.ragged_dot(h, wo, group_sizes)        # (S*k, M)
+    out = _grouped_dot(h, wo, group_sizes, gp)          # (S*k, M)
     out = out + bo[exp_sorted]
 
     # unsort and weighted-combine the k expert outputs per token
@@ -276,13 +329,36 @@ def moe_layer(tokens, gate_w, wi, bi, wo, bo, gate: TopKGate, *, rng=None,
     return y, l_aux, exp_counts
 
 
+def resolve_hierarchical_a2a(knob, outer_size, E, ep):
+    """Whether the EP exchange stages ICI -> DCN: "auto" engages iff the
+    mesh has an outer (DCN) axis > 1 and the experts divide the combined
+    shard grid; True additionally *requires* divisibility (loud error
+    instead of a silent flat fallback); False never stages."""
+    if knob is False or knob is None:
+        return False
+    if outer_size <= 1:
+        return False
+    if E % (ep * outer_size) != 0:
+        if knob is True:
+            raise ValueError(
+                f"hierarchical EP needs experts ({E}) divisible by "
+                f"expert*outer shards ({ep}*{outer_size})")
+        return False
+    return True
+
+
 def moe_swiglu_ragged_ep(tokens, gate_w, w1, w3, w2, k=2, *,
-                         expert_axis="expert"):
+                         expert_axis="expert", outer_axis="data_outer",
+                         hierarchical="auto", dcn_quantize=False,
+                         grouped_kernel="auto", return_counts=False):
     """EXPERT-PARALLEL dropless SwiGLU MoE for the serving models
-    (mixtral): the same pack / all_to_all / per-shard ``ragged_dot`` /
+    (mixtral): the same pack / all_to_all / per-shard grouped-GEMM /
     exchange-back machinery as :func:`moe_layer_ragged_ep`, with the
     SwiGLU expert FFN (w1 gate, w3 up, w2 down, no biases) and mixtral's
-    softmax-then-top-k renormalized combine weights.
+    softmax-then-top-k renormalized combine weights. The expert product
+    runs the Pallas grouped kernel or ``lax.ragged_dot`` per the
+    ``grouped_kernel`` knob ("auto" = the 'moe_grouped_mm' winner cache;
+    a cold cache keeps the ragged program).
 
     Exists because GSPMD cannot partition ``lax.ragged_dot`` over the
     expert (group) dim of the weights: with moe_w* sharded
@@ -301,11 +377,28 @@ def moe_swiglu_ragged_ep(tokens, gate_w, w1, w3, w2, k=2, *,
     sharded inside the region and the down projection's partial sums
     psum over 'tensor' (the Megatron row-parallel reduction).
 
-    tokens: (..., M); token count needn't divide the expert axis (zero
-    rows pad the shard split and are sliced off). Returns y like tokens.
+    POD SCALE — hierarchical ICI->DCN exchange: when the mesh carries a
+    ``data_outer`` (cross-slice DCN) axis and ``hierarchical`` resolves
+    on, experts shard over the combined (outer, expert) grid and the
+    flat all_to_all splits into two tiled hops: an ICI-local exchange
+    over ``expert_axis`` delivering each token to its target inner rank,
+    then one DCN hop over ``outer_axis`` delivering it to its target
+    slice — per-slice traffic aggregated per inner rank, the PR-3
+    two-stage collective discipline. ``dcn_quantize`` applies the qgZ
+    int8 block round trip (``comm.quantized.dcn_precision_clamp``) to
+    the token payload of the DCN legs ONLY (both directions; the ICI
+    hop and the int32 expert ids stay exact).
+
+    tokens: (..., M); token count needn't divide the shard grid (zero
+    rows pad the split, their gate weights are masked to zero and they
+    ride with the invalid expert id so they can never skew
+    ``group_sizes``, the FFN groups, or the combine). Returns y shaped
+    like tokens (plus global per-expert dispatch counts when
+    ``return_counts`` — the padding-audit observable).
     """
     mesh = jax.sharding.get_abstract_mesh()
     ep = 1 if mesh.empty else mesh.shape.get(expert_axis, 1)
+    wo = 1 if mesh.empty else mesh.shape.get(outer_axis, 1)
     orig_shape = tokens.shape
     M = orig_shape[-1]
     flat = tokens.reshape(-1, M)
@@ -314,9 +407,12 @@ def moe_swiglu_ragged_ep(tokens, gate_w, w1, w3, w2, k=2, *,
     if ep == 1:
         raise ValueError("moe_swiglu_ragged_ep needs an expert mesh axis "
                          "> 1; use the dense ragged_dot path otherwise")
-    assert E % ep == 0, f"experts {E} not divisible by expert axis {ep}"
-    E_loc = E // ep
-    pad = (-S) % ep
+    hier = resolve_hierarchical_a2a(hierarchical, wo, E, ep)
+    ep_total = ep * wo if hier else ep
+    assert E % ep_total == 0, \
+        f"experts {E} not divisible by expert shards {ep_total}"
+    E_loc = E // ep_total
+    pad = (-S) % ep_total
     if pad:
         # jnp.pad, NOT concatenate-with-zeros: on jaxlib < 0.6 a traced
         # concatenate feeding a manual (shard_map) region gets its layout
@@ -324,71 +420,123 @@ def moe_swiglu_ragged_ep(tokens, gate_w, w1, w3, w2, k=2, *,
         # transposed data (verified with an identity shard_map)
         flat = jnp.pad(flat, ((0, pad), (0, 0)))
     tn = "tensor" if "tensor" in mesh.shape else None
+    shard_axes = (outer_axis, expert_axis) if hier else (expert_axis,)
 
     def shard_fn(x, gate_w, w1, w3, w2):
         S_loc = x.shape[0]
         cap = S_loc * k                                  # exact transport
+        shard = lax.axis_index(expert_axis)
+        if hier:
+            shard = lax.axis_index(outer_axis) * ep + shard
+        # pad-row audit: rows past the true token count carry zero gate
+        # weight and the invalid expert id — they occupy transport slots
+        # (static capacity) but never enter group_sizes, the grouped
+        # FFN, or the combine
+        valid = (shard * S_loc + jnp.arange(S_loc)) < S
+        valid_rep = jnp.repeat(valid, k)
+
         logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
         probs = jax.nn.softmax(logits, axis=-1)
         weights, experts = jax.lax.top_k(probs, k)
         weights = weights / jnp.sum(weights, axis=-1, keepdims=True)
 
         flat_exp = experts.reshape(-1).astype(jnp.int32)
-        flat_w = weights.reshape(-1).astype(x.dtype)
+        flat_w = jnp.where(valid_rep, weights.reshape(-1), 0.0) \
+            .astype(x.dtype)
         dest = flat_exp // E_loc
-        local_e = flat_exp % E_loc
+        local_e = jnp.where(valid_rep, flat_exp % E_loc, E_loc)
         x_rep = jnp.repeat(x, k, axis=0)
 
         order = jnp.argsort(dest, stable=True)
         dest_s = dest[order]
         pos_in_bucket = jnp.arange(cap) - jnp.searchsorted(
             dest_s, dest_s, side="left")
-        send_x = jnp.zeros((ep, cap, M), x.dtype)
-        send_e = jnp.full((ep, cap), E_loc, jnp.int32)   # E_loc = invalid
-        send_x = send_x.at[dest_s, pos_in_bucket].set(x_rep[order])
-        send_e = send_e.at[dest_s, pos_in_bucket].set(local_e[order])
-
-        recv_x = lax.all_to_all(send_x, expert_axis, 0, 0, tiled=False)
-        recv_e = lax.all_to_all(send_e, expert_axis, 0, 0, tiled=False)
-        rx = recv_x.reshape(ep * cap, M)
-        re = recv_e.reshape(ep * cap)
+        if hier:
+            # buckets keyed (inner rank, outer slice): stage 1 exchanges
+            # over the ICI expert axis, stage 2 moves each token's
+            # aggregated per-slice bucket across DCN once
+            i_dest_s = dest_s % ep
+            o_dest_s = dest_s // ep
+            send_x = jnp.zeros((ep, wo, cap, M), x.dtype)
+            send_e = jnp.full((ep, wo, cap), E_loc, jnp.int32)
+            send_x = send_x.at[i_dest_s, o_dest_s, pos_in_bucket].set(
+                x_rep[order])
+            send_e = send_e.at[i_dest_s, o_dest_s, pos_in_bucket].set(
+                local_e[order])
+            recv_x = lax.all_to_all(send_x, expert_axis, 0, 0,
+                                    tiled=False)
+            recv_e = lax.all_to_all(send_e, expert_axis, 0, 0,
+                                    tiled=False)
+            if dcn_quantize:
+                from ..comm.quantized import dcn_precision_clamp
+                recv_x = dcn_precision_clamp(recv_x)
+            recv_x = lax.all_to_all(recv_x, outer_axis, 1, 1,
+                                    tiled=False)
+            recv_e = lax.all_to_all(recv_e, outer_axis, 1, 1,
+                                    tiled=False)
+        else:
+            send_x = jnp.zeros((ep, cap, M), x.dtype)
+            send_e = jnp.full((ep, cap), E_loc, jnp.int32)
+            send_x = send_x.at[dest_s, pos_in_bucket].set(x_rep[order])
+            send_e = send_e.at[dest_s, pos_in_bucket].set(local_e[order])
+            recv_x = lax.all_to_all(send_x, expert_axis, 0, 0,
+                                    tiled=False)
+            recv_e = lax.all_to_all(send_e, expert_axis, 0, 0,
+                                    tiled=False)
+        rx = recv_x.reshape(ep_total * cap, M)
+        re = recv_e.reshape(ep_total * cap)
 
         g_order = jnp.argsort(re, stable=True)
         xs = rx[g_order]
         es = re[g_order]
         group_sizes = jnp.bincount(re, length=E_loc).astype(jnp.int32)
-        g = lax.ragged_dot(xs, w1, group_sizes)
-        u = lax.ragged_dot(xs, w3, group_sizes)
-        out = lax.ragged_dot(jax.nn.silu(g) * u, w2, group_sizes)
+        gp = resolve_grouped_params(grouped_kernel, ep_total * cap,
+                                    E_loc, M, w1.shape[-1], x.dtype)
+        out = _grouped_swiglu_ffn(xs, w1, w3, w2, group_sizes, gp)
         if tn is not None:
             # row-parallel down projection: F is 'tensor'-sharded, so
-            # the local ragged_dot holds partial sums (no-op at tp=1)
+            # the local grouped product holds partial sums (no-op tp=1)
             out = lax.psum(out, tn)
         out = jnp.where((es < E_loc)[:, None], out, 0.0)
 
         back = jnp.zeros_like(out).at[g_order].set(out)
-        back = back.reshape(ep, cap, M)
-        ret = lax.all_to_all(back, expert_axis, 0, 0, tiled=False)
-        ret_flat = ret[dest_s, pos_in_bucket]
+        if hier:
+            back = back.reshape(ep, wo, cap, M)
+            if dcn_quantize:
+                from ..comm.quantized import dcn_precision_clamp
+                back = dcn_precision_clamp(back)
+            ret = lax.all_to_all(back, outer_axis, 1, 1, tiled=False)
+            ret = lax.all_to_all(ret, expert_axis, 0, 0, tiled=False)
+            ret_flat = ret[i_dest_s, o_dest_s, pos_in_bucket]
+        else:
+            back = back.reshape(ep, cap, M)
+            ret = lax.all_to_all(back, expert_axis, 0, 0, tiled=False)
+            ret_flat = ret[dest_s, pos_in_bucket]
         unsorted = jnp.zeros_like(ret_flat).at[order].set(ret_flat)
         y = jnp.sum(
             (unsorted * flat_w[:, None]).reshape(S_loc, k, M), axis=1)
-        return y.astype(tokens.dtype)
+        counts = lax.psum(
+            lax.dynamic_update_slice(jnp.zeros((E,), jnp.int32),
+                                     group_sizes, (shard * E_loc,)),
+            shard_axes)
+        return y.astype(tokens.dtype), counts
 
-    y = jax.shard_map(
+    y, counts = jax.shard_map(
         shard_fn,
-        in_specs=(P(expert_axis), P(), P(expert_axis, None, tn),
-                  P(expert_axis, None, tn), P(expert_axis, tn, None)),
-        out_specs=P(expert_axis), check_vma=False,
+        in_specs=(P(shard_axes), P(), P(shard_axes, None, tn),
+                  P(shard_axes, None, tn), P(shard_axes, tn, None)),
+        out_specs=(P(shard_axes), P()), check_vma=False,
     )(flat, gate_w, w1, w3, w2)
     if pad:
         y = y[:S]
-    return y.reshape(orig_shape)
+    y = y.reshape(orig_shape)
+    return (y, counts) if return_counts else y
 
 
 def moe_layer_ragged_ep(tokens, gate_w, wi, bi, wo, bo, k=1, *,
                         activation=jax.nn.gelu, expert_axis="expert",
-                        batch_axes=BATCH_AXES, seq_sharded=False):
+                        batch_axes=BATCH_AXES, seq_sharded=False,
+                        grouped_kernel="auto"):
     """EXPERT-PARALLEL dropless MoE: shard_map over the expert axis with an
     explicit all_to_all exchange and per-shard grouped GEMM
     (``lax.ragged_dot``) — the reference's CUTLASS ``moe_gemm`` composed
@@ -414,17 +562,29 @@ def moe_layer_ragged_ep(tokens, gate_w, wi, bi, wo, bo, k=1, *,
     if mesh.empty or mesh.shape.get(expert_axis, 1) == 1:
         return moe_layer_ragged(tokens, gate_w, wi, bi, wo, bo, k=k,
                                 activation=activation,
-                                seq_sharded=seq_sharded)
+                                seq_sharded=seq_sharded,
+                                grouped_kernel=grouped_kernel)
     ep = mesh.shape[expert_axis]
     E = gate_w.shape[-1]
     assert E % ep == 0, f"experts {E} not divisible by expert axis {ep}"
     E_loc = E // ep
     orig_shape = tokens.shape
     M = orig_shape[-1]
-    manual_axes = tuple(a for a in (batch_axes if isinstance(
+    # the region is FULL-manual (every mesh axis — jaxlib < 0.6's
+    # partitioner check-fails on manual subgroups, and an EP x ring /
+    # EP x TP composition would otherwise gather the non-manual axes):
+    # the flat token dim is sharded over the batch axes plus, when the
+    # caller runs sequence-parallel, the 'seq' axis (so EP x ring keeps
+    # its sequence shards — the (B, T, M) -> (B*T, M) reshape is
+    # batch-major, seq-minor); the FFN dim stays 'tensor'-sharded with
+    # the down projection's partial sums psum'd (row-parallel).
+    token_axes = tuple(a for a in (batch_axes if isinstance(
         batch_axes, tuple) else (batch_axes,)) if a in mesh.shape)
-    if expert_axis not in manual_axes:
-        manual_axes = manual_axes + (expert_axis,)
+    if expert_axis not in token_axes:
+        token_axes = token_axes + (expert_axis,)
+    if seq_sharded and "seq" in mesh.shape:
+        token_axes = token_axes + ("seq",)
+    tn = "tensor" if "tensor" in mesh.shape else None
 
     def shard_fn(x, gate_w, wi, bi, wo, bo):
         x = x.reshape(-1, M)
@@ -432,19 +592,19 @@ def moe_layer_ragged_ep(tokens, gate_w, wi, bi, wo, bo, k=1, *,
         cap = S_loc * k                                  # exact transport
         logits = x.astype(jnp.float32) @ gate_w.astype(jnp.float32)
         weights, experts, _, counts = topk_routing(logits, k)
-        counts = lax.psum(counts, manual_axes)
+        counts = lax.psum(counts, token_axes)
         # The GShard aux loss is nonlinear in the per-expert statistics,
         # so psum the raw sums (prob mass + first-choice counts) across
         # shards FIRST and form the loss once from global-batch values —
         # a pmean of per-shard losses biases the balance gradient
         # whenever routing differs across shards.
         probs = jax.nn.softmax(logits, axis=-1)
-        probsum = lax.psum(jnp.sum(probs, axis=0), manual_axes)
+        probsum = lax.psum(jnp.sum(probs, axis=0), token_axes)
         first = lax.psum(
             jnp.sum(jax.nn.one_hot(experts[:, 0], E), axis=0),
-            manual_axes)
+            token_axes)
         n_shards = 1
-        for a in manual_axes:
+        for a in token_axes:
             n_shards *= mesh.shape[a]
         S_glob = S_loc * n_shards
         l_aux = E * jnp.sum((probsum / S_glob) * (first / S_glob))
@@ -477,10 +637,17 @@ def moe_layer_ragged_ep(tokens, gate_w, wi, bi, wo, bo, k=1, *,
         xs = rx[g_order]
         es = re[g_order]
         group_sizes = jnp.bincount(re, length=E_loc).astype(jnp.int32)
-        h = lax.ragged_dot(xs, wi, group_sizes)
+        gp = resolve_grouped_params(grouped_kernel, ep * cap, E_loc, M,
+                                    wi.shape[-1], xs.dtype)
+        h = _grouped_dot(xs, wi, group_sizes, gp)
         safe_e = jnp.minimum(es, E_loc - 1)
         h = activation(h + bi[safe_e])
-        out = lax.ragged_dot(h, wo, group_sizes)
+        out = _grouped_dot(h, wo, group_sizes, gp)
+        if tn is not None:
+            # row-parallel down projection: F is 'tensor'-sharded, so
+            # the local grouped product holds partial sums (no-op tp=1);
+            # bo is replicated and must land AFTER the reduction
+            out = lax.psum(out, tn)
         out = out + bo[safe_e]
         out = jnp.where((es < E_loc)[:, None], out, 0.0)
 
@@ -495,13 +662,13 @@ def moe_layer_ragged_ep(tokens, gate_w, wi, bi, wo, bo, k=1, *,
         return y.astype(tokens.dtype), l_aux, counts
 
     flat = tokens.reshape(-1, M)
-    token_spec = P(tuple(manual_axes))
+    token_spec = P(tuple(token_axes))
     y, l_aux, counts = jax.shard_map(
         shard_fn,
-        in_specs=(token_spec, P(), P(expert_axis), P(expert_axis),
-                  P(expert_axis), P(expert_axis)),
-        out_specs=(token_spec, P(), P()),
-        axis_names=set(manual_axes), check_vma=False,
+        in_specs=(token_spec, P(), P(expert_axis, None, tn),
+                  P(expert_axis, tn), P(expert_axis, tn, None),
+                  P(expert_axis, None)),
+        out_specs=(token_spec, P(), P()), check_vma=False,
     )(flat, gate_w, wi, bi, wo, bo)
     y = y.reshape(orig_shape)
     y = _constrain(

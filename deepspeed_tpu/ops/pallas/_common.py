@@ -70,6 +70,14 @@ def ce_bucket(N, D, V):
     return f"N{pow2_bucket(N)},D{int(D)},V{int(V)}"
 
 
+def moe_grouped_bucket(S, E, M, F):
+    """Grouped expert-FFN bucket: tokens-per-shard (rows entering the
+    grouped product, incl. the k-replication) pow2-rounded; local expert
+    count and model/FFN dims exact (they gate block validity and the
+    kernel-vs-ragged crossover)."""
+    return f"S{pow2_bucket(S)},E{int(E)},M{int(M)},F{int(F)}"
+
+
 def paged_decode_bucket(B, MB, BS, KVH, G, d):
     """Serving decode-shape bucket: batch slots and blocks-per-seq
     pow2-rounded (nearby batch mixes share a winner); block size,

@@ -1,0 +1,453 @@
+"""Grouped (ragged) matmul over per-expert weight groups as Pallas TPU
+kernels — the dropless-MoE expert FFN.
+
+Counterpart of the reference's CUTLASS grouped ``moe_gemm``
+(``inference/v2/kernels/cutlass_ops``) and the megablox ``gmm`` pattern:
+rows are sorted by routed expert and ``group_sizes[e]`` rows multiply
+expert ``e``'s weight block. The XLA path for this is ``lax.ragged_dot``
+— one op per projection, each re-streaming the full (E, K, N) weight
+tensor and re-deciding tiling generically. These kernels own the whole
+grouped product in ONE launch:
+
+  * the row dimension is cut into m-tiles and each tile is assigned to
+    the group(s) whose rows it holds via scalar-prefetched tile maps
+    (``group_ids``/``m_tile_ids`` — a tile straddling a group boundary
+    is visited once per group, so compute stays proportional to rows,
+    never to experts x rows); each expert's weight tile streams through
+    VMEM exactly once per (m-tile, n-tile) visit;
+  * a fused SwiGLU variant (``grouped_swiglu``) runs the whole
+    w1/w3 -> silu*mul -> w2 expert chain with the gate/up products
+    sharing one streamed activation tile and the silu*mul epilogue
+    applied in-register (the g/u intermediates never hit HBM
+    separately);
+  * the backward accumulates dw PER GROUP in fp32 (``_tgmm``: out block
+    keyed by group id, row-masked accumulation over the group's
+    m-tiles, weight-dtype cast fused in the epilogue) and emits dx
+    through the same grouped kernel with the weight operand transposed
+    in its index map (no materialized (E, N, K) transpose).
+
+Rows beyond ``sum(group_sizes)`` produce ZEROS (the ``lax.ragged_dot``
+contract — MoE transport padding relies on it). Off-TPU the kernels run
+in Pallas interpreter mode; shapes whose dims cannot form tile-aligned
+blocks fall back to ``lax.ragged_dot`` with identical semantics.
+
+The kernel-vs-ragged choice and the tile sizes are autotunable: the MoE
+layers resolve ``"auto"`` against the persistent winner cache (registry
+op ``"moe_grouped_mm"``, bucketed by tokens-per-shard | experts | model
+dims) with ``TUNE_DEFAULTS`` — backend ``"ragged"`` — on a cold cache,
+so a miss is byte-identical to the pre-kernel program.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ._common import interpret_default as _interpret_default
+from ._common import round_up as _round_up
+from ._common import sds as _sds
+
+# cold-cache dispatch default: the XLA ragged_dot path (current
+# behavior); the kernel backend and its tile sweep are the measured
+# candidates (autotuning/kernel_registry.py 'moe_grouped_mm')
+TUNE_DEFAULTS = {"backend": "ragged",
+                 "block_m": 128, "block_n": 128, "block_k": 128}
+
+
+def _pick_block(dim, want):
+    """Largest divisor of ``dim`` <= want in 128-lane units (K and N
+    each sit in a lane position in at least one of the fwd/dx/dw
+    kernels); ``dim`` itself always qualifies when it fits. None = no
+    valid block (caller falls back to ragged_dot)."""
+    if dim <= want:
+        return dim
+    b = (want // 128) * 128
+    while b >= 128:
+        if dim % b == 0:
+            return b
+        b -= 128
+    return None
+
+
+# ------------------------------------------------------------- metadata
+def _group_metadata(group_sizes, m_pad, tm, E):
+    """Logical-tile maps for a grouped matmul over rows padded to
+    ``m_pad`` (a ``tm`` multiple).
+
+    Returns (group_ids, m_tile_ids, starts, ends, num_tiles): logical
+    tile i computes group ``group_ids[i]``'s rows inside physical m-tile
+    ``m_tile_ids[i]``. Each group covers the tiles its row range
+    [starts, ends) touches (a boundary tile shared by two groups is
+    visited by both); empty groups are clamped to one (masked-empty)
+    visit so their dw blocks still get written; the LAST group's range
+    extends to ``m_pad`` so every physical tile is visited and padding
+    rows come out zero. Static size ``tiles_m + E``; entries past
+    ``num_tiles`` are masked no-ops in the kernels."""
+    tiles_m = m_pad // tm
+    G = tiles_m + E
+    ends = jnp.cumsum(group_sizes).astype(jnp.int32)
+    starts = ends - group_sizes.astype(jnp.int32)
+    r_starts = jnp.minimum(starts // tm, tiles_m - 1)
+    r_ends = -(-ends // tm)                       # ceil
+    r_ends = r_ends.at[E - 1].set(tiles_m)        # tail coverage
+    tiles_per = jnp.maximum(r_ends - r_starts, 1)
+    gids = jnp.repeat(jnp.arange(E, dtype=jnp.int32), tiles_per,
+                      total_repeat_length=G)
+    offs = jnp.concatenate([jnp.zeros((1,), jnp.int32),
+                            jnp.cumsum(tiles_per)[:-1].astype(jnp.int32)])
+    within = jnp.arange(G, dtype=jnp.int32) - offs[gids]
+    mtids = jnp.minimum(r_starts[gids] + within, tiles_m - 1)
+    num_tiles = jnp.sum(tiles_per).astype(jnp.int32).reshape(1)
+    return gids, mtids, starts, ends, num_tiles
+
+
+def _row_mask(mt, g, st_ref, en_ref, valid, tm):
+    """(tm, 1) bool: rows of physical tile ``mt`` inside group ``g``'s
+    row range — and nothing at all on a padded logical tile."""
+    rows = mt * tm + lax.broadcasted_iota(jnp.int32, (tm, 1), 0)
+    return (rows >= st_ref[g]) & (rows < en_ref[g]) & valid
+
+
+# ------------------------------------------------------------- gmm fwd
+def _gmm_kernel(gid_ref, mtid_ref, st_ref, en_ref, nt_ref,
+                x_ref, w_ref, o_ref, acc, *, tm, nk, trans_w):
+    i = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _zero():
+        acc[...] = jnp.zeros_like(acc)
+
+    x = x_ref[...]                     # (tm, tk)
+    w = w_ref[0]                       # (tk, tn) | (tn, tk) when trans_w
+    cw = 1 if trans_w else 0
+    acc[...] += lax.dot_general(
+        x, w, (((1,), (cw,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _flush():
+        g = gid_ref[i]
+        mt = mtid_ref[i]
+        mask = _row_mask(mt, g, st_ref, en_ref, i < nt_ref[0], tm)
+        prev_mt = jnp.where(i == 0, -1, mtid_ref[jnp.maximum(i - 1, 0)])
+        prev = jnp.where(mt != prev_mt,
+                         jnp.zeros_like(o_ref[...]), o_ref[...])
+        o_ref[...] = jnp.where(mask, acc[...].astype(o_ref.dtype), prev)
+
+
+def _gmm(x, w, group_sizes, *, tm, tn, tk, trans_w, interpret):
+    """out[s, n] = sum_k x[s, k] w[g(s), k, n] (w (E, N, K) contracted on
+    its last dim when ``trans_w``). x rows pre-padded to a tm multiple;
+    rows outside every group come out zero."""
+    M, K = x.shape
+    E = w.shape[0]
+    N = w.shape[1] if trans_w else w.shape[2]
+    gids, mtids, starts, ends, num = _group_metadata(group_sizes, M, tm, E)
+    G = int(gids.shape[0])
+    grid = (N // tn, G, K // tk)
+
+    w_spec = pl.BlockSpec((1, tn, tk),
+                          lambda j, i, kk, gid, mtid, st, en, nt:
+                          (gid[i], j, kk)) if trans_w else \
+        pl.BlockSpec((1, tk, tn),
+                     lambda j, i, kk, gid, mtid, st, en, nt:
+                     (gid[i], kk, j))
+    out = pl.pallas_call(
+        functools.partial(_gmm_kernel, tm=tm, nk=K // tk, trans_w=trans_w),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((tm, tk),
+                             lambda j, i, kk, gid, mtid, st, en, nt:
+                             (mtid[i], kk)),
+                w_spec,
+            ],
+            out_specs=pl.BlockSpec(
+                (tm, tn),
+                lambda j, i, kk, gid, mtid, st, en, nt: (mtid[i], j)),
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        ),
+        out_shape=_sds((M, N), x.dtype, x),
+        interpret=interpret,
+    )(gids, mtids, starts, ends, num, x, w)
+    return out
+
+
+# ------------------------------------------------- fused SwiGLU up chain
+def _swiglu_up_kernel(gid_ref, mtid_ref, st_ref, en_ref, nt_ref,
+                      x_ref, w1_ref, w3_ref, o_ref, gacc, uacc, *,
+                      tm, nk):
+    i = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _zero():
+        gacc[...] = jnp.zeros_like(gacc)
+        uacc[...] = jnp.zeros_like(uacc)
+
+    x = x_ref[...]
+    gacc[...] += lax.dot_general(x, w1_ref[0], (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    uacc[...] += lax.dot_general(x, w3_ref[0], (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _flush():
+        g = gid_ref[i]
+        mt = mtid_ref[i]
+        mask = _row_mask(mt, g, st_ref, en_ref, i < nt_ref[0], tm)
+        # silu*mul epilogue in fp32, one round to the output dtype
+        gg = gacc[...]
+        h = (gg * jax.nn.sigmoid(gg)) * uacc[...]
+        prev_mt = jnp.where(i == 0, -1, mtid_ref[jnp.maximum(i - 1, 0)])
+        prev = jnp.where(mt != prev_mt,
+                         jnp.zeros_like(o_ref[...]), o_ref[...])
+        o_ref[...] = jnp.where(mask, h.astype(o_ref.dtype), prev)
+
+
+def _swiglu_up(x, w1, w3, group_sizes, *, tm, tn, tk, interpret):
+    """h[s, f] = silu(x w1[g(s)])[s, f] * (x w3[g(s)])[s, f] in one
+    launch — the gate and up products share each streamed x tile."""
+    M, K = x.shape
+    E, _, F = w1.shape
+    gids, mtids, starts, ends, num = _group_metadata(group_sizes, M, tm, E)
+    G = int(gids.shape[0])
+    w_spec = pl.BlockSpec((1, tk, tn),
+                          lambda j, i, kk, gid, mtid, st, en, nt:
+                          (gid[i], kk, j))
+    return pl.pallas_call(
+        functools.partial(_swiglu_up_kernel, tm=tm, nk=K // tk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(F // tn, G, K // tk),
+            in_specs=[
+                pl.BlockSpec((tm, tk),
+                             lambda j, i, kk, gid, mtid, st, en, nt:
+                             (mtid[i], kk)),
+                w_spec, w_spec,
+            ],
+            out_specs=pl.BlockSpec(
+                (tm, tn),
+                lambda j, i, kk, gid, mtid, st, en, nt: (mtid[i], j)),
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32),
+                            pltpu.VMEM((tm, tn), jnp.float32)],
+        ),
+        out_shape=_sds((M, F), x.dtype, x),
+        interpret=interpret,
+    )(gids, mtids, starts, ends, num, x, w1, w3)
+
+
+# ------------------------------------------------------------- dw (tgmm)
+def _tgmm_kernel(gid_ref, mtid_ref, st_ref, en_ref, nt_ref,
+                 x_ref, g_ref, o_ref, acc, *, tm, last_i):
+    i = pl.program_id(2)
+
+    gid = gid_ref[i]
+    prev_g = jnp.where(i == 0, -1, gid_ref[jnp.maximum(i - 1, 0)])
+    next_g = jnp.where(i == last_i, -1,
+                       gid_ref[jnp.minimum(i + 1, last_i)])
+
+    @pl.when(gid != prev_g)
+    def _zero():
+        acc[...] = jnp.zeros_like(acc)
+
+    mask = _row_mask(mtid_ref[i], gid, st_ref, en_ref, i < nt_ref[0], tm)
+    x = jnp.where(mask, x_ref[...], 0)            # rows outside the group
+    acc[...] += lax.dot_general(                  # contribute nothing
+        x, g_ref[...], (((0,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(gid != next_g)
+    def _flush():
+        o_ref[0] = acc[...].astype(o_ref.dtype)
+
+
+def _tgmm(x, dy, group_sizes, E, *, tm, tn, tk, out_dtype, interpret):
+    """dw[e, k, n] = sum_{s in group e} x[s, k] dy[s, n] — the per-group
+    weight-grad accumulation: the out block is keyed by group id, fp32
+    accumulation runs over the group's row tiles (boundary tiles row-
+    masked), and the weight-dtype cast lands in the flush epilogue.
+    Empty groups write zeros (their single clamped visit is all-masked).
+    """
+    M, K = x.shape
+    N = dy.shape[1]
+    gids, mtids, starts, ends, num = _group_metadata(group_sizes, M, tm, E)
+    G = int(gids.shape[0])
+    return pl.pallas_call(
+        functools.partial(_tgmm_kernel, tm=tm, last_i=G - 1),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(K // tk, N // tn, G),
+            in_specs=[
+                pl.BlockSpec((tm, tk),
+                             lambda ki, ni, i, gid, mtid, st, en, nt:
+                             (mtid[i], ki)),
+                pl.BlockSpec((tm, tn),
+                             lambda ki, ni, i, gid, mtid, st, en, nt:
+                             (mtid[i], ni)),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, tk, tn),
+                lambda ki, ni, i, gid, mtid, st, en, nt: (gid[i], ki, ni)),
+            scratch_shapes=[pltpu.VMEM((tk, tn), jnp.float32)],
+        ),
+        out_shape=_sds((E, K, N), out_dtype, x),
+        interpret=interpret,
+    )(gids, mtids, starts, ends, num, x, dy)
+
+
+# ---------------------------------------------------------------- public
+def _blocks_fit(M, K, N, bm, bn, bk):
+    """Resolve (tm, tn, tk) or None — K/N must form 128-aligned divisor
+    blocks (each appears in a lane position in at least one of the
+    fwd/dx/dw kernels); the row dim is padded to tm outside."""
+    tn = _pick_block(N, bn)
+    tk = _pick_block(K, bk)
+    if tn is None or tk is None or min(M, K, N) < 8:
+        return None
+    tm = min(bm, _round_up(M, 8))
+    return tm, tn, tk
+
+
+def _pad_rows(x, tm):
+    M = x.shape[0]
+    pad = _round_up(M, tm) - M
+    return (jnp.pad(x, ((0, pad), (0, 0))) if pad else x), M
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _gmm_diff(x, w, group_sizes, tm, tn, tk, interpret):
+    xp, M = _pad_rows(x, tm)
+    return _gmm(xp, w, group_sizes, tm=tm, tn=tn, tk=tk, trans_w=False,
+                interpret=interpret)[:M]
+
+
+def _gmm_diff_fwd(x, w, group_sizes, tm, tn, tk, interpret):
+    return (_gmm_diff(x, w, group_sizes, tm, tn, tk, interpret),
+            (x, w, group_sizes))
+
+
+def _gmm_diff_bwd(tm, tn, tk, interpret, res, dy):
+    x, w, group_sizes = res
+    E = w.shape[0]
+    xp, M = _pad_rows(x, tm)
+    dyp, _ = _pad_rows(dy, tm)
+    # dx contracts the OUT dim (tn) and emits the contract dim (tk):
+    # same grouped kernel, weight operand transposed in its index map
+    dx = _gmm(dyp, w, group_sizes, tm=tm, tn=tk, tk=tn, trans_w=True,
+              interpret=interpret)[:M]
+    dw = _tgmm(xp, dyp, group_sizes, E, tm=tm, tn=tn, tk=tk,
+               out_dtype=w.dtype, interpret=interpret)
+    return dx, dw, None
+
+
+_gmm_diff.defvjp(_gmm_diff_fwd, _gmm_diff_bwd)
+
+
+def grouped_matmul(x, w, group_sizes, *, block_m=128, block_n=128,
+                   block_k=128, interpret=None):
+    """``lax.ragged_dot`` drop-in: x (S, K) rows sorted by group, w
+    (E, K, N), group_sizes (E,) int32 -> (S, N); rows beyond
+    ``sum(group_sizes)`` are zero. Differentiable (dx through the
+    transposed-weight kernel, dw through the per-group fp32 ``_tgmm``).
+    Shapes whose dims cannot form tile-aligned blocks fall back to
+    ``lax.ragged_dot`` with identical math.
+    """
+    if x.ndim != 2 or w.ndim != 3 or x.shape[1] != w.shape[1]:
+        raise ValueError(
+            f"grouped_matmul expects x (S, K) and w (E, K, N); got "
+            f"{x.shape} / {w.shape}")
+    fit = _blocks_fit(x.shape[0], x.shape[1], w.shape[2],
+                      block_m, block_n, block_k)
+    if fit is None:
+        return lax.ragged_dot(x, w, group_sizes)
+    tm, tn, tk = fit
+    if interpret is None:
+        interpret = _interpret_default()
+    return _gmm_diff(x, w, group_sizes.astype(jnp.int32), tm, tn, tk,
+                     bool(interpret))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8))
+def _swiglu_diff(x, w1, w3, w2, group_sizes, tm, tn, tk, interpret):
+    xp, M = _pad_rows(x, tm)
+    h = _swiglu_up(xp, w1, w3, group_sizes, tm=tm, tn=tn, tk=tk,
+                   interpret=interpret)
+    return _gmm(h, w2, group_sizes, tm=tm, tn=tk, tk=tn, trans_w=False,
+                interpret=interpret)[:M]
+
+
+def _swiglu_diff_fwd(x, w1, w3, w2, group_sizes, tm, tn, tk, interpret):
+    return (_swiglu_diff(x, w1, w3, w2, group_sizes, tm, tn, tk,
+                         interpret),
+            (x, w1, w3, w2, group_sizes))
+
+
+def _swiglu_diff_bwd(tm, tn, tk, interpret, res, dy):
+    """Backward with the flash-style remat trade: g and u are recomputed
+    from x (two grouped products) instead of living in HBM between
+    forward and backward; every matmul is the grouped kernel and each
+    dw accumulates per group in fp32."""
+    x, w1, w3, w2, group_sizes = res
+    E = w1.shape[0]
+    xp, M = _pad_rows(x, tm)
+    dyp, _ = _pad_rows(dy, tm)
+    kw = dict(tm=tm, interpret=interpret)
+    g = _gmm(xp, w1, group_sizes, tn=tn, tk=tk, trans_w=False, **kw)
+    u = _gmm(xp, w3, group_sizes, tn=tn, tk=tk, trans_w=False, **kw)
+    gf = g.astype(jnp.float32)
+    sg = jax.nn.sigmoid(gf)
+    sil = (gf * sg).astype(x.dtype)
+    dh = _gmm(dyp, w2, group_sizes, tn=tn, tk=tk, trans_w=True, **kw)
+    dhf = dh.astype(jnp.float32)
+    dg = (dhf * u.astype(jnp.float32)
+          * (sg * (1 + gf * (1 - sg)))).astype(x.dtype)
+    du = (dhf * sil.astype(jnp.float32)).astype(x.dtype)
+    dx = (_gmm(dg, w1, group_sizes, tn=tk, tk=tn, trans_w=True, **kw)
+          + _gmm(du, w3, group_sizes, tn=tk, tk=tn, trans_w=True,
+                 **kw))[:M]
+    dw1 = _tgmm(xp, dg, group_sizes, E, tn=tn, tk=tk,
+                out_dtype=w1.dtype, **kw)
+    dw3 = _tgmm(xp, du, group_sizes, E, tn=tn, tk=tk,
+                out_dtype=w3.dtype, **kw)
+    h = (sil.astype(jnp.float32) * u.astype(jnp.float32)).astype(x.dtype)
+    dw2 = _tgmm(h, dyp, group_sizes, E, tn=tk, tk=tn,
+                out_dtype=w2.dtype, **kw)
+    return dx, dw1, dw3, dw2, None
+
+
+_swiglu_diff.defvjp(_swiglu_diff_fwd, _swiglu_diff_bwd)
+
+
+def grouped_swiglu(x, w1, w3, w2, group_sizes, *, block_m=128,
+                   block_n=128, block_k=128, interpret=None):
+    """The whole SwiGLU expert chain as grouped kernels:
+    ``gmm(silu(gmm(x, w1)) * gmm(x, w3), w2)`` with the gate/up products
+    fused into one launch (shared x tiles, in-register silu*mul
+    epilogue). x (S, K); w1/w3 (E, K, F); w2 (E, F, K'); -> (S, K').
+    Same fallback/zero-tail/backward contract as ``grouped_matmul``.
+    """
+    E, K, F = w1.shape
+    if x.ndim != 2 or x.shape[1] != K or w3.shape != w1.shape or \
+            w2.shape[:2] != (E, F):
+        raise ValueError(
+            f"grouped_swiglu shape mismatch: x {x.shape}, w1 {w1.shape}, "
+            f"w3 {w3.shape}, w2 {w2.shape}")
+    fit = _blocks_fit(x.shape[0], K, F, block_m, block_n, block_k)
+    # the down projection re-uses the same tiles with roles swapped, so
+    # its output dim (w2's last) must form blocks too
+    fit_dn = fit and _pick_block(w2.shape[2], block_k)
+    if fit is None or fit_dn is None or fit_dn != fit[2]:
+        g = lax.ragged_dot(x, w1, group_sizes)
+        u = lax.ragged_dot(x, w3, group_sizes)
+        return lax.ragged_dot(jax.nn.silu(g) * u, w2, group_sizes)
+    tm, tn, tk = fit
+    if interpret is None:
+        interpret = _interpret_default()
+    return _swiglu_diff(x, w1, w3, w2, group_sizes.astype(jnp.int32),
+                        tm, tn, tk, bool(interpret))

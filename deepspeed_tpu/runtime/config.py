@@ -315,6 +315,52 @@ class SequenceConfig:
 
 
 @dataclass
+class MoEConfig:
+    """Dropless-MoE block (moe/sharded_moe.py + ops/pallas/
+    grouped_matmul.py — the engine installs it on the model as
+    ``model._moe_cfg``; mixtral consults it per dispatch, and for
+    MoE-layer models (GPT2MoE) an explicit non-"auto"
+    ``grouped_kernel`` here overrides the model-config knob):
+
+      grouped_kernel   expert-FFN engine for the ragged (dropless)
+                       paths: "auto" (default — resolve kernel-vs-
+                       ragged_dot and tile sizes per shape bucket from
+                       the 'moe_grouped_mm' autotune winner cache; a
+                       cold cache keeps the lax.ragged_dot program
+                       byte-identical) | true (Pallas grouped-GEMM
+                       kernel, default tiles) | false (ragged_dot).
+      hierarchical_a2a "auto" (default — the EP all_to_all stages
+                       ICI -> DCN iff the mesh has a data_outer axis
+                       > 1 and the experts divide the combined
+                       (outer, expert) shard grid) | true (require the
+                       staging; loud error if experts don't divide) |
+                       false (always the flat single-hop exchange).
+      dcn_quantize     qgZ int8 block round trip on the token payload
+                       of the DCN legs ONLY (both directions of the
+                       data_outer hop; the ICI hop stays exact) —
+                       requires a hierarchical stage, ignored without
+                       one (same discipline as comm_overlap).
+    """
+    grouped_kernel: object = "auto"    # "auto" | bool
+    hierarchical_a2a: object = "auto"  # "auto" | bool
+    dcn_quantize: bool = False
+
+    def __post_init__(self):
+        if self.grouped_kernel not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"moe.grouped_kernel must be true|false|'auto', got "
+                f"{self.grouped_kernel!r}")
+        if self.hierarchical_a2a not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"moe.hierarchical_a2a must be true|false|'auto', got "
+                f"{self.hierarchical_a2a!r}")
+        if not isinstance(self.dcn_quantize, bool):
+            raise DeepSpeedConfigError(
+                f"moe.dcn_quantize must be a bool, got "
+                f"{self.dcn_quantize!r}")
+
+
+@dataclass
 class AutotuneConfig:
     """Measured kernel dispatch (autotuning/kernel_dispatch.py): kernel
     tunables set to "auto" (flash blocks / mlp_kernel / fused_layernorm
@@ -456,6 +502,7 @@ class DeepSpeedConfig:
                                        C.CHECKPOINT_ENGINE)
         self.comm_overlap = _take(config, CommOverlapConfig, "comm_overlap")
         self.sequence = _take(config, SequenceConfig, "sequence")
+        self.moe = _take(config, MoEConfig, "moe")
         self.autotune = _take(config, AutotuneConfig, "autotune")
         self.activation_checkpointing = _take(
             config, ActivationCheckpointingConfig, C.ACTIVATION_CHECKPOINTING)

@@ -135,6 +135,17 @@ class DeepSpeedEngine:
                 "sequence config block could not be installed on the "
                 "model (attribute assignment rejected); ring attention "
                 "will use the module defaults", ranks=[0])
+        # dropless-MoE knobs (config 'moe' block): grouped-GEMM kernel
+        # dispatch + hierarchical ICI->DCN expert all_to_all staging
+        # (moe/sharded_moe.py; mixtral._mlp and the MoE layers consult
+        # model._moe_cfg per dispatch)
+        try:
+            self.model._moe_cfg = self.config.moe
+        except (AttributeError, TypeError):   # frozen/slotted models
+            log_dist(
+                "moe config block could not be installed on the model "
+                "(attribute assignment rejected); MoE layers will use "
+                "the module defaults", ranks=[0])
         self.zero_stage = self.config.zero.stage
         self.param_dtype = self.config.precision_dtype
         model_dtype = getattr(getattr(model, "config", None), "dtype",

@@ -40,6 +40,7 @@ _BUCKETS = {
     "layernorm": "R256,D128",
     "fused_ce": "N128,D128,V384",
     "ring_block": "T64,d32",
+    "moe_grouped_mm": "S128,E4,M128,F256",
     "paged_decode": "B4,MB4,BS16,kh2,g2,d32",
     "paged_chunk": "C16,MB4,BS16,kh2,g2,d32",
 }

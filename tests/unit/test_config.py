@@ -149,6 +149,38 @@ def test_sequence_block_validation():
                         dp_world_size=8)
 
 
+def test_moe_block_defaults_and_parses():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
+    mo = cfg.moe
+    assert mo.grouped_kernel == "auto"
+    assert mo.hierarchical_a2a == "auto"
+    assert mo.dcn_quantize is False
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "moe": {"grouped_kernel": True, "hierarchical_a2a": False,
+                "dcn_quantize": True},
+    }, dp_world_size=8)
+    mo = cfg.moe
+    assert mo.grouped_kernel is True
+    assert mo.hierarchical_a2a is False
+    assert mo.dcn_quantize is True
+
+
+def test_moe_block_validation():
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "moe": {"grouped_kernel": "fast"}},
+                        dp_world_size=8)
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "moe": {"hierarchical_a2a": "always"}},
+                        dp_world_size=8)
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8,
+                         "moe": {"dcn_quantize": "yes"}},
+                        dp_world_size=8)
+
+
 def test_autotune_defaults():
     cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
     at = cfg.autotune
