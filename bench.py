@@ -43,6 +43,12 @@ Disable with BENCH_VARIANTS=none, or pick a subset
 (BENCH_VARIANTS=mlp_down,bwd_qmajor,1.3B,overlap,autotune,ring_on,
 moe_on,moe_off).
 
+``extras.telemetry`` embeds the observability layer's own read of a
+measured run (ISSUE 9): single-chip MFU (cost_analysis flops), goodput,
+step percentiles from ``engine.telemetry_report()``, and the pod-wide
+straggler delta from a 2-host virtual-mesh probe
+(benchmarks/telemetry_probe.py). BENCH_TELEMETRY=0 skips it.
+
 The full report is also ALWAYS written into the tree as
 ``BENCH_local.json`` (the r06/r07 driver artifacts vanished; a lost
 driver artifact must never again erase a round's measurements).
@@ -229,6 +235,62 @@ def _run_variants(names, steps, warmup):
     return out
 
 
+def _telemetry_extras(steps, warmup):
+    """``extras.telemetry`` (ISSUE 9): the telemetry layer's own read
+    of a measured run — single-chip MFU/goodput/step percentiles from
+    ``engine.telemetry_report()`` (tiny preset so it never competes
+    with the headline for HBM), plus the pod-wide straggler-delta
+    aggregation from a 2-host virtual-mesh probe
+    (benchmarks/telemetry_probe.py). Failures are isolated like every
+    variant: telemetry must never cost the headline number."""
+    import subprocess
+    import sys as _sys
+    out = {}
+    saved = {k: os.environ.get(k)
+             for k in ("BENCH_TELEMETRY", "BENCH_PRESET",
+                       "BENCH_MICRO_BS", "BENCH_SEQ")}
+    os.environ.update({"BENCH_TELEMETRY": "1", "BENCH_PRESET": "tiny",
+                       "BENCH_MICRO_BS": "8", "BENCH_SEQ": "128"})
+    try:
+        engine, batch = build_bench_engine()
+        for _ in range(warmup):
+            engine.train_batch(batch)
+        engine.telemetry.reset_window()     # compile out of the window
+        for _ in range(steps):
+            engine.train_batch(batch)
+        engine.telemetry.drain()
+        snap = engine.telemetry_report() or {}
+        out["local"] = {k: snap.get(k) for k in (
+            "mfu_pct", "flops_source", "goodput_pct",
+            "tokens_per_sec_chip", "step_time_ms_p50",
+            "step_time_ms_p99", "collectives", "exposed_comm_pct",
+            "peak_assumed")}
+        del engine, batch
+        gc.collect()
+    except Exception as e:  # noqa: BLE001 - isolate, like variants
+        out["local"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    try:
+        here = os.path.dirname(os.path.abspath(__file__))
+        probe = subprocess.run(
+            [_sys.executable,
+             os.path.join(here, "benchmarks", "telemetry_probe.py"),
+             "--hosts", "2", "--steps", "5", "--warmup", "2"],
+            capture_output=True, text=True, timeout=600)
+        line = probe.stdout.strip().splitlines()[-1]
+        parsed = json.loads(line)
+        out["cluster"] = parsed.get("cluster")
+        out["cluster_hosts"] = parsed.get("hosts")
+    except Exception as e:  # noqa: BLE001
+        out["cluster"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    return out
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
@@ -281,6 +343,15 @@ def main():
     except Exception as e:          # report, don't hide the bench
         autotune_info["error"] = f"{type(e).__name__}: {e}"[:200]
 
+    # telemetry self-measurement (MFU/goodput + the 2-host virtual-mesh
+    # straggler probe) — the trajectory artifacts pick the new metrics
+    # up from here automatically. BENCH_TELEMETRY=0 skips.
+    telemetry_info = {}
+    if os.environ.get("BENCH_TELEMETRY", "") != "0":
+        telemetry_info = _telemetry_extras(
+            int(os.environ.get("BENCH_TELEMETRY_STEPS", "6")),
+            int(os.environ.get("BENCH_TELEMETRY_WARMUP", "2")))
+
     report = {
         "metric": (f"gpt2-{preset} zero{stage}"
                    + (f"-offload-{offload}" if offload else "")
@@ -299,6 +370,7 @@ def main():
             "kernels_parity": kernels_parity,
             "variants": variants,
             "autotune": autotune_info,
+            "telemetry": telemetry_info,
         },
     }
 

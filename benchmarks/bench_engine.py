@@ -160,6 +160,15 @@ def build_bench_engine():
                                               "on_first_use")
     elif at == "0":
         autotune_cfg["mode"] = "off"
+    # BENCH_TELEMETRY=1: arm the telemetry block (monitor/telemetry.py)
+    # so bench.py can read MFU/goodput/step percentiles straight off
+    # engine.telemetry_report() — no monitor backend needed
+    telemetry_cfg = {}
+    if os.environ.get("BENCH_TELEMETRY", "") == "1":
+        telemetry_cfg = {
+            "enabled": True,
+            "interval_steps": int(os.environ.get(
+                "BENCH_TELEMETRY_INTERVAL", "5"))}
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model,
         **({"topology": topo} if topo is not None else {}),
@@ -182,6 +191,7 @@ def build_bench_engine():
                 if offload else {"stage": stage}),
             **({"comm_overlap": overlap_cfg} if overlap_cfg else {}),
             **({"autotune": autotune_cfg} if autotune_cfg else {}),
+            **({"telemetry": telemetry_cfg} if telemetry_cfg else {}),
         })
     bsz = engine.config.train_batch_size
     rng = np.random.RandomState(0)

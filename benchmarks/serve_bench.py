@@ -532,6 +532,10 @@ def _mixed_one(name, rate, n_requests, long_prompt, short_prompt,
         "completed": len([1 for ts in tok_times.values() if ts]),
         "wall_s": round(wall, 2),
         "devices": len(jax.devices()),
+        # the engine's OWN per-request accounting (monitor/telemetry.py
+        # ServingTelemetry — what a production fan-out would export),
+        # next to the harness-measured percentiles as a cross-check
+        "engine_telemetry": engine.telemetry_snapshot(),
     }
 
 

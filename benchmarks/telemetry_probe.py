@@ -1,0 +1,130 @@
+"""2-host virtual-mesh telemetry probe: the cluster-aggregation +
+straggler-delta numbers for ``bench.py`` ``extras.telemetry``.
+
+Spawns N single-process workers (default 2) that each train a tiny
+engine on the CPU backend with telemetry armed and the fs cluster
+transport ringed through a shared tmp dir (``DSTPU_TELEM_DIR`` +
+``DSTPU_TELEM_NODE``/``DSTPU_TELEM_PEERS`` — the same virtual-host
+idiom the elastic-agent tests use with ``DSTPU_HOT_*``). Host 1 runs a
+genuinely heavier per-step workload (larger micro batch), so the
+aggregation has a REAL straggler to find — no injected sleeps in the
+production path. The ring's first node gathers at its final flush
+(with a wait so the peers' files land) and prints the pod aggregate.
+
+Standalone:  python benchmarks/telemetry_probe.py [--hosts 2]
+             [--steps 6] [--straggle-factor 4]
+prints one JSON object; bench.py embeds it as
+``extras.telemetry.cluster``.
+"""
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+
+def worker(args):
+    """One virtual host: tiny engine, telemetry on, fs cluster ring."""
+    import numpy as np
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    from __graft_entry__ import _provision
+    _provision(1)
+    import deepspeed_tpu
+    from deepspeed_tpu.models.gpt2 import GPT2, GPT2_TINY
+
+    micro = args.micro
+    model = GPT2(GPT2_TINY)
+    engine, _, _, _ = deepspeed_tpu.initialize(model=model, config={
+        "train_micro_batch_size_per_gpu": micro,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "bf16": {"enabled": True},
+        "zero_optimization": {"stage": 1},
+        "steps_per_print": 0,
+        "telemetry": {"enabled": True, "interval_steps": args.steps,
+                      "cluster_agg": True},
+    })
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(
+        0, 1024, (engine.config.train_batch_size, 128)).astype(np.int32)}
+    for _ in range(args.warmup):
+        engine.train_batch(batch)
+    # restart the interval window post-warmup so compile time never
+    # poses as a straggler
+    engine.telemetry.reset_window()
+    for _ in range(args.steps):
+        engine.train_batch(batch)
+    # the flush at step == interval ran; ring node 0 re-gathers with a
+    # wait so every peer's final metrics are in
+    tel = engine.telemetry
+    tel.drain()
+    out = dict(tel.snapshot())
+    if tel.cluster is not None and tel.cluster.is_root:
+        last = out.get("cluster")
+        metrics = {"node": tel.cluster.node,
+                   "step": out.get("step", args.steps),
+                   "mean_step_ms": out.get("mean_step_ms")}
+        from deepspeed_tpu.monitor.telemetry import aggregate_cluster
+        got = tel.cluster.gather(metrics, wait_s=20.0)
+        agg = aggregate_cluster(got, order=tel.cluster.peers) or last
+        out["cluster"] = agg
+    print("TELEM_PROBE " + json.dumps(out))
+    return 0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hosts", type=int, default=2)
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--micro", type=int, default=2)
+    # the straggler's micro batch = micro * straggle-factor: a real
+    # workload skew, measured end to end
+    ap.add_argument("--straggle-factor", type=int, default=4)
+    ap.add_argument("--worker", action="store_true")
+    args = ap.parse_args()
+    if args.worker:
+        return worker(args)
+
+    hosts = [f"h{i}" for i in range(args.hosts)]
+    with tempfile.TemporaryDirectory(prefix="dstpu_telem_probe_") as d:
+        procs = []
+        for i, h in enumerate(hosts):
+            env = dict(os.environ)
+            env.pop("XLA_FLAGS", None)
+            env.update({
+                "JAX_PLATFORMS": "cpu",
+                "DSTPU_TELEM_DIR": d,
+                "DSTPU_TELEM_NODE": h,
+                "DSTPU_TELEM_PEERS": ",".join(hosts),
+            })
+            micro = args.micro * (args.straggle_factor
+                                  if i == len(hosts) - 1 else 1)
+            procs.append(subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__), "--worker",
+                 "--steps", str(args.steps), "--warmup",
+                 str(args.warmup), "--micro", str(micro)],
+                env=env, stdout=subprocess.PIPE, text=True))
+        outs = [p.communicate(timeout=600)[0] for p in procs]
+        for p in procs:
+            if p.returncode != 0:
+                raise SystemExit(f"probe worker failed rc={p.returncode}")
+        root = next(
+            (json.loads(line[len("TELEM_PROBE "):])
+             for out in outs for line in out.splitlines()
+             if line.startswith("TELEM_PROBE ")
+             and json.loads(line[len("TELEM_PROBE "):]).get("cluster")),
+            None)
+    report = {"hosts": len(hosts),
+              "cluster": (root or {}).get("cluster"),
+              "root_snapshot": {k: v for k, v in (root or {}).items()
+                                if k != "cluster"}}
+    print(json.dumps(report))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

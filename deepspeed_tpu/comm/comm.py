@@ -221,6 +221,33 @@ def ring_exchange_bytes(payload, shift=1):
     return local[:n].tobytes(), origin
 
 
+def allgather_bytes(payload):
+    """Host-level byte allgather across the PROCESS ring: every process
+    contributes ``payload``; returns the list of all processes' payloads
+    in process order, or ``None`` in a single-process world.
+
+    Same transport discipline as :func:`ring_exchange_bytes` (one
+    length allgather sizes a padded buffer, then one data collective
+    moves everything over the accelerator fabric) — the telemetry
+    layer's cluster aggregation (monitor/telemetry.py) uses this to
+    pool per-host step-time metrics at flush boundaries. Collective:
+    every process must call at the same point.
+    """
+    nproc = jax.process_count()
+    if nproc <= 1:
+        return None
+    from jax.experimental import multihost_utils
+    data = np.frombuffer(bytes(payload), dtype=np.uint8)
+    lengths = np.asarray(multihost_utils.process_allgather(
+        np.asarray([data.size], np.int64))).reshape(-1)
+    width = max(1, int(lengths.max()))
+    buf = np.zeros((width,), np.uint8)
+    buf[:data.size] = data
+    stacked = np.asarray(multihost_utils.process_allgather(buf))
+    return [stacked[i, :int(lengths[i])].tobytes()
+            for i in range(nproc)]
+
+
 def barrier(name="dstpu_barrier"):
     """Host-level barrier across all processes (works multi-host, where a
     naive jit over the global mesh would reject host-local inputs)."""

@@ -118,6 +118,16 @@ class DSElasticAgent:
         start when hang detection is on, any host is non-local, and
         heartbeat_dir was left at its default (an explicitly-given dir
         is trusted, with a one-time shared-FS warning).
+      flightrec_root: flight-recorder dump dir (monitor/
+        flight_recorder.py). When set, the agent (a) exports
+        ``DSTPU_FLIGHTREC_DIR`` / ``DSTPU_FLIGHTREC_NODE`` to workers
+        (which also arms telemetry's 'auto' resolution), and (b) on a
+        membership change reads each failed host's dump and attaches
+        its event tail to the failure classification
+        (``last_failure_records``) — so "why did host 3 die" starts
+        from the victim's own black box: the last steps it completed,
+        the fault points that fired, and the checkpoint tier its
+        generation restored from.
       hot_root: hot-tier store root (checkpoint_engine/hot_tier.py).
         When set, the agent (a) exports the replica ring to workers via
         ``DSTPU_HOT_TIER_ROOT`` / ``DSTPU_HOT_NODE`` / ``DSTPU_HOT_PEERS``
@@ -132,7 +142,7 @@ class DSElasticAgent:
                  on_restart=None, heartbeat_timeout_s=None,
                  heartbeat_dir=None, tensor_parallel=1, expert_parallel=1,
                  pipe_parallel=1, seq_parallel=1, restart_backoff_s=None,
-                 hot_root=None):
+                 hot_root=None, flightrec_root=None):
         self.launch_fn = launch_fn
         self.hosts = list(hosts)
         self.ds_config = ds_config
@@ -155,9 +165,13 @@ class DSElasticAgent:
         backoff.update(restart_backoff_s or {})
         self.restart_backoff_s = backoff
         self.hot_root = hot_root
+        self.flightrec_root = flightrec_root
         self.topology = self.compute_topology(self.hosts, validate=False)
         # host -> failure class of the most recent membership change
         self.last_failures = {}
+        # host -> parsed flight-recorder dump of the most recent
+        # membership change (only hosts whose dump was readable)
+        self.last_failure_records = {}
         self._check_heartbeat_dir()
 
     # ------------------------------------------------------------ heartbeat
@@ -252,6 +266,9 @@ class DSElasticAgent:
             env["DSTPU_HOT_TIER_ROOT"] = self.hot_root
             env["DSTPU_HOT_NODE"] = str(host)
             env["DSTPU_HOT_PEERS"] = ",".join(str(h) for h in self.hosts)
+        if self.flightrec_root:
+            env["DSTPU_FLIGHTREC_DIR"] = self.flightrec_root
+            env["DSTPU_FLIGHTREC_NODE"] = str(host)
         return env
 
     # ------------------------------------------------------------ internals
@@ -328,11 +345,41 @@ class DSElasticAgent:
                 time.sleep(self.poll_s)
         return (not failures), failures
 
+    def _attach_flight_records(self, failures):
+        """Read each failed host's flight-recorder dump and attach the
+        event tail to the classification: the victim's last completed
+        steps, fired fault points, and the tier its generation restored
+        from — the difference between 'host 3 exited 1' and a lead."""
+        self.last_failure_records = {}
+        if not self.flightrec_root:
+            return
+        from ..monitor import flight_recorder
+        for host, kind in failures.items():
+            rec = flight_recorder.read_dump(self.flightrec_root, host)
+            if rec is None:
+                logger.info(
+                    f"elastic agent: no flight-recorder dump for failed "
+                    f"host {host} under {self.flightrec_root}")
+                continue
+            self.last_failure_records[host] = rec
+            tail = rec.get("events", [])[-8:]
+            summary = ", ".join(
+                e.get("kind", "?")
+                + (f"({e['point']})" if e.get("kind") == "fault_point"
+                   else f"(tier={e['tier']})" if e.get("kind") == "restore"
+                   else "")
+                for e in tail)
+            logger.warning(
+                f"elastic agent: flight record of {host} ({kind}, "
+                f"dump reason={rec.get('reason')!r}): last events "
+                f"[{summary}]")
+
     def _handle_membership_change(self, failures):
         """Classify, drop dead/hung hosts (keeping corrupt-checkpoint
         ones — their HOST is healthy), purge the hot-tier stores of the
         hosts whose RAM is gone, and apply the per-class backoff."""
         self.last_failures = dict(failures)
+        self._attach_flight_records(failures)
         lost = [h for h, kind in failures.items()
                 if kind in (FAILURE_DEAD, FAILURE_HUNG)]
         for h in lost:

@@ -103,6 +103,13 @@ class RaggedInferenceEngineConfig:
     # explicit autotune settings should give each its own process.
     autotune_mode: str = ""
     autotune_cache: str = ""
+    # per-request TTFT/TPOT accounting (monitor/telemetry.py
+    # ServingTelemetry): bounded sample windows, dispatch-amortized
+    # TPOT; with a monitor passed to the engine, Serve/Telemetry/*
+    # events flow through the same MonitorMaster fan-out as training,
+    # every telemetry_interval completed requests
+    telemetry: bool = True
+    telemetry_interval: int = 32
 
     def __post_init__(self):
         if self.paged_kernel not in (True, False, "auto"):
@@ -124,6 +131,11 @@ class RaggedInferenceEngineConfig:
             raise ValueError(
                 f"splitfuse_tokens must be >= 0, got "
                 f"{self.splitfuse_tokens}")
+        if not isinstance(self.telemetry_interval, int) \
+                or self.telemetry_interval < 1:
+            raise ValueError(
+                f"telemetry_interval must be an int >= 1, got "
+                f"{self.telemetry_interval!r}")
 
 
 @dataclass
@@ -141,13 +153,22 @@ class InferenceEngineV2:
     ``get(uid)`` returns the generated tokens."""
 
     def __init__(self, model, config=None, params=None, topology=None,
-                 **kwargs):
+                 monitor=None, **kwargs):
         if isinstance(config, dict):
             config = RaggedInferenceEngineConfig(**{**config, **kwargs})
         elif config is None:
             config = RaggedInferenceEngineConfig(**kwargs)
         self.config = config
         self.model = model
+        # serving-side telemetry: TTFT/TPOT histograms exported through
+        # the same MonitorMaster fan-out as training when ``monitor``
+        # (a monitor.Monitor / MonitorMaster) is given; always readable
+        # via telemetry_snapshot() for serve_bench
+        self.telemetry = None
+        if config.telemetry:
+            from ...monitor.telemetry import ServingTelemetry
+            self.telemetry = ServingTelemetry(
+                monitor=monitor, interval=config.telemetry_interval)
         mcfg = model.config
         self.max_seq_len = mcfg.max_seq_len
 
@@ -250,6 +271,8 @@ class InferenceEngineV2:
             temperature=(self.config.temperature if temperature is None
                          else float(temperature)),
             top_k=(self.config.top_k if top_k is None else int(top_k))))
+        if self.telemetry is not None:
+            self.telemetry.on_submit(uid)   # TTFT clock starts at submit
         return uid
 
     def is_done(self, uid):
@@ -563,9 +586,13 @@ class InferenceEngineV2:
 
     def _post_token(self, seq, token):
         seq.generated.append(token)
+        if self.telemetry is not None:
+            self.telemetry.on_token(seq.uid)
         if ((seq.eos_token_id >= 0 and token == seq.eos_token_id)
                 or len(seq.generated) >= seq.max_new_tokens):
             self._results[seq.uid] = np.asarray(seq.generated, np.int32)
+            if self.telemetry is not None:
+                self.telemetry.on_finish(seq.uid)
             if self.kv_pool is not None:
                 # drop residency before the allocator recycles the ids
                 self.kv_pool.release(seq.blocks)
@@ -646,6 +673,23 @@ class InferenceEngineV2:
         return out
 
     def step(self):
+        """One scheduler iteration (see :meth:`_step_inner`). The
+        dispatch boundary is where serving telemetry amortizes this
+        dispatch's wall time across the tokens it produced (per-token
+        deltas inside one multi-step dispatch are meaningless)."""
+        out = self._step_inner()
+        if self.telemetry is not None:
+            self.telemetry.on_dispatch(active=self.state_mgr.n_active)
+            self.telemetry.maybe_emit()
+        return out
+
+    def telemetry_snapshot(self):
+        """Current TTFT/TPOT percentiles + counters (None when serving
+        telemetry is disabled)."""
+        return None if self.telemetry is None else \
+            self.telemetry.percentiles()
+
+    def _step_inner(self):
         """One scheduler iteration: admit+prefill pending, then up to
         ``decode_steps_per_dispatch`` decode steps for every active
         sequence in one device program. Returns list of (uid, token)

@@ -226,6 +226,14 @@ def parse_args(argv=None):
                              "agent purges a dead host's store on "
                              "membership change). Empty = no hot-tier "
                              "ring wiring")
+    parser.add_argument("--elastic_flightrec_root", default="",
+                        help="flight-recorder dump dir exported to "
+                             "workers (DSTPU_FLIGHTREC_DIR/NODE; also "
+                             "arms telemetry 'auto'). On a membership "
+                             "change the agent reads the failed hosts' "
+                             "dumps and logs their event tails. Must "
+                             "be on a shared filesystem with remote "
+                             "hosts. Empty = no flight-record wiring")
     parser.add_argument("--elastic_heartbeat_timeout", type=float,
                         default=None,
                         help="seconds without a worker heartbeat before "
@@ -392,6 +400,8 @@ def main(argv=None):
                                chips_per_host=(slots.pop() if
                                                len(slots) == 1 else 1),
                                hot_root=args.elastic_hot_root or None,
+                               flightrec_root=(
+                                   args.elastic_flightrec_root or None),
                                heartbeat_timeout_s=(
                                    args.elastic_heartbeat_timeout),
                                heartbeat_dir=args.elastic_heartbeat_dir)

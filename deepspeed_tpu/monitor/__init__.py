@@ -1,2 +1,5 @@
 from .monitor import MonitorMaster
 from .config import DeepSpeedMonitorConfig, TensorBoardConfig, WandbConfig, CSVConfig
+from .tag_schema import TAG_SCHEMA
+from .telemetry import TelemetryCollector, ServingTelemetry
+from .flight_recorder import FlightRecorder

@@ -1,0 +1,175 @@
+"""Crash flight recorder: a bounded ring of recent structured events,
+dumped to ``{ckpt_root}/flightrec/host{n}.json`` when the process dies.
+
+A pod incident leaves almost nothing behind: the dead worker's logs end
+mid-step and the elastic agent only sees an exit code. This module keeps
+the last N structured events — step completions, fired fault-injection
+points, checkpoint saves/restores (with the tier that served them),
+reshape decisions, heartbeats, profiler actions — in memory, and writes
+them out when it matters:
+
+  * **crash** — the engine wraps its step/save/load paths and calls
+    :meth:`FlightRecorder.crash` on any ``BaseException`` (including the
+    chaos suite's ``SimulatedKill``) before re-raising;
+  * **SIGTERM** — :meth:`install_sigterm` chains a dump in front of the
+    previous handler (the elastic agent tears surviving workers down
+    with ``terminate()``, so every teardown leaves a record);
+  * **hang-detection / SIGKILL** — nothing can run in the victim, so the
+    telemetry layer also dumps *opportunistically* at every flush
+    interval (off the step path, on the telemetry pool): a worker killed
+    cold still leaves a dump at most ``interval_steps`` old.
+
+The elastic agent reads the dumps of failed hosts
+(:func:`read_dump`) and attaches the event tail to its failure
+classification, so "why did host 3 die" starts from data instead of
+archaeology.
+
+Dumps are plain JSON (one object, ``events`` newest-last) written
+atomically (tmp + rename) — a dump torn by the dying process never
+shadows an older complete one.
+"""
+
+import collections
+import itertools
+import json
+import os
+import signal
+import threading
+import time
+
+# unique per-dump tmp-name sequence (next() is atomic under the GIL)
+_DUMP_SEQ = itertools.count()
+
+
+def node_name():
+    """This process's node id for dump naming: the elastic agent exports
+    ``DSTPU_FLIGHTREC_NODE`` (its host name for the worker); otherwise
+    the jax process index."""
+    node = os.environ.get("DSTPU_FLIGHTREC_NODE")
+    if node:
+        return str(node)
+    try:
+        import jax
+        return str(jax.process_index())
+    except Exception:  # noqa: BLE001 - pre-backend-init callers
+        return "0"
+
+
+def dump_path(root, node):
+    """Dump file for ``node`` under ``root`` — shared by the writer
+    (worker) and the reader (elastic agent)."""
+    return os.path.join(root, f"host{node}.json")
+
+
+def read_dump(root, node):
+    """The agent-side reader: parsed dump dict for ``node``, or None
+    when no (complete) dump exists."""
+    try:
+        with open(dump_path(root, node), encoding="utf-8") as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring. ``record`` is the hot-path entry
+    (one deque append under an uncontended lock); everything else runs
+    off the step path."""
+
+    def __init__(self, size=256, node=None):
+        self._lock = threading.Lock()
+        self._events = collections.deque(maxlen=max(8, int(size)))
+        self.node = node_name() if node is None else str(node)
+        self.root = None          # set via set_root; None = tmp fallback
+        self._prev_sigterm = None
+        self._dumped_reason = None
+
+    # ------------------------------------------------------------ events
+    def record(self, kind, **data):
+        ev = {"t": round(time.time(), 6), "kind": kind}
+        ev.update(data)
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self):
+        with self._lock:
+            return list(self._events)
+
+    # ------------------------------------------------------------- dumps
+    def set_root(self, root):
+        """First-wins dump directory: config/env beats the
+        save_checkpoint-derived ``{ckpt_root}/flightrec`` default."""
+        if root and self.root is None:
+            self.root = root
+
+    def _resolved_root(self):
+        if self.root:
+            return self.root
+        import tempfile
+        return os.path.join(tempfile.gettempdir(), "dstpu_flightrec")
+
+    def dump(self, reason="manual"):
+        """Write the ring to ``{root}/host{node}.json`` (atomic).
+        Returns the path, or None when the write itself failed — a
+        dying process must never die *harder* because its black box
+        could not be written."""
+        root = self._resolved_root()
+        path = dump_path(root, self.node)
+        payload = {
+            "node": self.node,
+            "pid": os.getpid(),
+            "reason": reason,
+            "dumped_at": round(time.time(), 6),
+            "events": self.events(),
+        }
+        try:
+            os.makedirs(root, exist_ok=True)
+            # per-call unique tmp: a main-thread crash dump can race a
+            # pool-thread interval dump in the SAME process, and a
+            # shared pid-only tmp would tear the JSON both are writing
+            tmp = (f"{path}.tmp.{os.getpid()}."
+                   f"{threading.get_ident()}.{next(_DUMP_SEQ)}")
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(payload, f)
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(tmp, path)
+            self._dumped_reason = reason
+            return path
+        except OSError:
+            return None
+
+    def crash(self, exc):
+        """Record the terminal exception and dump. Called from
+        ``except BaseException`` wrappers — must never raise."""
+        try:
+            self.record("crash", error=f"{type(exc).__name__}: {exc}"[:300])
+            self.dump(reason="crash")
+        except Exception:  # noqa: BLE001 - never mask the real failure
+            pass
+
+    # ------------------------------------------------------------ signals
+    def install_sigterm(self):
+        """Chain a dump in front of the current SIGTERM disposition.
+        Main-thread only (signal module restriction); a non-main-thread
+        caller is a silent no-op."""
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _handler(signum, frame):
+            self.record("sigterm")
+            self.dump(reason="sigterm")
+            prev = self._prev_sigterm
+            if callable(prev):
+                prev(signum, frame)
+            elif prev == signal.SIG_DFL:
+                # restore the default and re-deliver so the exit status
+                # still says "terminated by SIGTERM"
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                os.kill(os.getpid(), signal.SIGTERM)
+
+        try:
+            self._prev_sigterm = signal.signal(signal.SIGTERM, _handler)
+            return True
+        except (ValueError, OSError):  # non-main thread / exotic host
+            return False

@@ -42,8 +42,17 @@ class WandbMonitor(Monitor):
                    entity=config.team or None)
 
     def write_events(self, event_list):
+        """One ``wandb.log`` call per step, with every tag of that step
+        batched into a single dict. The reference's per-tag loop issues
+        N sequential calls whose ``step`` kwargs conflict (wandb treats
+        a repeated step as out-of-order and silently drops rows) —
+        batching is both the supported API shape and ~N times fewer
+        RPCs."""
+        by_step = {}
         for tag, value, step in event_list:
-            self.wandb.log({tag: float(value)}, step=int(step))
+            by_step.setdefault(int(step), {})[tag] = float(value)
+        for step in sorted(by_step):
+            self.wandb.log(by_step[step], step=step)
 
 
 class csvMonitor(Monitor):  # noqa: N801 - reference class name
@@ -58,7 +67,11 @@ class csvMonitor(Monitor):  # noqa: N801 - reference class name
 
     def _file(self, tag):
         if tag not in self._files:
-            safe = tag.replace("/", "_")
+            # tags carry '/' (Train/Samples/lr) — sanitized into the
+            # flat one-file-per-tag layout; an unsanitized tag would be
+            # an open() into a nonexistent subdirectory (regression
+            # covered in tests/unit/test_monitor.py)
+            safe = tag.replace("/", "_").replace(os.sep, "_")
             # line-buffered: rows survive preemption/SIGKILL mid-run
             self._files[tag] = open(
                 os.path.join(self.dir, f"{safe}.csv"), "a", buffering=1)

@@ -1,0 +1,120 @@
+"""Metric tag schema — the documented contract for every event the
+production code writes into the MonitorMaster fan-out.
+
+Every ``(tag, value, step)`` event emitted from ``deepspeed_tpu/``
+must name a tag registered here, and every registered tag must be
+emitted by production code — both directions are linted by
+``tests/unit/test_telemetry.py`` (the test_fault_points_lint.py
+discipline applied to metrics: a renamed emission site or a stale
+registry entry cannot silently rot the schema dashboards are built on).
+
+This module deliberately holds NOTHING but the registry: the lint
+collects emitted-tag literals by grepping the package with this file
+excluded, so the registry's own keys never count as "emissions".
+
+Tag grammar: ``<Domain>/<Group>/<name>`` with domain ``Train`` or
+``Serve``; values are floats (host ids / tiers are reported as numeric
+indices). Steps are the engine's global step (Train) or the completed-
+request count (Serve).
+"""
+
+# tag -> one-line meaning (the README "Observability" table is
+# generated from the same entries)
+TAG_SCHEMA = {
+    # --- per-step training samples (engine._write_monitor_events) ---
+    "Train/Samples/lr":
+        "learning rate applied at this step",
+    "Train/Samples/train_loss":
+        "loss of the most recent train_batch",
+    "Train/Samples/loss_scale":
+        "dynamic loss scale (fp16 runs only)",
+
+    # --- checkpoint health (engine._write_ckpt_monitor_events) ---
+    "Train/Checkpoint/save_latency_ms":
+        "wall time of the most recent save_checkpoint",
+    "Train/Checkpoint/load_latency_ms":
+        "wall time of the most recent load_checkpoint",
+    "Train/Checkpoint/retries":
+        "cumulative shard-write retries (retry/degrade policy)",
+    "Train/Checkpoint/fallbacks":
+        "cumulative writer degradations (native->python, async->sync)",
+    "Train/Checkpoint/save_errors":
+        "cumulative saves that failed after retry+degrade",
+    "Train/Checkpoint/load_fallbacks":
+        "cumulative corrupt-generation fallbacks on load",
+    "Train/Checkpoint/gc_removed":
+        "cumulative tags removed by retention GC",
+    "Train/Checkpoint/hot_pushes":
+        "cumulative hot-tier replica pushes completed",
+    "Train/Checkpoint/hot_push_errors":
+        "cumulative advisory hot-tier push failures",
+    "Train/Checkpoint/hot_restores":
+        "cumulative loads served from in-memory replicas",
+    "Train/Checkpoint/hot_fallbacks":
+        "hot tier present but load degraded to durable",
+    "Train/Checkpoint/durable_restores":
+        "cumulative loads that read persistent storage",
+    "Train/Checkpoint/reshape":
+        "1 when this resume re-partitioned onto a new topology",
+
+    # --- step analytics (monitor/telemetry.py, every interval_steps) ---
+    "Train/Telemetry/step_time_ms_p50":
+        "median per-step wall time over the interval (this host)",
+    "Train/Telemetry/step_time_ms_p99":
+        "p99 per-step wall time over the interval (this host)",
+    "Train/Telemetry/tokens_per_sec_chip":
+        "interval token throughput / participating chips",
+    "Train/Telemetry/mfu_pct":
+        "model-flops utilization: step FLOPs (XLA cost_analysis) "
+        "/ step time / per-chip peak",
+    "Train/Telemetry/collectives":
+        "logical collectives in the compiled step program (an async "
+        "start/done pair counts once; HLO parse)",
+    "Train/Telemetry/exposed_comm_pct":
+        "share of step collectives with no async start/done pair "
+        "(comm the schedule left exposed)",
+    "Train/Telemetry/goodput_pct":
+        "productive share of wall time: 100 * (1 - ckpt/restore/"
+        "reshape/restart overhead / elapsed)",
+
+    # --- pod-wide aggregation (rank 0 only; cluster_agg transports) ---
+    "Train/Telemetry/cluster_step_ms_p50":
+        "p50 of per-host mean step time across the pod",
+    "Train/Telemetry/cluster_step_ms_p99":
+        "p99 of per-host mean step time across the pod",
+    "Train/Telemetry/straggler_delta_ms":
+        "slowest host's mean step time minus the pod median",
+    "Train/Telemetry/straggler_host":
+        "index (ring order) of the slowest host",
+    "Train/Telemetry/cluster_hosts":
+        "hosts whose metrics reached this aggregation round",
+
+    # --- serving (inference/v2 engine; step = completed requests) ---
+    "Serve/Telemetry/ttft_ms_p50":
+        "median time-to-first-token over the sample window",
+    "Serve/Telemetry/ttft_ms_p99":
+        "p99 time-to-first-token over the sample window",
+    "Serve/Telemetry/tpot_ms_p50":
+        "median time-per-output-token (dispatch-amortized)",
+    "Serve/Telemetry/tpot_ms_p99":
+        "p99 time-per-output-token (dispatch-amortized)",
+    "Serve/Telemetry/completed":
+        "requests completed since engine construction",
+    "Serve/Telemetry/active":
+        "sequences decoding when the window was emitted",
+}
+
+
+def check_tag(tag):
+    """Raise on a tag the schema does not document. This is the
+    TEST-SIDE enforcement (the schema lint and unit tests call it);
+    the production emit path (``TelemetryCollector._emit``) only
+    warns on an undocumented tag — telemetry must never kill a run
+    over a dashboard label."""
+    if tag not in TAG_SCHEMA:
+        raise KeyError(
+            f"metric tag {tag!r} is not registered in "
+            f"monitor/tag_schema.py TAG_SCHEMA — document it there "
+            f"(and the lint in tests/unit/test_telemetry.py will hold "
+            f"both directions)")
+    return tag

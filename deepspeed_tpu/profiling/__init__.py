@@ -1,1 +1,2 @@
-from .flops_profiler import FlopsProfiler, get_model_profile
+from .flops_profiler import FlopsProfiler, get_model_profile, \
+    compiled_costs

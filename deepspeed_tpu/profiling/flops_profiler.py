@@ -19,13 +19,29 @@ def _param_count(params):
     return sum(int(np.prod(x.shape)) for x in jax.tree.leaves(params))
 
 
+def compiled_costs(compiled):
+    """Normalize ``Compiled.cost_analysis()`` across jax versions into
+    one flat dict (older jax returns ``[dict]``; key spellings vary
+    between ``bytes accessed`` and ``bytes_accessed``). The single
+    extraction point the engine's flops hook and the telemetry layer's
+    MFU both read — the two can never disagree on what "step flops"
+    means."""
+    try:
+        costs = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 - some backends ship no analysis
+        return {}
+    if isinstance(costs, (list, tuple)):
+        costs = costs[0] if costs else {}
+    costs = dict(costs or {})
+    if "bytes accessed" not in costs and "bytes_accessed" in costs:
+        costs["bytes accessed"] = costs["bytes_accessed"]
+    return costs
+
+
 def _cost_analysis(fn, *args, static_argnums=()):
     compiled = jax.jit(fn, static_argnums=static_argnums).lower(
         *args).compile()
-    costs = compiled.cost_analysis()
-    if isinstance(costs, (list, tuple)):  # older jax returns [dict]
-        costs = costs[0] if costs else {}
-    return compiled, dict(costs or {})
+    return compiled, compiled_costs(compiled)
 
 
 class FlopsProfiler:

@@ -412,6 +412,95 @@ class AutotuneConfig:
 
 
 @dataclass
+class TelemetryConfig:
+    """Pod telemetry block (monitor/telemetry.py + flight_recorder.py —
+    the always-on observability layer the engine wires through
+    MonitorMaster):
+
+      enabled          "auto" (default: on iff a monitor backend is
+                       configured, DSTPU_TELEMETRY=1, a flight-recorder
+                       dir is exported (DSTPU_FLIGHTREC_DIR), or the
+                       process runs under an elastic agent
+                       (ELASTIC_GENERATION)) | true | false.
+      interval_steps   steps between telemetry flushes (percentiles,
+                       MFU, goodput, cluster aggregation, opportunistic
+                       flight dumps). The step path itself only appends
+                       to a ring.
+      cluster_agg      "auto" (on iff the jax world is multi-process or
+                       a fs-transport ring is exported via
+                       DSTPU_TELEM_DIR + DSTPU_TELEM_PEERS /
+                       DSTPU_HOT_PEERS) | true | false — the pod-wide
+                       p50/p99 + straggler-delta aggregation.
+      flight_recorder_size
+                       bounded in-memory event ring (steps, fault
+                       points, restores + tier, reshapes, profiler
+                       actions) dumped to
+                       ``{ckpt_root}/flightrec/host{n}.json`` on
+                       crash/SIGTERM and opportunistically each flush.
+      profile_port     jax.profiler server port for live xprof attach
+                       (0 = DSTPU_PROFILE_PORT env or off). Step-ranged
+                       captures arm via DSTPU_PROFILE_STEPS=a:b or a
+                       PROFILE trigger file in the flight-recorder dir.
+      flightrec_dir    explicit dump dir ("" = DSTPU_FLIGHTREC_DIR env,
+                       else derived from the first save_checkpoint's
+                       save_dir).
+    """
+    enabled: object = "auto"          # "auto" | bool
+    interval_steps: int = 20
+    cluster_agg: object = "auto"      # "auto" | bool
+    flight_recorder_size: int = 256
+    profile_port: int = 0
+    flightrec_dir: str = ""
+
+    def __post_init__(self):
+        if self.enabled not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"telemetry.enabled must be true|false|'auto', got "
+                f"{self.enabled!r}")
+        if self.cluster_agg not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"telemetry.cluster_agg must be true|false|'auto', got "
+                f"{self.cluster_agg!r}")
+        if not isinstance(self.interval_steps, int) \
+                or self.interval_steps < 1:
+            raise DeepSpeedConfigError(
+                f"telemetry.interval_steps must be an int >= 1, got "
+                f"{self.interval_steps!r}")
+        if not isinstance(self.flight_recorder_size, int) \
+                or self.flight_recorder_size < 8:
+            raise DeepSpeedConfigError(
+                f"telemetry.flight_recorder_size must be an int >= 8, "
+                f"got {self.flight_recorder_size!r}")
+        if not isinstance(self.profile_port, int) or self.profile_port < 0:
+            raise DeepSpeedConfigError(
+                f"telemetry.profile_port must be an int >= 0, got "
+                f"{self.profile_port!r}")
+
+    def resolve_enabled(self, monitor_enabled=False):
+        """'auto' turns telemetry on when someone can see it (a monitor
+        backend) or someone supervises it (elastic agent / exported
+        flight-recorder dir)."""
+        if self.enabled != "auto":
+            return bool(self.enabled)
+        import os
+        return bool(monitor_enabled
+                    or os.environ.get("DSTPU_TELEMETRY") == "1"
+                    or os.environ.get("DSTPU_FLIGHTREC_DIR")
+                    or os.environ.get("ELASTIC_GENERATION") is not None)
+
+    def resolve_cluster_agg(self):
+        if self.cluster_agg != "auto":
+            return bool(self.cluster_agg)
+        import os
+        import jax
+        if jax.process_count() > 1:
+            return True
+        return bool(os.environ.get("DSTPU_TELEM_DIR")
+                    and (os.environ.get("DSTPU_TELEM_PEERS")
+                         or os.environ.get("DSTPU_HOT_PEERS")))
+
+
+@dataclass
 class ActivationCheckpointingConfig:
     partition_activations: bool = False   # accepted for parity; XLA shards
     contiguous_memory_optimization: bool = False
@@ -504,6 +593,7 @@ class DeepSpeedConfig:
         self.sequence = _take(config, SequenceConfig, "sequence")
         self.moe = _take(config, MoEConfig, "moe")
         self.autotune = _take(config, AutotuneConfig, "autotune")
+        self.telemetry = _take(config, TelemetryConfig, "telemetry")
         self.activation_checkpointing = _take(
             config, ActivationCheckpointingConfig, C.ACTIVATION_CHECKPOINTING)
         self.comms_logger = _take(config, CommsLoggerConfig, C.COMMS_LOGGER)

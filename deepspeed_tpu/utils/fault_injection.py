@@ -113,6 +113,15 @@ class FaultInjector:
         self._arms = {}
         self._fired = {}     # point -> total fire() calls (hit or not)
         self._hits = {}      # point -> injected-failure count
+        # observers called as fn(point, injected=True) whenever an
+        # armed point actually injects (clean fires on unarmed points
+        # stay silent — d2h/write/rename fire on every save and would
+        # flood a bounded ring). The telemetry flight recorder rides
+        # here so fired points land in the crash dump. Deliberately NOT
+        # cleared by reset(): tests reset armed faults constantly;
+        # detaching a live engine's recorder mid-run would silently
+        # blind its black box.
+        self._listeners = []
         self._load_env()
 
     # ------------------------------------------------------------- arming
@@ -162,9 +171,35 @@ class FaultInjector:
             arm.fails -= 1
             self._hits[point] = self._hits.get(point, 0) + 1
             kill = arm.kill
+        self._notify(point, True)
         if kill:
             raise SimulatedKill(point)
         raise FaultError(point, n)
+
+    # ----------------------------------------------------------- listeners
+    def add_listener(self, fn):
+        """Register ``fn(point, injected)`` — called outside the lock
+        whenever an armed point injects; listener exceptions are
+        swallowed (observability must never alter fault semantics)."""
+        with self._lock:
+            if fn not in self._listeners:
+                self._listeners.append(fn)
+
+    def remove_listener(self, fn):
+        with self._lock:
+            try:
+                self._listeners.remove(fn)
+            except ValueError:
+                pass
+
+    def _notify(self, point, injected):
+        with self._lock:
+            listeners = list(self._listeners)
+        for fn in listeners:
+            try:
+                fn(point, injected)
+            except Exception:  # noqa: BLE001 - observers are advisory
+                pass
 
     # ---------------------------------------------------------- inspection
     def fired(self, point):
@@ -191,3 +226,5 @@ injector = FaultInjector()
 fire = injector.fire
 arm = injector.arm
 reset = injector.reset
+add_listener = injector.add_listener
+remove_listener = injector.remove_listener

@@ -214,6 +214,60 @@ def test_autotune_validation():
                             dp_world_size=8)
 
 
+def test_telemetry_defaults():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
+    t = cfg.telemetry
+    assert t.enabled == "auto"
+    assert t.interval_steps == 20
+    assert t.cluster_agg == "auto"
+    assert t.flight_recorder_size == 256
+    assert t.profile_port == 0
+    assert t.flightrec_dir == ""
+
+
+def test_telemetry_block_parses():
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "telemetry": {"enabled": True, "interval_steps": 5,
+                      "cluster_agg": False, "flight_recorder_size": 64,
+                      "profile_port": 9012,
+                      "flightrec_dir": "/tmp/fr"},
+    }, dp_world_size=8)
+    t = cfg.telemetry
+    assert t.enabled is True
+    assert t.interval_steps == 5
+    assert t.cluster_agg is False
+    assert t.flight_recorder_size == 64
+    assert t.profile_port == 9012
+    assert t.flightrec_dir == "/tmp/fr"
+
+
+def test_telemetry_validation():
+    for bad in ({"enabled": "yes"},
+                {"interval_steps": 0},
+                {"cluster_agg": "maybe"},
+                {"flight_recorder_size": 4},
+                {"profile_port": -1}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_batch_size": 8, "telemetry": bad},
+                            dp_world_size=8)
+
+
+def test_telemetry_resolve_enabled(monkeypatch):
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
+    t = cfg.telemetry
+    for v in ("DSTPU_TELEMETRY", "DSTPU_FLIGHTREC_DIR",
+              "ELASTIC_GENERATION"):
+        monkeypatch.delenv(v, raising=False)
+    assert t.resolve_enabled(monitor_enabled=False) is False
+    assert t.resolve_enabled(monitor_enabled=True) is True
+    monkeypatch.setenv("ELASTIC_GENERATION", "0")
+    assert t.resolve_enabled(monitor_enabled=False) is True
+    monkeypatch.delenv("ELASTIC_GENERATION")
+    monkeypatch.setenv("DSTPU_FLIGHTREC_DIR", "/tmp/fr")
+    assert t.resolve_enabled(monitor_enabled=False) is True
+
+
 # ----------------------------- inference-side serving config (v2 engine)
 
 

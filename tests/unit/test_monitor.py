@@ -1,0 +1,168 @@
+"""Monitor fan-out tests (ISSUE 9 satellites): csv slash-tag
+round-trip, wandb per-step batching, MonitorMaster fan-out and rank-0
+gating, and import-failure degradation."""
+
+import os
+import sys
+import types
+
+import pytest
+
+from deepspeed_tpu.monitor.config import (DeepSpeedMonitorConfig,
+                                          CSVConfig, WandbConfig)
+from deepspeed_tpu.monitor.monitor import (MonitorMaster, Monitor,
+                                           csvMonitor, WandbMonitor)
+
+
+class _StubMonitor(Monitor):
+    def __init__(self):
+        self.events = []
+        self.flushes = 0
+
+    def write_events(self, event_list):
+        self.events.extend(event_list)
+
+    def flush(self):
+        self.flushes += 1
+
+
+def _csv_cfg(tmp_path):
+    return CSVConfig(enabled=True, output_path=str(tmp_path),
+                     job_name="job")
+
+
+class TestCsvMonitor:
+    def test_slash_tags_round_trip(self, tmp_path):
+        """Regression (ISSUE 9 satellite): production tags carry '/'
+        (Train/Samples/lr, Train/Checkpoint/save_latency_ms) — the
+        one-file-per-tag layout must sanitize them instead of open()ing
+        into a nonexistent subdirectory."""
+        mon = csvMonitor(_csv_cfg(tmp_path))
+        events = [("Train/Samples/lr", 0.001, 1),
+                  ("Train/Checkpoint/save_latency_ms", 12.5, 1),
+                  ("Train/Samples/lr", 0.002, 2)]
+        mon.write_events(events)
+        mon.flush()
+        path = os.path.join(str(tmp_path), "job", "Train_Samples_lr.csv")
+        assert os.path.exists(path)
+        with open(path) as f:
+            rows = [line.strip().split(",") for line in f if line.strip()]
+        assert rows == [["1", "0.001"], ["2", "0.002"]]
+        ckpt = os.path.join(str(tmp_path), "job",
+                            "Train_Checkpoint_save_latency_ms.csv")
+        assert os.path.exists(ckpt)
+
+    def test_no_subdirectories_created(self, tmp_path):
+        mon = csvMonitor(_csv_cfg(tmp_path))
+        mon.write_events([("Train/Telemetry/mfu_pct", 33.3, 5)])
+        job_dir = os.path.join(str(tmp_path), "job")
+        entries = os.listdir(job_dir)
+        assert entries and all(
+            os.path.isfile(os.path.join(job_dir, e)) for e in entries), \
+            f"slash tags must not create subdirectories: {entries}"
+
+
+class TestWandbBatching:
+    def _fake_wandb(self):
+        calls = []
+        mod = types.ModuleType("wandb")
+        mod.init = lambda **kw: calls.append(("init", kw))
+        mod.log = lambda data, step=None: calls.append(
+            ("log", dict(data), step))
+        return mod, calls
+
+    def test_one_log_call_per_step(self, monkeypatch):
+        """ISSUE 9 satellite: all tags of a step batch into ONE
+        wandb.log dict — N sequential calls with a repeated step kwarg
+        are treated as out-of-order by wandb and silently dropped."""
+        mod, calls = self._fake_wandb()
+        monkeypatch.setitem(sys.modules, "wandb", mod)
+        mon = WandbMonitor(WandbConfig(enabled=True))
+        mon.write_events([("Train/Samples/lr", 0.1, 7),
+                          ("Train/Samples/train_loss", 2.5, 7),
+                          ("Train/Telemetry/mfu_pct", 41.0, 7)])
+        logs = [c for c in calls if c[0] == "log"]
+        assert len(logs) == 1
+        _, data, step = logs[0]
+        assert step == 7
+        assert data == {"Train/Samples/lr": 0.1,
+                        "Train/Samples/train_loss": 2.5,
+                        "Train/Telemetry/mfu_pct": 41.0}
+
+    def test_multiple_steps_ordered(self, monkeypatch):
+        mod, calls = self._fake_wandb()
+        monkeypatch.setitem(sys.modules, "wandb", mod)
+        mon = WandbMonitor(WandbConfig(enabled=True))
+        mon.write_events([("a/b/c", 1.0, 9), ("a/b/d", 2.0, 8),
+                          ("a/b/c", 3.0, 8)])
+        logs = [c for c in calls if c[0] == "log"]
+        assert [c[2] for c in logs] == [8, 9]
+        assert logs[0][1] == {"a/b/d": 2.0, "a/b/c": 3.0}
+
+
+class TestMonitorMaster:
+    def _master_cfg(self, tmp_path):
+        return DeepSpeedMonitorConfig.from_dict({
+            "csv_monitor": {"enabled": True,
+                            "output_path": str(tmp_path),
+                            "job_name": "fanout"}})
+
+    def test_fan_out_reaches_every_writer(self, tmp_path):
+        master = MonitorMaster(self._master_cfg(tmp_path))
+        assert master.enabled
+        stub = _StubMonitor()
+        master.monitors.append(stub)
+        events = [("Train/Samples/lr", 0.5, 3)]
+        master.write_events(events)
+        master.flush()
+        assert stub.events == events
+        assert os.path.exists(os.path.join(
+            str(tmp_path), "fanout", "Train_Samples_lr.csv"))
+
+    def test_disabled_config_writes_nothing(self):
+        master = MonitorMaster(DeepSpeedMonitorConfig.from_dict({}))
+        assert not master.enabled
+        master.write_events([("a/b/c", 1.0, 1)])   # must not raise
+
+    def test_rank0_gating(self, tmp_path, monkeypatch):
+        """Only jax.process_index() == 0 writes (the reference's rank
+        gate realized on process index)."""
+        import jax
+        monkeypatch.setattr(jax, "process_index", lambda: 1)
+        master = MonitorMaster(self._master_cfg(tmp_path))
+        assert not master.enabled
+        assert master.monitors == []
+
+    def test_backend_import_failure_degrades(self, tmp_path,
+                                             monkeypatch):
+        """An unavailable optional backend downgrades to a warning
+        (reference hard-requires the package)."""
+        import builtins
+        real_import = builtins.__import__
+
+        def failing(name, *a, **kw):
+            if name == "wandb":
+                raise ImportError("no wandb in this container")
+            return real_import(name, *a, **kw)
+
+        monkeypatch.setattr(builtins, "__import__", failing)
+        cfg = DeepSpeedMonitorConfig.from_dict({
+            "wandb": {"enabled": True},
+            "csv_monitor": {"enabled": True,
+                            "output_path": str(tmp_path),
+                            "job_name": "degrade"}})
+        master = MonitorMaster(cfg)
+        assert master.enabled           # csv still works
+        assert len(master.monitors) == 1
+        assert isinstance(master.monitors[0], csvMonitor)
+
+
+class TestTensorBoardOptional:
+    def test_tensorboard_skipped_without_torch(self, tmp_path):
+        pytest.importorskip("torch.utils.tensorboard")
+        from deepspeed_tpu.monitor.config import TensorBoardConfig
+        from deepspeed_tpu.monitor.monitor import TensorBoardMonitor
+        mon = TensorBoardMonitor(TensorBoardConfig(
+            enabled=True, output_path=str(tmp_path), job_name="tb"))
+        mon.write_events([("Train/Samples/lr", 0.1, 1)])
+        mon.flush()
