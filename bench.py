@@ -39,9 +39,14 @@ variant can never cost the headline number:
                    BENCH_MOE_KERNEL=1/0): GPT2MoE ragged routing with
                    the Pallas grouped-GEMM kernel (ops/pallas/
                    grouped_matmul.py) vs lax.ragged_dot
+  pipe_zb/gpipe/zb_offload  the pp=2 schedule + host-offload pair
+                   (benchmarks/pipeline_probe.py subprocess on a
+                   virtual pipe mesh — zero-bubble vs gpipe wall time,
+                   offload-on host-copy/memory read; BENCH_PIPE_PROBE=0
+                   skips)
 Disable with BENCH_VARIANTS=none, or pick a subset
 (BENCH_VARIANTS=mlp_down,bwd_qmajor,1.3B,overlap,autotune,ring_on,
-moe_on,moe_off).
+moe_on,moe_off,pipe — 'pipe' selects the subprocess probe rows).
 
 ``extras.telemetry`` embeds the observability layer's own read of a
 measured run (ISSUE 9): single-chip MFU (cost_analysis flops), goodput,
@@ -235,6 +240,42 @@ def _run_variants(names, steps, warmup):
     return out
 
 
+def _pipeline_variants():
+    """The CPU-sized pp variant pair (ISSUE 10): a pp=2 pipe-only mesh
+    in a subprocess (the telemetry-probe pattern — pipeline needs >= 2
+    devices, the driver gives one chip) A/B-ing the zero-bubble
+    schedule vs gpipe and the host-offload lever. Rows land in
+    extras.variants as pipe_*; failures are isolated like every
+    variant. BENCH_PIPE_PROBE=0 skips; real-pod numbers come from the
+    multichip artifact's pp row (__graft_entry__.measured_multichip)."""
+    import subprocess
+    import sys as _sys
+    here = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"   # virtual pipe mesh: the pair is a
+    # scheduling read on one chip; pod-scale numbers live in MULTICHIP
+    env.pop("XLA_FLAGS", None)
+    out = {}
+    try:
+        probe = subprocess.run(
+            [_sys.executable,
+             os.path.join(here, "benchmarks", "pipeline_probe.py"),
+             "--pipe", os.environ.get("BENCH_PIPE", "2"),
+             "--steps", os.environ.get("BENCH_PIPE_STEPS", "3"),
+             "--warmup", "1",
+             "--rows", "zb,gpipe,zb_offload"],
+            env=env, capture_output=True, text=True, timeout=900)
+        parsed = json.loads(probe.stdout.strip().splitlines()[-1])
+        for name, row in parsed.get("rows", {}).items():
+            out[f"pipe_{name}"] = row
+        out["pipe_meta"] = {k: parsed.get(k) for k in
+                            ("pipe", "backend", "host_kind", "preset",
+                             "seq_len", "global_batch")}
+    except Exception as e:  # noqa: BLE001 - isolate, like variants
+        out["pipe_probe"] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    return out
+
+
 def _telemetry_extras(steps, warmup):
     """``extras.telemetry`` (ISSUE 9): the telemetry layer's own read
     of a measured run — single-chip MFU/goodput/step percentiles from
@@ -324,12 +365,22 @@ def main():
         "BENCH_VARIANTS",
         "mlp_down,bwd_qmajor,bwd_qmajor_512,1.3B,overlap,overlap_off,"
         "autotune,autotune_off,ring_on,ring_off,moe_on,moe_off,"
-        "moe_autotune")
+        "moe_autotune,pipe")
     if vnames and vnames != "none":
+        # 'pipe' selects the subprocess probe below, not an in-process
+        # re-timing — keep it out of the env-override variant loop
         variants = _run_variants(
-            [v for v in vnames.split(",") if v],
+            [v for v in vnames.split(",") if v and v != "pipe"],
             int(os.environ.get("BENCH_VARIANT_STEPS", "5")),
             int(os.environ.get("BENCH_VARIANT_WARMUP", "2")))
+
+    # the pp=2 schedule/offload pair (subprocess virtual mesh): rides
+    # extras.variants like every lever — and obeys the same subset
+    # mechanism ('pipe' must be in the BENCH_VARIANTS selection;
+    # BENCH_PIPE_PROBE=0 is the independent off switch)
+    if os.environ.get("BENCH_PIPE_PROBE", "1") == "1" \
+            and vnames != "none" and "pipe" in vnames.split(","):
+        variants.update(_pipeline_variants())
 
     # the tuned winner table travels WITH the artifact: whatever the
     # autotune variants (or a pre-warmed cache) measured on this chip is

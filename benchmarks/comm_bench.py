@@ -54,6 +54,11 @@ _BUS_FACTOR = {
     # row is the bandwidth bound on hiding one rotation under one ring
     # step's compute
     "ppermute": lambda w: 1.0,
+    # the same exchange over the 'pipe' axis: the pipeline executors'
+    # per-tick activation rotation (runtime/pipe/spmd.py) — the
+    # bandwidth bound on hiding one stage handoff under one tick's
+    # block compute (--pipe N carves the axis on flat meshes)
+    "ppermute_pipe": lambda w: 1.0,
     # hierarchical expert dispatch (moe_swiglu_ragged_ep's staged
     # exchange): ICI-local all_to_all over the inner axis, then ONE
     # cross-slice hop over data_outer — vs the flat single-hop
@@ -135,6 +140,21 @@ def bench(sizes_mb, trials=10, axis="data", outer_axis="data_outer",
     # pair exchanges over the (outer x inner) grid, so its payload
     # reshapes to W*Wo rows and its busbw factor uses the combined size
     ops = [(n, f, W) for n, f in ops]
+    Wp = dict(mesh.shape).get("pipe", 1)
+    if Wp > 1:
+        # the pipe-axis neighbor exchange measured over ITS OWN axis
+        # (payload sharded P('pipe'), W_pipe shards)
+        ops.append((
+            "ppermute_pipe",
+            jax.jit(lambda x: shard_map(
+                lambda x: dist.send_forward(x, "pipe"), mesh=mesh,
+                in_specs=P("pipe"), out_specs=P("pipe"),
+                check_vma=False)(x)),
+            Wp))
+    else:
+        results.append({"op": "ppermute_pipe",
+                        "skipped": "pipe axis is 1 on this mesh (use "
+                                   "--pipe to carve one)"})
     if Wo > 1:
         hier = P((outer_axis, axis))
         ops += [
@@ -163,7 +183,11 @@ def bench(sizes_mb, trials=10, axis="data", outer_axis="data_outer",
         # W*2048 | n, the hierarchical rows need (W*Wo)^2 | n (local
         # chunk n/(W*Wo) re-bucketed into W x Wo) — non-power-of-two
         # worlds (6 devices, --outer 3) break the naive W*Wo*2048 round
-        blk = math.lcm(W * 2048, (W * Wo) ** 2)
+        # every reshaped row layout must divide, incl. the pipe row's
+        # (Wp, -1) view — fold Wp in or non-dividing pipe sizes (e.g.
+        # --pipe 3 on 6 devices) error out of every measurement
+        blk = math.lcm(W * 2048, (W * Wo) ** 2,
+                       dict(mesh.shape).get("pipe", 1))
         n = max(blk, n // blk * blk)
         x = jnp.asarray(np.random.RandomState(0).randn(W, n // W),
                         jnp.float32)
@@ -254,20 +278,33 @@ def main():
                          "DP (zero_shard_size) so the hierarchical "
                          "all_to_all rows run — the staging decision "
                          "probe for meshes without a real DCN axis")
+    ap.add_argument("--pipe", type=int, default=0,
+                    help="carve a pipe axis of this size so the "
+                         "ppermute_pipe row (the pipeline executors' "
+                         "per-tick stage handoff) measures over a real "
+                         "pipe axis")
     ap.add_argument("--json", action="store_true",
                     help="one JSON line on stdout (table -> stderr)")
     ap.add_argument("--overlap-mb", type=float, default=16,
                     help="overlap probe payload (0 disables the probe)")
     args = ap.parse_args()
     dist.init_distributed()
-    if args.outer > 1:
+    if args.outer > 1 or args.pipe > 1:
         import jax as _jax
         n = len(_jax.devices())
-        if n % args.outer:
-            raise SystemExit(f"--outer {args.outer} does not divide "
+        pipe = max(args.pipe, 1)
+        if n % pipe:
+            raise SystemExit(f"--pipe {args.pipe} does not divide "
                              f"world size {n}")
+        dp = n // pipe   # the outer axis carves the REMAINING dp grid
+        if args.outer > 1 and (dp % args.outer or dp // args.outer < 1):
+            raise SystemExit(f"--outer {args.outer} does not divide "
+                             f"the data-parallel size {dp} left after "
+                             f"--pipe {pipe}")
         groups.initialize(groups.TopologyConfig(
-            zero_shard_size=n // args.outer))
+            pipe_parallel_size=pipe,
+            zero_shard_size=(dp // args.outer
+                             if args.outer > 1 else -1)))
     else:
         groups.initialize()
     out = sys.stderr if args.json else sys.stdout

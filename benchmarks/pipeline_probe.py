@@ -1,0 +1,178 @@
+"""Pipeline-parallel probe: a pp>=2 virtual-mesh (or real-pod) A/B of
+the schedule and host-offload levers, runnable from a single-chip bench
+process (the telemetry_probe pattern — bench.py shells out to it so the
+pp rows land in ``BENCH_local.json`` even when the driver exposes one
+chip).
+
+Measures, at ``--pipe`` stages on a pipe-only mesh:
+  * schedule A/B: zero-bubble (zb) vs gpipe vs 1f1b wall time of real
+    optimizer steps (bubble fractions attached from the analytic
+    lock-step model the telemetry layer reports);
+  * offload A/B: the zb schedule with the activation rings host-placed
+    vs device-resident — on backends with a real host memory kind the
+    rows also record the compiled program's host-copy count and the
+    memory-analysis temp bytes (the live-HBM drop the offload buys);
+    on CPU (single memory space) the offload rows record
+    ``host_kind: null`` and measure only the identity overhead.
+
+Prints one JSON line: {"pipe": S, "rows": {...}}.
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _build(pipe, schedule, offload, args):
+    import numpy as np
+    import jax
+    import deepspeed_tpu
+    from deepspeed_tpu.models import GPT2Pipe
+    from deepspeed_tpu.models.gpt2 import PRESETS
+    from dataclasses import replace
+    from deepspeed_tpu.utils import groups
+    from deepspeed_tpu.utils.groups import TopologyConfig
+
+    cfg = replace(PRESETS[args.preset], max_seq_len=args.seq,
+                  dtype=args.dtype, remat=True,
+                  pipe_microbatches=args.micro_batches,
+                  use_flash_attention=False)
+    groups.reset()
+    topo = groups.initialize(
+        TopologyConfig(pipe_parallel_size=pipe, data_parallel_size=1),
+        devices=jax.devices()[:pipe], force=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2Pipe(cfg), topology=topo, config={
+            "train_micro_batch_size_per_gpu": args.batch,
+            "gradient_accumulation_steps": 1,
+            "steps_per_print": 0,
+            "optimizer": {"type": "AdamW", "params": {"lr": 2e-4}},
+            "gradient_clipping": 1.0,
+            **({"bf16": {"enabled": True}}
+               if args.dtype == "bfloat16" else {}),
+            "zero_optimization": {"stage": args.zero_stage},
+            "pipeline": {"schedule": schedule,
+                         "offload_activations": bool(offload),
+                         "offload_moments": False},
+        })
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(
+        0, cfg.vocab_size, (args.batch, args.seq)).astype(np.int32)}
+    return engine, batch
+
+
+def _measure(pipe, schedule, offload, args):
+    import numpy as np
+    import jax
+    engine, batch = _build(pipe, schedule, offload, args)
+    loss = None
+    for _ in range(args.warmup):
+        loss = engine.train_batch(batch)
+    float(np.asarray(engine.state["step"]))
+    t0 = time.perf_counter()
+    for _ in range(args.steps):
+        loss = engine.train_batch(batch)
+    float(np.asarray(engine.state["step"]))
+    dt = time.perf_counter() - t0
+    seq = engine.model.config.max_seq_len
+    row = {
+        "schedule": schedule, "offload": bool(offload),
+        "tokens_per_sec_chip": round(
+            args.batch * seq * args.steps / dt / pipe, 1),
+        "step_time_s": round(dt / args.steps, 4),
+        "final_loss": float(loss),
+        "pipeline": engine.pipeline_report(),
+    }
+    if args.hlo:
+        rep = engine.verify_comm_overlap(batch)
+        row["hlo"] = {
+            "in_loop_by_op": rep["in_loop_by_op"],
+            "host_copies": rep["host_copies"],
+            "in_loop_host_copies": rep["in_loop_host_copies"],
+        }
+        # live-HBM proof point: XLA's own buffer assignment for the
+        # step program (the offload-on/off delta is the acceptance
+        # number on real accelerators)
+        try:
+            with jax.set_mesh(engine.mesh):
+                b = jax.tree.map(engine._add_gas_dim, batch)
+                b = engine._shard_batch(b, with_gas_dim=True)
+                c = engine._train_step_jit.lower(
+                    engine.state, b, engine._current_lr(),
+                    None).compile()
+            ma = c.memory_analysis()
+            row["memory"] = {
+                "temp_bytes": int(ma.temp_size_in_bytes),
+                "host_temp_bytes": int(
+                    getattr(ma, "host_temp_size_in_bytes", 0) or 0),
+            }
+        except Exception as e:  # noqa: BLE001 - advisory
+            row["memory"] = {"error": f"{type(e).__name__}: {e}"[:200]}
+    return row
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--pipe", type=int, default=2)
+    ap.add_argument("--preset", default="tiny")
+    ap.add_argument("--seq", type=int, default=128)
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--micro-batches", type=int, default=4,
+                    dest="micro_batches")
+    ap.add_argument("--zero-stage", type=int, default=0,
+                    dest="zero_stage")
+    ap.add_argument("--dtype", default="float32")
+    ap.add_argument("--steps", type=int, default=3)
+    ap.add_argument("--warmup", type=int, default=1)
+    ap.add_argument("--hlo", type=int, default=1)
+    ap.add_argument("--rows", default="zb,gpipe,zb_offload")
+    args = ap.parse_args()
+
+    # ensure the HOST platform can seat a pipe-only mesh (the flag only
+    # affects the cpu platform, so it is harmless when a real pod runs
+    # the probe; must land before the first device touch). Callers that
+    # want the virtual mesh on an accelerator-attached machine also set
+    # JAX_PLATFORMS=cpu in the subprocess env (bench.py does).
+    if "--xla_force_host_platform_device_count" not in \
+            os.environ.get("XLA_FLAGS", ""):
+        os.environ["XLA_FLAGS"] = (
+            os.environ.get("XLA_FLAGS", "")
+            + f" --xla_force_host_platform_device_count={args.pipe}"
+        ).strip()
+
+    import jax
+    from deepspeed_tpu.runtime.swap_tensor import host_stage
+    rows = {}
+    plan = {
+        "zb": ("zb", False),
+        "1f1b": ("1f1b", False),
+        "gpipe": ("gpipe", False),
+        "zb_offload": ("zb", True),
+        "gpipe_offload": ("gpipe", True),
+    }
+    for name in [r for r in args.rows.split(",") if r]:
+        if name not in plan:
+            rows[name] = {"error": f"unknown row {name!r}"}
+            continue
+        sched, off = plan[name]
+        try:
+            rows[name] = _measure(args.pipe, sched, off, args)
+        except Exception as e:  # noqa: BLE001 - isolate rows
+            rows[name] = {"error": f"{type(e).__name__}: {e}"[:300]}
+    print(json.dumps({
+        "pipe": args.pipe,
+        "backend": jax.default_backend(),
+        "host_kind": host_stage.host_memory_kind(),
+        "preset": args.preset, "seq_len": args.seq,
+        "global_batch": args.batch,
+        "rows": rows,
+    }))
+
+
+if __name__ == "__main__":
+    main()

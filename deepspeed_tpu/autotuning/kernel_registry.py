@@ -728,6 +728,113 @@ def _pgc_parity(b, dtype, params):
            f"paged_chunk tuned {params}")
 
 
+# ------------------------------------------------- pipeline step shape
+# The pipeline executors' two schedule-level knobs (runtime/pipe/):
+# microbatch count M (more microbatches amortize the fill/drain bubble
+# but shrink the per-tick batch below MXU efficiency — the knee is a
+# MEASURED property of the chip) and the host-offload round trip. The
+# step emulates the lock-step executor's cost structure on one device:
+# a scan over the schedule's tick count, each tick a block fwd+bwd at
+# the candidate's per-tick token count (plus the host staging round
+# trip when the candidate offloads), so one chain step prices one
+# global batch through the pipe and candidates are directly comparable.
+
+
+def _pipe_micro_grid(S, B):
+    """Candidate microbatch counts that the bucket's batch grid can
+    actually run (B % m == 0 — GPT2Pipe's hard requirement; a cached
+    winner the model cannot execute would turn 'auto' into a crash).
+    Never empty: 1 divides everything."""
+    grid = [m for m in (S, 2 * S, 4 * S) if m <= B and B % m == 0]
+    return grid or [1]
+
+
+def _pipe_defaults(b):
+    grid = _pipe_micro_grid(b["S"], b["B"])
+    # the 2S guidance when the grid admits it, else the largest valid
+    return {"micro": 2 * b["S"] if 2 * b["S"] in grid else grid[-1],
+            "offload": 0}
+
+
+def _pipe_candidates(b):
+    cands = [_pipe_defaults(b)]
+    for m in _pipe_micro_grid(b["S"], b["B"]):
+        cands.append({"micro": m, "offload": 0})
+    from ..runtime.swap_tensor import host_stage
+    if host_stage.available():
+        for c in list(cands):
+            cands.append(dict(c, offload=1))
+    return _dedup(cands)
+
+
+def _pipe_tokens(b, params):
+    """Per-tick token count for the candidate, capped so a search step
+    stays affordable; the cap formula is shared by every candidate so
+    clamped comparisons stay fair."""
+    micro = max(1, int(params["micro"]))
+    return max(1, min((b["B"] * b["T"]) // micro, 1 << 13))
+
+
+def _pipe_step(b, dtype, params):
+    from ..runtime.swap_tensor import host_stage
+    D = b["D"]
+    F = 4 * D
+    micro = max(1, int(params["micro"]))
+    n_ticks = micro + 2 * (b["S"] - 1)
+    rows = _pipe_tokens(b, params)
+    offload = bool(params.get("offload"))
+    ks = jax.random.split(jax.random.key(0), 3)
+    x = jax.random.normal(ks[0], (rows, D), dtype) * 0.3
+    w1 = jax.random.normal(ks[1], (D, F), dtype) / math.sqrt(D)
+    w2 = jax.random.normal(ks[2], (F, D), dtype) / math.sqrt(F)
+
+    def block(x, w1, w2):
+        return x + jax.nn.gelu(x @ w1) @ w2
+
+    def tick_loss(x, w1, w2):
+        return jnp.sum(block(x, w1, w2).astype(jnp.float32) ** 2)
+
+    g = jax.grad(tick_loss, (0, 1, 2))
+
+    def step(carry):
+        x, w1, w2 = carry
+
+        def tick(c, _):
+            x_, w1_, w2_ = c
+            if offload:
+                # the ring round trip: stage the tick's activation to
+                # host and read it back (what the executor's offloaded
+                # input ring costs per tick)
+                x_ = host_stage.to_device(host_stage.to_host(x_))
+            dx, d1, d2 = g(x_, w1_, w2_)
+            return (x_ + _EPS * dx.astype(x_.dtype),
+                    w1_ + _EPS * d1.astype(w1_.dtype),
+                    w2_ + _EPS * d2.astype(w2_.dtype)), None
+
+        (x, w1, w2), _ = jax.lax.scan(tick, (x, w1, w2), None,
+                                      length=n_ticks)
+        return (x, w1, w2)
+
+    return step, (x, w1, w2)
+
+
+def _pipe_parity(b, dtype, params):
+    """The candidate changes scheduling shape, not math: the host
+    round trip must be an identity, and the microbatch count must
+    divide the bucket's batch grid."""
+    from ..runtime.swap_tensor import host_stage
+    micro = max(1, int(params["micro"]))
+    if b["B"] % micro:
+        raise AssertionError(
+            f"pipe_microbatch candidate micro={micro} does not divide "
+            f"batch bucket B={b['B']} — the model could never run it")
+    x = jax.random.normal(jax.random.key(2), (64, b["D"]), dtype)
+    if params.get("offload"):
+        _close(host_stage.to_device(host_stage.to_host(x)), x,
+               f"pipe_microbatch offload round trip {params}",
+               dict(rtol=0, atol=0))
+
+
 # ---------------------------------------------------------------- table
 REGISTRY = {
     "flash_attention": {
@@ -777,5 +884,11 @@ REGISTRY = {
         "candidates": _pgc_candidates,
         "make_step": _pgc_step,
         "parity": _pgc_parity,
+    },
+    "pipe_microbatch": {
+        "defaults": _pipe_defaults,
+        "candidates": _pipe_candidates,
+        "make_step": _pipe_step,
+        "parity": _pipe_parity,
     },
 }

@@ -76,9 +76,13 @@ class GPT2Config:
     # (2x the pipe axis size, amortizing the fill/drain bubble)
     pipe_microbatches: int = 0
     # pipeline training schedule: 'gpipe' (all-forward then autodiff
-    # backward; residual memory grows with microbatch count) or '1f1b'
+    # backward; residual memory grows with microbatch count), '1f1b'
     # (interleaved forward/backward, live activations bounded by
-    # O(stages) — runtime/pipe/spmd.py pipeline_1f1b_grads)
+    # O(stages) — runtime/pipe/spmd.py pipeline_1f1b_grads), or 'zb'
+    # (zero-bubble: 1F1B with the backward W/B split so weight-grad
+    # work fills the drain ticks — pipeline_zb_grads; same memory
+    # class, strictly lower executor bubble). The engine's pipeline
+    # config block can override this when its schedule != 'auto'.
     pipe_schedule: str = "gpipe"
     # chunked cross entropy: unembed+CE computed per loss_chunk tokens
     # under remat so the full (B, T, V) fp32 logits never materialize
@@ -180,9 +184,14 @@ GPT2_TINY = GPT2Config(n_layer=2, n_head=4, d_model=128, max_seq_len=128,
 GPT2_125M = GPT2Config(n_layer=12, n_head=12, d_model=768)
 GPT2_350M = GPT2Config(n_layer=24, n_head=16, d_model=1024)
 GPT2_1_3B = GPT2Config(n_layer=24, n_head=32, d_model=2048)
+# the GPT-3 13B shape (40 x 5120, 40 heads): the pipeline + host-offload
+# target — does not fit one small-pod chip's HBM without pp>=2 and the
+# offload tiers (ROADMAP item 4's measured point)
+GPT2_13B = GPT2Config(n_layer=40, n_head=40, d_model=5120,
+                      max_seq_len=2048)
 
 PRESETS = {"tiny": GPT2_TINY, "125M": GPT2_125M, "350M": GPT2_350M,
-           "1.3B": GPT2_1_3B}
+           "1.3B": GPT2_1_3B, "13B": GPT2_13B}
 
 
 def _dtype(cfg):

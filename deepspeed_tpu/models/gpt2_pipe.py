@@ -64,6 +64,38 @@ class GPT2Pipe(GPT2):
             return 1
         return mesh.shape["pipe"]
 
+    def _block_constrain(self):
+        """Sharding constraints for the code INSIDE the pipelined
+        region. On a pipe-only mesh (every non-pipe axis size 1) the
+        constraints are semantic no-ops — and skipping them keeps the
+        partial-manual shard_map program legal on legacy jaxlib, which
+        has no shard_map replication rule for sharding_constraint (the
+        reason the data>1 pipeline tests carry
+        ``legacy_jax_pipeline_xfail``)."""
+        mesh = jax.sharding.get_abstract_mesh()
+        if not mesh.empty and all(
+                n == 1 for a, n in mesh.shape.items() if a != "pipe"):
+            return lambda x, spec: x
+        return lax.with_sharding_constraint
+
+    def _resolved_pipe(self, S):
+        """(schedule, microbatches, offload) for this trace: the
+        engine-installed ``_pipe_cfg`` (runtime/config.py
+        PipelineConfig, resolved) wins where set; the model-config
+        knobs are the no-engine fallback."""
+        from ..runtime.pipe.spmd import PipeOffload
+        cfg = self.config
+        pc = getattr(self, "_pipe_cfg", None)
+        schedule = (getattr(pc, "schedule", None)
+                    or cfg.pipe_schedule)
+        M = (getattr(pc, "micro_batches", 0)
+             or cfg.pipe_microbatches or 2 * S)
+        offload = PipeOffload(
+            activations=bool(getattr(pc, "offload_activations", False)),
+            double_buffer=bool(getattr(pc, "offload_double_buffer",
+                                       True)))
+        return schedule, M, offload
+
     def apply_with_aux(self, params, input_ids, *, rng=None, train=False,
                        seq_sharded=False, return_hidden=False):
         S = self._pipe_size()
@@ -86,7 +118,7 @@ class GPT2Pipe(GPT2):
                 "supported yet (pallas_call under a partial-manual "
                 "shard_map); use the dense backend with pipe")
         B, T = input_ids.shape
-        M = cfg.pipe_microbatches or 2 * S
+        _, M, offload = self._resolved_pipe(S)
         if B % M:
             raise ValueError(f"batch {B} not divisible by "
                              f"pipe_microbatches {M}")
@@ -94,7 +126,7 @@ class GPT2Pipe(GPT2):
         act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
         mb_act_spec = P(None, BATCH_AXES, "seq" if seq_sharded else None,
                         None)
-        constrain = lax.with_sharding_constraint
+        constrain = self._block_constrain()
 
         # --- embedding (outside the pipe; replicated over 'pipe') ---
         x = self.embed(params, input_ids, rng=rng, train=train,
@@ -134,8 +166,16 @@ class GPT2Pipe(GPT2):
 
             if cfg.remat:
                 from .common import resolve_remat_policy
-                block_fn = jax.checkpoint(
-                    block_fn, policy=resolve_remat_policy(cfg.remat_policy))
+                policy = resolve_remat_policy(cfg.remat_policy)
+                if offload.activations:
+                    # GPipe keeps every in-flight microbatch's residuals
+                    # live for autodiff — with offload on, save them
+                    # into host memory instead of recomputing (the
+                    # reference's cpu_checkpointing; swap_tensor tier)
+                    from ..runtime.activation_checkpointing import (
+                        checkpointing as ckpt)
+                    policy = ckpt.offload_policy() or policy
+                block_fn = jax.checkpoint(block_fn, policy=policy)
 
         layer_rngs = jax.random.split(
             rng if rng is not None else jax.random.key(0), cfg.n_layer)
@@ -154,15 +194,18 @@ class GPT2Pipe(GPT2):
 
     def loss(self, params, batch, *, rng=None, train=True,
              seq_sharded=False):
-        """1F1B-scheduled training loss when ``pipe_schedule='1f1b'`` and
-        the mesh pipelines: the interleaved executor computes loss AND
-        grads in one pass with O(stages) live activations
-        (pipeline_1f1b_grads; reference _exec_schedule +
-        schedule.py:189 TrainSchedule). Identical loss value to the
-        GPipe path — parity-tested."""
+        """Steady-state pipelined training loss when the resolved
+        schedule is '1f1b' or 'zb' and the mesh pipelines: the
+        interleaved executor computes loss AND grads in one pass with
+        O(stages) live activations (pipeline_1f1b_grads /
+        pipeline_zb_grads — the latter splits each backward into B/W
+        passes so weight-grad work fills the drain ticks, optionally
+        with the activation rings host-offloaded). Identical loss value
+        to the GPipe path — parity-tested."""
         cfg = self.config
         S = self._pipe_size()
-        if S == 1 or cfg.pipe_schedule != "1f1b":
+        schedule, M, offload = self._resolved_pipe(S)
+        if S == 1 or schedule not in ("1f1b", "zb"):
             return super().loss(params, batch, rng=rng, train=train,
                                 seq_sharded=seq_sharded)
         if cfg.use_flash_attention is True \
@@ -176,20 +219,19 @@ class GPT2Pipe(GPT2):
             # explicit flash/ring errors rather than training wrong
             raise NotImplementedError(
                 "MoE aux (load-balance) losses are not threaded through "
-                "pipe_schedule='1f1b'; use the GPipe schedule for MoE "
+                "the 1f1b/zb schedules; use the GPipe schedule for MoE "
                 "pipeline models")
-        from ..runtime.pipe.spmd import pipeline_1f1b_loss
+        from ..runtime.pipe.spmd import pipeline_loss
         from .common import (chunked_softmax_xent, next_token_xent,
                              resolve_remat_policy)
 
         ids = batch["input_ids"]
         B, T = ids.shape
-        M = cfg.pipe_microbatches or 2 * S
         if B % M:
             raise ValueError(f"batch {B} not divisible by "
                              f"pipe_microbatches {M}")
         act_spec = P(BATCH_AXES, "seq" if seq_sharded else None, None)
-        constrain = lax.with_sharding_constraint
+        constrain = self._block_constrain()
         x = self.embed(params, ids, rng=rng, train=train,
                        constrain=constrain, act_spec=act_spec)
         causal = jnp.tril(jnp.ones((T, T), jnp.bool_))
@@ -244,6 +286,7 @@ class GPT2Pipe(GPT2):
                        "lnf_bias": params["lnf_bias"]}
         x_mb = split_microbatches(x, M)
         ids_mb = split_microbatches(ids, M)
-        return pipeline_1f1b_loss(
-            block_fn, head_loss, "pipe", params["blocks"], layer_rngs,
-            head_params, x_mb, ids_mb)
+        return pipeline_loss(
+            block_fn, head_loss, "pipe", schedule,
+            offload if schedule == "zb" else None,
+            params["blocks"], layer_rngs, head_params, x_mb, ids_mb)

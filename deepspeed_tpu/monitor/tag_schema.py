@@ -77,6 +77,19 @@ TAG_SCHEMA = {
         "productive share of wall time: 100 * (1 - ckpt/restore/"
         "reshape/restart overhead / elapsed)",
 
+    # --- pipeline parallelism (telemetry._flush when a pipelined
+    #     engine armed set_pipeline; engine.pipeline_report is the
+    #     source) ---
+    "Train/Pipeline/bubble_pct":
+        "analytic executor bubble fraction of the active schedule "
+        "(lock-step wall model, runtime/pipe/schedule.py)",
+    "Train/Pipeline/steady_tick_ms":
+        "mean step wall time / schedule tick count — the microbatch "
+        "steady-state tick wall",
+    "Train/Pipeline/offload_bytes_per_step":
+        "D2H+H2D activation-ring payload host offload stages per step "
+        "(0 = offload off) — the copy overhead the schedule must hide",
+
     # --- pod-wide aggregation (rank 0 only; cluster_agg transports) ---
     "Train/Telemetry/cluster_step_ms_p50":
         "p50 of per-host mean step time across the pod",

@@ -395,6 +395,7 @@ class TelemetryCollector:
         self._overhead_s = {}
         self._warned_tags = set()
         self._pending_cluster_events = None
+        self._pipeline = None
         self.last = {}
         # single background worker (created lazily at the first flush
         # that needs it): fs gathers + opportunistic flight dumps ride
@@ -441,6 +442,12 @@ class TelemetryCollector:
         time never poses as a slow step."""
         self._step_ms.clear()
         self._tokens = 0
+
+    def set_pipeline(self, info):
+        """Arm the per-flush pipeline metrics (engine.pipeline_report():
+        stages/microbatches/ticks, analytic bubble fraction, host
+        staging payload). None disarms."""
+        self._pipeline = info
 
     # ------------------------------------------------------------ feedback
     def note_overhead(self, kind, seconds):
@@ -565,6 +572,17 @@ class TelemetryCollector:
                            snap["collectives"], step))
             events.append(("Train/Telemetry/exposed_comm_pct",
                            snap["exposed_comm_pct"], step))
+        if self._pipeline is not None:
+            p = self._pipeline
+            snap["pipeline"] = dict(
+                p, steady_tick_ms=round(
+                    mean_ms / max(1, p.get("ticks", 1)), 4))
+            events.append(("Train/Pipeline/bubble_pct",
+                           p["bubble_pct"], step))
+            events.append(("Train/Pipeline/steady_tick_ms",
+                           snap["pipeline"]["steady_tick_ms"], step))
+            events.append(("Train/Pipeline/offload_bytes_per_step",
+                           p.get("offload_bytes_per_step", 0), step))
         self._emit(events)
 
         if self.cluster is not None:

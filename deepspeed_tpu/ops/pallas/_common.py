@@ -87,6 +87,14 @@ def paged_decode_bucket(B, MB, BS, KVH, G, d):
            f"kh{int(KVH)},g{int(G)},d{int(d)}"
 
 
+def pipe_bucket(S, B, T, D):
+    """Pipeline-step bucket: stage count exact (it sets the tick count
+    and the candidate microbatch grid), per-stage batch rows
+    pow2-rounded, sequence pow2-rounded, model width exact (it gates
+    the per-tick block cost)."""
+    return f"S{int(S)},B{pow2_bucket(B)},T{pow2_bucket(T)},D{int(D)}"
+
+
 def paged_chunk_bucket(C, MB, BS, KVH, G, d):
     """SplitFuse chunk-shape bucket: the chunk length C is exact (it
     gates block_c validity — one compiled chunk program per engine

@@ -98,17 +98,33 @@ _POLICY_ALIASES = {
 }
 
 
+def offload_policy():
+    """The host-offload remat policy (save matmul residuals into host
+    memory instead of recomputing — the reference's cpu_checkpointing
+    copy of saved activations), or None when this jax/backend cannot
+    express it (no offload policy maker, or a single memory space —
+    the CPU test mesh). Target memory kind resolved per-platform by
+    swap_tensor/host_stage.py ('pinned_host' on TPU)."""
+    from ..swap_tensor import host_stage
+    maker = getattr(jax.checkpoint_policies,
+                    "offload_dot_with_no_batch_dims", None)
+    kind = host_stage.host_memory_kind()
+    if maker is None or kind is None:
+        return None
+    return maker("device", kind)
+
+
 def resolve_policy(name_or_none, cpu_checkpointing=False):
     """Map a policy name (+ cpu_checkpointing) to a jax.checkpoint policy."""
     if cpu_checkpointing:
-        # offload matmul residuals to pinned host memory instead of
+        # offload matmul residuals to host memory instead of
         # recomputing (the reference copies saved activations to CPU)
-        maker = getattr(jax.checkpoint_policies,
-                        "offload_dot_with_no_batch_dims", None)
-        if maker is not None:
-            return maker("device", "pinned_host")
-        logger.warning("cpu_checkpointing requested but this jax has no "
-                       "offload policy; using the remat policy instead")
+        policy = offload_policy()
+        if policy is not None:
+            return policy
+        logger.warning("cpu_checkpointing requested but this jax/backend "
+                       "cannot offload (no policy maker or single memory "
+                       "space); using the remat policy instead")
     if not name_or_none:
         return None
     canonical = _POLICY_ALIASES.get(name_or_none, name_or_none)

@@ -115,10 +115,109 @@ class TensorParallelConfig:
 
 @dataclass
 class PipelineConfig:
+    """Pipeline-parallelism block (runtime/pipe/ — the engine resolves
+    it and installs ``model._pipe_cfg``; GPT2Pipe consults it per loss):
+
+      stages              pipe mesh axis size (the topology builder
+                          reads this when no explicit topology is given).
+      micro_batches       microbatches in flight. 0 = auto: the
+                          'pipe_microbatch' autotune op's winner for
+                          this (stages, batch, seq, d_model) bucket
+                          when the winner cache has one, else 2*stages
+                          (amortizes the fill/drain bubble).
+      schedule            'auto' (defer to the model's own
+                          pipe_schedule knob — back-compat; the bench/
+                          probe paths set 'zb' explicitly) | 'gpipe'
+                          (fill-drain + autodiff backward) | '1f1b'
+                          (interleaved, O(stages) live activations) |
+                          'zb' (zero-bubble: 1F1B with the backward
+                          W/B split filling the drain ticks —
+                          runtime/pipe/spmd.py pipeline_zb_grads).
+      offload_activations host placement of the steady-state
+                          executors' activation rings (and the GPipe
+                          path's saved residuals via the offload remat
+                          policy): 'auto' = on iff the backend has a
+                          distinct host memory kind AND the estimated
+                          train state does not fit HBM (the 13B-on-
+                          small-pods case); true forces (identity on
+                          single-memory-space backends, with a
+                          warning); false off.
+      offload_moments     optimizer-moment placement on host memory
+                          via sharding-with-memory-kind: 'auto' = off
+                          (moments offload changes the optimizer
+                          update's memory traffic every step — opt in
+                          explicitly or let the HBM-fit heuristic of a
+                          13B recipe set it); true requires the
+                          backend kind (degrades with a warning).
+      offload_double_buffer
+                          prefetch the next tick's ring read one tick
+                          early so the H2D copy hides under compute
+                          (the comm-overlap discipline applied to host
+                          copies); false fetches at use (A/B lever).
+    """
     stages: int = 1
-    micro_batches: int = 0            # 0 = use gradient_accumulation_steps
+    micro_batches: int = 0            # 0 = auto (winner cache, else 2S)
     partition_method: str = "uniform"
     activation_checkpoint_interval: int = 0
+    schedule: str = "auto"            # auto | gpipe | 1f1b | zb
+    offload_activations: object = "auto"   # "auto" | bool
+    offload_moments: object = "auto"       # "auto" | bool
+    offload_double_buffer: bool = True
+
+    def __post_init__(self):
+        if self.schedule not in ("auto", "gpipe", "1f1b", "zb"):
+            raise DeepSpeedConfigError(
+                f"pipeline.schedule must be auto|gpipe|1f1b|zb, got "
+                f"{self.schedule!r}")
+        for name in ("offload_activations", "offload_moments"):
+            if getattr(self, name) not in (True, False, "auto"):
+                raise DeepSpeedConfigError(
+                    f"pipeline.{name} must be true|false|'auto', got "
+                    f"{getattr(self, name)!r}")
+        if not isinstance(self.micro_batches, int) \
+                or self.micro_batches < 0:
+            raise DeepSpeedConfigError(
+                f"pipeline.micro_batches must be an int >= 0 (0 = "
+                f"auto), got {self.micro_batches!r}")
+        if not isinstance(self.stages, int) or self.stages < 1:
+            raise DeepSpeedConfigError(
+                f"pipeline.stages must be an int >= 1, got "
+                f"{self.stages!r}")
+
+    def resolve_schedule(self, model_schedule=None):
+        """'auto' defers to the model's own pipe_schedule knob (so the
+        existing model-config surface keeps its meaning); an explicit
+        block schedule wins over the model."""
+        if self.schedule != "auto":
+            return self.schedule
+        return model_schedule or "gpipe"
+
+    @staticmethod
+    def hbm_fits(est_state_bytes, hbm_bytes, margin=0.8):
+        """The HBM-fit heuristic behind offload 'auto': does the
+        estimated per-chip train state fit in ``margin`` of HBM?
+        Unknown sizes (None/0) count as fitting — 'auto' must never
+        turn offload on blind."""
+        if not est_state_bytes or not hbm_bytes:
+            return True
+        return est_state_bytes <= margin * hbm_bytes
+
+    def resolve_offload_activations(self, available, pipe_world=1,
+                                    est_state_bytes=None, hbm_bytes=None):
+        """'auto': on iff the backend can stage to host, a pipe axis is
+        actually present, and the HBM-fit heuristic says the state does
+        NOT fit — the reference only swaps when memory forces it."""
+        if self.offload_activations != "auto":
+            return bool(self.offload_activations)
+        return bool(available and pipe_world > 1
+                    and not self.hbm_fits(est_state_bytes, hbm_bytes))
+
+    def resolve_offload_moments(self, available):
+        """'auto' = off (see the field doc); True degrades to off with
+        the host_stage warning when the backend has one memory space."""
+        if self.offload_moments == "auto":
+            return False
+        return bool(self.offload_moments) and bool(available)
 
 
 @dataclass

@@ -149,6 +149,19 @@ class DeepSpeedEngine:
                 "the module defaults", ranks=[0])
         self.zero_stage = self.config.zero.stage
         self.param_dtype = self.config.precision_dtype
+        # pipeline block (config 'pipeline'): schedule / microbatch /
+        # host-offload resolution happens ONCE here (pre-state: the
+        # moments placement changes the optimizer-state shardings) and
+        # is installed on the model as _pipe_cfg for GPT2Pipe to
+        # consult at trace time
+        self._pipe = self._resolve_pipeline()
+        try:
+            self.model._pipe_cfg = self._pipe
+        except (AttributeError, TypeError):   # frozen/slotted models
+            log_dist(
+                "pipeline config block could not be installed on the "
+                "model (attribute assignment rejected); pipelined "
+                "models will use their module defaults", ranks=[0])
         model_dtype = getattr(getattr(model, "config", None), "dtype",
                               None)
         if model_dtype is not None and \
@@ -246,6 +259,11 @@ class DeepSpeedEngine:
                     or tcfg.flightrec_dir):
                 self.telemetry.flight.install_sigterm()
             self._telemetry_lower_args = None
+            # pipelined runs: arm the per-flush pipeline metrics
+            # (bubble fraction, steady-tick wall, offload payload)
+            pinfo = self.pipeline_report()
+            if pinfo is not None:
+                self.telemetry.set_pipeline(pinfo)
 
         # data efficiency (reference engine.py:336-367): the curriculum
         # scheduler changes the SEQUENCE LENGTH the jitted step sees
@@ -407,14 +425,24 @@ class DeepSpeedEngine:
 
     def _opt_state_shardings(self, master):
         """Optimizer state sharding: subtrees structurally matching the
-        param tree inherit master shardings (m/v/etc.); scalars replicate."""
+        param tree inherit master shardings (m/v/etc.); scalars
+        replicate. With ``pipeline.offload_moments`` resolved on, the
+        moment subtrees are re-targeted at the host memory kind
+        (sharding-with-memory-kind — the reference's swap_tensor
+        optimizer tier expressed as placement; XLA streams them through
+        the update)."""
         master_def = jax.tree.structure(master)
         state_shape = jax.eval_shape(self.optimizer.init, master)
         repl = NamedSharding(self.mesh, P())
+        moment_sh = self.master_shardings
+        if getattr(self._pipe, "offload_moments", False):
+            from .swap_tensor import host_stage
+            moment_sh = jax.tree.map(host_stage.with_host_memory_kind,
+                                     self.master_shardings)
         out = {}
         for key, sub in state_shape.items():
             if jax.tree.structure(sub) == master_def:
-                out[key] = self.master_shardings
+                out[key] = moment_sh
             else:
                 out[key] = jax.tree.map(lambda _: repl, sub)
         return out
@@ -684,6 +712,168 @@ class DeepSpeedEngine:
                 in_shardings=(st_sh(), self.grad_shardings, None),
                 out_shardings=(st_sh(), None))
 
+    # ---------------------------------------------------------- pipeline
+    def _resolve_pipeline(self):
+        """Resolve the ``pipeline`` config block against this topology
+        and backend (runtime/config.py PipelineConfig docs the knobs):
+        schedule, microbatch count (winner cache via the
+        'pipe_microbatch' autotune op when 0/auto), and the host-offload
+        placements — activations need a distinct host memory kind
+        (swap_tensor/host_stage.py) and 'auto' additionally needs the
+        HBM-fit heuristic to say the state does NOT fit."""
+        from types import SimpleNamespace
+        from .swap_tensor import host_stage
+        pcfg = self.config.pipeline
+        S = self.topology.get_pipe_parallel_world_size()
+        mcfg = getattr(self.model, "config", None)
+        model_sched = getattr(mcfg, "pipe_schedule", None)
+        schedule = pcfg.resolve_schedule(model_sched)
+        avail = host_stage.available()
+        est = self._estimate_pipe_state_bytes()
+        hbm = self._device_hbm_bytes()
+        acts = pcfg.resolve_offload_activations(
+            avail, pipe_world=S, est_state_bytes=est, hbm_bytes=hbm)
+        moments = pcfg.resolve_offload_moments(avail)
+        if pcfg.offload_moments is True and not avail:
+            log_dist(
+                "pipeline.offload_moments=true but this backend has a "
+                "single memory space; moments stay device-resident",
+                ranks=[0])
+        if pcfg.offload_activations is True and not avail:
+            log_dist(
+                "pipeline.offload_activations=true but this backend "
+                "has a single memory space; staging degrades to "
+                "identity (no bytes move)", ranks=[0])
+        micro = pcfg.micro_batches or getattr(
+            mcfg, "pipe_microbatches", 0)
+        if not micro and S > 1 and mcfg is not None \
+                and hasattr(mcfg, "d_model"):
+            # 'auto' M: the measured knee between bubble amortization
+            # (more microbatches) and per-tick MXU efficiency (fewer) —
+            # cold cache = the 2S guidance, same program as before
+            from ..ops.pallas._common import (dispatch, dtype_name,
+                                              pipe_bucket)
+            # the pipelined loss sees ONE accumulation micro-step's
+            # rows, not the global batch — bucket and divisibility
+            # must use what the model will actually split
+            B = max(1, self.config.train_batch_size
+                    // self.config.gradient_accumulation_steps)
+            bucket = pipe_bucket(S, B, mcfg.max_seq_len, mcfg.d_model)
+            winner = dispatch("pipe_microbatch", bucket,
+                              dtype_name(self.param_dtype),
+                              {"micro": 2 * S, "offload": int(acts)})
+            micro = int(winner["micro"])
+            if B % micro:
+                # the bucket pow2-rounds B, so a cached winner can fail
+                # the REAL batch's divisibility — 'auto' must degrade
+                # to a dividing count, never crash the trace
+                micro = next((m for m in (2 * S, S, 1) if B % m == 0),
+                             1)
+                log_dist(
+                    f"pipeline: tuned micro_batches "
+                    f"{winner['micro']} does not divide the global "
+                    f"batch {B}; using {micro}", ranks=[0])
+        if S > 1:
+            log_dist(
+                f"pipeline: stages={S} schedule={schedule} "
+                f"micro_batches={micro or 2 * S} offload_acts={acts} "
+                f"offload_moments={moments} "
+                f"(host_kind={host_stage.host_memory_kind()})",
+                ranks=[0])
+        return SimpleNamespace(
+            stages=S, schedule=schedule, micro_batches=int(micro),
+            offload_activations=bool(acts),
+            offload_moments=bool(moments),
+            offload_double_buffer=bool(pcfg.offload_double_buffer))
+
+    def _estimate_pipe_state_bytes(self):
+        """Rough per-chip train-state bytes for the HBM-fit heuristic:
+        working params + grads (divided over pipe x tensor) plus the
+        fp32 master + Adam moments (divided over the ZeRO partition
+        group from stage >= 1). A heuristic for the offload 'auto'
+        knob, not an allocator."""
+        import jax.numpy as _jnp
+        mcfg = getattr(self.model, "config", None)
+        count = getattr(mcfg, "num_params", None)
+        if not callable(count):
+            return None
+        n = count()
+        pp = max(1, self.topology.get_pipe_parallel_world_size())
+        tp = max(1, self.topology.get_model_parallel_world_size())
+        dp = max(1, self.topology.get_data_parallel_world_size())
+        shard = pp * tp
+        pbytes = _jnp.dtype(self.param_dtype).itemsize
+        gname = self.config.grad_accum_dtype
+        gbytes = {"bf16": 2, "fp16": 2}.get(gname, 4)
+        opt_shard = shard * (dp if self.zero_stage >= 1 else 1)
+        return int(n * (pbytes + gbytes) / shard + n * 12 / opt_shard)
+
+    def _device_hbm_bytes(self):
+        """Per-chip device memory budget: DSTPU_HBM_BYTES override,
+        else the backend's own bytes_limit, else None (the heuristic
+        then counts everything as fitting)."""
+        env = os.environ.get("DSTPU_HBM_BYTES")
+        if env:
+            try:
+                return int(float(env))
+            except ValueError:
+                logger.warning(
+                    f"ignoring non-numeric DSTPU_HBM_BYTES={env!r}")
+        try:
+            stats = jax.devices()[0].memory_stats()
+            return int(stats["bytes_limit"]) if stats else None
+        except Exception:  # noqa: BLE001 - CPU/older backends
+            return None
+
+    def pipeline_report(self):
+        """Schedule/offload analytics for the active pipeline (None at
+        pipe=1): the analytic executor bubble fractions
+        (runtime/pipe/schedule.py lock-step wall model — the number
+        telemetry emits as Train/Pipeline/bubble_pct) and the host
+        staging payload the offload moves per step."""
+        pr = self._pipe
+        S = pr.stages
+        if S <= 1:
+            return None
+        from .pipe.schedule import executor_bubble_fraction
+        sched = pr.schedule if pr.schedule in ("gpipe", "1f1b", "zb") \
+            else "gpipe"
+        M = pr.micro_batches or 2 * S
+        gas = max(1, self.config.gradient_accumulation_steps)
+        # ticks per OPTIMIZER step: each accumulation micro-step runs
+        # one full schedule pass (telemetry's step wall covers all gas)
+        ticks = gas * (M + 2 * (S - 1) if sched in ("1f1b", "zb")
+                       else 2 * (M + S - 1))
+        info = {
+            "stages": S, "micro_batches": M, "schedule": sched,
+            "ticks": ticks,
+            "bubble_pct": round(
+                100 * executor_bubble_fraction(sched, M, S), 3),
+            "gpipe_bubble_pct": round(
+                100 * executor_bubble_fraction("gpipe", M, S), 3),
+            "offload_activations": pr.offload_activations,
+            "offload_moments": pr.offload_moments,
+            "offload_bytes_per_step": 0,
+        }
+        mcfg = getattr(self.model, "config", None)
+        from .swap_tensor import host_stage
+        if pr.offload_activations and host_stage.available() \
+                and mcfg is not None and hasattr(mcfg, "d_model"):
+            # the ring traffic: each tick stages one microbatch's
+            # activation D2H (ring write) and reads one back H2D —
+            # the copy-overhead budget the offload must hide, PER CHIP
+            # (the batch dim shards over dp, so a chip's ring only
+            # stages its own slice). Zero on single-memory-space
+            # backends: there staging is identity and reporting
+            # phantom bytes would poison the A/B
+            dp = max(1, self.topology.get_data_parallel_world_size())
+            rows = max(1, self.config.train_batch_size
+                       // (gas * dp * M))
+            act = rows * mcfg.max_seq_len * mcfg.d_model * \
+                jnp.dtype(self.param_dtype).itemsize
+            info["offload_bytes_per_step"] = int(2 * ticks * act)
+        return info
+
     # ------------------------------------------------------- comm overlap
     def _install_comm_overlap(self, gdtype):
         """Install the per-layer comm hook on the model (zero/overlap.py):
@@ -752,6 +942,13 @@ class DeepSpeedEngine:
                     self.state, batch, self._current_lr(), None).compile()
         report = comm_overlap.overlap_report(compiled.as_text(),
                                              mesh=self.mesh)
+        # pipelined step: attach the schedule analytics (bubble
+        # fractions, offload payload) next to what the HLO shows — the
+        # in-loop collective-permute count is the pipe's steady-state
+        # rotation, host_copies its staging traffic
+        pinfo = self.pipeline_report()
+        if pinfo is not None:
+            report["pipeline"] = pinfo
         self.comm_overlap_report = report
         if require_async and report["n_collectives"] \
                 and not report["async_pairs"]:

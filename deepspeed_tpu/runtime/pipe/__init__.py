@@ -2,17 +2,24 @@ from .module import LayerSpec, TiedLayerSpec, PipelineModule
 from .topology import (ProcessTopology, PipeDataParallelTopology,
                        PipeModelDataParallelTopology, PipelineParallelGrid)
 from .schedule import (TrainSchedule, InferenceSchedule, PipeSchedule,
-                       ForwardPass, BackwardPass, SendActivation,
-                       RecvActivation, SendGrad, RecvGrad, LoadMicroBatch,
-                       ReduceGrads, OptimizerStep)
-from .spmd import spmd_pipeline
+                       ZeroBubbleSchedule, ForwardPass, BackwardPass,
+                       BackwardActGrad, BackwardWeightGrad,
+                       SendActivation, RecvActivation, SendGrad, RecvGrad,
+                       LoadMicroBatch, ReduceGrads, OptimizerStep,
+                       executor_bubble_fraction, executor_tick_units)
+from .spmd import (spmd_pipeline, pipeline_1f1b_grads, pipeline_zb_grads,
+                   pipeline_loss, PipeOffload)
 
 __all__ = [
     "LayerSpec", "TiedLayerSpec", "PipelineModule",
     "ProcessTopology", "PipeDataParallelTopology",
     "PipeModelDataParallelTopology", "PipelineParallelGrid",
     "TrainSchedule", "InferenceSchedule", "PipeSchedule",
-    "ForwardPass", "BackwardPass", "SendActivation", "RecvActivation",
-    "SendGrad", "RecvGrad", "LoadMicroBatch", "ReduceGrads", "OptimizerStep",
-    "spmd_pipeline",
+    "ZeroBubbleSchedule",
+    "ForwardPass", "BackwardPass", "BackwardActGrad",
+    "BackwardWeightGrad", "SendActivation", "RecvActivation",
+    "SendGrad", "RecvGrad", "LoadMicroBatch", "ReduceGrads",
+    "OptimizerStep", "executor_bubble_fraction", "executor_tick_units",
+    "spmd_pipeline", "pipeline_1f1b_grads", "pipeline_zb_grads",
+    "pipeline_loss", "PipeOffload",
 ]

@@ -208,6 +208,30 @@ class PipelineModule:
         return self.loss_fn(out, batch)
 
     # -------------------------------------------------------------- analysis
+    def schedule_streams(self, schedule, micro_batches):
+        """Per-stage instruction streams for this module's stage count
+        — the analysis surface the reference exposes through its
+        schedule objects. ``schedule``: 'gpipe' (the forward
+        InferenceSchedule view), '1f1b', or 'zb'."""
+        from .schedule import (InferenceSchedule, TrainSchedule,
+                               ZeroBubbleSchedule)
+        cls = {"gpipe": InferenceSchedule, "1f1b": TrainSchedule,
+               "zb": ZeroBubbleSchedule}.get(schedule)
+        if cls is None:
+            raise ValueError(f"unknown schedule {schedule!r}")
+        return [cls(micro_batches, self.num_stages, s)
+                for s in range(self.num_stages)]
+
+    def bubble_report(self, micro_batches):
+        """Analytic executor bubble fraction per schedule at this stage
+        count (runtime/pipe/schedule.py lock-step wall model) — the
+        M-selection aid the pipe_microbatch autotune op measures for
+        real."""
+        from .schedule import executor_bubble_fraction
+        return {s: round(executor_bubble_fraction(
+                    s, micro_batches, self.num_stages), 4)
+                for s in ("gpipe", "1f1b", "zb")}
+
     def stage_param_counts(self):
         counts = []
         for s in range(self.num_stages):

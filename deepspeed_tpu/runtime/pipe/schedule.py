@@ -51,6 +51,18 @@ class BackwardPass(PipeInstruction):
     """kwargs: micro_batch, buffer_id."""
 
 
+class BackwardActGrad(PipeInstruction):
+    """Zero-bubble B pass: activation gradient only (dx from dy) — the
+    piece the previous stage is waiting on. kwargs: micro_batch,
+    buffer_id."""
+
+
+class BackwardWeightGrad(PipeInstruction):
+    """Zero-bubble W pass: weight gradient only (dW from the saved
+    input and dy) — free-floating filler work, scheduled into the
+    drain bubble. kwargs: micro_batch, buffer_id."""
+
+
 class SendActivation(PipeInstruction):
     """kwargs: micro_batch, buffer_id."""
 
@@ -98,6 +110,134 @@ class PipeSchedule:
     def bubble_fraction(self):
         """Idle fraction of the pipeline fill/drain: (S-1)/(M+S-1)."""
         return (self.stages - 1) / (self.micro_batches + self.stages - 1)
+
+
+class ZeroBubbleSchedule(PipeSchedule):
+    """ZB-H1: 1F1B with each backward split into B (activation grad,
+    stays on the drain wave) and W (weight grad, deferred into the
+    forward-drain ticks). Written in the reference's imperative
+    per-stage phase style; the tick-parity test
+    (tests/unit/test_pipe_fast.py) pins this stream against the SPMD
+    executor's index maps (runtime/pipe/spmd.py zb_*_index — the
+    executed order), so neither can drift from the other.
+
+    Per stage s (K_s = min(2(S-1) - s, M) deferred microbatches):
+      * F(m) at tick m + s; B(m) at tick m + 2(S-1) - s (1F1B waves);
+      * W(m) fused right after B(m) for m < M - K_s (steady state);
+      * W(m) for the last K_s microbatches lands on tick m + 2(S-1) —
+        s ticks after its own B, occupying a tick whose forward slot
+        has drained. Memory: the 1F1B input ring plus K_s <= S saved
+        cotangents — still O(stages)."""
+
+    def num_pipe_buffers(self):
+        # input ring (2S in the executor) + the deferred-cotangent ring
+        return 2 * self.stages + min(self.stages, self.micro_batches)
+
+    def deferred_window(self):
+        return min(2 * (self.stages - 1) - self.stage_id,
+                   self.micro_batches)
+
+    def tick_ops(self, t):
+        """('F'|'B'|'W', micro_batch) ops this stage runs at tick t, in
+        executor phase order (F, then B, then W)."""
+        M, S, s = self.micro_batches, self.stages, self.stage_id
+        K = self.deferred_window()
+        ops = []
+        f = t - s
+        if 0 <= f < M:
+            ops.append(("F", f))
+        b = t - 2 * (S - 1) + s
+        if 0 <= b < M:
+            ops.append(("B", b))
+            if b < M - K:
+                ops.append(("W", b))        # fused: steady state
+        w = t - 2 * (S - 1)
+        if max(M - K, 0) <= w < M:
+            ops.append(("W", w))            # deferred: drain filler
+        return ops
+
+    def num_ticks(self):
+        return self.micro_batches + 2 * (self.stages - 1)
+
+    def steps(self):
+        M, S = self.micro_batches, self.stages
+        nbuf = 2 * S
+        for t in range(self.num_ticks()):
+            step = []
+            for kind, mb in self.tick_ops(t):
+                buf = mb % nbuf
+                if kind == "F":
+                    if self.is_first_stage or self.is_last_stage:
+                        step.append(LoadMicroBatch(micro_batch=mb,
+                                                   buffer_id=buf))
+                    if not self.is_first_stage:
+                        step.append(RecvActivation(micro_batch=mb,
+                                                   buffer_id=buf))
+                    step.append(ForwardPass(micro_batch=mb,
+                                            buffer_id=buf))
+                    if not self.is_last_stage:
+                        step.append(SendActivation(micro_batch=mb,
+                                                   buffer_id=buf))
+                elif kind == "B":
+                    if not self.is_last_stage:
+                        step.append(RecvGrad(micro_batch=mb,
+                                             buffer_id=buf))
+                    step.append(BackwardActGrad(micro_batch=mb,
+                                                buffer_id=buf))
+                    if not self.is_first_stage:
+                        step.append(SendGrad(micro_batch=mb,
+                                             buffer_id=buf))
+                else:
+                    step.append(BackwardWeightGrad(micro_batch=mb,
+                                                   buffer_id=buf))
+            yield step
+        yield [ReduceGrads(), OptimizerStep()]
+
+    def bubble_fraction(self):
+        return executor_bubble_fraction("zb", self.micro_batches,
+                                        self.stages)
+
+
+# ------------------------------------------------- lock-step wall model
+def executor_tick_units(schedule, micro_batches, stages):
+    """Per-tick cost of the SPMD rotation-loop executors in compute
+    units (F = B = W = 1): every tick ends in a collective ppermute, so
+    the tick costs the BUSIEST stage's lane count. Returns the list of
+    per-tick max-unit costs.
+
+      'gpipe'  M+S-1 forward ticks (1 unit) then, via autodiff of the
+               scan, M+S-1 backward ticks (B+W fused = 2 units).
+      '1f1b'   the interleaved executor computes its forward lane
+               unconditionally (garbage on invalid ticks, masked
+               accumulation) and the fused B+W backward likewise:
+               3 units x (M + 2(S-1)) ticks, flat.
+      'zb'     invalid lanes are lax.cond no-ops and W defers into the
+               forward-drain ticks: the per-tick max drops wherever
+               the busiest stage's W has been deferred away.
+    """
+    M, S = micro_batches, stages
+    if schedule == "gpipe":
+        return [1] * (M + S - 1) + [2] * (M + S - 1)
+    if schedule == "1f1b":
+        return [3] * (M + 2 * (S - 1))
+    if schedule == "zb":
+        walls = []
+        scheds = [ZeroBubbleSchedule(M, S, s) for s in range(S)]
+        for t in range(M + 2 * (S - 1)):
+            walls.append(max(len(sc.tick_ops(t)) for sc in scheds))
+        return walls
+    raise ValueError(f"unknown schedule {schedule!r}")
+
+
+def executor_bubble_fraction(schedule, micro_batches, stages):
+    """Idle fraction of the lock-step executor wall: 1 - useful/wall,
+    useful = 3M units per stage (F + B + W per microbatch). GPipe
+    reduces to the classical (S-1)/(M+S-1); the zero-bubble executor
+    is strictly below it (the acceptance bar) because the deferred W
+    wave fills the drain ticks the others idle (or burn garbage
+    forwards) through."""
+    wall = sum(executor_tick_units(schedule, micro_batches, stages))
+    return max(0.0, 1.0 - (3.0 * micro_batches) / wall)
 
 
 class InferenceSchedule(PipeSchedule):

@@ -29,6 +29,18 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+# The steady-state executors compute every gradient EXPLICITLY inside
+# the manual region (jax.vjp over per-shard closures; nothing
+# differentiates through the shard_map itself) and reduce stage-local
+# results with explicit psums, so legacy jax's check_rep machinery —
+# whose cond-branch replication unification predates the vma typing the
+# executors' pcast annotations target — adds no safety, only spurious
+# mismatches (e.g. on the head-loss cond). vma-era jax keeps full
+# checking; the GPipe path (spmd_pipeline), which IS differentiated
+# through, always keeps it (its transpose relies on the rewrite pass).
+_STEADY_STATE_KW = {} if hasattr(jax.lax, "pvary") else \
+    {"check_vma": False}
+
 
 def spmd_pipeline(block_fn, layers, x_mb, *, pipe_axis="pipe",
                   unroll_local=False):
@@ -392,6 +404,331 @@ def pipeline_1f1b_grads(block_fn, head_loss_fn, layers_params, layers_aux,
         in_specs=(P(pipe_axis), P(pipe_axis), P(), P(), P()),
         out_specs=(P(), P(pipe_axis), P(), P()),
         axis_names={pipe_axis},
+        **_STEADY_STATE_KW,
+    )(layers_params, layers_aux, head_params, x_mb, tgt_mb)
+    dlayers = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                           gacc, layers_params)
+    dhead = jax.tree.map(lambda g, p: g.astype(p.dtype),
+                         hgrads, head_params)
+    dx_mb = jax.tree.map(lambda g, x: g.astype(x.dtype), dx_mb, x_mb)
+    return loss, (dlayers, dhead, dx_mb)
+
+
+# --------------------------------------------------- zero-bubble executor
+#
+# ZB-H1 (the W/B backward split) on top of the 1F1B rotation loop. Each
+# block's backward splits into the activation-grad pass B (dx from dy —
+# the only piece the previous stage is waiting on) and the weight-grad
+# pass W (dW from the ring-saved input and dy — nothing downstream
+# consumes it until the optimizer). 1F1B runs B and W fused on the
+# backward wave, so every drain tick costs B+W while the forward slot
+# idles; here each stage DEFERS its trailing ``zb_deferred_window``
+# microbatches' W passes into exactly those forward-drain ticks. The
+# index maps (shared with schedule.py's ZeroBubbleSchedule — the
+# tick-parity test pins the two together):
+#
+#     F(m) on stage s  at tick m + s                       (fill wave)
+#     B(m) on stage s  at tick m + 2(S-1) - s              (drain wave)
+#     W(m) fused with B(m)          for m <  M - K_s
+#     W(m) deferred    at tick m + 2(S-1)  (all stages!)   for m >= M - K_s
+#
+# with K_s = min(2(S-1) - s, M): stage s has exactly 2(S-1) - s ticks
+# after its last F and the deferred W(m) wave lands s ticks after B(m) —
+# always causally after its own B. Invalid slots are lax.cond no-ops
+# (the 1F1B executor computes garbage forwards during the drain instead),
+# so the lock-step wall — every tick costs the busiest stage, the
+# ppermute is the barrier — drops below the GPipe figure:
+# ``schedule.executor_bubble_fraction`` is the model, asserted by tests.
+#
+# Memory: the 1F1B input ring plus a dy ring of ``S`` slots (a deferred
+# microbatch's cotangent lives the s ticks between its B and W) — still
+# O(stages), never O(M). Cost of the split: B and W each rematerialize
+# the block forward (two recomputes per microbatch instead of the fused
+# pass's one) — the standard ZB trade under full activation
+# checkpointing, bought back by the drain ticks it fills.
+#
+# Host offload (``offload=``): the input/dy rings are the activation
+# carries the reference's ``swap_tensor`` + ``activation_checkpointing``
+# layers spill; with offload on they are INITIALIZED in host memory
+# (swap_tensor/host_stage.py) so the in-scan dynamic-update-slice
+# writes stage D2H and the reads stage H2D (copy-start/copy-done pairs
+# under the latency-hiding scheduler; overlap_report counts them). The
+# next tick's B input is prefetched one tick early (``x_pref`` carry, a
+# real double buffer); the last stage consumes its own same-tick
+# forward input from registers, never through the host.
+
+
+def zb_deferred_window(stage_id, micro_batches, stages):
+    """K_s: how many trailing microbatches' W passes stage s defers into
+    its forward-drain ticks. Polymorphic over python ints and traced
+    values (the executor and the schedule spec share it)."""
+    lo = 2 * (stages - 1) - stage_id
+    if isinstance(stage_id, int):
+        return min(lo, micro_batches)
+    return jnp.minimum(lo, micro_batches)
+
+
+def zb_f_index(t, stage_id, micro_batches, stages):
+    """Microbatch whose FORWARD stage ``stage_id`` runs at tick t
+    (valid iff in [0, M))."""
+    return t - stage_id
+
+
+def zb_b_index(t, stage_id, micro_batches, stages):
+    """Microbatch whose activation-grad (B) pass runs at tick t."""
+    return t - 2 * (stages - 1) + stage_id
+
+
+def zb_w_deferred_index(t, stage_id, micro_batches, stages):
+    """Microbatch whose DEFERRED weight-grad (W) pass runs at tick t —
+    a uniform wave (independent of the stage: the per-stage deferral
+    window exactly cancels the backward skew). Valid iff in
+    [max(M - K_s, 0), M)."""
+    return t - 2 * (stages - 1)
+
+
+def zb_num_ticks(micro_batches, stages):
+    """Same tick count as 1F1B: the last deferred W (microbatch M-1)
+    lands on tick M - 1 + 2(S-1), the final tick."""
+    return micro_batches + 2 * (stages - 1)
+
+
+def pipeline_zb_grads(block_fn, head_loss_fn, layers_params, layers_aux,
+                      head_params, x_mb, tgt_mb, *, pipe_axis="pipe",
+                      offload=None):
+    """Zero-bubble (ZB-H1) pipelined training pass: mean loss over M
+    microbatches AND all gradients in ONE jitted SPMD program, with the
+    backward W/B split filling the drain bubble (see the module-level
+    schedule notes above). Signature and return match
+    :func:`pipeline_1f1b_grads`; ``offload`` is an optional
+    ``PipeOffload`` (host placement of the activation rings)."""
+    mesh = jax.sharding.get_abstract_mesh()
+    S = mesh.shape[pipe_axis]
+    M = _leading(x_mb)
+    R = _ring_capacity(S)
+    n_ticks = zb_num_ticks(M, S)
+    f32_boundary = jax.default_backend() == "cpu"
+
+    off = offload if offload is not None else PipeOffload()
+    if off.activations:
+        from ..swap_tensor import host_stage
+        to_host = host_stage.to_host
+        to_device = host_stage.to_device
+    else:
+        to_host = to_device = lambda x: x
+
+    def _b(x):
+        if f32_boundary and jnp.issubdtype(x.dtype, jnp.floating) \
+                and jnp.finfo(x.dtype).bits < 32:
+            return jnp.float32
+        return x.dtype
+
+    def stage_fn(lp, la, hp, x_mb, tgt_mb):
+        sid = lax.axis_index(pipe_axis)
+        K = zb_deferred_window(sid, M, S)
+        # see pipeline_1f1b_grads: differentiate only pipe-varying head
+        # params or the transpose inserts a cross-stage psum per tick
+        hp = jax.tree.map(
+            lambda p: lax.pcast(p, (pipe_axis,), to="varying"), hp)
+        perm_f = [(i, (i + 1) % S) for i in range(S)]
+        perm_b = [(i, (i - 1) % S) for i in range(S)]
+
+        def fwd_local(x, lp):
+            def body(c, sl):
+                p, a = sl
+                return block_fn(c, p, a), None
+            y, _ = lax.scan(body, x, (lp, la))
+            return y
+
+        def vz(x, dt=None):
+            z = lax.pcast(
+                jnp.zeros(x.shape, _b(x)), (pipe_axis,), to="varying")
+            return z.astype(dt or x.dtype)
+
+        x0 = jax.tree.map(lambda x: x[0], x_mb)
+        act0 = jax.tree.map(vz, x0)
+        dy0 = jax.tree.map(vz, x0)
+        ring0 = jax.tree.map(
+            lambda x: to_host(
+                jnp.tile(vz(x)[None], (R,) + (1,) * x.ndim)), x0)
+        # deferred cotangents live the s ticks between B(m) and W(m):
+        # an S-slot ring (slot m % S) bounds them by stages, not M
+        dyring0 = jax.tree.map(
+            lambda x: to_host(
+                jnp.tile(vz(x)[None], (S,) + (1,) * x.ndim)), x0)
+        # prefetch buffer lives WITH the ring (host when offloading) so
+        # the scan carry keeps one consistent memory space
+        xpref0 = jax.tree.map(lambda x: to_host(vz(x)), x0)
+        gacc0 = jax.tree.map(lambda p: vz(p, jnp.float32), lp)
+        hacc0 = jax.tree.map(
+            lambda p: lax.pcast(jnp.zeros(p.shape, jnp.float32),
+                                (pipe_axis,), to="varying"), hp)
+        dx0 = jax.tree.map(
+            lambda x: jnp.zeros((M,) + x.shape[1:], _b(x)), x_mb)
+        dx0 = jax.tree.map(
+            lambda x: lax.pcast(x, (pipe_axis,), to="varying"), dx0)
+        loss0 = lax.pcast(jnp.zeros((), jnp.float32), (pipe_axis,),
+                          to="varying")
+
+        def tick(carry, t):
+            (act_in, dy_in, ring, dy_ring, x_pref, gacc, hacc, dx_out,
+             loss_acc) = carry
+            # ---------- F phase: stage s runs microbatch t - s; invalid
+            # slots are cond no-ops (the drain tick's forward lane is
+            # freed for the deferred W below, not burned on garbage)
+            f_idx = zb_f_index(t, sid, M, S)
+            f_valid = (f_idx >= 0) & (f_idx < M)
+            f_safe = jnp.clip(f_idx, 0, M - 1)
+            inject = jax.tree.map(
+                lambda x, a: x[f_safe].astype(a.dtype), x_mb, act_in)
+            x_in = jax.tree.map(
+                lambda i, a: jnp.where(sid == 0, i, a), inject, act_in)
+
+            def f_branch(x_, lp_):
+                return fwd_local(x_, lp_)
+
+            def f_skip(x_, lp_):
+                return jax.tree.map(vz, x_)
+
+            y = lax.cond(f_valid, f_branch, f_skip, x_in, lp)
+            slot = f_safe % R
+            ring = jax.tree.map(
+                lambda r, x: r.at[slot].set(
+                    jnp.where(f_valid, to_host(x), r[slot])), ring, x_in)
+
+            # head: per-microbatch loss + 1/M cotangent seed, last stage
+            # only AND only while it still has forwards (its B wave ends
+            # with its F wave, so drain ticks skip the unembed entirely)
+            tgt = jax.tree.map(lambda x: x[f_safe], tgt_mb)
+            seed = lax.pcast(jnp.float32(1.0 / M), (pipe_axis,),
+                             to="varying")
+
+            def head_branch(hp_, y_, tgt_, seed_):
+                l_mb_, vjp_h = jax.vjp(
+                    lambda h, yy: head_loss_fn(h, yy, tgt_), hp_, y_)
+                dhp_, dy_ = vjp_h(seed_)
+                return l_mb_, dhp_, dy_
+
+            def skip_branch(hp_, y_, tgt_, seed_):
+                zv = lambda a: lax.pcast(jnp.zeros(a.shape, a.dtype),
+                                         (pipe_axis,), to="varying")
+                return (zv(jnp.zeros((), jnp.float32)),
+                        jax.tree.map(zv, hp_), jax.tree.map(zv, y_))
+
+            head_on = (sid == S - 1) & f_valid
+            l_mb, dhp, dy_seed = lax.cond(head_on, head_branch,
+                                          skip_branch, hp, y, tgt, seed)
+            seed_valid = head_on
+            loss_acc = loss_acc + jnp.where(seed_valid, l_mb, 0.0)
+            hacc = jax.tree.map(
+                lambda a, g: a + jnp.where(seed_valid,
+                                           g.astype(jnp.float32), 0.0),
+                hacc, dhp)
+
+            # ---------- B phase: activation-grad only (dx via the
+            # x-closure vjp — XLA's cone for dx alone, no dW work on the
+            # wave the next stage is waiting on)
+            b_idx = zb_b_index(t, sid, M, S)
+            b_valid = (b_idx >= 0) & (b_idx < M)
+            b_safe = jnp.clip(b_idx, 0, M - 1)
+            dy = jax.tree.map(
+                lambda s_, d: jnp.where(sid == S - 1,
+                                        s_.astype(d.dtype), d),
+                dy_seed, dy_in)
+            # last stage: B(m) == F(m) same tick — its input is still in
+            # registers; other stages use the one-tick-early prefetch
+            # (double_buffer, the default) or fetch at use (A/B lever)
+            if off.double_buffer:
+                x_fetch = x_pref
+            else:
+                x_fetch = jax.tree.map(lambda r: r[b_safe % R], ring)
+            x_for_b = jax.tree.map(
+                lambda xi, xp: jnp.where(sid == S - 1, xi,
+                                         to_device(xp).astype(xi.dtype)),
+                x_in, x_fetch)
+
+            def b_branch(x_, lp_, dy_):
+                _, vjp_x = jax.vjp(lambda xx: fwd_local(xx, lp_), x_)
+                (dx_,) = vjp_x(dy_)
+                return dx_
+
+            def b_skip(x_, lp_, dy_):
+                return jax.tree.map(vz, x_)
+
+            dx = lax.cond(b_valid, b_branch, b_skip, x_for_b, lp, dy)
+            # stash the cotangent a DEFERRED microbatch's W will need
+            defer_class = b_valid & (b_idx >= M - K)
+            dslot = b_safe % S
+            dy_ring = jax.tree.map(
+                lambda r, d: r.at[dslot].set(
+                    jnp.where(defer_class, to_host(d), r[dslot])),
+                dy_ring, dy)
+            write_dx = (sid == 0) & b_valid
+            dx_out = jax.tree.map(
+                lambda buf, d: buf.at[b_safe].set(
+                    jnp.where(write_dx, d.astype(buf.dtype),
+                              buf[b_safe])),
+                dx_out, dx)
+
+            # ---------- W phase: weight-grad pass — fused with B for
+            # the early microbatches, the deferred wave for the last K_s
+            w_idx = zb_w_deferred_index(t, sid, M, S)
+            w_safe = jnp.clip(w_idx, 0, M - 1)
+            w_def = (w_idx >= jnp.maximum(M - K, 0)) & (w_idx < M)
+            w_fused = b_valid & (b_idx < M - K)
+            x_w = jax.tree.map(
+                lambda fb, r: jnp.where(
+                    w_def, to_device(r[w_safe % R]).astype(fb.dtype), fb),
+                x_for_b, ring)
+            dy_w = jax.tree.map(
+                lambda d, r: jnp.where(
+                    w_def, to_device(r[w_safe % S]).astype(d.dtype), d),
+                dy, dy_ring)
+
+            def w_branch(x_, lp_, dy_):
+                _, vjp_p = jax.vjp(lambda pp: fwd_local(x_, pp), lp_)
+                (dlp_,) = vjp_p(dy_)
+                return jax.tree.map(
+                    lambda g: g.astype(jnp.float32), dlp_)
+
+            def w_skip(x_, lp_, dy_):
+                return jax.tree.map(lambda p: vz(p, jnp.float32), lp_)
+
+            dlp = lax.cond(w_def | w_fused, w_branch, w_skip,
+                           x_w, lp, dy_w)
+            gacc = jax.tree.map(lambda a, g: a + g, gacc, dlp)
+
+            # prefetch NEXT tick's B input out of the (host) ring — the
+            # H2D copy gets a full tick of compute to hide under
+            nb_safe = jnp.clip(zb_b_index(t + 1, sid, M, S), 0, M - 1)
+            x_pref = jax.tree.map(lambda r: r[nb_safe % R], ring)
+
+            act_nxt = jax.tree.map(
+                lambda o: lax.ppermute(
+                    o.astype(_b(o)), pipe_axis, perm_f).astype(o.dtype), y)
+            dy_nxt = jax.tree.map(
+                lambda o: lax.ppermute(
+                    o.astype(_b(o)), pipe_axis, perm_b).astype(o.dtype),
+                dx)
+            return (act_nxt, dy_nxt, ring, dy_ring, x_pref, gacc, hacc,
+                    dx_out, loss_acc), None
+
+        carry = (act0, dy0, ring0, dyring0, xpref0, gacc0, hacc0, dx0,
+                 loss0)
+        (_, _, _, _, _, gacc, hacc, dx_out, loss_acc), _ = lax.scan(
+            tick, carry, jnp.arange(n_ticks))
+
+        loss = lax.psum(loss_acc, pipe_axis) / M
+        hgrads = jax.tree.map(lambda a: lax.psum(a, pipe_axis), hacc)
+        dx_mb = jax.tree.map(lambda a: lax.psum(a, pipe_axis), dx_out)
+        return loss, gacc, hgrads, dx_mb
+
+    loss, gacc, hgrads, dx_mb = jax.shard_map(
+        stage_fn,
+        in_specs=(P(pipe_axis), P(pipe_axis), P(), P(), P()),
+        out_specs=(P(), P(pipe_axis), P(), P()),
+        axis_names={pipe_axis},
+        **_STEADY_STATE_KW,
     )(layers_params, layers_aux, head_params, x_mb, tgt_mb)
     dlayers = jax.tree.map(lambda g, p: g.astype(p.dtype),
                            gacc, layers_params)
@@ -402,33 +739,57 @@ def pipeline_1f1b_grads(block_fn, head_loss_fn, layers_params, layers_aux,
 
 
 import functools as _functools
+from typing import NamedTuple as _NamedTuple
+
 import numpy as _np
 
 
-@_functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
-def pipeline_1f1b_loss(block_fn, head_loss_fn, pipe_axis, layers_params,
-                       layers_aux, head_params, x_mb, tgt_mb):
-    """Differentiable wrapper over :func:`pipeline_1f1b_grads`: returns
-    the mean microbatch loss; ``jax.grad`` through it yields the grads the
-    1F1B pass already computed (stored as vjp residuals), so the engine's
-    ordinary value_and_grad drives the pipelined schedule unchanged."""
-    loss, _ = pipeline_1f1b_grads(
+class PipeOffload(_NamedTuple):
+    """Host-offload knobs threaded through the custom_vjp wrappers
+    (hashable — nondiff custom_vjp args must be). ``activations`` puts
+    the executor's input/dy rings in host memory
+    (swap_tensor/host_stage.py resolves the platform's memory kind;
+    identity when the platform has a single memory space)."""
+    activations: bool = False
+    double_buffer: bool = True
+
+
+def _grads_fn(schedule):
+    if schedule == "zb":
+        return pipeline_zb_grads
+    if schedule == "1f1b":
+        return pipeline_1f1b_grads
+    raise ValueError(f"unknown steady-state pipeline schedule "
+                     f"{schedule!r} (want '1f1b' or 'zb')")
+
+
+@_functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3, 4))
+def pipeline_loss(block_fn, head_loss_fn, pipe_axis, schedule, offload,
+                  layers_params, layers_aux, head_params, x_mb, tgt_mb):
+    """Differentiable wrapper over the steady-state executors: returns
+    the mean microbatch loss; ``jax.grad`` through it yields the grads
+    the pipelined pass already computed (stored as vjp residuals), so
+    the engine's ordinary value_and_grad drives the schedule unchanged.
+    ``schedule``: '1f1b' | 'zb'; ``offload``: PipeOffload or None."""
+    kw = {"offload": offload} if schedule == "zb" else {}
+    loss, _ = _grads_fn(schedule)(
         block_fn, head_loss_fn, layers_params, layers_aux, head_params,
-        x_mb, tgt_mb, pipe_axis=pipe_axis)
+        x_mb, tgt_mb, pipe_axis=pipe_axis, **kw)
     return loss
 
 
-def _pl_fwd(block_fn, head_loss_fn, pipe_axis, layers_params, layers_aux,
-            head_params, x_mb, tgt_mb):
-    loss, (dl, dh, dx) = pipeline_1f1b_grads(
+def _pl_fwd(block_fn, head_loss_fn, pipe_axis, schedule, offload,
+            layers_params, layers_aux, head_params, x_mb, tgt_mb):
+    kw = {"offload": offload} if schedule == "zb" else {}
+    loss, (dl, dh, dx) = _grads_fn(schedule)(
         block_fn, head_loss_fn, layers_params, layers_aux, head_params,
-        x_mb, tgt_mb, pipe_axis=pipe_axis)
+        x_mb, tgt_mb, pipe_axis=pipe_axis, **kw)
     # the int-dtype primals ride along so the bwd rule can shape their
     # float0 cotangents
     return loss, (dl, dh, dx, layers_aux, tgt_mb)
 
 
-def _pl_bwd(block_fn, head_loss_fn, pipe_axis, res, g):
+def _pl_bwd(block_fn, head_loss_fn, pipe_axis, schedule, offload, res, g):
     dl, dh, dx, layers_aux, tgt_mb = res
     scale = lambda tr: jax.tree.map(lambda a: (a * g).astype(a.dtype), tr)
     f0 = lambda tr: jax.tree.map(
@@ -436,4 +797,13 @@ def _pl_bwd(block_fn, head_loss_fn, pipe_axis, res, g):
     return (scale(dl), f0(layers_aux), scale(dh), scale(dx), f0(tgt_mb))
 
 
-pipeline_1f1b_loss.defvjp(_pl_fwd, _pl_bwd)
+pipeline_loss.defvjp(_pl_fwd, _pl_bwd)
+
+
+def pipeline_1f1b_loss(block_fn, head_loss_fn, pipe_axis, layers_params,
+                       layers_aux, head_params, x_mb, tgt_mb):
+    """Back-compat alias: the 1F1B schedule through the generic
+    :func:`pipeline_loss` wrapper."""
+    return pipeline_loss(block_fn, head_loss_fn, pipe_axis, "1f1b", None,
+                         layers_params, layers_aux, head_params, x_mb,
+                         tgt_mb)

@@ -1,1 +1,2 @@
 from .swapper import AsyncTensorSwapper, OptimizerStateSwapper
+from . import host_stage

@@ -54,9 +54,9 @@ class HostOffloadOptimizer:
     the update with the native CPU Adam worker pool.
 
     step(host_grads, lr, on_leaf) walks the leaves; ``on_leaf(path,
-    master_leaf)`` fires after each leaf's update so the caller can push
-    the refreshed (bf16) leaf back to the device while the next leaf's
-    NVMe reads are in flight."""
+    master_flat, shape)`` fires after each leaf's update so the caller
+    can push the refreshed (bf16) leaf back to the device while the
+    next leaf's NVMe reads are in flight."""
 
     def __init__(self, master_tree, opt_config, offload_opt_cfg,
                  offload_param_cfg=None, num_threads=8):
@@ -174,16 +174,12 @@ class HostOffloadOptimizer:
     # --------------------------------------------------------- checkpointing
     def master_tree(self):
         """Full fp32 master as a nested tree (reads from NVMe if tiered)."""
-        it = iter(self._paths)
-
-        def take(shape_path):
-            path = next(it)
-            shape = self._shapes[path]
+        def take(path):
             if self.master_nvme:
                 flat = self._swapper.swap_in(self._key(path, "w"))
             else:
                 flat = _get_path(self.master, path).reshape(-1)
-            return flat.reshape(shape).copy()
+            return flat.reshape(self._shapes[path]).copy()
         return self._map_structure(take)
 
     def state_tree(self):
@@ -191,10 +187,7 @@ class HostOffloadOptimizer:
         the checkpointable optimizer state (reads back from NVMe when
         tiered)."""
         def fetch(which):
-            it = iter(self._paths)
-
-            def take(_):
-                path = next(it)
+            def take(path):
                 if self.state_nvme:
                     flat = self._swapper.swap_in(self._key(path, which))
                 else:
@@ -230,8 +223,9 @@ class HostOffloadOptimizer:
             self._swapper.wait()
 
     def _map_structure(self, take):
-        """Rebuild the nested master structure calling take(path) in
-        _leaf_paths order."""
+        """Rebuild the nested master structure: ``take(path)`` is
+        called with each _leaf_paths path (the callbacks above resolve
+        their own storage from it — no stateful parallel iteration)."""
         def build(paths, depth):
             heads = {}
             for p in paths:

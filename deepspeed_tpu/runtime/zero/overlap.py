@@ -378,6 +378,41 @@ def parse_collectives(hlo_text):
     return out
 
 
+# host-staging copies: the XLA host-offload pass legalizes memory-kind
+# transfers (pipeline activation rings, moment placement) into
+# copy-start/copy-done pairs whose shapes carry the host memory space
+# marker S(5). CPU has a single memory space, so these only appear on
+# TPU/GPU programs.
+_COPY_LINE_RE = re.compile(
+    r"%[\w.\-]+\s*=\s*.*?\scopy(-start|-done)?(?:\.\d+)?\(")
+_HOST_SPACE_RE = re.compile(r"S\(5\)")
+
+
+def parse_host_copies(hlo_text):
+    """Copy ops whose shapes carry the host memory space (S(5)) — the
+    staging traffic host offload generates. Returns dicts
+    {phase, computation, in_loop, line} like parse_collectives."""
+    out = []
+    bodies = set()
+    cur = None
+    for line in hlo_text.splitlines():
+        mc = _COMP_RE.match(line)
+        if mc and line.rstrip().endswith("{"):
+            cur = mc.group(1)
+        for mb in _WHILE_BODY_RE.finditer(line):
+            bodies.add(mb.group(1))
+        m = _COPY_LINE_RE.search(line)
+        if m and _HOST_SPACE_RE.search(line):
+            out.append({
+                "phase": (m.group(1) or "").lstrip("-") or None,
+                "computation": cur,
+                "line": line.strip(),
+            })
+    for c in out:
+        c["in_loop"] = c["computation"] in bodies
+    return out
+
+
 def count_async_pairs(collectives):
     """Matched ``*-start``/``*-done`` pairs per collective op kind."""
     pairs = 0
@@ -441,15 +476,24 @@ def overlap_report(hlo_text, mesh=None):
     for c in colls:
         if c["in_loop"]:
             in_loop_by_op[c["op"]] = in_loop_by_op.get(c["op"], 0) + 1
+    # host staging traffic (pipeline ring offload / moment placement):
+    # S(5)-space copies, async pairs counted like the collectives
+    copies = parse_host_copies(hlo_text)
+    copy_starts = sum(1 for c in copies if c["phase"] == "start")
+    copy_dones = sum(1 for c in copies if c["phase"] == "done")
     return {
         "n_collectives": len(colls),
         "async_pairs": count_async_pairs(colls),
         "in_loop": sum(1 for c in colls if c["in_loop"]),
         # per-op in-(scan)-loop counts: a ring-attention step reports its
         # KV rotation here as 'collective-permute' (engine
-        # verify_comm_overlap's acceptance signal for the overlap)
+        # verify_comm_overlap's acceptance signal for the overlap); a
+        # pipelined step its stage rotation
         "in_loop_by_op": in_loop_by_op,
         "ops": sorted({c["op"] for c in colls}),
         "axes": sorted({tuple(a) for a in axes}),
+        "host_copies": len(copies),
+        "host_copy_async_pairs": min(copy_starts, copy_dones),
+        "in_loop_host_copies": sum(1 for c in copies if c["in_loop"]),
         "collectives": colls,
     }

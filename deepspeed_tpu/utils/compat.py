@@ -81,5 +81,23 @@ def install():
         if not hasattr(jax.tree, "map_with_path"):
             jax.tree.map_with_path = _tu.tree_map_with_path
 
+    if not hasattr(jax.lax, "pvary"):   # vma-era marker: legacy check_rep
+        # legacy shard_map's check_rep registry predates a rule for the
+        # remat-policy `name` primitive (jax.ad_checkpoint.
+        # checkpoint_name — a pure identity tag), so any model using
+        # named remat policies failed to trace inside a partial-manual
+        # region ("No replication rule for name"). The standard
+        # (replication-intersection) rule is exactly right for an
+        # identity; registering it makes the pipe-only-mesh pipeline
+        # executors traceable on legacy jaxlib.
+        try:
+            from jax._src.ad_checkpoint import name_p
+            from jax.experimental import shard_map as _esm
+            if name_p not in _esm._check_rules:
+                _esm.register_standard_check(name_p)
+                _esm.register_standard_rewrite(name_p)
+        except Exception:  # noqa: BLE001 - internals moved; vma-era jax
+            pass           # has its own rule anyway
+
 
 install()

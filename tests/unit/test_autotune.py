@@ -43,6 +43,7 @@ _BUCKETS = {
     "moe_grouped_mm": "S128,E4,M128,F256",
     "paged_decode": "B4,MB4,BS16,kh2,g2,d32",
     "paged_chunk": "C16,MB4,BS16,kh2,g2,d32",
+    "pipe_microbatch": "S2,B8,T128,D128",
 }
 
 

@@ -348,3 +348,84 @@ def test_checkpoint_hot_tier_validation():
         with pytest.raises(DeepSpeedConfigError):
             DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
                              "checkpoint_engine": bad})
+
+
+def test_pipeline_block_defaults():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1})
+    p = cfg.pipeline
+    assert (p.stages, p.micro_batches, p.schedule) == (1, 0, "auto")
+    assert p.offload_activations == "auto"
+    assert p.offload_moments == "auto"
+    assert p.offload_double_buffer is True
+    # 'auto' schedule defers to the model knob; explicit block wins
+    assert p.resolve_schedule("1f1b") == "1f1b"
+    assert p.resolve_schedule(None) == "gpipe"
+
+
+def test_pipeline_block_parses_and_roundtrips():
+    raw = {"train_micro_batch_size_per_gpu": 1,
+           "pipeline": {"stages": 4, "micro_batches": 8,
+                        "schedule": "zb",
+                        "offload_activations": True,
+                        "offload_moments": False,
+                        "offload_double_buffer": False}}
+    cfg = DeepSpeedConfig(raw)
+    p = cfg.pipeline
+    assert (p.stages, p.micro_batches, p.schedule) == (4, 8, "zb")
+    assert p.offload_activations is True
+    assert p.offload_double_buffer is False
+    assert p.resolve_schedule("gpipe") == "zb"   # explicit wins
+    # dict round trip preserves the block
+    again = DeepSpeedConfig(cfg.to_dict())
+    assert again.pipeline.schedule == "zb"
+    assert again.pipeline.micro_batches == 8
+
+
+def test_pipeline_block_validation():
+    for bad in ({"schedule": "zb2"}, {"offload_activations": "yes"},
+                {"offload_moments": 2}, {"micro_batches": -1},
+                {"stages": 0}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                             "pipeline": bad})
+
+
+def test_pipeline_offload_auto_resolution():
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1})
+    p = cfg.pipeline
+    # the three 'auto' gates: host kind available, pipe axis present,
+    # HBM-fit heuristic says the state does NOT fit
+    big, hbm = 40 << 30, 16 << 30
+    assert p.resolve_offload_activations(
+        True, pipe_world=2, est_state_bytes=big, hbm_bytes=hbm) is True
+    assert p.resolve_offload_activations(
+        True, pipe_world=1, est_state_bytes=big, hbm_bytes=hbm) is False
+    assert p.resolve_offload_activations(
+        False, pipe_world=2, est_state_bytes=big, hbm_bytes=hbm) is False
+    assert p.resolve_offload_activations(
+        True, pipe_world=2, est_state_bytes=1 << 30,
+        hbm_bytes=hbm) is False
+    # unknown sizes never turn offload on blind
+    assert p.resolve_offload_activations(
+        True, pipe_world=2) is False
+    # explicit true wins regardless (host_stage degrades to identity
+    # on single-memory-space backends)
+    forced = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 1,
+         "pipeline": {"offload_activations": True}}).pipeline
+    assert forced.resolve_offload_activations(False) is True
+    # moments: 'auto' stays off; explicit true needs the backend kind
+    assert p.resolve_offload_moments(True) is False
+    forced_m = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 1,
+         "pipeline": {"offload_moments": True}}).pipeline
+    assert forced_m.resolve_offload_moments(True) is True
+    assert forced_m.resolve_offload_moments(False) is False
+
+
+def test_pipeline_hbm_fits():
+    from deepspeed_tpu.runtime.config import PipelineConfig
+    assert PipelineConfig.hbm_fits(None, 16 << 30)
+    assert PipelineConfig.hbm_fits(1 << 30, None)
+    assert PipelineConfig.hbm_fits(10 << 30, 16 << 30)
+    assert not PipelineConfig.hbm_fits(15 << 30, 16 << 30)  # 0.8 margin
