@@ -389,8 +389,15 @@ def main():
     autotune_info = {"cache_path": None, "table": {}}
     try:
         from deepspeed_tpu.autotuning import kernel_dispatch
+        dk = kernel_dispatch.device_kind()
         autotune_info = {"cache_path": kernel_dispatch.cache_path(),
-                         "table": kernel_dispatch.table()}
+                         "table": kernel_dispatch.table(),
+                         # the device-kind refusal rule, made legible in
+                         # the artifact itself: winners measured on CPU
+                         # (interpret-mode emulation) exercise code paths
+                         # but must never steer a real TPU's defaults
+                         "device_kind": dk,
+                         "cpu_artifact": dk.lower() == "cpu"}
     except Exception as e:          # report, don't hide the bench
         autotune_info["error"] = f"{type(e).__name__}: {e}"[:200]
 

@@ -212,6 +212,68 @@ def bench(sizes_mb, trials=10, axis="data", outer_axis="data_outer",
     return results
 
 
+def _fit_alpha_beta(rows, shards):
+    """(alpha_s, beta_Bps) from a payload sweep of one op: two-point fit
+    of ``t = alpha + bytes/beta`` on the smallest and largest measured
+    payloads (per-shard bytes — the wire a single link carries)."""
+    pts = sorted((r["mb"] * 1e6 / max(1, shards), r["ms"] * 1e-3)
+                 for r in rows if "ms" in r and r["ms"] > 0)
+    if not pts:
+        return None
+    (b0, t0), (b1, t1) = pts[0], pts[-1]
+    if b1 > b0 and t1 > t0:
+        beta = (b1 - b0) / (t1 - t0)
+        alpha = max(0.0, t0 - b0 / beta)
+    else:
+        beta = b1 / t1
+        alpha = 0.0
+    return alpha, beta
+
+
+def cache_rows(results, mesh=None, axis="data", outer_axis="data_outer"):
+    """Winner-cache entry rows distilled from a bench() sweep: one
+    ``comm_link`` pseudo-op row per link class, in the exact shape
+    ``autotuning.kernel_cache.seed_entries`` ingests. The ICI row fits
+    alpha-beta from the ppermute sweep (neighbor exchange — the purest
+    single-link measure); the DCN row from the hierarchical
+    all_to_all_flat sweep when --outer carved a cross-slice axis.
+    ``comm_link`` rows live in the cache file only — never in the op
+    REGISTRY — so dispatch ignores them; the planner's
+    ``calibrate_links`` is their sole reader."""
+    from deepspeed_tpu.ops.pallas._common import topo_signature
+    from deepspeed_tpu.autotuning.kernel_dispatch import device_kind
+    mesh = mesh if mesh is not None else groups.get_mesh()
+    shape = dict(mesh.shape)
+    W = shape.get(axis, 1)
+    Wo = shape.get(outer_axis, 1)
+    topo = topo_signature(mesh)
+    by_op = {}
+    for r in results:
+        by_op.setdefault(r.get("op"), []).append(r)
+    rows = []
+    for kind, op_name, shards in (("ici", "ppermute", W),
+                                  ("dcn", "all_to_all_flat", W * Wo)):
+        fit = _fit_alpha_beta(by_op.get(op_name, []), shards)
+        if fit is None:
+            continue
+        alpha, beta = fit
+        best = max((r for r in by_op[op_name] if "busbw_gbps" in r),
+                   key=lambda r: r["mb"], default=None)
+        rows.append({
+            "device_kind": device_kind(), "op": "comm_link",
+            "bucket": f"{topo},k{kind}", "dtype": "float32",
+            "params": {
+                "kind": kind,
+                "alpha_us": round(alpha * 1e6, 3),
+                "beta_gbps": round(beta / 1e9, 3),
+                "busbw_gbps": (best or {}).get("busbw_gbps"),
+                "source": op_name,
+            },
+            "measured_ms": (best or {}).get("ms"),
+        })
+    return rows
+
+
 def overlap_probe(mb=16, trials=10, axis="data", chain=16, dim=1024,
                   out=sys.stdout):
     """Hidden-vs-exposed collective time: time (a) a matmul chain alone,
@@ -285,6 +347,12 @@ def main():
                          "pipe axis")
     ap.add_argument("--json", action="store_true",
                     help="one JSON line on stdout (table -> stderr)")
+    ap.add_argument("--seed-cache", action="store_true",
+                    help="merge the distilled comm_link alpha-beta rows "
+                         "into the kernel winner cache "
+                         "(DSTPU_AUTOTUNE_CACHE or the default path) so "
+                         "the auto-parallelism planner calibrates from "
+                         "measured link speeds")
     ap.add_argument("--overlap-mb", type=float, default=16,
                     help="overlap probe payload (0 disables the probe)")
     args = ap.parse_args()
@@ -318,12 +386,19 @@ def main():
         except Exception as e:  # noqa: BLE001
             overlap = {"error": f"{type(e).__name__}: {e}"[:200]}
             print(f"overlap probe FAIL {e}", file=out)
+    rows = cache_rows(results, axis=args.axis)
+    if args.seed_cache:
+        from deepspeed_tpu.autotuning.kernel_cache import seed_entries
+        from deepspeed_tpu.autotuning.kernel_dispatch import cache_path
+        n = seed_entries(rows)
+        print(f"seeded {n} comm_link row(s) -> {cache_path()}", file=out)
     if args.json:
         print(json.dumps({
             "mesh": dict(groups.get_mesh().shape),
             "axis": args.axis,
             "trials": args.trials,
             "results": results,
+            "cache_rows": rows,
             "overlap": overlap,
         }))
 

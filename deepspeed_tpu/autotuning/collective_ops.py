@@ -1,0 +1,523 @@
+"""Collective-bearing tunable ops (the kernel registry generalized).
+
+PR 4's registry tuned Pallas kernels; this module registers the
+COLLECTIVE and SCHEDULE knobs that stayed hand-set through five PRs —
+comm_overlap.bucket_mb, hierarchical grad staging, dcn_quantize, the
+ring KV-rotation chunking, the prefetch scan unroll, and the hot-tier
+replica count — as first-class registry ops with the exact same
+contract (defaults / candidates / make_step / parity, see
+kernel_registry's module docstring).
+
+What changes vs the kernel ops:
+
+  * step builders run under a MESH. ``_fit_mesh`` carves the bucket's
+    topology signature out of the available device pool (a tier-1 CPU
+    run gets the all-ones mesh, where every collective degrades to
+    loopback/identity but the pattern still traces and times), so one
+    registry serves both the virtual-mesh CI and a real pod search.
+  * winners are cached per (device_kind, topology-signature,
+    shape-bucket): the mesh shape is folded into the bucket STRING by
+    the ``ops/pallas/_common`` collective bucket builders, so the cache
+    file format, the CACHE_VERSION, and the device-kind refusal rule
+    are all untouched.
+  * ``comm_bench --json`` emits rows in the cache entry format for the
+    staging/quantize ops (flat vs two-stage all_to_all, the int8 DCN
+    leg), so one driver comm_bench run seeds these winners — and the
+    planner's alpha-beta link calibration — without a separate search.
+
+Every op's defaults reproduce the current hand-set config values, so a
+cold cache keeps dispatch byte-identical to the pre-registry programs
+(the PR 4/6/8 contract, asserted in tests/unit/test_planner.py).
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from .kernel_registry import _EPS, _close, _dedup
+
+# per-layer emulation width for the gradient-collective steps: enough
+# rows that the reduce has a real payload, small enough that a search
+# step stays affordable on one chip
+_MAX_ELEMS = 1 << 16
+
+
+def _fit_axis(n_avail, want):
+    """Largest divisor of ``n_avail`` that is <= ``want`` — the axis
+    size the device pool can actually carve."""
+    w = min(max(1, int(want)), n_avail)
+    while n_avail % w:
+        w -= 1
+    return w
+
+
+def _fit_mesh(axes):
+    """Mesh over the available devices approximating the bucket's
+    topology signature: each requested axis is clamped to what the
+    remaining pool factors (single-chip runs get all-ones — collectives
+    become loopback but the program shape is the candidate's)."""
+    devs = jax.devices()
+    n = len(devs)
+    sizes = []
+    for _, want in axes:
+        s = _fit_axis(n, want)
+        sizes.append(s)
+        n //= s
+    arr = np.array(devs[: math.prod(sizes)]).reshape(sizes)
+    return Mesh(arr, tuple(name for name, _ in axes))
+
+
+def _grad_elems(b, per_axis=1):
+    """Per-layer gradient payload (elements) for the L-MB bucket,
+    capped, rounded to a multiple of ``per_axis`` (shard divisibility)."""
+    n = max(256, min((int(b.get("L", 1)) << 20) // 4, _MAX_ELEMS))
+    return -(-n // per_axis) * per_axis
+
+
+# ------------------------------------------------- comm_overlap.bucket_mb
+# The layer-granular reduce gate (runtime/zero/overlap.py): a scan layer
+# whose grad bytes are below bucket_mb emits no in-scan collective (its
+# reduction coalesces into the post-backward one). The candidate changes
+# WHERE the reduce lands, never the math — a mean is linear, so the
+# per-layer and the coalesced reductions agree exactly (the parity).
+
+_CB_LAYERS = 4
+
+
+def _cb_defaults(b):
+    return {"bucket_mb": 32}
+
+
+def _cb_candidates(b):
+    return _dedup([_cb_defaults(b)] + [{"bucket_mb": m}
+                                       for m in (0, 8, 32, 128)])
+
+
+def _cb_reduce(b, dtype, params):
+    mesh = _fit_mesh([("data", b.get("dp", 1))])
+    W = mesh.shape["data"]
+    n = _grad_elems(b, W)
+    layer_bytes = (n // W) * jnp.dtype(dtype).itemsize
+    bucket_bytes = int(params["bucket_mb"]) << 20
+    in_scan = bucket_bytes == 0 or layer_bytes >= bucket_bytes
+
+    def body(x):
+        acc = jnp.zeros_like(x)
+        g = x
+        for _ in range(_CB_LAYERS):
+            g = jnp.tanh(g * 1.0005)
+            acc = acc + (lax.pmean(g, "data") if in_scan else g)
+        return acc if in_scan else lax.pmean(acc, "data")
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("data"),
+                       out_specs=P("data"), check_vma=False)
+    x0 = (jax.random.normal(jax.random.key(0), (n,), jnp.float32)
+          * 0.3).astype(dtype)
+    return fn, x0
+
+
+def _cb_step(b, dtype, params):
+    fn, x0 = _cb_reduce(b, dtype, params)
+
+    def step(x):
+        return x + _EPS * fn(x)
+
+    return step, x0
+
+
+def _cb_parity(b, dtype, params):
+    got_fn, x0 = _cb_reduce(b, dtype, params)
+    ref_fn, _ = _cb_reduce(b, dtype, {"bucket_mb": 32})
+    _close(got_fn(x0), ref_fn(x0),
+           f"comm_bucket tuned {params}", dict(rtol=1e-5, atol=1e-5))
+
+
+# --------------------------------------------- comm_overlap.hierarchical
+# Two-stage grad reduction (ZeRO++/MiCS): reduce-scatter over the inner
+# ICI 'data' axis, cross-slice mean over 'data_outer' (DCN), gather
+# back — vs the flat mean over both axes. Same value either way (the
+# parity); which is faster is a measured property of the ICI/DCN links.
+
+
+def _gs_defaults(b):
+    # the CommOverlapConfig.resolve_hierarchical heuristic: stage iff
+    # the mesh has a cross-slice axis — cold cache == today's 'auto'
+    return {"hierarchical": int(b.get("do", 1) > 1)}
+
+
+def _gs_candidates(b):
+    return _dedup([_gs_defaults(b), {"hierarchical": 0},
+                   {"hierarchical": 1}])
+
+
+def _gs_reduce(b, dtype, params):
+    mesh = _fit_mesh([("data_outer", b.get("do", 1)),
+                      ("data", b.get("dp", 1))])
+    W = mesh.shape["data"]
+    Wo = mesh.shape["data_outer"]
+    n = _grad_elems(b, W * Wo * W)      # scatter needs local % W == 0
+
+    def body(x):
+        if params["hierarchical"]:
+            s = lax.psum_scatter(x, "data", scatter_dimension=0,
+                                 tiled=True) / W
+            s = lax.pmean(s, "data_outer")
+            return lax.all_gather(s, "data", axis=0, tiled=True)
+        return lax.pmean(x, ("data_outer", "data"))
+
+    fn = jax.shard_map(body, mesh=mesh,
+                       in_specs=P(("data_outer", "data")),
+                       out_specs=P(("data_outer", "data")),
+                       check_vma=False)
+    x0 = (jax.random.normal(jax.random.key(1), (n,), jnp.float32)
+          * 0.3).astype(dtype)
+    return fn, x0
+
+
+def _gs_step(b, dtype, params):
+    fn, x0 = _gs_reduce(b, dtype, params)
+
+    def step(x):
+        return x + _EPS * fn(x)
+
+    return step, x0
+
+
+def _gs_parity(b, dtype, params):
+    got_fn, x0 = _gs_reduce(b, dtype, params)
+    ref_fn, _ = _gs_reduce(b, dtype, {"hierarchical": 0})
+    _close(got_fn(x0), ref_fn(x0),
+           f"grad_staging tuned {params}", dict(rtol=1e-5, atol=1e-5))
+
+
+# ------------------------------------------------- moe.hierarchical_a2a
+# The EP exchange: flat single-hop all_to_all over the combined
+# (data_outer, expert) grid vs the staged ICI -> DCN pair
+# (moe/sharded_moe.py). The step runs the full dispatch/combine round
+# trip (exchange, expert compute, inverse exchange) so a candidate is
+# priced the way the MoE layer pays it.
+
+
+def _a2a_defaults(b):
+    # resolve_hierarchical_a2a's 'auto': stage iff a cross-slice axis
+    # exists (the divisibility gate stays at the consumption site)
+    return {"staged": int(b.get("do", 1) > 1)}
+
+
+def _a2a_candidates(b):
+    return _dedup([_a2a_defaults(b), {"staged": 0}, {"staged": 1}])
+
+
+def _a2a_exchange(b, dtype, params):
+    mesh = _fit_mesh([("data_outer", b.get("do", 1)),
+                      ("expert", b.get("ep", 1))])
+    ep = mesh.shape["expert"]
+    wo = mesh.shape["data_outer"]
+    grid = ep * wo
+    M = max(8, int(b.get("M", 64)))
+    rows = max(grid * grid,
+               min(int(b.get("S", 256)), _MAX_ELEMS // M)
+               // (grid * grid) * (grid * grid))
+
+    def body(x):
+        loc = x.shape[0]
+        if params["staged"]:
+            xb = x.reshape(ep, wo, loc // grid, M)
+            xb = lax.all_to_all(xb, "expert", 0, 0, tiled=False)
+            xb = lax.all_to_all(xb, "data_outer", 1, 1, tiled=False)
+            y = jnp.tanh(xb * 1.0005)
+            y = lax.all_to_all(y, "data_outer", 1, 1, tiled=False)
+            y = lax.all_to_all(y, "expert", 0, 0, tiled=False)
+            return y.reshape(loc, M)
+        xb = x.reshape(grid, loc // grid, M)
+        xb = lax.all_to_all(xb, ("data_outer", "expert"), 0, 0,
+                            tiled=False)
+        y = jnp.tanh(xb * 1.0005)
+        y = lax.all_to_all(y, ("data_outer", "expert"), 0, 0,
+                           tiled=False)
+        return y.reshape(loc, M)
+
+    spec = P(("data_outer", "expert"))
+    fn = jax.shard_map(body, mesh=mesh, in_specs=spec, out_specs=spec,
+                       check_vma=False)
+    x0 = (jax.random.normal(jax.random.key(2), (rows, M), jnp.float32)
+          * 0.3).astype(dtype)
+    return fn, x0
+
+
+def _a2a_step(b, dtype, params):
+    fn, x0 = _a2a_exchange(b, dtype, params)
+
+    def step(x):
+        return x + _EPS * fn(x)
+
+    return step, x0
+
+
+def _a2a_parity(b, dtype, params):
+    """Both routes are exchange/compute/inverse-exchange round trips:
+    the result must equal the locally-computed tanh regardless of the
+    staging (tokens come home to the rows they left)."""
+    fn, x0 = _a2a_exchange(b, dtype, params)
+    _close(fn(x0), jnp.tanh(x0.astype(jnp.float32) * 1.0005),
+           f"a2a_staging tuned {params}", dict(rtol=1e-5, atol=1e-5))
+
+
+# ------------------------------------------------------- dcn_quantize
+# qgZ int8 block round trip on the cross-slice (DCN) payload
+# (comm/quantized.dcn_precision_clamp). Lossy by design: the parity
+# bound is the int8 block-quantization error, not exactness.
+
+
+def _dq_defaults(b):
+    return {"quantize": 0}
+
+
+def _dq_candidates(b):
+    return _dedup([_dq_defaults(b), {"quantize": 1}])
+
+
+def _dq_reduce(b, dtype, params):
+    from ..comm.quantized import dcn_precision_clamp
+    mesh = _fit_mesh([("data_outer", b.get("do", 1))])
+    Wo = mesh.shape["data_outer"]
+    n = -(-_grad_elems(b) // (2048 * Wo)) * (2048 * Wo)
+
+    def body(x):
+        g = x
+        if params["quantize"]:
+            g = dcn_precision_clamp(g)
+        return lax.pmean(g, "data_outer")
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P("data_outer"),
+                       out_specs=P("data_outer"), check_vma=False)
+    x0 = (jax.random.normal(jax.random.key(3), (n,), jnp.float32)
+          * 0.3).astype(dtype)
+    return fn, x0
+
+
+def _dq_step(b, dtype, params):
+    fn, x0 = _dq_reduce(b, dtype, params)
+
+    def step(x):
+        return x + _EPS * fn(x)
+
+    return step, x0
+
+
+def _dq_parity(b, dtype, params):
+    got_fn, x0 = _dq_reduce(b, dtype, params)
+    ref_fn, _ = _dq_reduce(b, dtype, {"quantize": 0})
+    tol = (dict(rtol=0.1, atol=0.1) if params.get("quantize")
+           else dict(rtol=1e-6, atol=1e-6))
+    _close(got_fn(x0), ref_fn(x0), f"dcn_quantize tuned {params}", tol)
+
+
+# ------------------------------------------------ sequence.rotate_chunks
+# The ring-attention KV rotation (sequence/ring.py _rotate): one fused
+# ppermute of the stacked KV buffer vs splitting it into n chunked
+# ppermutes so the first chunk's landing overlaps the rest of the wire
+# time. chunks=1 is bit-for-bit the pre-knob single-ppermute program.
+
+
+def _rr_defaults(b):
+    return {"chunks": 1}
+
+
+def _rr_candidates(b):
+    return _dedup([_rr_defaults(b)] + [{"chunks": c} for c in (1, 2, 4)
+                                       if int(b.get("d", 64)) % c == 0])
+
+
+def _rr_rotate(b, dtype, params):
+    from ..sequence.ring import _rotate
+    mesh = _fit_mesh([("seq", b.get("R", 1))])
+    R = mesh.shape["seq"]
+    T = max(8, min(int(b.get("T", 128)), 512))
+    d = int(b.get("d", 64))
+    chunks = int(params["chunks"])
+    perm = [(j, (j + 1) % R) for j in range(R)]
+
+    def body(kv):
+        def ring_step(c, _):
+            c = _rotate(c, "seq", perm, chunks)
+            return jnp.tanh(c * 1.0005), None
+
+        out, _ = lax.scan(ring_step, kv, None, length=max(R - 1, 1))
+        return out
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=P(None, None, "seq"),
+                       out_specs=P(None, None, "seq"), check_vma=False)
+    kv0 = (jax.random.normal(jax.random.key(4), (2, T, R * d),
+                             jnp.float32) * 0.3).astype(dtype)
+    return fn, kv0
+
+
+def _rr_step(b, dtype, params):
+    fn, kv0 = _rr_rotate(b, dtype, params)
+
+    def step(kv):
+        return kv + _EPS * fn(kv)
+
+    return step, kv0
+
+
+def _rr_parity(b, dtype, params):
+    """Chunked rotation is a pure data-movement refactor: it must equal
+    the single fused ppermute EXACTLY."""
+    got_fn, kv0 = _rr_rotate(b, dtype, params)
+    ref_fn, _ = _rr_rotate(b, dtype, {"chunks": 1})
+    _close(got_fn(kv0), ref_fn(kv0),
+           f"ring_rotate tuned {params}", dict(rtol=0, atol=0))
+
+
+# --------------------------------------------- comm_overlap.scan_unroll
+# The prefetch unroll hint (engine._install_comm_overlap -> gpt2's
+# layer scan): more bodies per scan iteration give the ZeRO-3 layer
+# gather more matmuls to hide under, at compile-time/code-size cost.
+# Mathematically the identity transform (the parity).
+
+
+def _su_defaults(b):
+    return {"unroll": 2}
+
+
+def _su_candidates(b):
+    return _dedup([_su_defaults(b)] + [{"unroll": u} for u in (1, 2, 4)])
+
+
+def _su_run(b, dtype, params):
+    N = max(2, min(int(b.get("N", 4)), 12))
+    D = max(32, min(int(b.get("D", 128)), 256))
+    u = max(1, int(params["unroll"]))
+    ks = jax.random.split(jax.random.key(5), 2)
+    x0 = (jax.random.normal(ks[0], (64, D), jnp.float32) * 0.3) \
+        .astype(dtype)
+    w = (jax.random.normal(ks[1], (D, D), jnp.float32)
+         / math.sqrt(D)).astype(dtype)
+
+    def loss(y, w):
+        return jnp.sum(jnp.tanh(y @ w).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss)
+
+    def run(x, w):
+        def layer(c, _):
+            return c + _EPS * g(c, w).astype(c.dtype), None
+
+        y, _ = lax.scan(layer, x, None, length=N, unroll=min(u, N))
+        return y
+
+    return run, x0, w
+
+
+def _su_step(b, dtype, params):
+    run, x0, w = _su_run(b, dtype, params)
+
+    def step(carry):
+        x, w_ = carry
+        return (run(x, w_), w_)
+
+    return step, (x0, w)
+
+
+def _su_parity(b, dtype, params):
+    """Unroll changes code shape, not the op sequence: the unrolled
+    scan must equal the unroll=1 scan exactly."""
+    run, x0, w = _su_run(b, dtype, params)
+    ref, _, _ = _su_run(b, dtype, {"unroll": 1})
+    _close(run(x0, w), ref(x0, w),
+           f"scan_unroll tuned {params}", dict(rtol=0, atol=0))
+
+
+# ------------------------------------------ checkpoint_engine.hot_replicas
+# The hot-tier replication factor K (checkpoint_engine/hot_tier.py):
+# each save pushes K ring-neighbor replicas of the shard. The step
+# prices the per-save host staging round trips a candidate K costs
+# (swap_tensor/host_stage — identity on single-memory-space backends,
+# the same degrade the tier itself has).
+
+
+def _hr_defaults(b):
+    return {"k": 1}
+
+
+def _hr_candidates(b):
+    return _dedup([_hr_defaults(b)] + [{"k": k} for k in (0, 1, 2)])
+
+
+def _hr_step(b, dtype, params):
+    from ..runtime.swap_tensor import host_stage
+    n = max(1024, min((int(b.get("G", 1)) << 20) // 4, _MAX_ELEMS))
+    k = max(0, int(params["k"]))
+    x0 = (jax.random.normal(jax.random.key(6), (n,), jnp.float32)
+          * 0.3).astype(dtype)
+
+    def step(x):
+        acc = x
+        for _ in range(k):
+            acc = host_stage.to_device(host_stage.to_host(acc))
+        return jnp.tanh(acc * 1.0005)
+
+    return step, x0
+
+
+def _hr_parity(b, dtype, params):
+    from ..runtime.swap_tensor import host_stage
+    x = jax.random.normal(jax.random.key(7), (256,), dtype)
+    for _ in range(max(0, int(params["k"]))):
+        x2 = host_stage.to_device(host_stage.to_host(x))
+        _close(x2, x, f"hot_replicas staging round trip {params}",
+               dict(rtol=0, atol=0))
+
+
+# ---------------------------------------------------------------- table
+COLLECTIVE_REGISTRY = {
+    "comm_bucket": {
+        "defaults": _cb_defaults,
+        "candidates": _cb_candidates,
+        "make_step": _cb_step,
+        "parity": _cb_parity,
+    },
+    "grad_staging": {
+        "defaults": _gs_defaults,
+        "candidates": _gs_candidates,
+        "make_step": _gs_step,
+        "parity": _gs_parity,
+    },
+    "a2a_staging": {
+        "defaults": _a2a_defaults,
+        "candidates": _a2a_candidates,
+        "make_step": _a2a_step,
+        "parity": _a2a_parity,
+    },
+    "dcn_quantize": {
+        "defaults": _dq_defaults,
+        "candidates": _dq_candidates,
+        "make_step": _dq_step,
+        "parity": _dq_parity,
+    },
+    "ring_rotate": {
+        "defaults": _rr_defaults,
+        "candidates": _rr_candidates,
+        "make_step": _rr_step,
+        "parity": _rr_parity,
+    },
+    "scan_unroll": {
+        "defaults": _su_defaults,
+        "candidates": _su_candidates,
+        "make_step": _su_step,
+        "parity": _su_parity,
+    },
+    "hot_replicas": {
+        "defaults": _hr_defaults,
+        "candidates": _hr_candidates,
+        "make_step": _hr_step,
+        "parity": _hr_parity,
+    },
+}

@@ -151,3 +151,29 @@ class KernelCache:
 
     def __len__(self):
         return len(self.entries)
+
+
+def seed_entries(rows, path=None):
+    """Merge externally measured rows into the winner cache file —
+    the ``comm_bench --seed-cache`` ingest path. Each row is a dict in
+    cache-entry shape (device_kind/op/bucket/dtype/params [+
+    measured_ms]); malformed rows are skipped, the write is the same
+    atomic tmp+rename as save(). Returns the number merged."""
+    path = path or default_cache_path()
+    cache = KernelCache.load(path)
+    n = 0
+    for r in rows or []:
+        if not isinstance(r, dict):
+            continue
+        try:
+            cache.put(str(r["device_kind"]), str(r["op"]),
+                      str(r["bucket"]), str(r.get("dtype", "float32")),
+                      dict(r.get("params") or {}),
+                      measured_ms=r.get("measured_ms"),
+                      default_ms=r.get("default_ms"),
+                      candidates=r.get("candidates"))
+            n += 1
+        except (KeyError, TypeError, ValueError):
+            continue
+    cache.save(path)
+    return n

@@ -892,3 +892,11 @@ REGISTRY = {
         "parity": _pipe_parity,
     },
 }
+
+# collective/schedule ops (collective_ops.py — step builders run under a
+# virtual or real mesh, winners keyed by topology signature folded into
+# the bucket string) ride the SAME registry: dispatch, the measured
+# search, the cache, and the kernel_parity harness treat them uniformly
+from .collective_ops import COLLECTIVE_REGISTRY  # noqa: E402
+
+REGISTRY.update(COLLECTIVE_REGISTRY)

@@ -1,0 +1,544 @@
+"""Auto-parallelism planner: ``plan(model_desc, pod_desc)`` picks the mesh.
+
+Counterpart of the reference fork's ``autotuning/`` config search — the
+layer above the kernel-grain winner cache. Where the reference launches
+real trial runs per candidate config, this planner composes the pieces
+the repo already measures:
+
+  * the PR-10 lock-step wall model (``runtime/pipe/schedule.py``
+    ``executor_tick_units``) prices every pipe schedule's bubble in
+    compute units, extended here with alpha-beta communication terms per
+    ICI/DCN link;
+  * the alpha-beta constants calibrate from the collective winner cache's
+    ``comm_link`` rows (seeded by ``benchmarks/comm_bench.py --json``,
+    the measured busbw table) and fall back to the pod descriptor's
+    nominal link speeds;
+  * the engine's ``_estimate_pipe_state_bytes``/HBM-fit heuristic prunes
+    plans whose device-resident train state cannot fit, and prices the
+    host-staging traffic of the offload variants that can.
+
+``plan()`` enumerates admissible pp x do x dp x ep x sp x tp meshes and
+pipe schedules, scores each, and returns a ranked :class:`PlanReport`
+whose top plan converts straight into engine config keys
+(:meth:`Plan.config`); ``parallelism: "auto"`` in the runtime config
+makes the engine consume it when no explicit topology was given.
+
+KNOB_TABLE is the single source of truth tying every ``"auto"``-accepting
+config knob to its resolver (a registry op, a heuristic, or this
+planner) — the two-direction coverage lint in
+``tests/unit/test_planner_lint.py`` keeps it honest.
+"""
+
+import itertools
+import math
+from dataclasses import dataclass, field, asdict
+
+MESH_AXES = ("pipe", "data_outer", "data", "expert", "seq", "tensor")
+
+# ---------------------------------------------------------- knob table
+# Every config knob that accepts "auto" maps to the thing that resolves
+# it: {"op": <kernel_registry op consulted by dispatch>} or
+# {"resolver": <heuristic/planner description>} (op None). Model-level
+# kernel tunables ride at the bottom so every registry op is reachable
+# from some "auto" knob (the lint's second direction).
+KNOB_TABLE = {
+    "comm_overlap.enabled": {
+        "op": None, "resolver": "heuristic: on iff dp_world > 1 "
+        "(CommOverlapConfig.resolve_enabled)"},
+    "comm_overlap.bucket_mb": {
+        "op": "comm_bucket", "resolver": "engine._install_comm_overlap "
+        "dispatch over the layer-grad bucket; 32 cold"},
+    "comm_overlap.hierarchical": {
+        "op": "grad_staging", "resolver": "engine._resolve_grad_staging "
+        "dispatch; do>1 heuristic cold"},
+    "comm_overlap.dcn_quantize": {
+        "op": "dcn_quantize", "resolver": "engine._install_comm_overlap "
+        "dispatch; off cold (numerics)"},
+    "comm_overlap.scan_unroll": {
+        "op": "scan_unroll", "resolver": "engine._install_comm_overlap "
+        "dispatch; 2 cold"},
+    "sequence.block_kernel": {
+        "op": "ring_block", "resolver": "sequence/ring._resolve_blocks "
+        "dispatch; r05 tiles cold"},
+    "sequence.rotate_chunks": {
+        "op": "ring_rotate", "resolver": "sequence/ring._resolve_rotate "
+        "dispatch; fused single ppermute cold"},
+    "moe.grouped_kernel": {
+        "op": "moe_grouped_mm", "resolver": "moe grouped-GEMM dispatch; "
+        "lax.ragged_dot cold"},
+    "moe.hierarchical_a2a": {
+        "op": "a2a_staging", "resolver": "sharded_moe."
+        "resolve_hierarchical_a2a dispatch behind the divisibility "
+        "gate; do>1 heuristic cold"},
+    "moe.dcn_quantize": {
+        "op": "dcn_quantize", "resolver": "moe_swiglu_ragged_ep "
+        "dispatch; off cold (numerics)"},
+    "checkpoint_engine.hot_tier": {
+        "op": None, "resolver": "heuristic: on iff the elastic launcher "
+        "exported the ring env (resolve_hot_tier)"},
+    "checkpoint_engine.hot_replicas": {
+        "op": "hot_replicas", "resolver": "engine hot-store dispatch "
+        "over the shard-payload bucket; K=1 cold"},
+    "pipeline.schedule": {
+        "op": None, "resolver": "planner: plan() schedule of the top "
+        "plan under parallelism='auto'; model knob otherwise"},
+    "pipeline.micro_batches": {
+        "op": "pipe_microbatch", "resolver": "engine._resolve_pipeline "
+        "dispatch (0 = auto sentinel); 2S cold"},
+    "pipeline.offload_activations": {
+        "op": None, "resolver": "heuristic: host staging available AND "
+        "NOT hbm_fits (resolve_offload_activations)"},
+    "pipeline.offload_moments": {
+        "op": None, "resolver": "heuristic: off unless explicit "
+        "(resolve_offload_moments); planner turns it on with offload "
+        "plans"},
+    "telemetry.enabled": {
+        "op": None, "resolver": "heuristic: monitor backend / env hints "
+        "(TelemetryConfig.resolve_enabled)"},
+    "telemetry.cluster_agg": {
+        "op": None, "resolver": "heuristic: multi-process or exported "
+        "telemetry ring (resolve_cluster_agg)"},
+    "parallelism": {
+        "op": None, "resolver": "planner: plan() top plan builds the "
+        "TopologyConfig when no explicit topology is given"},
+    # model/serving-level kernel tunables (not config blocks; listed so
+    # the registry-coverage direction of the lint sees their ops)
+    "gpt2.flash_block_q": {
+        "op": "flash_attention", "resolver": "flash_attention dispatch"},
+    "gpt2.mlp_kernel": {
+        "op": "mlp_matmul", "resolver": "fused MLP dispatch"},
+    "gpt2.fused_layernorm": {
+        "op": "layernorm", "resolver": "fused layernorm dispatch"},
+    "gpt2.fused_loss_kernel": {
+        "op": "fused_ce", "resolver": "fused cross-entropy dispatch"},
+    "serving.paged_kernel": {
+        "op": "paged_decode", "resolver": "paged decode dispatch"},
+    "serving.paged_block_c": {
+        "op": "paged_chunk", "resolver": "SplitFuse chunk dispatch"},
+}
+
+
+# ------------------------------------------------------------ descriptors
+
+@dataclass
+class ModelDesc:
+    """What the planner needs to know about the model: parameter count
+    and the dims that gate axis admissibility (heads for tp, sequence
+    for sp, layers for pp, experts for ep)."""
+    params: int
+    n_layer: int
+    d_model: int
+    n_head: int
+    max_seq_len: int
+    vocab_size: int = 0
+    experts: int = 0
+    param_bytes: int = 4              # working param/activation itemsize
+    grad_bytes: int = 4               # grad accumulation itemsize
+    name: str = ""
+
+    @classmethod
+    def from_model_config(cls, mcfg):
+        """Build from a gpt2/mixtral-style model config (None -> a tiny
+        placeholder the planner treats as single-chip work)."""
+        if mcfg is None:
+            return cls(params=1 << 20, n_layer=1, d_model=64, n_head=1,
+                       max_seq_len=128, name="unknown")
+        count = getattr(mcfg, "num_params", None)
+        params = int(count()) if callable(count) else 1 << 20
+        dt = str(getattr(mcfg, "dtype", "float32"))
+        pb = 2 if ("16" in dt) else 4
+        return cls(
+            params=params,
+            n_layer=int(getattr(mcfg, "n_layer", 1)),
+            d_model=int(getattr(mcfg, "d_model", 64)),
+            n_head=int(getattr(mcfg, "n_head", 1)),
+            max_seq_len=int(getattr(mcfg, "max_seq_len", 128)),
+            vocab_size=int(getattr(mcfg, "vocab_size", 0)),
+            experts=int(getattr(mcfg, "num_experts", 0) or 0),
+            param_bytes=pb,
+            name=type(mcfg).__name__)
+
+
+@dataclass
+class PodDesc:
+    """What the planner needs to know about the cluster: chip count and
+    HBM (the pruning constraint), slice structure (what DCN crosses),
+    and nominal link/compute speeds (the alpha-beta fallbacks when no
+    measured ``comm_link`` rows exist)."""
+    n_chips: int
+    hbm_bytes: int
+    n_slices: int = 1                 # data_outer may only split slices
+    chip_flops: float = 2.0e14        # peak per-chip FLOP/s (relative)
+    ici_gbps: float = 100.0           # per-link ICI bandwidth
+    dcn_gbps: float = 12.5            # per-host DCN bandwidth
+    ici_alpha_us: float = 1.0         # per-collective ICI launch cost
+    dcn_alpha_us: float = 25.0
+    host_gbps: float = 10.0           # HBM<->host staging bandwidth
+    host_offload: bool = True         # backend has a host memory kind
+    device_kind: str = ""             # "" = the local jax device kind
+
+    @classmethod
+    def from_devices(cls):
+        """Describe the pod jax actually sees (the engine's
+        ``parallelism: 'auto'`` path). HBM honors the DSTPU_HBM_BYTES
+        override like the engine's own heuristic."""
+        import os
+        import jax
+        devs = jax.devices()
+        hbm = 0
+        env = os.environ.get("DSTPU_HBM_BYTES")
+        if env:
+            try:
+                hbm = int(float(env))
+            except ValueError:
+                hbm = 0
+        if not hbm:
+            try:
+                stats = devs[0].memory_stats()
+                hbm = int(stats["bytes_limit"]) if stats else 0
+            except Exception:  # noqa: BLE001 - CPU/older backends
+                hbm = 0
+        try:
+            n_slices = len({getattr(d, "slice_index", 0) for d in devs})
+        except Exception:  # noqa: BLE001
+            n_slices = 1
+        from .kernel_dispatch import device_kind
+        return cls(n_chips=len(devs), hbm_bytes=hbm,
+                   n_slices=max(1, n_slices), device_kind=device_kind())
+
+
+@dataclass
+class Plan:
+    """One scored candidate: a full mesh assignment plus the pipe
+    schedule/microbatch/offload choice and the wall-model breakdown."""
+    mesh: dict                        # axis -> size over MESH_AXES
+    schedule: str                     # gpipe | 1f1b | zb | none (pp=1)
+    micro_batches: int
+    offload: bool                     # host-offload moments/activations
+    wall_ms: float
+    breakdown: dict                   # term -> ms
+    est_state_bytes: int
+    hbm_fits: bool
+
+    def config(self, base=None):
+        """Engine-ready config keys for this plan (merged over ``base``
+        when given): the topology axis sizes plus the pipeline block."""
+        out = dict(base or {})
+        out["tensor_parallel"] = {"size": self.mesh["tensor"]}
+        out["sequence_parallel_size"] = self.mesh["seq"]
+        out["expert_parallel_size"] = self.mesh["expert"]
+        pipe = dict(out.get("pipeline", {}))
+        pipe["stages"] = self.mesh["pipe"]
+        if self.schedule != "none":
+            pipe["schedule"] = self.schedule
+            pipe["micro_batches"] = self.micro_batches
+        pipe["offload_activations"] = bool(self.offload)
+        pipe["offload_moments"] = bool(self.offload)
+        out["pipeline"] = pipe
+        if self.mesh["data_outer"] > 1:
+            zero = dict(out.get("zero_optimization", {}))
+            zero.setdefault("stage", 1)
+            zero["mics_shard_size"] = self.mesh["data"]
+            out["zero_optimization"] = zero
+        return out
+
+    def topology_kwargs(self):
+        """Kwargs for utils.groups.TopologyConfig reproducing this
+        mesh (data_outer rides on zero_shard_size subdividing DP)."""
+        do, dp = self.mesh["data_outer"], self.mesh["data"]
+        return dict(
+            tensor_parallel_size=self.mesh["tensor"],
+            pipe_parallel_size=self.mesh["pipe"],
+            seq_parallel_size=self.mesh["seq"],
+            expert_parallel_size=self.mesh["expert"],
+            zero_shard_size=(dp if do > 1 else -1))
+
+
+@dataclass
+class PlanReport:
+    """Ranked plan() output: ``plans[0]`` is the recommendation;
+    ``considered``/``pruned`` record the search's shape so a surprising
+    answer can be audited."""
+    model: ModelDesc
+    pod: PodDesc
+    plans: list
+    considered: int = 0
+    pruned_hbm: int = 0
+    links: dict = field(default_factory=dict)
+
+    def top(self):
+        return self.plans[0] if self.plans else None
+
+    def to_config(self, base=None):
+        best = self.top()
+        return best.config(base) if best is not None else dict(base or {})
+
+    def table(self):
+        """Human-readable ranking (bench/README surface)."""
+        lines = [f"{'rank':>4} {'mesh (pp,do,dp,ep,sp,tp)':>26} "
+                 f"{'sched':>6} {'M':>4} {'offl':>5} {'wall_ms':>10} "
+                 f"{'state_gb':>9}"]
+        for i, p in enumerate(self.plans):
+            m = p.mesh
+            lines.append(
+                f"{i + 1:>4} "
+                f"{'x'.join(str(m[a]) for a in MESH_AXES):>26} "
+                f"{p.schedule:>6} {p.micro_batches:>4} "
+                f"{str(bool(p.offload)):>5} {p.wall_ms:>10.3f} "
+                f"{p.est_state_bytes / 1e9:>9.2f}")
+        return "\n".join(lines)
+
+    def to_dict(self):
+        return {
+            "model": asdict(self.model), "pod": asdict(self.pod),
+            "considered": self.considered, "pruned_hbm": self.pruned_hbm,
+            "links": {k: list(v) for k, v in self.links.items()},
+            "plans": [asdict(p) for p in self.plans],
+        }
+
+
+# ------------------------------------------------------ link calibration
+
+def calibrate_links(pod, cache=None):
+    """(alpha_s, beta_Bps) per link class from the collective cache's
+    ``comm_link`` rows (op 'comm_link', bucket '<topo>,k<ici|dcn>',
+    params {alpha_us, beta_gbps} — seeded by ``comm_bench --json`` /
+    ``--seed-cache``), honoring the device-kind refusal rule; the pod
+    descriptor's nominal numbers are the fallback. comm_link rows live
+    in the cache file but NOT in the op registry — dispatch never
+    consults them, only this calibration does."""
+    out = {
+        "ici": (pod.ici_alpha_us * 1e-6, pod.ici_gbps * 1e9),
+        "dcn": (pod.dcn_alpha_us * 1e-6, pod.dcn_gbps * 1e9),
+    }
+    if cache is None:
+        try:
+            from . import kernel_dispatch
+            from .kernel_cache import KernelCache
+            cache = KernelCache.load(kernel_dispatch.cache_path())
+        except Exception:  # noqa: BLE001 - no backend yet
+            return out
+    want_kind = pod.device_kind
+    if not want_kind:
+        try:
+            from .kernel_dispatch import device_kind
+            want_kind = device_kind()
+        except Exception:  # noqa: BLE001
+            want_kind = ""
+    for e in getattr(cache, "entries", {}).values():
+        if not isinstance(e, dict) or e.get("op") != "comm_link":
+            continue
+        if want_kind and e.get("device_kind") != want_kind:
+            continue  # the refusal rule: foreign chips don't calibrate
+        params = e.get("params") or {}
+        kind = params.get("kind") or (
+            "dcn" if ",kdcn" in str(e.get("bucket", "")) else "ici")
+        try:
+            alpha = float(params["alpha_us"]) * 1e-6
+            beta = float(params["beta_gbps"]) * 1e9
+        except (KeyError, TypeError, ValueError):
+            continue
+        if beta > 0:
+            out[kind] = (max(0.0, alpha), beta)
+    return out
+
+
+def _t_coll(bytes_, world, link, kind="ring"):
+    """alpha-beta time of one collective: ring all-reduce moves
+    2(W-1)/W x payload, gather/scatter/a2a (W-1)/W, neighbor exchange
+    1x."""
+    alpha, beta = link
+    if world <= 1:
+        return 0.0
+    factor = {"ring": 2 * (world - 1) / world,
+              "shard": (world - 1) / world,
+              "exchange": 1.0}[kind]
+    return alpha + factor * bytes_ / beta
+
+
+# ------------------------------------------------------------- scoring
+
+# fraction of comm time the latency-hiding scheduler is assumed to slide
+# under compute (the overlap-probe acceptance number's planning-side
+# stand-in); the schedule-dependent offload exposure mirrors how zb's
+# drain ticks absorb host staging where gpipe's bubble cannot
+_HIDDEN_FRAC = 0.75
+_OFFLOAD_EXPOSED = {"zb": 0.25, "1f1b": 0.5, "gpipe": 0.5, "none": 0.5}
+
+
+def _estimate_state_bytes(model, mesh, offload):
+    """The engine's ``_estimate_pipe_state_bytes`` heuristic on a plan:
+    working params+grads divide over (pipe, tensor, expert); the fp32
+    master + Adam moments divide over the full ZeRO partition group —
+    and move to host entirely under the offload variants."""
+    shard = mesh["pipe"] * mesh["tensor"] * max(1, mesh["expert"])
+    opt_shard = shard * mesh["data"] * mesh["data_outer"]
+    n = model.params
+    dev = n * (model.param_bytes + model.grad_bytes) / shard
+    if not offload:
+        dev += n * 12 / opt_shard
+    return int(dev)
+
+
+def _score(model, pod, mesh, schedule, M, offload, links, batch_tokens):
+    """Wall-clock model of one optimizer step (ms) + term breakdown.
+
+    Compute rides the PR-10 lock-step tick model: one unit = one
+    microbatch's forward through one stage, backward 2 units, so the
+    schedule's ``executor_tick_units`` sum prices its bubble; comm terms
+    are alpha-beta per link class, discounted by the overlap fraction
+    the latency-hiding scheduler is expected to hide."""
+    from ..runtime.pipe.schedule import executor_tick_units
+    pp, do, dp = mesh["pipe"], mesh["data_outer"], mesh["data"]
+    ep, sp, tp = mesh["expert"], mesh["seq"], mesh["tensor"]
+    ici, dcn = links["ici"], links["dcn"]
+    exposed = 1.0 - _HIDDEN_FRAC
+
+    tokens_micro = batch_tokens / (dp * do * M)
+    shard = pp * tp * max(1, ep)
+    # one tick unit ~ one microbatch forward on one stage's params
+    unit_s = 2.0 * (model.params / shard) * (tokens_micro / sp) \
+        / pod.chip_flops
+    if pp > 1:
+        ticks = executor_tick_units(schedule, M, pp)
+        t_compute = sum(ticks) * unit_s
+        n_ticks = len(ticks)
+    else:
+        t_compute = 3.0 * M * unit_s
+        n_ticks = 0
+
+    terms = {"compute": t_compute}
+    # gradient reduction: hierarchical two-stage when do > 1 (the
+    # comm_overlap discipline) — inner ring over ICI, the cross-slice
+    # hop on the already-scattered shard over DCN
+    gbytes = model.grad_bytes * model.params / shard
+    layers = max(1, model.n_layer // pp)
+    t_grad = _t_coll(gbytes, dp, ici, "ring") \
+        + (layers - 1) * ici[0] * (dp > 1)
+    if do > 1:
+        t_grad += _t_coll(gbytes / max(1, dp), do, dcn, "ring")
+    terms["grad_reduce"] = t_grad * exposed
+    # tensor-parallel activation reductions: ~2 psums per layer over tp
+    if tp > 1:
+        act_b = tokens_micro / sp * model.d_model * model.param_bytes
+        terms["tp_reduce"] = M * layers * 2 \
+            * _t_coll(act_b, tp, ici, "ring") * exposed
+    # pipe handoffs: one boundary exchange per tick
+    if pp > 1:
+        act_b = tokens_micro / sp * model.d_model * model.param_bytes
+        terms["pipe_handoff"] = n_ticks \
+            * _t_coll(act_b, pp, ici, "exchange") * exposed
+    # ring-attention KV rotations: (sp-1) per layer per microbatch
+    if sp > 1:
+        kv_b = 2 * tokens_micro / sp * model.d_model * model.param_bytes
+        terms["ring_rotate"] = M * layers * (sp - 1) \
+            * _t_coll(kv_b, sp, ici, "exchange") * exposed
+    # expert all_to_all: two exchanges per MoE layer per microbatch
+    if ep > 1:
+        tok_b = tokens_micro * model.d_model * model.param_bytes
+        t_one = _t_coll(tok_b, ep, ici, "shard")
+        if do > 1:
+            t_one += _t_coll(tok_b, do, dcn, "shard")
+        terms["expert_a2a"] = M * layers * 2 * t_one * exposed
+    # host staging of the offloaded fp32 master + moments (and the
+    # activation rings the schedule hides inside its drain ticks)
+    if offload:
+        opt_b = 12.0 * model.params / (shard * dp * do)
+        terms["host_offload"] = 2 * opt_b / (pod.host_gbps * 1e9) \
+            * _OFFLOAD_EXPOSED.get(schedule, 0.5)
+
+    wall = sum(terms.values())
+    return wall * 1e3, {k: round(v * 1e3, 6) for k, v in terms.items()}
+
+
+# ---------------------------------------------------------- enumeration
+
+def _divisors(n):
+    return [d for d in range(1, n + 1) if n % d == 0]
+
+
+def _admissible_meshes(model, pod, pp_min=1, pp_max=None):
+    """All axis assignments whose product is the chip count and whose
+    sizes the model dims admit (tp | heads, sp | seq/2 for the zigzag
+    split, pp <= layers, ep | experts, do <= slice count)."""
+    n = pod.n_chips
+    pp_cap = min(pp_max or n, model.n_layer, n)
+    for pp in _divisors(n):
+        if pp < pp_min or pp > pp_cap:
+            continue
+        rest_pp = n // pp
+        for do in _divisors(math.gcd(rest_pp, pod.n_slices)):
+            rest_do = rest_pp // do
+            for tp in _divisors(rest_do):
+                if model.n_head % tp or model.d_model % tp:
+                    continue
+                rest_tp = rest_do // tp
+                for sp in _divisors(rest_tp):
+                    if sp > 1 and model.max_seq_len % (2 * sp):
+                        continue
+                    rest_sp = rest_tp // sp
+                    eps = [1]
+                    if model.experts:
+                        eps = [e for e in _divisors(rest_sp)
+                               if model.experts % e == 0]
+                    for ep in eps:
+                        dp = rest_sp // ep
+                        yield {"pipe": pp, "data_outer": do, "data": dp,
+                               "expert": ep, "seq": sp, "tensor": tp}
+
+
+def plan(model_desc, pod_desc, *, batch_tokens=None, pp_min=1,
+         pp_max=None, schedules=("gpipe", "1f1b", "zb"),
+         micro_candidates=None, max_plans=8, cache=None):
+    """Enumerate-score-prune: returns a :class:`PlanReport` ranked by
+    the modeled step wall. Plans whose device-resident state fails the
+    HBM-fit margin are pruned (never ranked); offload variants move the
+    optimizer tail to host and pay the modeled staging cost, so when
+    both fit, the non-offload plan outranks its offload twin on the
+    staging term alone."""
+    model, pod = model_desc, pod_desc
+    if batch_tokens is None:
+        batch_tokens = max(1, 8 * pod.n_chips) * model.max_seq_len
+    links = calibrate_links(pod, cache=cache)
+    plans, considered, pruned = [], 0, 0
+    for mesh in _admissible_meshes(model, pod, pp_min, pp_max):
+        pp = mesh["pipe"]
+        scheds = list(schedules) if pp > 1 else ["none"]
+        micros = micro_candidates or ([2 * pp, 4 * pp] if pp > 1 else [1])
+        for schedule, M, offload in itertools.product(
+                scheds, micros, (False, True)):
+            considered += 1
+            if offload and not pod.host_offload:
+                continue
+            est = _estimate_state_bytes(model, mesh, offload)
+            from ..runtime.config import PipelineConfig
+            fits = PipelineConfig.hbm_fits(est, pod.hbm_bytes)
+            if not fits:
+                pruned += 1
+                continue
+            wall, terms = _score(model, pod, mesh, schedule, M, offload,
+                                 links, batch_tokens)
+            plans.append(Plan(
+                mesh=dict(mesh), schedule=schedule, micro_batches=M,
+                offload=offload, wall_ms=round(wall, 6),
+                breakdown=terms, est_state_bytes=est, hbm_fits=True))
+    plans.sort(key=lambda p: (p.wall_ms, p.offload,
+                              -p.mesh["data"], p.mesh["pipe"]))
+    return PlanReport(model=model, pod=pod, plans=plans[:max_plans],
+                      considered=considered, pruned_hbm=pruned,
+                      links=links)
+
+
+def plan_for_engine(model, raw_config):
+    """The engine's ``parallelism: "auto"`` entry: describe the model
+    and the visible pod, plan, and hand back the report (the engine
+    adopts ``report.top()``'s topology kwargs and pipeline choices).
+    Returns None when planning is impossible (no devices)."""
+    mdesc = ModelDesc.from_model_config(getattr(model, "config", None))
+    pdesc = PodDesc.from_devices()
+    if pdesc.n_chips < 1:
+        return None
+    tb = raw_config.get("train_batch_size") \
+        or raw_config.get("train_micro_batch_size_per_gpu")
+    batch_tokens = (int(tb) * mdesc.max_seq_len) if tb else None
+    return plan(mdesc, pdesc, batch_tokens=batch_tokens)

@@ -571,7 +571,8 @@ class GPT2:
                 q, kk, v, jax.sharding.get_abstract_mesh(),
                 batch_spec=P(BATCH_AXES), head_axis="tensor",
                 layout=scfg.layout, block_kernel=scfg.block_kernel,
-                double_buffer=scfg.double_buffer)
+                double_buffer=scfg.double_buffer,
+                rotate_chunks=getattr(scfg, "rotate_chunks", "auto"))
         elif cfg.flash_on and not seq_sharded and not force_dense:
             # pallas fused attention: O(T) memory, fp32 accumulation
             # (ops/pallas/flash_attention.py). Heads shard over 'tensor'.

@@ -329,11 +329,16 @@ def moe_layer(tokens, gate_w, wi, bi, wo, bo, gate: TopKGate, *, rng=None,
     return y, l_aux, exp_counts
 
 
-def resolve_hierarchical_a2a(knob, outer_size, E, ep):
+def resolve_hierarchical_a2a(knob, outer_size, E, ep, *, tokens=0,
+                             model_dim=0, dtype=None):
     """Whether the EP exchange stages ICI -> DCN: "auto" engages iff the
     mesh has an outer (DCN) axis > 1 and the experts divide the combined
-    shard grid; True additionally *requires* divisibility (loud error
-    instead of a silent flat fallback); False never stages."""
+    shard grid — then defers to the 'a2a_staging' collective winner for
+    this (device, topology, payload) bucket, whose cold-cache default IS
+    that heuristic (a measured winner can only flip an admissible case
+    back to flat, never force a non-dividing staging); True additionally
+    *requires* divisibility (loud error instead of a silent flat
+    fallback); False never stages."""
     if knob is False or knob is None:
         return False
     if outer_size <= 1:
@@ -344,6 +349,14 @@ def resolve_hierarchical_a2a(knob, outer_size, E, ep):
                 f"hierarchical EP needs experts ({E}) divisible by "
                 f"expert*outer shards ({ep}*{outer_size})")
         return False
+    if knob == "auto":
+        from ..ops.pallas._common import a2a_bucket, dispatch, dtype_name
+        import jax.numpy as jnp
+        win = dispatch(
+            "a2a_staging", a2a_bucket(tokens, model_dim),
+            dtype_name(dtype if dtype is not None else jnp.bfloat16),
+            {"staged": int(outer_size > 1)})
+        return bool(win["staged"])
     return True
 
 
@@ -407,7 +420,18 @@ def moe_swiglu_ragged_ep(tokens, gate_w, w1, w3, w2, k=2, *,
     if ep == 1:
         raise ValueError("moe_swiglu_ragged_ep needs an expert mesh axis "
                          "> 1; use the dense ragged_dot path otherwise")
-    hier = resolve_hierarchical_a2a(hierarchical, wo, E, ep)
+    hier = resolve_hierarchical_a2a(hierarchical, wo, E, ep,
+                                    tokens=S, model_dim=M,
+                                    dtype=tokens.dtype)
+    if dcn_quantize == "auto":
+        # qgZ on the DCN token legs: measured per payload bucket, OFF on
+        # a cold cache (quantization changes numerics — never on blind)
+        from ..ops.pallas._common import (dispatch, dtype_name,
+                                          grad_comm_bucket)
+        payload_mb = max(1, (S * M * flat.dtype.itemsize) >> 20)
+        dcn_quantize = bool(dispatch(
+            "dcn_quantize", grad_comm_bucket(payload_mb),
+            dtype_name(flat.dtype), {"quantize": 0})["quantize"])
     ep_total = ep * wo if hier else ep
     assert E % ep_total == 0, \
         f"experts {E} not divisible by expert shards {ep_total}"

@@ -103,6 +103,69 @@ def paged_chunk_bucket(C, MB, BS, KVH, G, d):
            f"kh{int(KVH)},g{int(G)},d{int(d)}"
 
 
+# ------------------------------------------- collective-op buckets
+# Collective-bearing ops (autotuning/collective_ops.py) are winners per
+# (device_kind, TOPOLOGY-SIGNATURE, shape-bucket): the mesh shape is
+# folded into the bucket STRING itself, so the cache file format and the
+# device-kind refusal rule are untouched — a winner measured on a
+# dp=4,do=2 mesh can never steer a dp=8 flat mesh, exactly as a T=1024
+# flash winner never steers T=128.
+
+def topo_signature(mesh=None):
+    """Compact mesh signature for collective bucket strings:
+    'pp1,do1,dp4,ep1,sp1,tp1' (every axis exact — each size changes the
+    collective's replica groups, so no two topologies may share a
+    winner). Falls back to the all-ones signature when no topology has
+    been initialized (single-chip/virtual runs)."""
+    shape = {}
+    if mesh is not None:
+        shape = dict(mesh.shape)
+    else:
+        try:
+            from ...utils import groups
+            shape = dict(groups.get_mesh().shape)
+        except Exception:  # noqa: BLE001 — pre-topology trace
+            shape = {}
+    g = lambda a: int(shape.get(a, 1))
+    return (f"pp{g('pipe')},do{g('data_outer')},dp{g('data')},"
+            f"ep{g('expert')},sp{g('seq')},tp{g('tensor')}")
+
+
+def grad_comm_bucket(layer_mb, mesh=None):
+    """Gradient-collective bucket (ops comm_bucket / grad_staging /
+    dcn_quantize): topology signature + the per-layer gradient payload
+    in MB, pow2-rounded (nearby layer sizes share a winner)."""
+    return f"{topo_signature(mesh)},L{pow2_bucket(max(1, layer_mb))}"
+
+
+def a2a_bucket(tokens, M, mesh=None):
+    """Expert all_to_all bucket (op a2a_staging): topology signature +
+    tokens-per-shard pow2-rounded + model width exact (it sets the
+    payload row size the staged exchange re-buckets)."""
+    return f"{topo_signature(mesh)},S{pow2_bucket(max(1, tokens))}," \
+           f"M{int(M)}"
+
+
+def ring_rotate_bucket(R, chunk, d, mesh=None):
+    """Ring KV-rotation bucket (op ring_rotate): ring size exact (it is
+    the perm), per-step chunk length pow2-rounded, head dim exact."""
+    return f"{topo_signature(mesh)},R{int(R)},T{pow2_bucket(chunk)}," \
+           f"d{int(d)}"
+
+
+def scan_unroll_bucket(n_layer, D, mesh=None):
+    """Layer-scan unroll bucket (op scan_unroll): layer count and model
+    width exact — they set how much compute one unrolled body gives the
+    prefetch gather to hide under."""
+    return f"{topo_signature(mesh)},N{int(n_layer)},D{int(D)}"
+
+
+def hot_replicas_bucket(shard_mb, mesh=None):
+    """Hot-tier replication bucket (op hot_replicas): topology signature
+    + per-host checkpoint shard payload in MB, pow2-rounded."""
+    return f"{topo_signature(mesh)},G{pow2_bucket(max(1, shard_mb))}"
+
+
 def interpret_default():
     """Kernels run in Pallas interpreter mode off-TPU (unit tests, the
     virtual CPU mesh)."""

@@ -270,7 +270,7 @@ class CheckpointEngineConfig:
     #   hot_keep_last hot-tier retention (a bounded RAM cache, not an
     #                 archive)
     hot_tier: object = "auto"
-    hot_replicas: int = 1
+    hot_replicas: object = 1          # int >= 0 | "auto" (winner cache)
     hot_root: str = ""
     hot_keep_last: int = 2
 
@@ -287,10 +287,13 @@ class CheckpointEngineConfig:
             raise DeepSpeedConfigError(
                 f"checkpoint_engine.hot_tier must be true|false|'auto', "
                 f"got {self.hot_tier!r}")
-        if self.hot_replicas < 0:
+        if self.hot_replicas != "auto" and (
+                not isinstance(self.hot_replicas, int)
+                or isinstance(self.hot_replicas, bool)
+                or self.hot_replicas < 0):
             raise DeepSpeedConfigError(
-                f"checkpoint_engine.hot_replicas must be >= 0, got "
-                f"{self.hot_replicas}")
+                f"checkpoint_engine.hot_replicas must be an int >= 0 or "
+                f"'auto', got {self.hot_replicas!r}")
         if self.hot_keep_last < 1:
             raise DeepSpeedConfigError(
                 f"checkpoint_engine.hot_keep_last must be >= 1 (the "
@@ -323,7 +326,10 @@ class CommOverlapConfig:
                     bytes are below this emits no in-scan collective (its
                     reduction coalesces into the post-backward one, the
                     reference's bucketing of small grads); also feeds the
-                    GPU combine-threshold flags. 0 = annotate everything.
+                    GPU combine-threshold flags. 0 = annotate everything;
+                    "auto" = the 'comm_bucket' autotune winner for this
+                    (device, topology, layer-payload) bucket, 32 on a
+                    cold cache (byte-identical to the hand-set default).
       prefetch      ZeRO-3: explicit per-layer param gather at the top of
                     the scan body + unroll hint + backward all-gather
                     pipelining flag, so layer i+1's gather flies under
@@ -339,16 +345,24 @@ class CommOverlapConfig:
                     numerics). Requires a hierarchical data_outer stage
                     — ignored (with a warning) otherwise; wire-level
                     int8 for explicit pipelines lives in
-                    comm/quantized.py.
+                    comm/quantized.py. "auto" = the 'dcn_quantize'
+                    autotune winner (off on a cold cache — quantization
+                    changes numerics, never turned on blind by default).
+      scan_unroll   unroll factor of the layer scan when comm overlap is
+                    on (gives XLA unrolled iterations to slide gathers /
+                    reductions across): int >= 1 | "auto" (the
+                    'scan_unroll' winner; 2 on a cold cache — the
+                    hand-set value overlap has shipped with).
       set_xla_flags whether the engine may append overlap flags to
                     XLA_FLAGS (only effective before backend init; the
                     DSTPU_COMM_OVERLAP=1 env does it at import time).
     """
     enabled: object = "auto"          # "auto" | bool
-    bucket_mb: int = 32
+    bucket_mb: object = 32            # int >= 0 | "auto" (winner cache)
     prefetch: bool = True
     hierarchical: object = "auto"     # "auto" | bool
-    dcn_quantize: bool = False
+    dcn_quantize: object = False      # bool | "auto" (winner cache)
+    scan_unroll: object = "auto"      # int >= 1 | "auto" (winner cache)
     set_xla_flags: bool = True
 
     def __post_init__(self):
@@ -360,10 +374,24 @@ class CommOverlapConfig:
             raise DeepSpeedConfigError(
                 f"comm_overlap.hierarchical must be true|false|'auto', "
                 f"got {self.hierarchical!r}")
-        if not isinstance(self.bucket_mb, int) or self.bucket_mb < 0:
+        if self.bucket_mb != "auto" and (
+                not isinstance(self.bucket_mb, int)
+                or isinstance(self.bucket_mb, bool)
+                or self.bucket_mb < 0):
             raise DeepSpeedConfigError(
-                f"comm_overlap.bucket_mb must be an int >= 0, got "
-                f"{self.bucket_mb!r}")
+                f"comm_overlap.bucket_mb must be an int >= 0 or 'auto', "
+                f"got {self.bucket_mb!r}")
+        if self.dcn_quantize not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"comm_overlap.dcn_quantize must be true|false|'auto', "
+                f"got {self.dcn_quantize!r}")
+        if self.scan_unroll != "auto" and (
+                not isinstance(self.scan_unroll, int)
+                or isinstance(self.scan_unroll, bool)
+                or self.scan_unroll < 1):
+            raise DeepSpeedConfigError(
+                f"comm_overlap.scan_unroll must be an int >= 1 or "
+                f"'auto', got {self.scan_unroll!r}")
 
     def resolve_enabled(self, dp_world_size):
         if self.enabled == "auto":
@@ -397,10 +425,15 @@ class SequenceConfig:
                     kernels so the rotation hides under compute (the
                     comm-overlap discipline); false serializes
                     rotate-then-compute (A/B lever).
+      rotate_chunks split each KV rotation into this many head-dim
+                    ppermutes so the first chunk lands early: int >= 1 |
+                    "auto" (the 'ring_rotate' autotune winner; 1 — the
+                    fused single-ppermute program — on a cold cache).
     """
     layout: str = "zigzag"
     block_kernel: object = "auto"
     double_buffer: bool = True
+    rotate_chunks: object = "auto"    # int >= 1 | "auto" (winner cache)
 
     def __post_init__(self):
         if self.layout not in ("zigzag", "contiguous"):
@@ -411,6 +444,13 @@ class SequenceConfig:
             raise DeepSpeedConfigError(
                 f"sequence.block_kernel must be true|false|'auto', got "
                 f"{self.block_kernel!r}")
+        if self.rotate_chunks != "auto" and (
+                not isinstance(self.rotate_chunks, int)
+                or isinstance(self.rotate_chunks, bool)
+                or self.rotate_chunks < 1):
+            raise DeepSpeedConfigError(
+                f"sequence.rotate_chunks must be an int >= 1 or 'auto', "
+                f"got {self.rotate_chunks!r}")
 
 
 @dataclass
@@ -442,7 +482,7 @@ class MoEConfig:
     """
     grouped_kernel: object = "auto"    # "auto" | bool
     hierarchical_a2a: object = "auto"  # "auto" | bool
-    dcn_quantize: bool = False
+    dcn_quantize: object = False       # bool | "auto" (winner cache)
 
     def __post_init__(self):
         if self.grouped_kernel not in (True, False, "auto"):
@@ -453,9 +493,9 @@ class MoEConfig:
             raise DeepSpeedConfigError(
                 f"moe.hierarchical_a2a must be true|false|'auto', got "
                 f"{self.hierarchical_a2a!r}")
-        if not isinstance(self.dcn_quantize, bool):
+        if self.dcn_quantize not in (True, False, "auto"):
             raise DeepSpeedConfigError(
-                f"moe.dcn_quantize must be a bool, got "
+                f"moe.dcn_quantize must be true|false|'auto', got "
                 f"{self.dcn_quantize!r}")
 
 
@@ -678,6 +718,15 @@ class DeepSpeedConfig:
         self.pipeline = _take(config, PipelineConfig, C.PIPELINE)
         self.seq_parallel_size = config.get(C.SEQUENCE_PARALLEL_SIZE, 1)
         self.expert_parallel_size = config.get(C.EXPERT_PARALLEL_SIZE, 1)
+        # "auto": when no explicit topology is given, run the
+        # auto-parallelism planner (autotuning/planner.py) over the model
+        # + visible pod and adopt its rank-1 mesh/schedule; "" keeps the
+        # hand-set axis sizes above (the historical behavior).
+        self.parallelism = config.get("parallelism", "")
+        if self.parallelism not in ("", "auto"):
+            raise DeepSpeedConfigError(
+                f"parallelism must be ''|'auto', got "
+                f"{self.parallelism!r}")
 
         opt = config.get(C.OPTIMIZER)
         self.optimizer = None if opt is None else _take(

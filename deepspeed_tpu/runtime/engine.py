@@ -81,13 +81,52 @@ class DeepSpeedEngine:
                      or os.environ.get("DSTPU_COMM_OVERLAP") == "1")))
         if want_flags:
             platform = comm_overlap.platform_guess()
+            # bucket_mb="auto" resolves from the winner cache LATER (at
+            # _install_comm_overlap, after the backend is up — dispatch
+            # needs device_kind); the pre-backend flags take the cold-
+            # cache default, which is what "auto" resolves to anyway
+            flag_mb = (co_early.bucket_mb
+                       if isinstance(co_early.bucket_mb, int) else 32)
             self._overlap_flags = comm_overlap.apply_xla_flags(
                 comm_overlap.xla_overlap_flags(
                     platform, prefetch=co_early.prefetch,
-                    bucket_mb=co_early.bucket_mb),
+                    bucket_mb=flag_mb),
                 comm_overlap.overlap_env_var(platform))
         else:
             self._overlap_flags = (False, "not requested")
+        # auto-parallelism: ``parallelism: "auto"`` hands the mesh choice
+        # to the planner (autotuning/planner.py) when no explicit
+        # topology was constructed — an explicit ``topology=`` argument
+        # always wins. The adopted plan is stashed so _resolve_pipeline
+        # can consume its schedule/microbatch/offload picks wherever the
+        # pipeline knobs were themselves left on 'auto'.
+        self._auto_plan = None
+        self.plan_report = None
+        if topology is None and raw.get("parallelism", "") == "auto":
+            from ..autotuning import planner as _planner
+            report = _planner.plan_for_engine(model, raw)
+            best = report.top() if report is not None else None
+            if best is not None:
+                self._auto_plan = best
+                self.plan_report = report
+                topology = groups.initialize(TopologyConfig(
+                    **best.topology_kwargs()))
+                m = best.mesh
+                log_dist(
+                    "parallelism=auto: planned mesh "
+                    + "x".join(f"{a}={m[a]}" for a in
+                               ("pipe", "data_outer", "data", "expert",
+                                "seq", "tensor"))
+                    + f" schedule={best.schedule} M={best.micro_batches}"
+                    + f" offload={best.offload}"
+                    + f" (modeled {best.wall_ms:.3g} ms/step,"
+                    + f" {report.considered} considered,"
+                    + f" {report.pruned_hbm} HBM-pruned)", ranks=[0])
+            else:
+                log_dist(
+                    "parallelism=auto: planner produced no feasible "
+                    "plan; falling back to the explicit config axes",
+                    ranks=[0])
         if topology is None:
             zero_raw = raw.get("zero_optimization", {})
             shard = int(zero_raw.get("mics_shard_size", -1))
@@ -118,11 +157,14 @@ class DeepSpeedEngine:
         kernel_dispatch.configure_from_config(self.config.autotune)
 
         # comm-overlap resolution (the XLA flags were handled above,
-        # pre-backend; this decides the program-level annotations)
+        # pre-backend; this decides the program-level annotations).
+        # hierarchical 'auto' consults the 'grad_staging' collective op's
+        # winner cache with the do>1 heuristic as the cold-cache default
+        # — same answer as before until a measured winner disagrees
         co = self.config.comm_overlap
         self._overlap_on = co.resolve_enabled(dp_world)
-        self._overlap_hier = self._overlap_on and co.resolve_hierarchical(
-            topology.axis_size("data_outer"))
+        self._overlap_hier = self._overlap_on and \
+            self._resolve_grad_staging(co, topology, model)
         self.comm_overlap_report = None
 
         self.model = model
@@ -212,9 +254,25 @@ class DeepSpeedEngine:
         ce_cfg = self.config.checkpoint_engine
         if ce_cfg.resolve_hot_tier():
             from .checkpoint_engine.hot_tier import HotTierStore
+            replicas = ce_cfg.hot_replicas
+            if replicas == "auto":
+                # measured replication degree for this per-host shard
+                # payload (op 'hot_replicas'; K=1 — the hand-set ring
+                # default — on a cold cache)
+                from ..ops.pallas._common import (dispatch, dtype_name,
+                                                  hot_replicas_bucket)
+                shard_mb = self._layer_grad_mb(
+                    self.model, self.param_dtype)
+                mcfg = getattr(self.model, "config", None)
+                shard_mb *= max(1, int(getattr(mcfg, "n_layer", 1)))
+                shard_mb = max(1, shard_mb // max(1, jax.process_count()))
+                replicas = int(dispatch(
+                    "hot_replicas", hot_replicas_bucket(shard_mb,
+                                                        self.mesh),
+                    dtype_name(self.param_dtype), {"k": 1})["k"])
             self.hot_store = HotTierStore(
                 root=ce_cfg.hot_root or None,
-                replicas=ce_cfg.hot_replicas,
+                replicas=int(replicas),
                 keep_last=ce_cfg.hot_keep_last,
                 counters=self.checkpoint_engine.counters)
         # which tier served the most recent load_checkpoint (None before
@@ -728,12 +786,25 @@ class DeepSpeedEngine:
         mcfg = getattr(self.model, "config", None)
         model_sched = getattr(mcfg, "pipe_schedule", None)
         schedule = pcfg.resolve_schedule(model_sched)
+        # parallelism=auto: the adopted plan's picks fill the knobs
+        # still on block-level 'auto' — an explicit pipeline.schedule
+        # wins, but the model-config default does not (opting into the
+        # planner makes it the authority for the schedule choice)
+        ap = getattr(self, "_auto_plan", None)
+        if ap is not None and pcfg.schedule == "auto" \
+                and ap.schedule != "none":
+            schedule = ap.schedule
         avail = host_stage.available()
         est = self._estimate_pipe_state_bytes()
         hbm = self._device_hbm_bytes()
         acts = pcfg.resolve_offload_activations(
             avail, pipe_world=S, est_state_bytes=est, hbm_bytes=hbm)
         moments = pcfg.resolve_offload_moments(avail)
+        if ap is not None and avail:
+            if pcfg.offload_activations == "auto" and ap.offload:
+                acts = True
+            if pcfg.offload_moments == "auto" and ap.offload:
+                moments = True
         if pcfg.offload_moments is True and not avail:
             log_dist(
                 "pipeline.offload_moments=true but this backend has a "
@@ -746,6 +817,19 @@ class DeepSpeedEngine:
                 "identity (no bytes move)", ranks=[0])
         micro = pcfg.micro_batches or getattr(
             mcfg, "pipe_microbatches", 0)
+        if not micro and S > 1 and ap is not None:
+            # the plan's M already priced the bubble/efficiency knee;
+            # degrade to a dividing count like the dispatch path does
+            micro = int(ap.micro_batches)
+            B = max(1, self.config.train_batch_size
+                    // self.config.gradient_accumulation_steps)
+            if B % micro:
+                micro = next((m for m in (2 * S, S, 1) if B % m == 0),
+                             1)
+                log_dist(
+                    f"pipeline: planned micro_batches "
+                    f"{ap.micro_batches} does not divide the global "
+                    f"batch {B}; using {micro}", ranks=[0])
         if not micro and S > 1 and mcfg is not None \
                 and hasattr(mcfg, "d_model"):
             # 'auto' M: the measured knee between bubble amortization
@@ -875,6 +959,37 @@ class DeepSpeedEngine:
         return info
 
     # ------------------------------------------------------- comm overlap
+    @staticmethod
+    def _layer_grad_mb(model, dtype):
+        """Per-layer gradient payload in MB — the shape-bucket key the
+        grad-collective autotune ops (comm_bucket / grad_staging /
+        dcn_quantize) are cached under. 1 when the model can't say."""
+        mcfg = getattr(model, "config", None)
+        count = getattr(mcfg, "num_params", None)
+        if not callable(count):
+            return 1
+        n_layer = max(1, int(getattr(mcfg, "n_layer", 1)))
+        per = count() * jnp.dtype(dtype).itemsize / n_layer
+        return max(1, int(per) >> 20)
+
+    def _resolve_grad_staging(self, co, topology, model):
+        """comm_overlap.hierarchical: explicit bool wins; 'auto' is the
+        'grad_staging' winner for this (device, topology, layer-payload)
+        bucket — the do>1 heuristic on a cold cache (byte-identical to
+        the pre-planner resolution)."""
+        do = topology.axis_size("data_outer")
+        if co.hierarchical != "auto":
+            return bool(co.hierarchical)
+        from ..ops.pallas._common import (dispatch, dtype_name,
+                                          grad_comm_bucket)
+        dt = self.config.precision_dtype
+        win = dispatch(
+            "grad_staging",
+            grad_comm_bucket(self._layer_grad_mb(model, dt),
+                             topology.mesh),
+            dtype_name(dt), {"hierarchical": int(do > 1)})
+        return bool(win["hierarchical"])
+
     def _install_comm_overlap(self, gdtype):
         """Install the per-layer comm hook on the model (zero/overlap.py):
         forward gathers the ZeRO-3 layer shard explicitly (the prefetch
@@ -900,24 +1015,52 @@ class DeepSpeedEngine:
         is_spec = lambda x: isinstance(x, P)
         grad_layer = jax.tree.map(comm_overlap.drop_layer_dim, blocks_grad,
                                   is_leaf=is_spec)
+        # 'auto' knobs resolve against the collective winner cache under
+        # the gradient bucket for this model+topology; every cold-cache
+        # default equals the hand-set value, so a miss compiles the
+        # byte-identical program
+        from ..ops.pallas._common import (dispatch, dtype_name,
+                                          grad_comm_bucket,
+                                          scan_unroll_bucket)
+        dt_name = dtype_name(self.param_dtype)
+        gbucket = grad_comm_bucket(
+            self._layer_grad_mb(self.model, self.param_dtype), self.mesh)
+        bucket_mb = co.bucket_mb
+        if bucket_mb == "auto":
+            bucket_mb = int(dispatch("comm_bucket", gbucket, dt_name,
+                                     {"bucket_mb": 32})["bucket_mb"])
+        dcn_quantize = co.dcn_quantize
+        if dcn_quantize == "auto":
+            dcn_quantize = bool(dispatch("dcn_quantize", gbucket, dt_name,
+                                         {"quantize": 0})["quantize"])
         gather_layer = None
         prefetch_on = (co.prefetch and self.zero_stage >= 3
                        and not self.offload_param_cfg.enabled)
         if prefetch_on:
             gather_layer = jax.tree.map(comm_overlap.drop_layer_dim,
                                         blocks_tp, is_leaf=is_spec)
-            # two consecutive layers per scan body: the i+1 gather has
-            # layer i's matmuls to hide under
-            self.model._scan_unroll_min = 2
+            # unrolled scan bodies give the i+1 gather layer i's matmuls
+            # to hide under; 'auto' = the 'scan_unroll' winner (2 — the
+            # hand-set minimum overlap has shipped with — on a miss)
+            unroll = co.scan_unroll
+            if unroll == "auto":
+                mcfg = getattr(self.model, "config", None)
+                unroll = int(dispatch(
+                    "scan_unroll",
+                    scan_unroll_bucket(getattr(mcfg, "n_layer", 1),
+                                       getattr(mcfg, "d_model", 0),
+                                       self.mesh),
+                    dt_name, {"unroll": 2})["unroll"])
+            self.model._scan_unroll_min = int(unroll)
         self.model._layer_comm_hook = comm_overlap.make_layer_comm_hook(
             grad_layer, gather_specs=gather_layer,
             hierarchical=self._overlap_hier,
-            dcn_quantize=co.dcn_quantize,
-            bucket_bytes=co.bucket_mb << 20, gdtype=gdtype)
+            dcn_quantize=dcn_quantize,
+            bucket_bytes=bucket_mb << 20, gdtype=gdtype)
         log_dist(
-            f"comm_overlap on: bucket_mb={co.bucket_mb} "
+            f"comm_overlap on: bucket_mb={bucket_mb} "
             f"prefetch={prefetch_on} hierarchical={self._overlap_hier} "
-            f"dcn_quantize={co.dcn_quantize} "
+            f"dcn_quantize={dcn_quantize} "
             f"xla_flags={self._overlap_flags[1]}", ranks=[0])
 
     def verify_comm_overlap(self, batch, require_async=False):

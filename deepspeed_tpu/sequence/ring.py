@@ -182,7 +182,25 @@ def _tree_where(pred, a, b):
 
 # --------------------------------------------------------- rotation driver
 
-def _ring_scan(kv, state, step0_fn, step_fn, axis_name, R, double_buffer):
+def _rotate(x, axis_name, perm, chunks=1):
+    """One ring rotation of the (stacked) KV buffer. ``chunks=1`` is the
+    single fused ppermute — bit-identical to the pre-knob program.
+    ``chunks>1`` splits the head dim into that many ppermutes so the
+    first chunk can land (and feed the next kernel's first tiles) while
+    the rest is still on the wire; whether the extra collective launches
+    beat one fused transfer is a measured property of the ICI link (the
+    'ring_rotate' autotune op / ``sequence.rotate_chunks`` knob). A
+    non-dividing chunk count degrades to the fused rotation."""
+    c = int(chunks)
+    if c <= 1 or x.shape[-1] % c:
+        return lax.ppermute(x, axis_name, perm)
+    return jnp.concatenate(
+        [lax.ppermute(p, axis_name, perm)
+         for p in jnp.split(x, c, axis=-1)], axis=-1)
+
+
+def _ring_scan(kv, state, step0_fn, step_fn, axis_name, R, double_buffer,
+               rotate_chunks=1):
     """R compute steps, R-1 KV rotations, no dead last rotation.
 
     ``double_buffer=True`` issues each rotation BEFORE the compute it
@@ -195,12 +213,12 @@ def _ring_scan(kv, state, step0_fn, step_fn, axis_name, R, double_buffer):
         return step0_fn(state, kv)
     perm = [(j, (j + 1) % R) for j in range(R)]
     if double_buffer:
-        kv_nxt = lax.ppermute(kv, axis_name, perm)   # overlaps step 0
+        kv_nxt = _rotate(kv, axis_name, perm, rotate_chunks)  # overlaps step 0
         state = step0_fn(state, kv)
 
         def body(carry, s):
             st, kvb = carry
-            kvn = lax.ppermute(kvb, axis_name, perm)
+            kvn = _rotate(kvb, axis_name, perm, rotate_chunks)
             st = step_fn(st, kvb, s)
             return (st, kvn), None
 
@@ -215,7 +233,7 @@ def _ring_scan(kv, state, step0_fn, step_fn, axis_name, R, double_buffer):
 
     def body(carry, s):
         st, kvb = carry
-        kvb = lax.ppermute(kvb, axis_name, perm)
+        kvb = _rotate(kvb, axis_name, perm, rotate_chunks)
         st = step_fn(st, kvb, s)
         return (st, kvb), None
 
@@ -223,7 +241,8 @@ def _ring_scan(kv, state, step0_fn, step_fn, axis_name, R, double_buffer):
     return state
 
 
-def _ring_bwd_scan(kv, dq0, dkv0, step_bwd, axis_name, R):
+def _ring_bwd_scan(kv, dq0, dkv0, step_bwd, axis_name, R,
+                   rotate_chunks=1):
     """Backward rotation driver: the dk/dv accumulators travel WITH the
     kv buffer (each rank adds its contribution to whatever kv it holds),
     and ONE extra rotation after the last step delivers them home."""
@@ -233,13 +252,13 @@ def _ring_bwd_scan(kv, dq0, dkv0, step_bwd, axis_name, R):
 
     def body(carry, s):
         dq, kvb, dkvb = carry
-        kvb = lax.ppermute(kvb, axis_name, perm)
-        dkvb = lax.ppermute(dkvb, axis_name, perm)
+        kvb = _rotate(kvb, axis_name, perm, rotate_chunks)
+        dkvb = _rotate(dkvb, axis_name, perm, rotate_chunks)
         dq, dkvb = step_bwd(dq, kvb, dkvb, s)
         return (dq, kvb, dkvb), None
 
     (dq, _, dkv), _ = lax.scan(body, (dq0, kv, dkv0), jnp.arange(1, R))
-    return dq, lax.ppermute(dkv, axis_name, perm)
+    return dq, _rotate(dkv, axis_name, perm, rotate_chunks)
 
 
 # ------------------------------------------------------ zigzag causal core
@@ -295,7 +314,7 @@ def _zig_step_bwd(dq, kvb, dkvb, s, *, qf, of, lsef, dof, r, C, bstep):
 
 
 def _zig_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
-                  interpret, double_buffer):
+                  interpret, double_buffer, rotate_chunks):
     """Zigzag-local (B, 2C, H, D) q/k/v -> (o, lse folded). Step 0 is
     plain causal attention on the local buffer (the zigzag pair's local
     order IS the global causal order), later steps unmasked pairs."""
@@ -315,31 +334,31 @@ def _zig_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
     state = _ring_scan(
         kv, state, step0,
         functools.partial(_zig_step, qf=qf, r=r, C=C, step=step),
-        axis_name, R, double_buffer)
+        axis_name, R, double_buffer, rotate_chunks)
     of, lse = flash_block_finalize(state)
     o = of.astype(q.dtype)
     return _unfold(o, B, H), (o, lse)
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
 def _ring_zigzag(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
-                 interpret, double_buffer):
+                 interpret, double_buffer, rotate_chunks):
     o, _ = _zig_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq,
-                         bk, bh, interpret, double_buffer)
+                         bk, bh, interpret, double_buffer, rotate_chunks)
     return o
 
 
 def _ring_zigzag_fwd(q, k, v, axis_name, R, scale, use_kernel, bq, bk,
-                     bh, interpret, double_buffer):
+                     bh, interpret, double_buffer, rotate_chunks):
     o, (of, lsef) = _zig_fwd_impl(q, k, v, axis_name, R, scale,
                                   use_kernel, bq, bk, bh, interpret,
-                                  double_buffer)
+                                  double_buffer, rotate_chunks)
     return o, (q, k, v, of, lsef)
 
 
 def _ring_zigzag_bwd(axis_name, R, scale, use_kernel, bq, bk, bh,
-                     interpret, double_buffer, res, do):
+                     interpret, double_buffer, rotate_chunks, res, do):
     q, k, v, of, lsef = res
     B, Tl, H, D = q.shape
     C = Tl // 2
@@ -356,7 +375,7 @@ def _ring_zigzag_bwd(axis_name, R, scale, use_kernel, bq, bk, bh,
         kv, dq0, dkv0,
         functools.partial(_zig_step_bwd, qf=qf, of=of, lsef=lsef,
                           dof=dof, r=r, C=C, bstep=bstep),
-        axis_name, R)
+        axis_name, R, rotate_chunks)
     dq = dq * scale                   # q was pre-scaled into the kernels
     return (_unfold(dq, B, H).astype(q.dtype),
             _unfold(dkv[0], B, H).astype(k.dtype),
@@ -369,7 +388,7 @@ _ring_zigzag.defvjp(_ring_zigzag_fwd, _ring_zigzag_bwd)
 # -------------------------------------------------- non-causal (full) core
 
 def _full_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
-                   interpret, double_buffer):
+                   interpret, double_buffer, rotate_chunks):
     from ..ops.pallas.flash_attention import (flash_block_finalize,
                                               flash_block_state)
     B, Tl, H, D = q.shape
@@ -382,31 +401,31 @@ def _full_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
         return step(qf, kvb[0], kvb[1], st, False)
 
     state = _ring_scan(kv, state, pair, lambda st, kvb, s: pair(st, kvb),
-                       axis_name, R, double_buffer)
+                       axis_name, R, double_buffer, rotate_chunks)
     of, lse = flash_block_finalize(state)
     o = of.astype(q.dtype)
     return _unfold(o, B, H), (o, lse)
 
 
 @functools.partial(jax.custom_vjp,
-                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11))
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10, 11, 12))
 def _ring_full(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
-               interpret, double_buffer):
+               interpret, double_buffer, rotate_chunks):
     o, _ = _full_fwd_impl(q, k, v, axis_name, R, scale, use_kernel, bq,
-                          bk, bh, interpret, double_buffer)
+                          bk, bh, interpret, double_buffer, rotate_chunks)
     return o
 
 
 def _ring_full_fwd(q, k, v, axis_name, R, scale, use_kernel, bq, bk, bh,
-                   interpret, double_buffer):
+                   interpret, double_buffer, rotate_chunks):
     o, (of, lsef) = _full_fwd_impl(q, k, v, axis_name, R, scale,
                                    use_kernel, bq, bk, bh, interpret,
-                                   double_buffer)
+                                   double_buffer, rotate_chunks)
     return o, (q, k, v, of, lsef)
 
 
 def _ring_full_bwd(axis_name, R, scale, use_kernel, bq, bk, bh, interpret,
-                   double_buffer, res, do):
+                   double_buffer, rotate_chunks, res, do):
     q, k, v, of, lsef = res
     B, Tl, H, D = q.shape
     _, bstep = _make_steps(use_kernel, bq, bk, bh, interpret)
@@ -422,7 +441,8 @@ def _ring_full_bwd(axis_name, R, scale, use_kernel, bq, bk, bh, interpret,
 
     dq0, dkv0 = pair_bwd(jnp.zeros(qf.shape, jnp.float32), kv,
                          jnp.zeros(kv.shape, jnp.float32), 0)
-    dq, dkv = _ring_bwd_scan(kv, dq0, dkv0, pair_bwd, axis_name, R)
+    dq, dkv = _ring_bwd_scan(kv, dq0, dkv0, pair_bwd, axis_name, R,
+                             rotate_chunks)
     dq = dq * scale
     return (_unfold(dq, B, H).astype(q.dtype),
             _unfold(dkv[0], B, H).astype(k.dtype),
@@ -506,9 +526,31 @@ def _resolve_blocks(block_kernel, chunk, D, dtype):
         int(win["block_h"])
 
 
+def _resolve_rotate(rotate_chunks, R, chunk, D, dtype):
+    """Per-rotation ppermute split count: 'auto' -> the autotune winner
+    cache's measured choice for this (device, topology, ring-bucket)
+    (kernel_registry op 'ring_rotate'; 1 = the fused single-ppermute
+    default on a miss). A count that doesn't divide the head dim
+    degrades to fused — never crash the trace over a tuning knob."""
+    if R <= 1:
+        return 1
+    if rotate_chunks == "auto":
+        from ..ops.pallas._common import (dispatch, dtype_name,
+                                          ring_rotate_bucket)
+        win = dispatch("ring_rotate", ring_rotate_bucket(R, chunk, D),
+                       dtype_name(dtype), {"chunks": 1})
+        rc = int(win["chunks"])
+    else:
+        rc = int(rotate_chunks or 1)
+    if rc > 1 and D % rc:
+        rc = 1
+    return max(1, rc)
+
+
 def ring_attention(q, k, v, axis_name="seq", causal=True, *,
                    layout="zigzag", block_kernel="auto",
-                   double_buffer=True, interpret=None, scale=None):
+                   double_buffer=True, rotate_chunks="auto",
+                   interpret=None, scale=None):
     """Blockwise ring attention over an axis group; call inside shard_map.
 
     q, k, v: (B, T_local, H, D) — this device's sequence block(s).
@@ -535,15 +577,16 @@ def ring_attention(q, k, v, axis_name="seq", causal=True, *,
         chunk = Tl
         use_kernel, bq, bk, bh = _resolve_blocks(block_kernel, chunk, D,
                                                  q.dtype)
+        rc = _resolve_rotate(rotate_chunks, int(ring), chunk, D, q.dtype)
         return _ring_full(q, k, v, axis_name, int(ring), float(scale),
                           use_kernel, bq, bk, bh, bool(interpret),
-                          bool(double_buffer))
+                          bool(double_buffer), rc)
     if ring == 1:
         use_kernel, bq, bk, bh = _resolve_blocks(block_kernel, Tl, D,
                                                  q.dtype)
         return _ring_zigzag(q, k, v, axis_name, 1, float(scale),
                             use_kernel, bq, bk, bh, bool(interpret),
-                            bool(double_buffer))
+                            bool(double_buffer), 1)
     if layout not in ("zigzag", "contiguous"):
         raise ValueError(
             f"ring layout must be 'zigzag'|'contiguous', got {layout!r}")
@@ -551,11 +594,12 @@ def ring_attention(q, k, v, axis_name="seq", causal=True, *,
         C = Tl // 2
         use_kernel, bq, bk, bh = _resolve_blocks(block_kernel, C, D,
                                                  q.dtype)
+        rc = _resolve_rotate(rotate_chunks, int(ring), C, D, q.dtype)
         qkv = _to_zigzag(jnp.stack([q, k, v]), axis_name, int(ring),
                          axis=2)
         o = _ring_zigzag(qkv[0], qkv[1], qkv[2], axis_name, int(ring),
                          float(scale), use_kernel, bq, bk, bh,
-                         bool(interpret), bool(double_buffer))
+                         bool(interpret), bool(double_buffer), rc)
         return _from_zigzag(o, axis_name, int(ring), axis=1)
     if layout == "zigzag":
         # odd local chunk: the early/late split doesn't exist — loudly
@@ -601,7 +645,8 @@ def ring_flops_info(ring, T_local, causal=True, layout="zigzag"):
 def ring_attention_sharded(q, k, v, mesh, *, axis_name="seq", causal=True,
                            batch_spec=P(BATCH_AXES), head_axis=None,
                            layout="zigzag", block_kernel="auto",
-                           double_buffer=True, interpret=None):
+                           double_buffer=True, rotate_chunks="auto",
+                           interpret=None):
     """Global-array entry: q/k/v (B, T, H, D) sequence-sharded on
     ``axis_name``; exact causal attention over the full sequence.
     ``head_axis``: optionally shard heads too (ring-CP x TP composition).
@@ -613,6 +658,7 @@ def ring_attention_sharded(q, k, v, mesh, *, axis_name="seq", causal=True,
                           causal=causal, layout=layout,
                           block_kernel=block_kernel,
                           double_buffer=double_buffer,
+                          rotate_chunks=rotate_chunks,
                           interpret=interpret),
         mesh=mesh, in_specs=(spec, spec, spec), out_specs=spec,
         check_vma=False)

@@ -44,6 +44,17 @@ _BUCKETS = {
     "paged_decode": "B4,MB4,BS16,kh2,g2,d32",
     "paged_chunk": "C16,MB4,BS16,kh2,g2,d32",
     "pipe_microbatch": "S2,B8,T128,D128",
+    # collective-bearing ops (autotuning/collective_ops.py): the mesh
+    # topology signature is folded into the bucket string; the step
+    # builders clamp requested axes to the devices actually present, so
+    # these trace on the 1-CPU tier as loopback collectives
+    "comm_bucket": "pp1,do1,dp4,ep1,sp1,tp1,L32",
+    "grad_staging": "pp1,do2,dp2,ep1,sp1,tp1,L32",
+    "a2a_staging": "pp1,do2,dp1,ep2,sp1,tp1,S256,M64",
+    "dcn_quantize": "pp1,do2,dp2,ep1,sp1,tp1,L32",
+    "ring_rotate": "pp1,do1,dp1,ep1,sp2,tp1,R2,T128,d64",
+    "scan_unroll": "pp1,do1,dp4,ep1,sp1,tp1,N4,D128",
+    "hot_replicas": "pp1,do1,dp4,ep1,sp1,tp1,G16",
 }
 
 

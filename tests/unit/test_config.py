@@ -429,3 +429,69 @@ def test_pipeline_hbm_fits():
     assert PipelineConfig.hbm_fits(1 << 30, None)
     assert PipelineConfig.hbm_fits(10 << 30, 16 << 30)
     assert not PipelineConfig.hbm_fits(15 << 30, 16 << 30)  # 0.8 margin
+
+
+# ------------------------------------------- PR12 auto-knob surfaces
+
+def test_new_auto_knob_defaults():
+    """The knobs PR12 opened to 'auto' keep their numeric/bool defaults
+    (cold-cache byte-identity depends on it) except the ones whose
+    default IS 'auto' by design."""
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
+    assert cfg.comm_overlap.scan_unroll == "auto"
+    assert cfg.sequence.rotate_chunks == "auto"
+    assert cfg.checkpoint_engine.hot_replicas == 1
+    assert cfg.moe.dcn_quantize is False
+    assert cfg.parallelism == ""
+
+
+def test_new_auto_knobs_parse_and_roundtrip():
+    raw = {
+        "train_batch_size": 8,
+        "parallelism": "auto",
+        "comm_overlap": {"bucket_mb": "auto", "dcn_quantize": "auto",
+                         "scan_unroll": 4},
+        "sequence": {"rotate_chunks": 2},
+        "moe": {"dcn_quantize": "auto"},
+        "checkpoint_engine": {"hot_replicas": "auto"},
+    }
+    cfg = DeepSpeedConfig(raw, dp_world_size=8)
+    assert cfg.parallelism == "auto"
+    assert cfg.comm_overlap.bucket_mb == "auto"
+    assert cfg.comm_overlap.dcn_quantize == "auto"
+    assert cfg.comm_overlap.scan_unroll == 4
+    assert cfg.sequence.rotate_chunks == 2
+    assert cfg.moe.dcn_quantize == "auto"
+    assert cfg.checkpoint_engine.hot_replicas == "auto"
+    # the same dict parses twice to the same block values (the config
+    # never mutates its input)
+    cfg2 = DeepSpeedConfig(raw, dp_world_size=8)
+    assert cfg2.comm_overlap.bucket_mb == "auto"
+    assert cfg2.sequence.rotate_chunks == 2
+
+
+def test_new_auto_knob_validation():
+    for block, field, bad in [
+        ("comm_overlap", "bucket_mb", "sometimes"),
+        ("comm_overlap", "scan_unroll", 0),
+        ("comm_overlap", "scan_unroll", True),
+        ("comm_overlap", "dcn_quantize", "yes"),
+        ("sequence", "rotate_chunks", 0),
+        ("sequence", "rotate_chunks", "maybe"),
+        ("moe", "dcn_quantize", "yes"),
+        ("checkpoint_engine", "hot_replicas", "many"),
+        ("checkpoint_engine", "hot_replicas", -1),
+    ]:
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_batch_size": 8, block: {field: bad}},
+                            dp_world_size=8)
+
+
+def test_parallelism_top_level_validation():
+    for ok in ("", "auto"):
+        cfg = DeepSpeedConfig({"train_batch_size": 8,
+                               "parallelism": ok}, dp_world_size=8)
+        assert cfg.parallelism == ok
+    with pytest.raises(DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 8, "parallelism": "manual"},
+                        dp_world_size=8)

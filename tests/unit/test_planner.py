@@ -1,0 +1,313 @@
+"""Auto-parallelism planner: golden plans, calibration discipline, the
+``parallelism: "auto"`` engine path, and cold-cache byte-identity.
+
+The golden cases pin the planner's load-bearing answers: the 13B
+preset on a small pod MUST come back as zero-bubble + host offload
+(ROADMAP item 4's measured point — nothing else fits HBM), a tiny model
+on one chip MUST come back as "do nothing", and an infeasible
+model/pod pair must yield an empty ranking, never a plan that would
+OOM at step one. Byte-identity pins the other contract: with a cold
+winner cache, every "auto" knob lowers the exact program the previous
+hand-set defaults did.
+"""
+
+import importlib.util
+import os
+
+import jax
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.autotuning.kernel_cache import KernelCache, seed_entries
+from deepspeed_tpu.autotuning.planner import (ModelDesc, PodDesc,
+                                              calibrate_links, plan)
+from deepspeed_tpu.models import GPT2, GPT2Config
+from deepspeed_tpu.models.gpt2 import GPT2_13B
+from deepspeed_tpu.utils import groups
+from deepspeed_tpu.utils.groups import TopologyConfig
+
+CFG = GPT2Config(n_layer=4, n_head=2, d_model=64, max_seq_len=32,
+                 vocab_size=256, remat=False, dtype="float32")
+
+# the acceptance pod: 8 chips x 16 GB — small enough that a 13B-class
+# model cannot keep device-resident Adam moments anywhere on the mesh
+SMALL_POD = dict(n_chips=8, hbm_bytes=16 << 30, n_slices=1)
+
+
+def _empty_cache_env(monkeypatch, tmp_path):
+    monkeypatch.setenv("DSTPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "cold_cache.json"))
+
+
+# ----------------------------------------------------------- golden plans
+
+def test_13b_small_pod_plans_zb_plus_offload():
+    """The headline golden case: GPT2-13B on an 8x16GB pod with pp >= 2
+    ranks zero-bubble + host offload first — every non-offload variant
+    is HBM-pruned (the +12 bytes/param moments never fit), and among
+    the offload survivors zb's tick sum is minimal."""
+    m = ModelDesc.from_model_config(GPT2_13B)
+    report = plan(m, PodDesc(**SMALL_POD), pp_min=2)
+    top = report.top()
+    assert top is not None
+    assert top.schedule == "zb"
+    assert top.offload is True
+    assert top.mesh["pipe"] >= 2
+    # the pruning actually happened (the case is non-vacuous) and every
+    # surviving rank fits
+    assert report.pruned_hbm > 0
+    assert all(p.hbm_fits for p in report.plans)
+    assert all(p.offload for p in report.plans), \
+        "a non-offload 13B plan survived HBM pruning on a 16GB chip"
+
+
+def test_tiny_model_single_chip_plans_identity():
+    m = ModelDesc(params=1 << 20, n_layer=2, d_model=64, n_head=2,
+                  max_seq_len=128)
+    report = plan(m, PodDesc(n_chips=1, hbm_bytes=16 << 30))
+    top = report.top()
+    assert top.mesh == {"pipe": 1, "data_outer": 1, "data": 1,
+                        "expert": 1, "seq": 1, "tensor": 1}
+    assert top.schedule == "none"
+    assert top.micro_batches == 1
+    # both offload variants fit; the staging cost must rank device-
+    # resident first
+    assert top.offload is False
+
+
+def test_infeasible_pod_is_never_ranked():
+    """13B on 2x1GB chips with no host memory tier: nothing fits, and
+    the report says so (empty ranking + a non-zero pruned counter)
+    instead of recommending an OOM."""
+    m = ModelDesc.from_model_config(GPT2_13B)
+    report = plan(m, PodDesc(n_chips=2, hbm_bytes=1 << 30,
+                             host_offload=False))
+    assert report.plans == []
+    assert report.pruned_hbm > 0
+
+
+def test_mesh_enumeration_respects_model_dims():
+    """Axis admissibility: tp must divide heads, sp the half-sequence,
+    pp the chip count and stay <= layers, and every mesh multiplies out
+    to the chip count."""
+    m = ModelDesc(params=1 << 22, n_layer=2, d_model=64, n_head=2,
+                  max_seq_len=128)
+    report = plan(m, PodDesc(**SMALL_POD), max_plans=64)
+    assert report.plans
+    for p in report.plans:
+        sizes = p.mesh
+        total = 1
+        for v in sizes.values():
+            total *= v
+        assert total == SMALL_POD["n_chips"]
+        assert m.n_head % sizes["tensor"] == 0
+        assert sizes["pipe"] <= m.n_layer
+        if sizes["seq"] > 1:
+            assert m.max_seq_len % (2 * sizes["seq"]) == 0
+        # no experts in this model: the expert axis may never be carved
+        assert sizes["expert"] == 1
+
+
+def test_plan_config_and_topology_roundtrip():
+    m = ModelDesc.from_model_config(GPT2_13B)
+    top = plan(m, PodDesc(**SMALL_POD), pp_min=2).top()
+    cfg = top.config({"train_batch_size": 64})
+    assert cfg["tensor_parallel"]["size"] == top.mesh["tensor"]
+    assert cfg["pipeline"]["stages"] == top.mesh["pipe"]
+    assert cfg["pipeline"]["schedule"] == "zb"
+    assert cfg["pipeline"]["offload_activations"] is True
+    assert cfg["train_batch_size"] == 64  # base keys survive the merge
+    # the topology kwargs build a real mesh of the planned shape
+    groups.reset()
+    topo = groups.initialize(TopologyConfig(**top.topology_kwargs()),
+                             force=True)
+    shape = dict(topo.mesh.shape)
+    assert shape["tensor"] == top.mesh["tensor"]
+    assert shape["pipe"] == top.mesh["pipe"]
+    assert shape["data"] * shape["data_outer"] == \
+        top.mesh["data"] * top.mesh["data_outer"]
+
+
+# ------------------------------------------------- alpha-beta calibration
+
+def _link_row(kind, alpha_us, beta_gbps, device_kind="cpu"):
+    return {"device_kind": device_kind, "op": "comm_link",
+            "bucket": f"pp1,do1,dp8,ep1,sp1,tp1,k{kind}",
+            "dtype": "float32",
+            "params": {"kind": kind, "alpha_us": alpha_us,
+                       "beta_gbps": beta_gbps, "busbw_gbps": beta_gbps}}
+
+
+def test_calibrate_links_reads_seeded_rows(tmp_path):
+    path = str(tmp_path / "cache.json")
+    n = seed_entries([_link_row("ici", 2.0, 40.0),
+                      _link_row("dcn", 50.0, 3.0)], path=path)
+    assert n == 2
+    pod = PodDesc(**SMALL_POD, device_kind="cpu")
+    links = calibrate_links(pod, cache=KernelCache.load(path))
+    assert links["ici"] == pytest.approx((2.0e-6, 40.0e9))
+    assert links["dcn"] == pytest.approx((50.0e-6, 3.0e9))
+
+
+def test_calibrate_links_refuses_foreign_device_kind(tmp_path):
+    """The cache's device-kind refusal rule applies to calibration too:
+    CPU-measured link speeds must never steer a TPU plan."""
+    path = str(tmp_path / "cache.json")
+    seed_entries([_link_row("ici", 2.0, 40.0, device_kind="cpu")],
+                 path=path)
+    pod = PodDesc(**SMALL_POD, device_kind="TPU v5e")
+    links = calibrate_links(pod, cache=KernelCache.load(path))
+    assert links["ici"] == (pod.ici_alpha_us * 1e-6, pod.ici_gbps * 1e9)
+
+
+def test_comm_bench_cache_rows_shape():
+    """comm_bench.cache_rows distills a sweep into seedable comm_link
+    entries: alpha from the small payload, beta from the slope."""
+    spec = importlib.util.spec_from_file_location(
+        "comm_bench", os.path.join(os.path.dirname(__file__), os.pardir,
+                                   os.pardir, "benchmarks",
+                                   "comm_bench.py"))
+    cb = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(cb)
+    groups.reset()
+    topo = groups.initialize(force=True)
+    results = [
+        {"op": "ppermute", "mb": 1, "ms": 1.0, "gbps": 1.0,
+         "busbw_gbps": 1.0},
+        {"op": "ppermute", "mb": 9, "ms": 2.0, "gbps": 4.5,
+         "busbw_gbps": 4.5},
+        {"op": "all_to_all", "mb": 1, "ms": 1.0, "gbps": 1.0,
+         "busbw_gbps": 0.875},
+    ]
+    rows = cb.cache_rows(results, mesh=topo.mesh)
+    assert [r["op"] for r in rows] == ["comm_link"]  # no dcn axis here
+    (row,) = rows
+    assert row["bucket"].endswith(",kici")
+    W = topo.mesh.shape["data"]
+    # t = alpha + bytes/beta through (1MB/W, 1ms) and (9MB/W, 2ms):
+    # beta = 8MB/W per ms, alpha = 1ms - (1MB/W)/beta = 0.875 ms
+    assert row["params"]["alpha_us"] == pytest.approx(875.0)
+    assert row["params"]["beta_gbps"] == pytest.approx(8e6 / W / 1e-3
+                                                       / 1e9)
+    # the rows round-trip through the seeder into a loadable cache
+    assert seed_entries(rows, path=os.path.join(
+        os.environ.get("TMPDIR", "/tmp"), "_planner_rows.json")) == 1
+
+
+# ------------------------------------------- parallelism: "auto" engine
+
+def _auto_engine(monkeypatch, tmp_path, **extra):
+    _empty_cache_env(monkeypatch, tmp_path)
+    groups.reset()
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2(CFG), config={
+            "train_batch_size": 8,
+            "steps_per_print": 0,
+            "parallelism": "auto",
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+            **extra,
+        })
+    return engine
+
+
+def test_parallelism_auto_builds_planned_mesh(monkeypatch, tmp_path):
+    """End-to-end on the virtual mesh: parallelism='auto' plans, adopts
+    the top plan's topology and pipeline picks, and the engine trains a
+    step on the planned mesh."""
+    engine = _auto_engine(monkeypatch, tmp_path)
+    ap = engine._auto_plan
+    assert ap is not None
+    assert engine.plan_report.top() is ap
+    shape = dict(engine.mesh.shape)
+    for axis in ("pipe", "tensor", "seq", "expert"):
+        assert shape[axis] == ap.mesh[axis]
+    if ap.schedule != "none":
+        assert engine._pipe.schedule == ap.schedule
+        assert engine._pipe.micro_batches == ap.micro_batches
+    rng = np.random.RandomState(0)
+    batch = {"input_ids": rng.randint(0, CFG.vocab_size, (8, 32))
+             .astype(np.int32)}
+    loss = float(engine.train_batch(batch))
+    assert np.isfinite(loss)
+
+
+def test_parallelism_auto_defers_to_explicit_topology(monkeypatch,
+                                                      tmp_path):
+    """An explicit topology= argument wins: the planner must never
+    override a mesh the caller constructed."""
+    _empty_cache_env(monkeypatch, tmp_path)
+    groups.reset()
+    topo = groups.initialize(
+        TopologyConfig(data_parallel_size=2), devices=jax.devices()[:2],
+        force=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2(CFG), topology=topo, config={
+            "train_batch_size": 8, "steps_per_print": 0,
+            "parallelism": "auto",
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 1},
+        })
+    assert engine._auto_plan is None
+    assert dict(engine.mesh.shape)["data"] == 2
+
+
+# ------------------------------------------------ cold-cache byte-identity
+
+def _lowered_text(engine, batch):
+    batch = jax.tree.map(engine._add_gas_dim, batch)
+    batch = engine._shard_batch(batch, with_gas_dim=True)
+    with jax.set_mesh(engine.mesh):
+        return engine._train_step_jit.lower(
+            engine.state, batch, engine._current_lr(), None).as_text()
+
+
+def _overlap_engine(dp, shard=-1, **co):
+    groups.reset()
+    topo = groups.initialize(
+        TopologyConfig(data_parallel_size=dp, zero_shard_size=shard),
+        devices=jax.devices()[:dp], force=True)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=GPT2(CFG), topology=topo, config={
+            "train_batch_size": 4, "steps_per_print": 0,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-3}},
+            "zero_optimization": {"stage": 2},
+            "comm_overlap": {"enabled": True, **co},
+        })
+    return engine
+
+
+def _batch(n=4):
+    rng = np.random.RandomState(0)
+    return {"input_ids": rng.randint(0, CFG.vocab_size,
+                                     (n, CFG.max_seq_len))
+            .astype(np.int32)}
+
+
+def test_cold_cache_bucket_auto_is_byte_identical(monkeypatch, tmp_path):
+    """With no measured winners, comm_overlap bucket_mb/dcn_quantize
+    'auto' must lower the exact program of the previous hand-set
+    defaults (bucket_mb=32, dcn_quantize off) — dispatch's cold-cache
+    answer IS the old default, so the HLO may not move by a byte."""
+    _empty_cache_env(monkeypatch, tmp_path)
+    batch = _batch()
+    auto = _lowered_text(_overlap_engine(
+        2, bucket_mb="auto", dcn_quantize="auto"), batch)
+    hand = _lowered_text(_overlap_engine(
+        2, bucket_mb=32, dcn_quantize=False), batch)
+    assert auto == hand
+
+
+def test_cold_cache_hierarchical_auto_is_byte_identical(monkeypatch,
+                                                        tmp_path):
+    """Same identity for the hierarchical grad staging knob on a real
+    data_outer split (dp=4, shard=2 -> do=2): 'auto' resolves through
+    the grad_staging op whose cold default is the do>1 heuristic."""
+    _empty_cache_env(monkeypatch, tmp_path)
+    batch = _batch()
+    auto = _lowered_text(_overlap_engine(
+        4, shard=2, hierarchical="auto"), batch)
+    hand = _lowered_text(_overlap_engine(
+        4, shard=2, hierarchical=True), batch)
+    assert auto == hand

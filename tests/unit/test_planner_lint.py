@@ -1,0 +1,122 @@
+"""Two-direction coverage lint for the auto-parallelism knob table.
+
+The planner's KNOB_TABLE claims to be the single source of truth tying
+every ``"auto"``-accepting config knob to its resolver. This lint keeps
+the claim honest in both directions, mechanically:
+
+  1. every config-block field that ACCEPTS "auto" (discovered by
+     construction probes, not by reading the table) appears in
+     KNOB_TABLE — a new auto knob cannot land without declaring who
+     resolves it;
+  2. every op in the tunable-op REGISTRY is reachable from some
+     KNOB_TABLE entry — a new registry op cannot land orphaned, with no
+     config surface that could ever consult its winners.
+"""
+
+import dataclasses
+
+import pytest
+
+from deepspeed_tpu.autotuning.planner import KNOB_TABLE
+from deepspeed_tpu.runtime import config as cfg_mod
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+# config key -> block dataclass, mirroring how DeepSpeedConfig wires its
+# sub-blocks (the lint probes the CLASSES so discovery needs no engine)
+_BLOCKS = {
+    "fp16": cfg_mod.FP16Config,
+    "bf16": cfg_mod.BF16Config,
+    "zero_optimization": cfg_mod.ZeroConfig,
+    "tensor_parallel": cfg_mod.TensorParallelConfig,
+    "pipeline": cfg_mod.PipelineConfig,
+    "checkpoint_engine": cfg_mod.CheckpointEngineConfig,
+    "comm_overlap": cfg_mod.CommOverlapConfig,
+    "sequence": cfg_mod.SequenceConfig,
+    "moe": cfg_mod.MoEConfig,
+    "autotune": cfg_mod.AutotuneConfig,
+    "telemetry": cfg_mod.TelemetryConfig,
+}
+
+# auto-sentinel exceptions: knobs whose 'auto' spelling is not the
+# string "auto" (pipeline.micro_batches uses 0, the reference idiom)
+_SENTINELS = {("pipeline", "micro_batches"): 0}
+
+_JUNK = "___definitely_not_a_knob_value___"
+
+
+def _accepts(cls, field, value):
+    try:
+        cls(**{field: value})
+        return True
+    except Exception:  # noqa: BLE001 - any validation error counts
+        return False
+
+
+def discovered_auto_knobs():
+    """Every (block, field) whose dataclass constructs with "auto" AND
+    rejects a junk value — i.e. validated fields where "auto" is a
+    deliberately admitted spelling, not an unvalidated pass-through."""
+    found = set()
+    for key, cls in _BLOCKS.items():
+        for f in dataclasses.fields(cls):
+            if _accepts(cls, f.name, "auto") \
+                    and not _accepts(cls, f.name, _JUNK):
+                found.add((key, f.name))
+    for (key, fname), sentinel in _SENTINELS.items():
+        cls = _BLOCKS[key]
+        if _accepts(cls, fname, sentinel) \
+                and not _accepts(cls, fname, _JUNK):
+            found.add((key, fname))
+    return found
+
+
+def test_every_auto_knob_is_in_the_table():
+    missing = {f"{b}.{f}" for b, f in discovered_auto_knobs()} \
+        - set(KNOB_TABLE)
+    assert not missing, (
+        f"config knobs accept 'auto' but declare no resolver in "
+        f"planner.KNOB_TABLE: {sorted(missing)} — add an entry naming "
+        f"the registry op or heuristic that resolves each")
+
+
+def test_table_block_knobs_really_accept_auto():
+    """The reverse of discovery for the block-level entries: a table row
+    must not claim an auto knob that the config no longer validates
+    (stale table rows would make the lint vacuous)."""
+    discovered = {f"{b}.{f}" for b, f in discovered_auto_knobs()}
+    block_rows = {k for k in KNOB_TABLE
+                  if k.split(".", 1)[0] in _BLOCKS and "." in k}
+    stale = block_rows - discovered
+    assert not stale, (
+        f"KNOB_TABLE rows name config fields that do not accept 'auto' "
+        f"(or are unvalidated): {sorted(stale)}")
+
+
+def test_top_level_parallelism_accepts_auto():
+    """The one auto knob living outside any block: top-level
+    ``parallelism`` — "" and "auto" pass, junk raises."""
+    DeepSpeedConfig({"train_batch_size": 1, "parallelism": "auto"},
+                    dp_world_size=1)
+    with pytest.raises(cfg_mod.DeepSpeedConfigError):
+        DeepSpeedConfig({"train_batch_size": 1, "parallelism": _JUNK},
+                        dp_world_size=1)
+    assert "parallelism" in KNOB_TABLE
+
+
+def test_every_registry_op_is_reachable_from_the_table():
+    from deepspeed_tpu.autotuning.kernel_registry import REGISTRY
+    table_ops = {v.get("op") for v in KNOB_TABLE.values()} - {None}
+    orphaned = set(REGISTRY) - table_ops
+    assert not orphaned, (
+        f"registry ops with no config knob that could consult their "
+        f"winners: {sorted(orphaned)} — add a KNOB_TABLE entry")
+
+
+def test_every_table_op_exists_in_the_registry():
+    from deepspeed_tpu.autotuning.kernel_registry import REGISTRY
+    table_ops = {v.get("op") for v in KNOB_TABLE.values()} - {None}
+    phantom = table_ops - set(REGISTRY)
+    assert not phantom, (
+        f"KNOB_TABLE names ops that are not in the registry: "
+        f"{sorted(phantom)} (note comm_link is cache-file-only by "
+        f"design and must never appear in the table)")
