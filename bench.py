@@ -332,6 +332,55 @@ def _telemetry_extras(steps, warmup):
     return out
 
 
+def _reconcile_extras(steps, warmup):
+    """``extras.reconcile`` (ISSUE 13): a step-ranged profiler capture
+    on the tiny preset, parsed into a StepDecomposition and reconciled
+    against the planner's ``_score`` breakdown for the mesh the run
+    actually used. The artifact carries the drift summary (which term
+    the cost model gets most wrong on this chip) and the measured term
+    split. Isolated like every variant — reconcile must never cost the
+    headline number."""
+    out = {}
+    saved = {k: os.environ.get(k)
+             for k in ("BENCH_TELEMETRY", "BENCH_PRESET",
+                       "BENCH_MICRO_BS", "BENCH_SEQ",
+                       "DSTPU_PROFILE_STEPS")}
+    # arm the capture BEFORE engine build (ProfilerControl reads the
+    # env at construction): trace the two steps after warmup
+    os.environ.update({
+        "BENCH_TELEMETRY": "1", "BENCH_PRESET": "tiny",
+        "BENCH_MICRO_BS": "8", "BENCH_SEQ": "128",
+        "DSTPU_PROFILE_STEPS": f"{warmup + 1}:{warmup + 3}"})
+    try:
+        engine, batch = build_bench_engine()
+        for _ in range(max(steps, warmup + 4)):
+            engine.train_batch(batch)
+        engine.telemetry.drain()            # reconcile runs pool-side
+        snap = engine.telemetry_report() or {}
+        out["summary"] = snap.get("reconcile")
+        rep = engine.reconcile_report()
+        if rep is not None:
+            dec = rep.get("decomposition") or {}
+            out["terms_measured_ms"] = dec.get("terms")
+            out["coverage_pct"] = dec.get("coverage_pct")
+            out["cpu_fallback"] = dec.get("cpu_fallback")
+            drift = rep.get("drift") or {}
+            out["drift_rows"] = drift.get("rows")
+            out["modeled_wall_ms"] = drift.get("modeled_wall_ms")
+            out["measured_wall_ms"] = drift.get("measured_wall_ms")
+        del engine, batch
+        gc.collect()
+    except Exception as e:  # noqa: BLE001 - isolate, like variants
+        out["error"] = f"{type(e).__name__}: {e}"[:300]
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return out
+
+
 def main():
     steps = int(os.environ.get("BENCH_STEPS", "10"))
     warmup = int(os.environ.get("BENCH_WARMUP", "3"))
@@ -410,6 +459,15 @@ def main():
             int(os.environ.get("BENCH_TELEMETRY_STEPS", "6")),
             int(os.environ.get("BENCH_TELEMETRY_WARMUP", "2")))
 
+    # modeled-vs-measured reconciliation (ISSUE 13): profile a short
+    # tiny-preset run and diff the planner's term breakdown against the
+    # trace's step decomposition. BENCH_RECONCILE=0 skips.
+    reconcile_info = {}
+    if os.environ.get("BENCH_RECONCILE", "1") != "0":
+        reconcile_info = _reconcile_extras(
+            int(os.environ.get("BENCH_RECONCILE_STEPS", "6")),
+            int(os.environ.get("BENCH_RECONCILE_WARMUP", "2")))
+
     report = {
         "metric": (f"gpt2-{preset} zero{stage}"
                    + (f"-offload-{offload}" if offload else "")
@@ -429,6 +487,7 @@ def main():
             "variants": variants,
             "autotune": autotune_info,
             "telemetry": telemetry_info,
+            "reconcile": reconcile_info,
         },
     }
 

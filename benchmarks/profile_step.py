@@ -31,6 +31,17 @@ def main():
         float(np.asarray(engine.state["step"]))
     print(f"trace written to {outdir}")
 
+    # immediate step anatomy (the trace_summary/reconcile CLIs go
+    # deeper; this is the at-a-glance readout)
+    from deepspeed_tpu.profiling import step_trace  # noqa: E402
+    d = step_trace.decompose_dir(outdir, steps=3, mesh=engine.mesh)
+    if d is not None:
+        print(f"step decomposition ({d.total_device_ms:.1f} ms/step, "
+              f"coverage {d.coverage_pct:.1f}%):")
+        for term, ms in sorted(d.terms.items(), key=lambda kv: -kv[1]):
+            if ms > 0:
+                print(f"  {term:>14}: {ms:.2f} ms")
+
 
 if __name__ == "__main__":
     main()

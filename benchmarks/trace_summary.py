@@ -1,101 +1,90 @@
 """Aggregate device-op self times from a jax.profiler Chrome trace.
 
 Usage: python benchmarks/trace_summary.py /tmp/dstpu_trace [n_steps]
-Prints per-op-name total duration (ms) sorted descending, grouped by a
-coarse family (matmul/fusion/pallas/...), divided by n_steps.
+       python benchmarks/trace_summary.py /tmp/dstpu_trace --steps 3 --json
+
+Thin CLI over ``deepspeed_tpu.profiling.step_trace`` (the parsing that
+used to live here, promoted to a library): prints per-op self time
+(ms/step) sorted descending plus coarse-family and planner-term rollups,
+or the full versioned ``StepDecomposition`` JSON with ``--json``.
+For modeled-vs-measured drift against the planner, see
+``python -m deepspeed_tpu.profiling.reconcile``.
 """
 
+import argparse
 import collections
-import glob
-import gzip
-import json
-import re
+import os
 import sys
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-def main():
-    root = sys.argv[1] if len(sys.argv) > 1 else "/tmp/dstpu_trace"
-    steps = int(sys.argv[2]) if len(sys.argv) > 2 else 3
-    paths = glob.glob(f"{root}/**/*.trace.json.gz", recursive=True)
-    if not paths:
-        raise SystemExit(f"no trace under {root}")
-    with gzip.open(sorted(paths)[-1], "rt") as f:
-        trace = json.load(f)
-    events = trace["traceEvents"]
+from deepspeed_tpu.profiling import step_trace  # noqa: E402
 
-    # find device-side track pids (TensorCore / device compute threads)
-    pid_names = {}
-    tid_names = {}
-    for e in events:
-        if e.get("ph") == "M" and e.get("name") == "process_name":
-            pid_names[e["pid"]] = e["args"].get("name", "")
-        if e.get("ph") == "M" and e.get("name") == "thread_name":
-            tid_names[(e["pid"], e["tid"])] = e["args"].get("name", "")
-    dev_pids = {p for p, n in pid_names.items()
-                if "TPU" in n or "/device" in n.lower() or "Core" in n}
-    # only the "XLA Ops" thread carries leaf device ops; Steps/Modules
-    # tracks are whole-step envelopes that would double count
-    op_tids = {k for k, n in tid_names.items()
-               if k[0] in dev_pids and n == "XLA Ops"}
 
-    # self time: duration minus nested children on the same (pid, tid)
-    by_tid = collections.defaultdict(list)
-    for e in events:
-        if e.get("ph") != "X" or (e["pid"], e.get("tid")) not in op_tids:
-            continue
-        by_tid[(e["pid"], e.get("tid"))].append(e)
+def build_parser():
+    p = argparse.ArgumentParser(
+        description="device-op self-time summary for a jax.profiler "
+                    "trace")
+    p.add_argument("root", nargs="?", default="/tmp/dstpu_trace",
+                   help="trace dir (searched recursively) or file")
+    # positional steps kept for the historical calling convention
+    p.add_argument("steps_pos", nargs="?", type=int, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--steps", type=int, default=3,
+                   help="steps the capture covered (default 3)")
+    p.add_argument("--top", type=int, default=45,
+                   help="op rows to print (default 45)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the StepDecomposition JSON instead of "
+                        "the table")
+    return p
 
-    per_op = collections.Counter()
-    per_op_n = collections.Counter()
-    total = 0.0
-    for evs in by_tid.values():
-        evs.sort(key=lambda e: (e["ts"], -e.get("dur", 0)))
-        stack = []  # (end_ts, child_time_accum index into selfs)
-        selfs = []
-        for e in evs:
-            ts, dur = e["ts"], e.get("dur", 0)
-            while stack and stack[-1][0] <= ts:
-                stack.pop()
-            if stack:
-                selfs[stack[-1][1]][1] -= dur
-            selfs.append([e, dur])
-            stack.append((ts + dur, len(selfs) - 1))
-        for e, sdur in selfs:
-            name = e.get("name", "?")
-            dur = max(sdur, 0) / 1000.0  # us -> ms
-            per_op[name] += dur
-            per_op_n[name] += 1
-            total += dur
 
-    print(f"device tracks: {[pid_names[p] for p in dev_pids]}")
-    print(f"total device time: {total:.1f} ms over {steps} steps "
-          f"= {total / steps:.1f} ms/step\n")
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    steps = args.steps_pos if args.steps_pos is not None else args.steps
+    path = step_trace.find_trace_file(args.root)
+    if path is None:
+        raise SystemExit(f"no trace under {args.root}")
+    d = step_trace.decompose(step_trace.load_trace_events(path),
+                             steps=max(1, steps), trace_path=path)
+    if d is None:
+        raise SystemExit(f"trace {path} carries no recognizable "
+                         f"device/op track")
+    if args.json:
+        sys.stdout.write(d.to_json())
+        return 0
+
+    print(f"device tracks: {d.device_tracks}"
+          + (" (CPU-client fallback)" if d.cpu_fallback else ""))
+    print(f"total device time: {d.total_device_ms * d.steps:.1f} ms "
+          f"over {d.steps} steps = {d.total_device_ms:.1f} ms/step\n")
     print(f"{'ms/step':>9}  {'count':>6}  op")
-    for name, dur in per_op.most_common(45):
-        print(f"{dur / steps:9.2f}  {per_op_n[name] // steps:6d}  "
-              f"{name[:100]}")
+    for row in d.per_op[:args.top]:
+        print(f"{row['ms']:9.2f}  {row['count'] // d.steps:6d}  "
+              f"{row['op'][:100]}")
 
-    # coarse families
     fams = collections.Counter()
-    for name, dur in per_op.items():
-        n = name.lower()
-        if "custom-call" in n or "pallas" in n or "flash" in n:
-            fam = "pallas/custom-call"
-        elif re.search(r"convolution|dot|einsum", n):
-            fam = "matmul"
-        elif "fusion" in n:
-            fam = "fusion(elementwise/other)"
-        elif "copy" in n or "transpose" in n or "bitcast" in n:
-            fam = "copy/layout"
-        elif "scatter" in n or "gather" in n or "dynamic" in n:
-            fam = "gather/scatter/DUS"
-        else:
-            fam = "other"
-        fams[fam] += dur
+    for row in d.per_op:
+        fams[row["family"]] += row["ms"]
     print("\nfamilies (ms/step):")
     for fam, dur in fams.most_common():
-        print(f"{dur / steps:9.2f}  {fam}")
+        print(f"{dur:9.2f}  {fam}")
+
+    print("\nplanner terms (exposed ms/step):")
+    for term in step_trace.DECOMP_TERMS:
+        v = d.terms.get(term, 0.0)
+        if v > 0:
+            print(f"{v:9.2f}  {term}")
+    for key, v in sorted(d.unmodeled.items()):
+        if v > 0:
+            print(f"{v:9.2f}  {key} (unmodeled)")
+    if d.collective_total_ms > 0:
+        print(f"\ncollectives: {d.collective_total_ms:.2f} ms/step "
+              f"({d.collective_exposed_ms:.2f} exposed, "
+              f"{d.collective_hidden_ms:.2f} hidden)")
+    return 0
 
 
 if __name__ == "__main__":
-    main()
+    sys.exit(main())

@@ -3,3 +3,8 @@ from .scheduler import Node, Reservation, ResourceManager, SubprocessRunner
 from .tuner import CostModel, GridSearchTuner, ModelBasedTuner, RandomTuner
 from . import kernel_dispatch
 from .kernel_cache import KernelCache, default_cache_path
+# NOTE: the module is exported, not the bare reconcile() function —
+# `autotuning.reconcile` must stay addressable as a module
+from . import reconcile
+from .reconcile import DriftReport, reconcile_trace, seed_rows, \
+    seed_cache

@@ -365,6 +365,14 @@ def _t_coll(bytes_, world, link, kind="ring"):
 _HIDDEN_FRAC = 0.75
 _OFFLOAD_EXPOSED = {"zb": 0.25, "1f1b": 0.5, "gpipe": 0.5, "none": 0.5}
 
+# every cost term ``_score`` can emit, in reporting order. This is the
+# reconciliation vocabulary: ``autotuning/reconcile.py`` pairs each one
+# with a measured ``profiling.step_trace`` decomposition key, and the
+# two-direction lint in tests/unit/test_reconcile.py greps ``_score``'s
+# source to keep this tuple honest.
+SCORE_TERMS = ("compute", "grad_reduce", "tp_reduce", "pipe_handoff",
+               "ring_rotate", "expert_a2a", "host_offload")
+
 
 def _estimate_state_bytes(model, mesh, offload):
     """The engine's ``_estimate_pipe_state_bytes`` heuristic on a plan:

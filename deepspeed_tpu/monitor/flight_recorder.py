@@ -83,6 +83,7 @@ class FlightRecorder:
         self.root = None          # set via set_root; None = tmp fallback
         self._prev_sigterm = None
         self._dumped_reason = None
+        self._context = {}        # sticky facts carried into every dump
 
     # ------------------------------------------------------------ events
     def record(self, kind, **data):
@@ -90,6 +91,19 @@ class FlightRecorder:
         ev.update(data)
         with self._lock:
             self._events.append(ev)
+
+    def set_context(self, key, value):
+        """Attach a sticky fact to every future dump (latest wins per
+        key) — unlike ring events these survive however many steps pass
+        before the crash. Telemetry parks the newest reconcile drift
+        summary here so a post-mortem shows whether the pod was running
+        off-model."""
+        with self._lock:
+            self._context[key] = value
+
+    def context(self):
+        with self._lock:
+            return dict(self._context)
 
     def events(self):
         with self._lock:
@@ -122,6 +136,11 @@ class FlightRecorder:
             "dumped_at": round(time.time(), 6),
             "events": self.events(),
         }
+        # only when something was parked: dumps stay byte-identical to
+        # the pre-context schema on runs that never reconcile
+        ctx = self.context()
+        if ctx:
+            payload["context"] = ctx
         try:
             os.makedirs(root, exist_ok=True)
             # per-call unique tmp: a main-thread crash dump can race a

@@ -90,6 +90,22 @@ TAG_SCHEMA = {
         "D2H+H2D activation-ring payload host offload stages per step "
         "(0 = offload off) — the copy overhead the schedule must hide",
 
+    # --- modeled-vs-measured reconciliation (telemetry._flush after a
+    #     ProfilerControl capture; autotuning/reconcile.py is the
+    #     source) ---
+    "Train/Reconcile/wall_err_pct":
+        "abs(modeled - measured) step wall / measured, pct — how far "
+        "off-model the pod is running",
+    "Train/Reconcile/top_drift_ms":
+        "largest absolute modeled-vs-measured drift across planner "
+        "_score terms (per step, ms)",
+    "Train/Reconcile/top_drift_term":
+        "index of the worst-drift term in planner.SCORE_TERMS "
+        "(-1 = none)",
+    "Train/Reconcile/coverage_pct":
+        "share of measured device time the step decomposition "
+        "attributed to a term",
+
     # --- pod-wide aggregation (rank 0 only; cluster_agg transports) ---
     "Train/Telemetry/cluster_step_ms_p50":
         "p50 of per-host mean step time across the pod",

@@ -284,15 +284,22 @@ class ProfilerControl:
     flight-recorder dir mid-run (content = step count, default 5;
     checked only at flush boundaries so the step path never stats a
     file). Traces land under ``{logdir}/xprof`` for
-    ``tensorboard --logdir`` / xprof."""
+    ``tensorboard --logdir`` / xprof.
 
-    def __init__(self, port=0, logdir=None, flight=None):
+    ``on_trace(logdir, steps, step)`` fires after a capture stops —
+    the step-anatomy hook: the collector hands the finished trace to
+    ``profiling.step_trace`` + the planner reconciler. Advisory: a
+    callback failure warns and never re-raises into the step path."""
+
+    def __init__(self, port=0, logdir=None, flight=None, on_trace=None):
         self.server = _maybe_start_server(
             port or os.environ.get("DSTPU_PROFILE_PORT", 0))
         self.logdir = logdir
         self.flight = flight
+        self.on_trace = on_trace
         self.range = self._parse(os.environ.get("DSTPU_PROFILE_STEPS"))
         self.active = False
+        self._trace_meta = None        # (logdir, start_step) while active
 
     @staticmethod
     def _parse(spec):
@@ -330,16 +337,27 @@ class ProfilerControl:
                 logdir = os.path.join(base, "xprof")
                 jax.profiler.start_trace(logdir)
                 self.active = True
+                self._trace_meta = (logdir, step)
                 self._record("profile_start", step=step, logdir=logdir)
             elif self.active and step >= r[1]:
                 jax.profiler.stop_trace()
                 self.active = False
                 self.range = None
                 self._record("profile_stop", step=step)
+                meta, self._trace_meta = self._trace_meta, None
+                if self.on_trace is not None and meta is not None:
+                    try:
+                        self.on_trace(meta[0], max(1, step - meta[1]),
+                                      step)
+                    except Exception as e:  # noqa: BLE001 - advisory
+                        logger.warning(
+                            f"telemetry: trace callback failed "
+                            f"({type(e).__name__}: {e})")
         except Exception as e:  # noqa: BLE001 - never break the step
             logger.warning(f"telemetry: profiler capture failed: {e}")
             self.active = False
             self.range = None
+            self._trace_meta = None
 
     def check_trigger(self, root, step):
         """Flush-boundary check for the ``PROFILE`` trigger file."""
@@ -384,7 +402,11 @@ class TelemetryCollector:
         self.cluster = (ClusterAggregator()
                         if cfg.resolve_cluster_agg() else None)
         self.profiler = ProfilerControl(port=cfg.profile_port,
-                                        flight=self.flight)
+                                        flight=self.flight,
+                                        on_trace=self._on_trace_ready)
+        self._reconcile_fn = None
+        self._reconcile_warned = False
+        self._pending_reconcile_events = None
         self._costs_fn = costs_fn
         self._costs = None
         self._costs_tried = False
@@ -448,6 +470,58 @@ class TelemetryCollector:
         stages/microbatches/ticks, analytic bubble fraction, host
         staging payload). None disarms."""
         self._pipeline = info
+
+    def set_reconcile(self, fn):
+        """Arm modeled-vs-measured reconciliation: ``fn(trace_dir,
+        steps)`` -> a ``DriftReport.summary()`` dict (or None) whenever
+        ``ProfilerControl`` finishes a step-ranged capture. The engine
+        wires its ``_telemetry_reconcile`` here; None disarms."""
+        self._reconcile_fn = fn
+
+    def _on_trace_ready(self, trace_dir, steps, step):
+        """ProfilerControl's stop hook. Trace parsing reads gzipped
+        JSON off disk — background-pool work, never the step path."""
+        if self._reconcile_fn is None:
+            return
+        self._submit(self._reconcile_round, trace_dir, steps, step)
+
+    def _reconcile_round(self, trace_dir, steps, step):
+        """Parse + reconcile one finished capture (pool side). Emits
+        nothing directly: events park for the next main-thread flush
+        (monitor writers are not thread-safe) and the summary lands in
+        ``self.last`` + the flight recorder's crash context."""
+        try:
+            summary = self._reconcile_fn(trace_dir, steps)
+        except Exception as e:  # noqa: BLE001 - reconcile is advisory
+            if not self._reconcile_warned:
+                self._reconcile_warned = True
+                logger.warning(f"telemetry: reconcile failed "
+                               f"({type(e).__name__}: {e})")
+            return
+        if summary is None:
+            if not self._reconcile_warned:
+                self._reconcile_warned = True
+                logger.warning(
+                    "telemetry: trace produced no step decomposition; "
+                    "reconcile skipped (platform may not emit XLA op "
+                    "tracks)")
+            return
+        self.last = dict(self.last, reconcile=summary)
+        self.flight.record("reconcile", step=int(step),
+                           top_term=summary.get("top_term", ""),
+                           top_drift_ms=summary.get("top_drift_ms", 0),
+                           wall_err_pct=summary.get("wall_err_pct", 0))
+        self.flight.set_context("reconcile", summary)
+        self._pending_reconcile_events = [
+            ("Train/Reconcile/wall_err_pct",
+             summary.get("wall_err_pct", 0.0), step),
+            ("Train/Reconcile/top_drift_ms",
+             summary.get("top_drift_ms", 0.0), step),
+            ("Train/Reconcile/top_drift_term",
+             summary.get("top_term_index", -1), step),
+            ("Train/Reconcile/coverage_pct",
+             summary.get("coverage_pct", 0.0), step),
+        ]
 
     # ------------------------------------------------------------ feedback
     def note_overhead(self, kind, seconds):
@@ -517,6 +591,10 @@ class TelemetryCollector:
         # latest wins; attribute swap is atomic under the GIL)
         pending, self._pending_cluster_events = \
             self._pending_cluster_events, None
+        if pending:
+            self._emit(pending)
+        pending, self._pending_reconcile_events = \
+            self._pending_reconcile_events, None
         if pending:
             self._emit(pending)
         samples = list(self._step_ms)
@@ -607,6 +685,9 @@ class TelemetryCollector:
         # must not blank it from snapshot())
         if "cluster" in self.last:
             snap.setdefault("cluster", self.last["cluster"])
+        # ...and the latest reconcile drift summary, same discipline
+        if "reconcile" in self.last:
+            snap.setdefault("reconcile", self.last["reconcile"])
         self.last = snap
 
     def _cluster_round(self, metrics, step, emit_now):

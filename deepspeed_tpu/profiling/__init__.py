@@ -1,2 +1,4 @@
 from .flops_profiler import FlopsProfiler, get_model_profile, \
     compiled_costs
+from .step_trace import StepDecomposition, decompose, decompose_dir, \
+    find_trace_file

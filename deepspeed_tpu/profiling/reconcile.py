@@ -1,0 +1,127 @@
+"""CLI: reconcile a captured ``jax.profiler`` trace against the
+planner's cost model.
+
+    python -m deepspeed_tpu.profiling.reconcile <trace_dir> \
+        --mesh dp=2,tp=4 [--steps N] [--json] [--seed-cache] [...]
+
+Parses the newest ``*.trace.json.gz`` under ``trace_dir`` into a
+``StepDecomposition``, scores the given mesh with ``planner._score``
+for the described model, and prints the modeled-vs-measured drift
+table (``--json`` for the machine-readable report). ``--seed-cache``
+distills the measured run into ``comm_link`` + ``op_cost`` winner-cache
+rows so the next ``plan()`` prices meshes from measured numbers.
+
+This module is the thin argv shell; the library lives in
+``deepspeed_tpu/autotuning/reconcile.py``.
+"""
+
+import argparse
+import json
+import sys
+
+from ..autotuning.planner import ModelDesc, PodDesc
+from ..autotuning import reconcile as _rec
+from . import step_trace
+
+
+def _parse_mesh(spec):
+    """'dp=2,tp=4' -> planner mesh dict (unnamed axes default to 1)."""
+    short = {"pp": "pipe", "do": "data_outer", "dp": "data",
+             "ep": "expert", "sp": "seq", "tp": "tensor"}
+    out = {}
+    for part in (spec or "").split(","):
+        if not part.strip():
+            continue
+        k, _, v = part.partition("=")
+        k = k.strip()
+        out[short.get(k, k)] = int(v)
+    return out
+
+
+def _count(s):
+    """int that also accepts '13e9'-style scientific notation."""
+    return int(float(s))
+
+
+def build_parser():
+    p = argparse.ArgumentParser(
+        prog="python -m deepspeed_tpu.profiling.reconcile",
+        description="modeled-vs-measured drift report for a profiler "
+                    "trace")
+    p.add_argument("trace_dir",
+                   help="dir holding the capture (searched recursively "
+                        "for *.trace.json.gz) or a trace file")
+    p.add_argument("--steps", type=int, default=1,
+                   help="train steps the capture covered (per-step "
+                        "normalization; default 1)")
+    p.add_argument("--mesh", default="",
+                   help="mesh the trace ran on, e.g. dp=2,tp=4 "
+                        "(axes: pp do dp ep sp tp; default all 1)")
+    p.add_argument("--schedule", default="none",
+                   choices=["none", "gpipe", "1f1b", "zb"])
+    p.add_argument("--micro-batches", type=int, default=1)
+    p.add_argument("--offload", action="store_true",
+                   help="score the host_offload term")
+    p.add_argument("--batch-tokens", type=_count, default=None)
+    # model description (defaults = the planner's tiny placeholder)
+    p.add_argument("--params", type=_count, default=1 << 20)
+    p.add_argument("--layers", type=int, default=1)
+    p.add_argument("--d-model", type=int, default=64)
+    p.add_argument("--heads", type=int, default=1)
+    p.add_argument("--seq-len", type=int, default=128)
+    p.add_argument("--experts", type=int, default=0)
+    p.add_argument("--json", action="store_true",
+                   help="emit the full decomposition + drift report as "
+                        "JSON instead of the table")
+    p.add_argument("--seed-cache", action="store_true",
+                   help="seed measured comm_link/op_cost rows into the "
+                        "winner cache")
+    p.add_argument("--cache", default=None,
+                   help="winner-cache path for --seed-cache (default: "
+                        "the dispatch cache path)")
+    return p
+
+
+def main(argv=None):
+    args = build_parser().parse_args(argv)
+    model = ModelDesc(params=args.params, n_layer=args.layers,
+                      d_model=args.d_model, n_head=args.heads,
+                      max_seq_len=args.seq_len, experts=args.experts,
+                      name="cli")
+    pod = PodDesc.from_devices()
+    mesh_shape = _parse_mesh(args.mesh)
+    decomp, report = _rec.reconcile_trace(
+        args.trace_dir, steps=max(1, args.steps), model=model, pod=pod,
+        mesh_shape=mesh_shape, schedule=args.schedule,
+        micro_batches=args.micro_batches, offload=args.offload,
+        batch_tokens=args.batch_tokens)
+    if decomp is None:
+        print("no parseable trace found", file=sys.stderr)
+        return 2
+    seeded = 0
+    if args.seed_cache and report is not None:
+        rows = _rec.seed_rows(decomp, report)
+        seeded = _rec.seed_cache(rows, path=args.cache)
+    if args.json:
+        out = {"decomposition": decomp.to_dict(),
+               "drift": None if report is None else report.to_dict()}
+        if args.seed_cache:
+            out["seeded_rows"] = seeded
+        print(json.dumps(out, indent=2, sort_keys=True))
+        return 0
+    print(f"trace: {decomp.trace_path}")
+    print(f"steps: {decomp.steps}  coverage: {decomp.coverage_pct:.1f}%"
+          f"  occupancy: {decomp.occupancy_pct:.1f}%")
+    if report is not None:
+        print(report.table())
+    else:
+        print("(planner scoring unavailable — decomposition only)")
+        for k, v in sorted(decomp.terms.items()):
+            print(f"  {k:>14}: {v:.4f} ms")
+    if args.seed_cache:
+        print(f"seeded {seeded} winner-cache rows")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -322,6 +322,10 @@ class DeepSpeedEngine:
             pinfo = self.pipeline_report()
             if pinfo is not None:
                 self.telemetry.set_pipeline(pinfo)
+            # step-anatomy reconciliation: when ProfilerControl stops a
+            # step-ranged capture, hand the trace to the parser + the
+            # planner reconciler (pool-side; advisory)
+            self.telemetry.set_reconcile(self._telemetry_reconcile)
 
         # data efficiency (reference engine.py:336-367): the curriculum
         # scheduler changes the SEQUENCE LENGTH the jitted step sees
@@ -1160,6 +1164,32 @@ class DeepSpeedEngine:
         they need queued background work folded in."""
         return None if self.telemetry is None else \
             self.telemetry.snapshot()
+
+    def _telemetry_reconcile(self, trace_dir, steps):
+        """TelemetryCollector's reconcile hook: parse the finished
+        profiler capture into a StepDecomposition, score this engine's
+        actual mesh/schedule with the planner's ``_score``, and stash
+        the full drift report for :meth:`reconcile_report`. Returns the
+        compact summary the collector emits, or None when the platform
+        produced no parseable trace (the collector warns once)."""
+        from ..autotuning import reconcile as _rec
+        decomp, report = _rec.from_engine(self, trace_dir, steps=steps)
+        self._last_reconcile = (decomp, report)
+        return None if report is None else report.summary()
+
+    def reconcile_report(self):
+        """The most recent modeled-vs-measured drift report as a dict
+        (``{"decomposition": ..., "drift": ...}``), or None before any
+        profiled capture reconciled. Drain telemetry first — the parse
+        runs on the collector's background pool."""
+        pair = getattr(self, "_last_reconcile", None)
+        if pair is None:
+            return None
+        decomp, report = pair
+        return {
+            "decomposition": None if decomp is None else decomp.to_dict(),
+            "drift": None if report is None else report.to_dict(),
+        }
 
     # ----------------------------------------------------------------- batch
     def deepspeed_io(self, dataset, batch_size=None, shuffle=True,
