@@ -18,10 +18,19 @@ even when a run is interrupted mid-sweep (the same lost-artifact lesson
 as ``bench.py``'s BENCH_local.json: three rounds of driver artifacts
 vanished).
 
+``bench_shared_prefix`` is the prefix-cache scenario: Poisson arrivals
+drawing from N prompt templates (per-request suffixes, configurable
+share ratio), prefix_cache on vs off — cache-on should collapse TTFT
+p50 (template prefills served from cached KV blocks) with p99 TPOT
+within noise, and the row carries the engine's own hit-rate/CoW/
+eviction counters.
+
 Run on the chip:  python benchmarks/serve_bench.py
 Env: SERVE_MODELS=gpt2-350M,llama-1b  SERVE_BATCHES=1,8
      SERVE_PROMPT=1024  SERVE_DECODE=128  SERVE_MIXED=1
      SERVE_MIXED_MODEL=gpt2-350M  SERVE_EP_MOE=1
+     SERVE_PREFIX=1  SERVE_PREFIX_MODEL=gpt2-350M  SERVE_PREFIX_N=24
+     SERVE_PREFIX_SHARE=0.75
 """
 
 import json
@@ -571,6 +580,140 @@ def bench_mixed_traffic(name="gpt2-350M", rate=2.0, n_requests=24,
     return rows
 
 
+def _shared_prefix_one(name, rate, n_requests, n_templates, template_len,
+                       suffix_len, share_ratio, decode_tokens, chunk,
+                       block_size, max_batch, prefix_cache, seed):
+    """One shared-prefix traffic run; returns the percentile row with
+    the engine's prefix-cache counters (hit rate, cached tokens, CoW
+    copies, evictions) measured over the driven traffic only — warm-up
+    requests are snapshotted out."""
+    groups.reset()
+    model = build_model(name)
+    template_len = min(
+        template_len,
+        model.config.max_seq_len - suffix_len - max(decode_tokens, 64))
+    engine = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            max_batch_size=max_batch, kv_block_size=block_size,
+            prompt_bucket=min(template_len + suffix_len, 512),
+            splitfuse_tokens=chunk, prefix_cache=prefix_cache))
+    r = np.random.RandomState(seed)
+    V = model.config.vocab_size
+    templates = [r.randint(0, V, (template_len,))
+                 for _ in range(n_templates)]
+    arrivals = np.cumsum(r.exponential(1.0 / rate, n_requests))
+    prompts = []
+    shared_count = 0
+    for _ in range(n_requests):
+        suffix = r.randint(0, V, (suffix_len,))
+        if r.rand() < share_ratio:
+            shared_count += 1
+            prompts.append(np.concatenate(
+                [templates[r.randint(n_templates)], suffix]))
+        else:
+            prompts.append(r.randint(0, V, (template_len + suffix_len,)))
+
+    # warm every program outside the driven requests' TTFT: chunk,
+    # fused chunk+decode, decode — and for the cache-on variant the CoW
+    # copy program (second warm-up shares the first's prompt, diverging
+    # mid-block). One donor request per template then runs to
+    # completion so the driven phase measures the WARM cache (hit rate
+    # ~= share_ratio): inserts happen at release, so without donors the
+    # first arrival of every template — plus every sharer admitted
+    # while it is still in flight — is a structural miss. The cache-off
+    # variant runs the identical donors, so the two rows differ only in
+    # the cache.
+    warm = r.randint(0, V, (template_len + suffix_len,))
+    w1 = engine.put(warm, max_new_tokens=decode_tokens, eos_token_id=-1)
+    for _ in range(2):
+        engine.step()              # w1 prefilling/decoding
+    w2 = engine.put(np.concatenate([warm[:-3], r.randint(0, V, (3,))]),
+                    max_new_tokens=4, eos_token_id=-1)
+    while not (engine.is_done(w1) and engine.is_done(w2)):
+        engine.step()
+    engine.get(w1), engine.get(w2)
+    donors = [engine.put(
+        np.concatenate([t, r.randint(0, V, (suffix_len,))]),
+        max_new_tokens=2, eos_token_id=-1) for t in templates]
+    while not all(engine.is_done(d) for d in donors):
+        engine.step()
+    for d in donors:
+        engine.get(d)
+    base = engine.prefix_cache.stats() if engine.prefix_cache else None
+
+    tok_times, submit, wall = _poisson_drive(engine, prompts, arrivals,
+                                             decode_tokens)
+
+    ttft, tpot = [], []
+    for uid, ts in tok_times.items():
+        if not ts:
+            continue
+        ttft.append(1e3 * (ts[0] - submit[uid]))
+        if len(ts) >= 2 and ts[-1] != ts[0]:
+            tpot.append(1e3 * (ts[-1] - ts[0]) / (len(ts) - 1))
+    row = {
+        "model": name, "mode": "shared-prefix",
+        "variant": {"prefix_cache": "on" if prefix_cache else "off"},
+        "arrival_rate_qps": rate, "n_requests": n_requests,
+        "n_templates": n_templates, "template_len": template_len,
+        "suffix_len": suffix_len, "share_ratio": share_ratio,
+        "shared_requests": shared_count,
+        "decode_tokens": decode_tokens, "splitfuse_tokens": chunk,
+        "ttft_ms_p50": _pct(ttft, 50), "ttft_ms_p99": _pct(ttft, 99),
+        "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p99": _pct(tpot, 99),
+        "completed": len([1 for ts in tok_times.values() if ts]),
+        "wall_s": round(wall, 2),
+        "devices": len(jax.devices()),
+        "engine_telemetry": engine.telemetry_snapshot(),
+    }
+    if engine.prefix_cache is not None:
+        s = engine.prefix_cache.stats()
+        lookups = s["lookups"] - base["lookups"]
+        hits = s["hits"] - base["hits"]
+        row["cache_hit_rate"] = round(100.0 * hits / lookups, 1) \
+            if lookups else 0.0
+        row["cached_tokens"] = s["cached_tokens"] - base["cached_tokens"]
+        row["cached_tokens_per_sec"] = round(
+            row["cached_tokens"] / max(wall, 1e-9), 1)
+        row["cow_copies"] = s["cow_copies"] - base["cow_copies"]
+        row["prefix_evictions"] = \
+            s["evicted_blocks"] - base["evicted_blocks"]
+        row["tree_blocks"] = s["tree_blocks"]
+    else:
+        row["cache_hit_rate"] = 0.0
+    return row
+
+
+def bench_shared_prefix(name="gpt2-350M", rate=2.0, n_requests=24,
+                        n_templates=4, template_len=512, suffix_len=64,
+                        share_ratio=0.75, decode_tokens=64, chunk=256,
+                        block_size=64, max_batch=8, seed=0):
+    """Shared-prefix Poisson traffic (ROADMAP item 3a's harness):
+    ``n_templates`` prompt templates, each request drawing a template +
+    per-request suffix with probability ``share_ratio`` (else a fully
+    random prompt of the same length). Reports TTFT/TPOT p50/p99 and
+    the cache hit rate for prefix_cache on vs off — the pass signal is
+    TTFT p50 collapsing on the cache-on row while p99 TPOT stays within
+    noise (cached prefixes skip prefill chunks; decode work is
+    unchanged). A variant that crashes records its error and the sweep
+    continues; every row is durable in SERVE_local.json immediately."""
+    rows = []
+    for prefix_cache in (True, False):
+        try:
+            rows.append(_record(_shared_prefix_one(
+                name, rate, n_requests, n_templates, template_len,
+                suffix_len, share_ratio, decode_tokens, chunk,
+                block_size, max_batch, prefix_cache, seed)))
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            rows.append(_record({
+                "model": name, "mode": "shared-prefix",
+                "variant": {"prefix_cache": "on" if prefix_cache
+                            else "off"},
+                "error": f"{type(e).__name__}: {e}"[:300]}))
+        write_local_report()           # partial sweep already durable
+    return rows
+
+
 def bench_ep_moe(decode_tokens=16, block_size=16, chunk=16,
                  expert_parallel=2):
     """EP Mixtral serving: experts sharded over the 'expert' mesh axis,
@@ -659,6 +802,21 @@ def main():
             n_requests=int(os.environ.get("SERVE_MIXED_N",
                                           "24" if on_tpu else "12")),
             **mixed_kw)
+    if os.environ.get("SERVE_PREFIX", "1") == "1":
+        # same CPU smoke-scale discipline as SERVE_MIXED: off-TPU the
+        # tiny model + small traffic still produce both rows in minutes
+        on_tpu = jax.default_backend() == "tpu"
+        pf_kw = {} if on_tpu else dict(
+            template_len=96, suffix_len=16, decode_tokens=16, chunk=16,
+            block_size=8, max_batch=4, rate=8.0, n_templates=2)
+        if "SERVE_PREFIX_SHARE" in os.environ:
+            pf_kw["share_ratio"] = float(os.environ["SERVE_PREFIX_SHARE"])
+        bench_shared_prefix(
+            name=os.environ.get("SERVE_PREFIX_MODEL",
+                                "gpt2-350M" if on_tpu else "tiny"),
+            n_requests=int(os.environ.get("SERVE_PREFIX_N",
+                                          "24" if on_tpu else "12")),
+            **pf_kw)
     if os.environ.get("SERVE_EP_MOE", "1") == "1":
         bench_ep_moe()
     if os.environ.get("SERVE_QUANT", ""):

@@ -835,6 +835,103 @@ def _pipe_parity(b, dtype, params):
                dict(rtol=0, atol=0))
 
 
+# ------------------------------------------------ prefix-cache policy
+# The serving prefix cache (inference/v2/prefix_cache.py) is host-side
+# scheduling policy, not a kernel — but whether it pays for itself, and
+# where the min-match knee sits, is a MEASURED property of the chip:
+# the lever trades skipped prefill compute against CoW copies and
+# scheduling overhead. Like pipe_microbatch, the step emulates the cost
+# structure on one device: a prefill-shaped forward over however much
+# of a synthetic shared-prefix prompt the candidate's policy does NOT
+# serve from cache (the bucket's traffic model: prompts span the pool's
+# per-slot block share and half of each prompt is a shared template).
+# The eviction watermark rides along untimed (it moves host-side
+# latency, not device compute); wm=0 candidates are listed first so
+# ties resolve to the hand-set on-demand policy.
+
+
+def _pfx_defaults(b):
+    from ..inference.v2.prefix_cache import PREFIX_CACHE_DEFAULTS
+    return dict(PREFIX_CACHE_DEFAULTS)
+
+
+def _pfx_prompt_blocks(b):
+    """Synthetic per-request prompt blocks for the bucket: the pool's
+    per-slot share (capped for step affordability)."""
+    return max(2, min(b["NB"] // max(1, b["B"]), 64))
+
+
+def _pfx_candidates(b):
+    cands = [_pfx_defaults(b)]
+    half = _pfx_prompt_blocks(b) // 2
+    for wm in (0, 25):
+        for mm in (1, 2, 4):
+            if mm > max(1, half):
+                continue          # a knee the traffic can never reach
+            cands.append({"enabled": 1, "min_match_blocks": mm,
+                          "evict_watermark_pct": wm})
+    return _dedup(cands)
+
+
+def _pfx_step(b, dtype, params):
+    BS = b["BS"]
+    pb = _pfx_prompt_blocks(b)
+    shared = pb // 2
+    skip = 0
+    if int(params["enabled"]) and shared >= int(
+            params["min_match_blocks"]):
+        skip = shared
+    rows = max(BS, (pb - skip) * BS)
+    D = 128
+    ks = jax.random.split(jax.random.key(0), 2)
+    x = jax.random.normal(ks[0], (pb * BS, D), dtype) * 0.3
+    w = jax.random.normal(ks[1], (D, D), dtype) / math.sqrt(D)
+
+    def step(carry):
+        x, w = carry
+        # the recomputed suffix's prefill-shaped forward; the cached
+        # prefix contributes nothing (that is the lever)
+        y = jax.nn.gelu(x[:rows] @ w) @ w.T
+        x = x.at[:rows].add(_EPS * y.astype(x.dtype))
+        return (x, w)
+
+    return step, (x, w)
+
+
+def _pfx_parity(b, dtype, params):
+    """The candidate changes admission policy, not math — check the
+    policy invariants on a live tree: knob ranges, and the hard rule
+    that a match never covers the whole prompt (the last token is
+    always recomputed so the first sampled token comes from a real
+    forward)."""
+    mm = int(params["min_match_blocks"])
+    if mm < 1:
+        raise AssertionError(
+            f"prefix_cache candidate min_match_blocks={mm} < 1")
+    wm = int(params["evict_watermark_pct"])
+    if not 0 <= wm <= 100:
+        raise AssertionError(
+            f"prefix_cache candidate evict_watermark_pct={wm} "
+            f"outside [0, 100]")
+    from ..inference.v2.blocked_allocator import BlockedAllocator
+    from ..inference.v2.prefix_cache import PrefixCache
+    BS = b["BS"]
+    alloc = BlockedAllocator(4)
+    pc = PrefixCache(alloc, BS, min_match_blocks=mm,
+                     evict_watermark_pct=wm)
+    toks = list(range(2 * BS))
+    pc.release(toks, alloc.allocate(2))
+    m = pc.match(toks)
+    if m.cached_len > len(toks) - 1:
+        raise AssertionError(
+            f"prefix_cache match covered the whole prompt "
+            f"(cached_len={m.cached_len}, T={len(toks)})")
+    if mm == 1 and m.cached_len != 2 * BS - 1:
+        raise AssertionError(
+            f"prefix_cache full-prompt re-match expected BS-1 partial "
+            f"tail (cached_len {2 * BS - 1}), got {m.cached_len}")
+
+
 # ---------------------------------------------------------------- table
 REGISTRY = {
     "flash_attention": {
@@ -890,6 +987,12 @@ REGISTRY = {
         "candidates": _pipe_candidates,
         "make_step": _pipe_step,
         "parity": _pipe_parity,
+    },
+    "prefix_cache": {
+        "defaults": _pfx_defaults,
+        "candidates": _pfx_candidates,
+        "make_step": _pfx_step,
+        "parity": _pfx_parity,
     },
 }
 

@@ -115,6 +115,13 @@ KNOB_TABLE = {
         "op": "paged_decode", "resolver": "paged decode dispatch"},
     "serving.paged_block_c": {
         "op": "paged_chunk", "resolver": "SplitFuse chunk dispatch"},
+    "serving.prefix_cache": {
+        "op": "prefix_cache", "resolver": "engine _resolve_prefix_cache "
+        "dispatch; cold default DISABLED so the disabled program stays "
+        "byte-identical"},
+    "serving.prefix_cache_min_match": {
+        "op": "prefix_cache", "resolver": "engine _resolve_prefix_cache "
+        "dispatch; cold default 1 block (the hand-set value)"},
 }
 
 

@@ -1,3 +1,4 @@
 from .blocked_allocator import BlockedAllocator
 from .ragged import DSSequenceDescriptor, DSStateManager, RaggedBatchWrapper
+from .prefix_cache import PrefixCache, PrefixMatch
 from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig

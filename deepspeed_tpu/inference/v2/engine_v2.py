@@ -91,6 +91,25 @@ class RaggedInferenceEngineConfig:
     # cache's tile for this (chunk, blocks, kv-heads, dtype) bucket,
     # int forces
     paged_block_c: object = "auto"
+    # Radix-tree prefix cache (inference/v2/prefix_cache.py): finished
+    # prompt+generation prefixes keep their KV blocks in a token-keyed
+    # tree; later requests sharing a prefix skip its prefill entirely
+    # (refcounted blocks, copy-on-write at the divergence point, LRU
+    # eviction of cold leaves under admission pressure).
+    #   "auto" (default): the winner cache's measured choice for this
+    #     pool-shape bucket; a COLD cache keeps the hand-set default —
+    #     DISABLED — so the admission path and every compiled program
+    #     stay byte-identical to prefix_cache=False.
+    #   True/False force. True raises on model/config combinations the
+    #   cache cannot serve correctly (sliding-window attention, KV host
+    #   offload); "auto" resolves them off silently.
+    prefix_cache: object = "auto"
+    # cap on tree-held blocks (0 = bounded only by the pool)
+    prefix_cache_blocks: int = 0
+    # minimum matched FULL blocks for a hit to be taken ("auto" = the
+    # winner cache's measured knee; below it, scheduling + CoW overhead
+    # beats the skipped prefill). Cold default: 1 block.
+    prefix_cache_min_match: object = "auto"
     # serving-side autotune dispatch state, applied COMPLETE at engine
     # construction and at this engine's program traces ("" = env/default
     # resolution — DSTPU_AUTOTUNE, default cache_only; an earlier
@@ -122,6 +141,28 @@ class RaggedInferenceEngineConfig:
             raise ValueError(
                 f"paged_block_c must be 'auto' or a positive int, got "
                 f"{self.paged_block_c!r}")
+        if self.prefix_cache not in (True, False, "auto"):
+            raise ValueError(
+                f"prefix_cache must be true|false|'auto', got "
+                f"{self.prefix_cache!r}")
+        if self.prefix_cache_min_match != "auto" and (
+                not isinstance(self.prefix_cache_min_match, int)
+                or isinstance(self.prefix_cache_min_match, bool)
+                or self.prefix_cache_min_match < 1):
+            raise ValueError(
+                f"prefix_cache_min_match must be 'auto' or an int >= 1, "
+                f"got {self.prefix_cache_min_match!r}")
+        if not isinstance(self.prefix_cache_blocks, int) \
+                or isinstance(self.prefix_cache_blocks, bool) \
+                or self.prefix_cache_blocks < 0:
+            raise ValueError(
+                f"prefix_cache_blocks must be an int >= 0, got "
+                f"{self.prefix_cache_blocks!r}")
+        if self.prefix_cache is True and self.kv_host_offload:
+            raise ValueError(
+                "prefix_cache=True is incompatible with kv_host_offload: "
+                "tree-held blocks would pin host/device residency the "
+                "offload pool cannot track — use one or the other")
         if self.autotune_mode not in ("", "off", "cache_only",
                                       "on_first_use", "search"):
             raise ValueError(
@@ -197,6 +238,23 @@ class InferenceEngineV2:
             max_batch=config.max_batch_size,
             max_blocks_per_seq=self.max_blocks_per_seq)
 
+        # radix-tree prefix cache over the block pool (host-side
+        # scheduling policy: the compiled programs never change, so
+        # disabled == byte-identical to the pre-cache engine)
+        self.prefix_cache = None
+        pc_on, pc_min_match, pc_watermark = self._resolve_prefix_cache(
+            mcfg, num_blocks)
+        if pc_on:
+            from .prefix_cache import PrefixCache
+            self.prefix_cache = PrefixCache(
+                self.state_mgr.allocator, BS,
+                min_match_blocks=pc_min_match,
+                max_blocks=config.prefix_cache_blocks,
+                evict_watermark_pct=pc_watermark)
+            self.state_mgr.prefix_cache = self.prefix_cache
+            if self.telemetry is not None:
+                self.telemetry.attach_prefix_cache(self.prefix_cache)
+
         dtype = jnp.dtype(config.dtype)
         self.dtype = dtype
         self.params, self.param_shardings = shard_params(
@@ -230,6 +288,7 @@ class InferenceEngineV2:
         self._decode_jit = None
         self._splitfuse_jit = None
         self._chunk_jit = None        # chunk-only (no decoders running)
+        self._cow_jit = None          # prefix-cache partial-tail copy
         self._prefill_q = deque()     # uids mid-chunked-prefill (SplitFuse)
         self._uid_next = 0
         log_dist(
@@ -305,6 +364,35 @@ class InferenceEngineV2:
         return bool(self._pending) or self.state_mgr.n_active > 0
 
     # ------------------------------------------------------------- programs
+    def _resolve_prefix_cache(self, mcfg, num_blocks):
+        """Resolve (enabled, min_match_blocks, evict_watermark_pct) for
+        the prefix cache. Model/config combinations the cache cannot
+        serve correctly refuse LOUDLY when forced on and resolve off
+        under "auto"; the "auto" spelling consults the winner cache for
+        this pool-shape bucket with cold-cache defaults equal to the
+        hand-set values (disabled, min-match 1, on-demand eviction), so
+        a cold-cache engine is byte-identical to prefix_cache=False."""
+        cfg = self.config
+        windows = tuple(getattr(mcfg, "attn_layer_windows", ()) or ())
+        if any(windows):
+            if cfg.prefix_cache is True:
+                raise ValueError(
+                    "prefix_cache=True on a sliding-window model "
+                    "(attn_layer_windows set): a cached block's KV is "
+                    "position-valid only inside each layer's window, so "
+                    "reusing it under a shifted suffix serves wrong "
+                    "attention — disable prefix_cache for this model")
+            return False, 1, 0
+        if cfg.prefix_cache is False or cfg.kv_host_offload:
+            # explicit off, or offload (True+offload raised in config
+            # validation; "auto" resolves off)
+            return False, 1, 0
+        from .prefix_cache import resolve_prefix_cache
+        return resolve_prefix_cache(
+            cfg.prefix_cache, cfg.prefix_cache_min_match,
+            B=cfg.max_batch_size, NB=num_blocks,
+            BS=cfg.kv_block_size, dtype=cfg.dtype)
+
     def _install_trace_state(self):
         """(Re)apply THIS engine's kernel/autotune knobs: the model
         attributes the paged paths read and the process dispatch state
@@ -461,12 +549,46 @@ class InferenceEngineV2:
                 out_shardings=(None, self._cache_sh))
         return self._chunk_jit
 
+    def _get_cow_copy(self):
+        """Prefix-cache copy-on-write: copy the first ``plen`` token
+        rows of block ``src`` into block ``dst`` across every layer's
+        K and V pools. A shared (refcount > 1) block is never written in
+        place — the sequence diverging inside it gets its matched slice
+        copied into a fresh block, then prefill resumes there. Block ids
+        and the slice length are traced operands, so every divergence
+        point shares ONE compiled program."""
+        if self._cow_jit is None:
+            BS = self.config.kv_block_size
+
+            def cow(cache, src, dst, plen):
+                keep = (jnp.arange(BS) < plen)[None, :, None]
+                return jax.tree.map(
+                    lambda p: p.at[dst].set(
+                        jnp.where(keep, p[src], p[dst])), cache)
+
+            self._cow_jit = jax.jit(
+                cow, donate_argnums=(0,),
+                in_shardings=(self._cache_sh, None, None, None),
+                out_shardings=self._cache_sh)
+        return self._cow_jit
+
+    def _apply_cow(self, seq):
+        fn = self._get_cow_copy()
+        src, dst, plen = seq.cow
+        with jax.set_mesh(self.mesh):
+            self.cache = fn(self.cache, np.int32(src), np.int32(dst),
+                            np.int32(plen))
+        self.state_mgr.cow_complete(seq)   # drops the claim ref on src
+
     def _step_splitfuse_chunk(self):
         """Run one fused dispatch: the next chunk of the oldest
         prefilling sequence + n decode steps (chunk-only when nothing is
-        decoding). Returns decode (uid, token) pairs."""
+        decoding). Returns decode (uid, token) pairs. Prefix-cache hits
+        ride this path even with SplitFuse off (chunk accounting already
+        handles a nonzero start offset); the chunk size then falls back
+        to the prompt bucket."""
         mgr = self.state_mgr
-        C = self.config.splitfuse_tokens
+        C = self.config.splitfuse_tokens or self.config.prompt_bucket
         uid = self._prefill_q[0]
         seq = mgr.get_sequence(uid)
         off = seq.prefill_offset
@@ -546,16 +668,25 @@ class InferenceEngineV2:
         bucket = self.config.prompt_bucket
         while self._pending:
             req = self._pending[0]
-            if not mgr.can_admit(len(req.prompt), req.max_new_tokens):
+            if not mgr.can_admit(len(req.prompt), req.max_new_tokens,
+                                 prompt=req.prompt):
                 break
             self._pending.popleft()
             slot, seq = mgr.admit(req.uid, req.prompt, req.max_new_tokens,
                                   req.eos_token_id,
                                   temperature=req.temperature,
                                   top_k=req.top_k)
-            if self.config.splitfuse_tokens:
+            if seq.cow is not None:
+                # partial-tail prefix hit: device-copy the matched slice
+                # into the fresh block before any prefill touches it
+                self._apply_cow(seq)
+            if self.config.splitfuse_tokens or seq.cached_len:
                 # SplitFuse: the prompt streams through chunk dispatches
-                # interleaved with decodes — no bucketed prefill here
+                # interleaved with decodes — no bucketed prefill here.
+                # Prefix-cache hits take the same path regardless: the
+                # chunk program's start/true_len accounting is what
+                # skips the cached prefix (the bucketed prefill always
+                # starts at 0)
                 self._prefill_q.append(req.uid)
                 continue
             T = len(req.prompt)

@@ -33,6 +33,13 @@ class DSSequenceDescriptor:
     # sequence decodes only once the whole prompt is in (the legacy
     # bucketed prefill writes it all at once)
     prefill_offset: int = 0
+    # Prefix cache: leading prompt tokens whose KV came from the radix
+    # tree (prefill_offset starts here — those tokens are never
+    # recomputed); ``cow`` = (src_block, dst_block, plen) when the
+    # matched tail is partial and the engine owes a device-side
+    # copy-on-write of the first plen tokens before prefill resumes
+    cached_len: int = 0
+    cow: tuple = None
 
     @property
     def seen_tokens(self):
@@ -61,6 +68,11 @@ class DSStateManager:
         self.max_blocks_per_seq = max_blocks_per_seq
         self._seqs = {}                  # uid -> descriptor
         self._slots = [None] * max_batch  # batch slot -> uid
+        # engine-attached radix tree (prefix_cache.py); when set, admit
+        # matches prompts against it and retire inserts finished
+        # prefixes back — all block lifetimes then run through
+        # refcounts (unref) instead of strict whole-ownership free()
+        self.prefix_cache = None
 
     # ------------------------------------------------------------- tracking
     @property
@@ -79,17 +91,35 @@ class DSStateManager:
     def blocks_needed(self, n_tokens):
         return -(-n_tokens // self.block_size)
 
-    def can_admit(self, prompt_len, max_new):
+    def can_admit(self, prompt_len, max_new, prompt=None):
         total = prompt_len + max_new
         if total > self.max_blocks_per_seq * self.block_size:
             return False  # can never fit; admit() would raise
-        return (self.free_slot() is not None
-                and self.allocator.free_blocks >= self.blocks_needed(total))
+        if self.free_slot() is None:
+            return False
+        needed = self.blocks_needed(total)
+        avail = self.allocator.free_blocks
+        if self.prefix_cache is not None:
+            if prompt is not None:
+                # matched blocks are reused, not allocated; the rest of
+                # the pool counts free-or-evictable, minus the match
+                # itself (its blocks may be the evictable ones, and
+                # claiming pins them)
+                k = len(self.prefix_cache.match(prompt).blocks)
+                needed -= k
+                avail += max(
+                    0, self.prefix_cache.evictable_blocks - k)
+            else:
+                avail = self.allocator.available_blocks
+        return avail >= needed
 
     def admit(self, uid, prompt, max_new_tokens, eos_token_id=-1,
               temperature=0.0, top_k=0):
         """Allocate blocks for the full prompt+generation budget and bind
-        the sequence to a batch slot. Returns (slot, descriptor)."""
+        the sequence to a batch slot. With a prefix cache attached, the
+        prompt's longest cached prefix is claimed first (refcount bumps,
+        no allocation) and only the remainder is allocated; prefill then
+        starts at ``cached_len``. Returns (slot, descriptor)."""
         slot = self.free_slot()
         assert slot is not None, "no free batch slot"
         prompt = np.asarray(prompt, np.int32)
@@ -102,16 +132,49 @@ class DSStateManager:
                                    max_new_tokens=max_new_tokens,
                                    eos_token_id=eos_token_id,
                                    temperature=temperature, top_k=top_k)
-        seq.blocks = self.allocator.allocate(self.blocks_needed(total))
+        m = None
+        if self.prefix_cache is not None:
+            m = self.prefix_cache.match(prompt)
+            self.prefix_cache.claim(m)   # refs matched blocks + stats
+        if m is not None and m.hit:
+            k = len(m.blocks)
+            fresh = self.allocator.allocate(self.blocks_needed(total) - k)
+            seq.blocks = list(m.blocks) + fresh
+            seq.cached_len = m.cached_len
+            seq.prefill_offset = m.cached_len
+            if m.cow_src is not None:
+                # the partial tail lands in the first fresh block; the
+                # engine copies the matched slice there on device
+                seq.cow = (m.cow_src, seq.blocks[k], m.cow_plen)
+        else:
+            seq.blocks = self.allocator.allocate(self.blocks_needed(total))
         self._seqs[uid] = seq
         self._slots[slot] = uid
         return slot, seq
 
+    def cow_complete(self, seq):
+        """The engine's device-side CoW slice copy landed: drop the
+        claim's temporary ref on the source block."""
+        src, _dst, _plen = seq.cow
+        self.prefix_cache.cow_release(src)
+        seq.cow = None
+
     def retire(self, uid):
-        """Free the sequence's blocks and slot; keep the descriptor (the
-        caller reads .generated) until ``flush``."""
+        """Release the sequence's blocks and slot; keep the descriptor
+        (the caller reads .generated) until ``flush``. With a prefix
+        cache, the finished prompt+generation prefix is inserted into
+        the tree and every block is unreffed exactly once (tree-adopted
+        blocks live on; the rest return to the free list). generated[-1]
+        is excluded from the insert: the final sampled token's KV write
+        may not have landed (it is written — if at all — by the
+        dispatch's over-decode)."""
         seq = self._seqs[uid]
-        self.allocator.free(seq.blocks)
+        if self.prefix_cache is not None:
+            tokens = seq.prompt if not seq.generated else np.concatenate(
+                [seq.prompt, np.asarray(seq.generated[:-1], np.int32)])
+            self.prefix_cache.release(tokens, seq.blocks)
+        else:
+            self.allocator.free(seq.blocks)
         seq.blocks = []
         seq.done = True
         self._slots[self._slots.index(uid)] = None
@@ -119,7 +182,13 @@ class DSStateManager:
     def flush(self, uid):
         seq = self._seqs.pop(uid)
         if seq.blocks:
-            self.allocator.free(seq.blocks)
+            if self.prefix_cache is not None:
+                # cancelled mid-flight: cache contents past the prefill
+                # frontier are unverified — drop refs without inserting
+                for b in seq.blocks:
+                    self.allocator.unref(b)
+            else:
+                self.allocator.free(seq.blocks)
             if self._slots.count(uid):
                 self._slots[self._slots.index(uid)] = None
 

@@ -131,6 +131,20 @@ TAG_SCHEMA = {
         "requests completed since engine construction",
     "Serve/Telemetry/active":
         "sequences decoding when the window was emitted",
+
+    # --- prefix cache (inference/v2/prefix_cache.py radix tree;
+    #     emitted only when the engine runs with prefix_cache on) ---
+    "Serve/Telemetry/prefix_hit_rate_pct":
+        "admissions whose prompt matched a cached prefix, pct of all "
+        "admissions since engine construction",
+    "Serve/Telemetry/cached_tokens_per_sec":
+        "prompt tokens served from cached KV blocks (prefill skipped) "
+        "per wall second since engine construction",
+    "Serve/Telemetry/prefix_evictions":
+        "cumulative cold tree blocks reclaimed by LRU eviction",
+    "Serve/Telemetry/cow_copies":
+        "cumulative copy-on-write block copies (partial-tail prefix "
+        "hits that diverge inside a shared block)",
 }
 
 

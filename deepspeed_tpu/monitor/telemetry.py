@@ -801,6 +801,14 @@ class ServingTelemetry:
         self.completed = 0
         self.active = 0
         self._emitted_at = 0
+        # engine-attached PrefixCache (inference/v2/prefix_cache.py);
+        # when set, its hit/eviction/CoW counters ride percentiles()
+        # and the Serve/Telemetry fan-out
+        self._prefix_cache = None
+        self._t0 = time.perf_counter()
+
+    def attach_prefix_cache(self, cache):
+        self._prefix_cache = cache
 
     def on_submit(self, uid):
         self._live[uid] = _ReqTimes(time.perf_counter())
@@ -843,7 +851,7 @@ class ServingTelemetry:
         self.completed += 1
 
     def percentiles(self):
-        return {
+        out = {
             "ttft_ms_p50": percentile(self._ttft_ms, 50),
             "ttft_ms_p99": percentile(self._ttft_ms, 99),
             "tpot_ms_p50": percentile(self._tpot_ms, 50),
@@ -851,6 +859,15 @@ class ServingTelemetry:
             "completed": self.completed,
             "active": self.active,
         }
+        if self._prefix_cache is not None:
+            s = self._prefix_cache.stats()
+            elapsed = max(1e-9, time.perf_counter() - self._t0)
+            out["prefix_hit_rate_pct"] = s["hit_rate_pct"]
+            out["cached_tokens_per_sec"] = round(
+                s["cached_tokens"] / elapsed, 1)
+            out["prefix_evictions"] = s["evicted_blocks"]
+            out["cow_copies"] = s["cow_copies"]
+        return out
 
     def maybe_emit(self):
         if self.monitor is None \
@@ -866,7 +883,15 @@ class ServingTelemetry:
                 ("Serve/Telemetry/ttft_ms_p50", "ttft_ms_p50"),
                 ("Serve/Telemetry/ttft_ms_p99", "ttft_ms_p99"),
                 ("Serve/Telemetry/tpot_ms_p50", "tpot_ms_p50"),
-                ("Serve/Telemetry/tpot_ms_p99", "tpot_ms_p99")):
-            if p[key] is not None:
+                ("Serve/Telemetry/tpot_ms_p99", "tpot_ms_p99"),
+                # prefix-cache effectiveness (only present with an
+                # attached PrefixCache — see attach_prefix_cache)
+                ("Serve/Telemetry/prefix_hit_rate_pct",
+                 "prefix_hit_rate_pct"),
+                ("Serve/Telemetry/cached_tokens_per_sec",
+                 "cached_tokens_per_sec"),
+                ("Serve/Telemetry/prefix_evictions", "prefix_evictions"),
+                ("Serve/Telemetry/cow_copies", "cow_copies")):
+            if p.get(key) is not None:
                 events.append((tag, p[key], step))
         self.monitor.write_events(events)

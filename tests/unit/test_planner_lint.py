@@ -92,6 +92,43 @@ def test_table_block_knobs_really_accept_auto():
         f"(or are unvalidated): {sorted(stale)}")
 
 
+def discovered_serving_auto_knobs():
+    """The serving-side construction probes: every
+    RaggedInferenceEngineConfig field that accepts "auto" AND rejects
+    junk (same discovery rule as the training blocks) — the v2 engine's
+    auto knobs (paged_kernel, paged_block_c, prefix_cache,
+    prefix_cache_min_match) cannot land without a KNOB_TABLE row."""
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        RaggedInferenceEngineConfig)
+    found = set()
+    for f in dataclasses.fields(RaggedInferenceEngineConfig):
+        if _accepts(RaggedInferenceEngineConfig, f.name, "auto") \
+                and not _accepts(RaggedInferenceEngineConfig,
+                                 f.name, _JUNK):
+            found.add(f.name)
+    return found
+
+
+def test_every_serving_auto_knob_is_in_the_table():
+    missing = {f"serving.{f}" for f in discovered_serving_auto_knobs()} \
+        - set(KNOB_TABLE)
+    assert not missing, (
+        f"serving config knobs accept 'auto' but declare no resolver "
+        f"in planner.KNOB_TABLE: {sorted(missing)} — add a "
+        f"serving.<field> entry naming the registry op that resolves "
+        f"each")
+
+
+def test_table_serving_knobs_really_accept_auto():
+    discovered = {f"serving.{f}"
+                  for f in discovered_serving_auto_knobs()}
+    rows = {k for k in KNOB_TABLE if k.startswith("serving.")}
+    stale = rows - discovered
+    assert not stale, (
+        f"KNOB_TABLE serving rows name engine-config fields that do "
+        f"not accept 'auto' (or are unvalidated): {sorted(stale)}")
+
+
 def test_top_level_parallelism_accepts_auto():
     """The one auto knob living outside any block: top-level
     ``parallelism`` — "" and "auto" pass, junk raises."""
