@@ -79,6 +79,10 @@ KNOB_TABLE = {
     "checkpoint_engine.hot_replicas": {
         "op": "hot_replicas", "resolver": "engine hot-store dispatch "
         "over the shard-payload bucket; K=1 cold"},
+    "checkpoint_engine.preempt_drain": {
+        "op": None, "resolver": "heuristic: on iff supervised — "
+        "ELASTIC_GENERATION or DSTPU_PREEMPT_DRAIN exported "
+        "(resolve_preempt_drain)"},
     "pipeline.schedule": {
         "op": None, "resolver": "planner: plan() schedule of the top "
         "plan under parallelism='auto'; model knob otherwise"},

@@ -27,6 +27,22 @@ Failures are CLASSIFIED, with per-class restart backoff:
                     The HOST is healthy — it is kept, and the same
                     world relaunches after the (longer) corrupt-class
                     backoff, giving shared storage time to settle.
+  ``dead_slice``    slice-aware refinement of dead/hung: EVERY host of
+                    one slice failed together (slice preemption, ICI
+                    fabric loss). The whole slice is dropped, its
+                    hot-tier stores purged, and the world relaunches at
+                    ``data_outer - 1`` — surviving slices keep their
+                    intra-slice dp; the cross-slice replicas they hold
+                    (hot_tier ``replica-from-*`` / ``zero-replica-*``)
+                    are exactly what the relaunch restores from.
+  ``preempted``     the worker exited PREEMPTED_EXIT_CODE after a
+                    graceful SIGTERM drain (it finished the in-flight
+                    step, forced a hot+replica push, dumped its flight
+                    recorder). The host is healthy and KEPT; the
+                    relaunch takes no backoff penalty. The agent
+                    forwards its own SIGTERM to the workers, so a
+                    maintenance notice delivered to the agent drains
+                    the whole world.
 """
 
 import inspect
@@ -49,9 +65,19 @@ from .elasticity import compute_elastic_config, ElasticityError
 # backs off instead of shrinking it.
 CORRUPT_CKPT_EXIT_CODE = 44
 
+# Workers exit with this code after a preemption-graceful drain: the
+# engine's SIGTERM handler sets a flag, the in-flight train_batch
+# finishes, _preempt_drain forces one hot+replica push plus a flight
+# dump, then SystemExit(43). Distinct from both a crash and a corrupt
+# checkpoint: the host is healthy AND the newest generation is already
+# in the hot tier — keep the host, relaunch with zero backoff.
+PREEMPTED_EXIT_CODE = 43
+
 FAILURE_DEAD = "dead"
 FAILURE_HUNG = "hung"
 FAILURE_CORRUPT = "corrupt_ckpt"
+FAILURE_DEAD_SLICE = "dead_slice"
+FAILURE_PREEMPTED = "preempted"
 
 _LOCAL_HOST_NAMES = ("localhost", "127.0.0.1", "::1", "")
 
@@ -135,6 +161,15 @@ class DSElasticAgent:
         each worker's env) and (b) purges a failed host's store on
         membership change — a dead host's RAM is gone; its replicas on
         survivors are exactly what the relaunched world restores from.
+      slices: optional ``{host: slice_id}`` membership map (hostfile
+        ``slice=K`` tokens via the launcher). With more than one
+        distinct slice the agent becomes SLICE-AWARE: worker_env
+        additionally exports ``DSTPU_HOT_SLICE`` / ``DSTPU_HOT_SLICES``
+        so the hot tier places replicas cross-slice, compute_topology
+        reports (and shrinks) ``do`` = surviving data_outer degree, and
+        a failure that takes out EVERY host of one slice is classified
+        ``dead_slice`` (firing the 'slice_loss' fault point once per
+        lost slice) instead of N independent host losses.
     """
 
     def __init__(self, launch_fn, hosts, ds_config=None, chips_per_host=1,
@@ -142,7 +177,7 @@ class DSElasticAgent:
                  on_restart=None, heartbeat_timeout_s=None,
                  heartbeat_dir=None, tensor_parallel=1, expert_parallel=1,
                  pipe_parallel=1, seq_parallel=1, restart_backoff_s=None,
-                 hot_root=None, flightrec_root=None):
+                 hot_root=None, flightrec_root=None, slices=None):
         self.launch_fn = launch_fn
         self.hosts = list(hosts)
         self.ds_config = ds_config
@@ -161,11 +196,21 @@ class DSElasticAgent:
         self.heartbeat_dir = heartbeat_dir or os.path.join(
             "/tmp", f"dstpu_heartbeats_{os.getpid()}")
         backoff = {FAILURE_DEAD: 0.0, FAILURE_HUNG: 0.0,
-                   FAILURE_CORRUPT: 5.0}
+                   FAILURE_CORRUPT: 5.0, FAILURE_DEAD_SLICE: 0.0,
+                   FAILURE_PREEMPTED: 0.0}
         backoff.update(restart_backoff_s or {})
         self.restart_backoff_s = backoff
         self.hot_root = hot_root
         self.flightrec_root = flightrec_root
+        self.slice_of = {str(h): str(s)
+                         for h, s in (slices or {}).items()}
+        self.slice_aware = len({self._slice_of(h)
+                                for h in self.hosts}) > 1
+        # live worker procs of the current generation — the SIGTERM
+        # forwarding handler terminates these so a maintenance notice
+        # to the AGENT drains every worker
+        self._live_procs = {}
+        self._preempt_notice = False
         self.topology = self.compute_topology(self.hosts, validate=False)
         # host -> failure class of the most recent membership change
         self.last_failures = {}
@@ -231,17 +276,31 @@ class DSElasticAgent:
         return (time.time() - beat) > self.heartbeat_timeout_s
 
     # ------------------------------------------------------------- topology
+    def _slice_of(self, host):
+        return self.slice_of.get(str(host), "0")
+
     def compute_topology(self, hosts, validate=True):
         """The surviving admissible topology for ``hosts`` — not just a
         world size. The model-sharding factors (tp/ep/pp/sp) are FIXED
         (a host loss cannot shrink tensor parallelism); what shrinks is
-        dp. -> dict(world, dp, tp, ep, pipe, seq, hosts). ``validate``
-        raises WorldFailure when the factors do not divide the world or
-        the elastic config rejects it."""
+        dp — and, slice-aware, ``do``: the surviving data_outer degree
+        is the number of slices still holding hosts, so a dead slice
+        shrinks do by one while each surviving slice keeps its
+        intra-slice dp. -> dict(world, dp, do, tp, ep, pipe, seq,
+        hosts). ``validate`` raises WorldFailure when the factors do
+        not divide the world, surviving slices are ragged (a data_outer
+        mesh needs equal slice populations), or the elastic config
+        rejects it."""
         world = len(hosts) * self.chips_per_host
         fixed = (self.tensor_parallel * self.expert_parallel
                  * self.pipe_parallel * self.seq_parallel)
+        slice_pop = {}
+        for h in hosts:
+            sl = self._slice_of(h)
+            slice_pop[sl] = slice_pop.get(sl, 0) + 1
+        do = len(slice_pop) if self.slice_of else 1
         topo = {"world": world, "dp": world // fixed if fixed else 0,
+                "do": do,
                 "tp": self.tensor_parallel, "ep": self.expert_parallel,
                 "pipe": self.pipe_parallel, "seq": self.seq_parallel,
                 "hosts": list(hosts)}
@@ -253,6 +312,12 @@ class DSElasticAgent:
                 f"{self.chips_per_host} chips) is not divisible by the "
                 f"fixed model-sharding factors tp*ep*pp*sp={fixed}: no "
                 f"admissible topology")
+        if self.slice_of and len(set(slice_pop.values())) > 1:
+            raise WorldFailure(
+                f"surviving slices are ragged ({slice_pop}): a "
+                f"data_outer mesh needs equal slice populations — a "
+                f"PARTIAL slice loss must drop the whole slice before "
+                f"relaunch")
         return topo
 
     def worker_env(self, host):
@@ -266,6 +331,10 @@ class DSElasticAgent:
             env["DSTPU_HOT_TIER_ROOT"] = self.hot_root
             env["DSTPU_HOT_NODE"] = str(host)
             env["DSTPU_HOT_PEERS"] = ",".join(str(h) for h in self.hosts)
+            if self.slice_of:
+                env["DSTPU_HOT_SLICE"] = self._slice_of(host)
+                env["DSTPU_HOT_SLICES"] = ",".join(
+                    self._slice_of(h) for h in self.hosts)
         if self.flightrec_root:
             env["DSTPU_FLIGHTREC_DIR"] = self.flightrec_root
             env["DSTPU_FLIGHTREC_NODE"] = str(host)
@@ -293,6 +362,8 @@ class DSElasticAgent:
             return FAILURE_HUNG
         if rc == CORRUPT_CKPT_EXIT_CODE:
             return FAILURE_CORRUPT
+        if rc == PREEMPTED_EXIT_CODE:
+            return FAILURE_PREEMPTED
         return FAILURE_DEAD
 
     def _supervise(self, procs):
@@ -302,6 +373,7 @@ class DSElasticAgent:
         and counted as failed — same recovery path as a dead one.
         Returns (ok, failures) with failures a dict host -> class."""
         live = dict(procs)
+        self._live_procs = live
         failures = {}
         launched_at = time.time()
         while live:
@@ -343,7 +415,36 @@ class DSElasticAgent:
                 live.clear()
             if live:
                 time.sleep(self.poll_s)
+        self._live_procs = {}
         return (not failures), failures
+
+    def install_sigterm_forwarding(self):
+        """Forward a SIGTERM delivered to the AGENT to every live
+        worker: each worker's preempt-drain handler finishes its
+        in-flight step, forces a hot+replica push + flight dump, and
+        exits PREEMPTED_EXIT_CODE — which this agent classifies as
+        'preempted' (host kept, zero backoff). Main-thread only (signal
+        module restriction); ``run()`` calls this, and it is safe to
+        call when no workers are live. Returns True when installed."""
+        import signal
+        import threading
+        if threading.current_thread() is not threading.main_thread():
+            return False
+
+        def _forward(signum, frame):
+            # signal context: flag + kill only, no logging/IO
+            self._preempt_notice = True
+            for p in list(self._live_procs.values()):
+                try:
+                    p.terminate()
+                except Exception:  # noqa: BLE001
+                    pass
+
+        try:
+            signal.signal(signal.SIGTERM, _forward)
+            return True
+        except (ValueError, OSError):
+            return False
 
     def _attach_flight_records(self, failures):
         """Read each failed host's flight-recorder dump and attach the
@@ -376,13 +477,47 @@ class DSElasticAgent:
 
     def _handle_membership_change(self, failures):
         """Classify, drop dead/hung hosts (keeping corrupt-checkpoint
-        ones — their HOST is healthy), purge the hot-tier stores of the
-        hosts whose RAM is gone, and apply the per-class backoff."""
+        and preempted ones — their HOST is healthy), refine host losses
+        into slice losses when slice-aware, purge the hot-tier stores
+        of the hosts whose RAM is gone, and apply the per-class
+        backoff. Slice refinement: a slice whose EVERY host failed is a
+        ``dead_slice`` (one 'slice_loss' fault point per slice, do
+        shrinks by one); a slice that lost only SOME hosts is dropped
+        WHOLE anyway — a data_outer mesh needs equal slice populations,
+        so the stranded healthy hosts cannot rejoin this world."""
+        failures = dict(failures)
+        lost = {h for h, kind in failures.items()
+                if kind in (FAILURE_DEAD, FAILURE_HUNG)}
+        if self.slice_of and lost:
+            by_slice = {}
+            for h in self.hosts:
+                by_slice.setdefault(self._slice_of(h), []).append(h)
+            for sl, members in sorted(by_slice.items()):
+                hit = [h for h in members if h in lost]
+                if not hit:
+                    continue
+                if len(hit) == len(members):
+                    fault_injection.fire("slice_loss")
+                    for h in members:
+                        failures[h] = FAILURE_DEAD_SLICE
+                    logger.warning(
+                        f"elastic agent: slice {sl} fully lost "
+                        f"({members}): dead_slice — data_outer shrinks "
+                        f"by one; surviving slices' replicas are the "
+                        f"restore source")
+                else:
+                    stranded = [h for h in members if h not in lost]
+                    lost.update(stranded)
+                    logger.warning(
+                        f"elastic agent: slice {sl} partially lost "
+                        f"({hit} of {members}): dropping the whole "
+                        f"slice — a data_outer mesh needs equal slice "
+                        f"populations, so {stranded} cannot rejoin "
+                        f"this world")
+                lost.update(members)
         self.last_failures = dict(failures)
         self._attach_flight_records(failures)
-        lost = [h for h, kind in failures.items()
-                if kind in (FAILURE_DEAD, FAILURE_HUNG)]
-        for h in lost:
+        for h in sorted(lost):
             fault_injection.fire("host_loss")
             if self.hot_root:
                 from ..runtime.checkpoint_engine import hot_tier
@@ -424,6 +559,7 @@ class DSElasticAgent:
         """Launch and supervise until clean exit. Returns the final host
         list. Raises WorldFailure when recovery is impossible."""
         self._validate_world(self.hosts)
+        self.install_sigterm_forwarding()
         while True:
             gen = self.restart_count
             logger.info(
@@ -434,6 +570,12 @@ class DSElasticAgent:
             self._clear_heartbeats(self.hosts)
             procs = self._launch(self.hosts)
             ok, failures = self._supervise(procs)
+            if self._preempt_notice:
+                self._preempt_notice = False
+                logger.warning(
+                    "elastic agent: SIGTERM forwarded to workers "
+                    "(preemption notice); drained workers relaunch "
+                    "with zero backoff")
             if ok:
                 return list(self.hosts)
             self._handle_membership_change(failures)

@@ -22,12 +22,19 @@ from ..utils.logging import logger
 DEFAULT_COORD_PORT = 8476
 
 
-def fetch_hostfile(path):
-    """Parse a DeepSpeed-style hostfile: ``hostname slots=N`` per line,
-    '#' comments. Returns ordered {hostname: slots} (slots = TPU chips on
-    that host; informational for JAX, which discovers local chips itself).
+def fetch_hostfile(path, with_slices=False):
+    """Parse a DeepSpeed-style hostfile: ``hostname slots=N [slice=K]``
+    per line, '#' comments. Returns ordered {hostname: slots} (slots =
+    TPU chips on that host; informational for JAX, which discovers local
+    chips itself). The optional ``slice=K`` token records which TPU
+    slice the host belongs to (multi-slice pods over DCN); with
+    ``with_slices=True`` the return is ``({host: slots}, {host: slice})``
+    where the slice map only holds hosts that declared one — the
+    elastic agent uses it for cross-slice replica placement and
+    dead-slice classification.
     """
     resource_pool = {}
+    slice_map = {}
     with open(path) as f:
         for ln, line in enumerate(f, 1):
             line = line.split("#")[0].strip()
@@ -36,15 +43,20 @@ def fetch_hostfile(path):
             parts = line.split()
             host = parts[0]
             slots = 0
-            if len(parts) > 1:
-                if not parts[1].startswith("slots="):
+            for tok in parts[1:]:
+                if tok.startswith("slots="):
+                    slots = int(tok.split("=", 1)[1])
+                elif tok.startswith("slice="):
+                    slice_map[host] = tok.split("=", 1)[1]
+                else:
                     raise ValueError(
                         f"{path}:{ln}: malformed line {line!r} "
-                        "(want 'host slots=N')")
-                slots = int(parts[1].split("=", 1)[1])
+                        "(want 'host slots=N [slice=K]')")
             if host in resource_pool:
                 raise ValueError(f"{path}:{ln}: duplicate host {host}")
             resource_pool[host] = slots
+    if with_slices:
+        return resource_pool, slice_map
     return resource_pool
 
 
@@ -349,11 +361,12 @@ def main(argv=None):
                    [sys.executable, args.script] + args.script_args,
                    os.environ.copy())
 
-    pool = fetch_hostfile(args.hostfile)
+    pool, slice_map = fetch_hostfile(args.hostfile, with_slices=True)
     pool = parse_inclusion_exclusion(pool, args.include, args.exclude)
     if not pool:
         raise SystemExit("no hosts left after filters")
     hosts = list(pool)
+    slice_map = {h: s for h, s in slice_map.items() if h in pool}
     coordinator = (f"{args.master_addr or hosts[0]}:{args.master_port}")
     cmds = build_worker_cmds(
         hosts, coordinator, args.script, args.script_args,
@@ -404,7 +417,10 @@ def main(argv=None):
                                    args.elastic_flightrec_root or None),
                                heartbeat_timeout_s=(
                                    args.elastic_heartbeat_timeout),
-                               heartbeat_dir=args.elastic_heartbeat_dir)
+                               heartbeat_dir=args.elastic_heartbeat_dir,
+                               # hostfile slice=K tokens: cross-slice
+                               # replica placement + dead_slice class
+                               slices=slice_map or None)
         agent.run()
         return 0
     logger.info(f"launching on {len(hosts)} hosts via {args.launcher}; "

@@ -54,6 +54,13 @@ TAG_SCHEMA = {
         "hot tier present but load degraded to durable",
     "Train/Checkpoint/durable_restores":
         "cumulative loads that read persistent storage",
+    "Train/Checkpoint/replica_pushes":
+        "cumulative cross-slice replica pushes (DCN peer writes + "
+        "MiCS zero-replica registrations)",
+    "Train/Checkpoint/replica_restores":
+        "cumulative loads served by the cross-slice replica tier",
+    "Train/Checkpoint/replica_fallbacks":
+        "replica tier present but load degraded to durable",
     "Train/Checkpoint/reshape":
         "1 when this resume re-partitioned onto a new topology",
 

@@ -542,6 +542,12 @@ class TelemetryCollector:
         self.flight.record(kind, **data)
 
     def on_crash(self, exc):
+        # SystemExit is a DELIBERATE exit, not a crash: the preempt
+        # drain raises it after recording 'preempted' and dumping with
+        # that reason — a crash-dump here would overwrite the orderly
+        # tail the elastic agent reads to classify the death
+        if isinstance(exc, SystemExit):
+            return
         self.flight.crash(exc)
 
     def _on_fault(self, point, injected):

@@ -60,6 +60,11 @@ def _new_counters():
         "hot_restores": 0,     # loads served from in-memory replicas
         "hot_fallbacks": 0,    # hot tier present but degraded to durable
         "durable_restores": 0,  # loads that DID read persistent storage
+        # cross-slice replica tier (slice-aware hot_tier + MiCS
+        # zero-replica registration)
+        "replica_pushes": 0,     # cross-slice replica/zero-replica pushes
+        "replica_restores": 0,   # loads served by the replica tier
+        "replica_fallbacks": 0,  # replica tier present but degraded
     }
 
 

@@ -21,6 +21,24 @@ Store layout (one subtree per node under a shared root):
 
     {root}/{node}/{tag}/own/shard-{p}.npz        this node's own save
     {root}/{node}/{tag}/from-{origin}/shard-{p}.npz   received replicas
+                                                 (origin in OUR slice)
+    {root}/{node}/{tag}/replica-from-{origin}/shard-{p}.npz
+        cross-slice replicas — provenance is burned into the dir name
+        so a survivor can tell, after the origin slice is gone and its
+        peers purged, which shards belong to the REPLICA restore tier
+    {root}/{node}/{tag}/zero-replica-{slice}/shard-r{p}.npz
+        the registered MiCS ZeRO replica: under cross-slice replication
+        (data_outer>1) every slice holds a full copy of master/opt
+        state in HBM; the engine persists this node's replica shards
+        here at each save so the surviving slice can restore from its
+        OWN memory even when no cross-slice push landed
+
+Slice awareness: when a slice map is configured (``slices`` arg or the
+``DSTPU_HOT_SLICES`` env the elastic agent exports), replica placement
+targets peers in a DIFFERENT slice first — a whole-slice failure (ICI
+outage, maintenance preemption) then still leaves every shard with a
+surviving copy, which ``manager.load_best_tiered`` serves as the
+``replica`` tier (ordered hot → replica → durable).
 
 Two transports own the peer push:
 
@@ -38,9 +56,13 @@ Two transports own the peer push:
 
 Fault points (utils/fault_injection): ``replica_push`` fires once per
 peer replica write, ``replica_fetch`` once per replica read during
-assembly (own-written shards read clean) — arming them makes pushes
-fail (advisory: the durable tier still lands) or poisons the replicas
-so loads degrade deterministically.
+assembly (own-written shards read clean), ``dcn_partition`` before each
+collective cross-peer exchange, ``replica_restore`` once per
+replica-TIER source read, and ``slice_loss`` at the slice-aware push
+boundary (arming it with ``kill`` models a whole slice dying
+mid-training) — arming the advisory ones makes pushes fail (the
+durable tier still lands) or poisons the replicas so loads degrade
+deterministically.
 """
 
 import concurrent.futures as futures
@@ -84,6 +106,12 @@ def purge_node(root, node):
     shutil.rmtree(os.path.join(root, _safe(node)), ignore_errors=True)
 
 
+# one-time (per process) hot_replicas clamp warning — a config int and
+# an autotuned 'hot_replicas' winner both flow through the constructor,
+# and a small pod must not log the same clamp on every engine build
+_CLAMP_WARNED = [False]
+
+
 class HotTierStore:
     """One node's view of the peer-replicated hot tier.
 
@@ -95,15 +123,30 @@ class HotTierStore:
       peers: ORDERED ring membership (list of node ids). Default:
         ``DSTPU_HOT_PEERS`` (comma-separated), else one id per jax
         process. Ring neighbors are computed from this order.
-      replicas: K — how many ring neighbors receive each shard.
+      replicas: K — how many ring neighbors receive each shard. Clamped
+        to ``len(peers) - 1`` (one-time warning): pushing more replicas
+        than there are distinct peers would re-send duplicate shards to
+        the same host, inflating save overhead for zero durability.
       keep_last: hot-tier retention (tags per node; the tier is a cache,
         not an archive).
       counters: optional engine counters dict (hot_pushes /
-        hot_push_errors bumped here).
+        hot_push_errors / replica_pushes bumped here).
+      slices: slice membership for cross-slice placement — a dict
+        ``{peer: slice_id}`` or a list aligned with ``peers``. Default:
+        ``DSTPU_HOT_SLICES`` env (comma-separated, aligned with
+        ``DSTPU_HOT_PEERS``; the elastic agent exports both). With more
+        than one distinct slice the store becomes slice-AWARE: replica
+        pushes target other-slice peers first and other-slice/
+        ``replica-from-*`` sources are served as the ``replica`` tier.
+      max_inflight_pushes: backlog bound for :meth:`push_async` — at
+        most this many pushes may be pending at once (oldest queued
+        push dropped with a counted ``hot_push_errors``), and a newer
+        push of the same tag supersedes a still-queued one.
     """
 
     def __init__(self, root=None, node=None, peers=None, replicas=1,
-                 keep_last=2, counters=None):
+                 keep_last=2, counters=None, slices=None,
+                 max_inflight_pushes=4):
         import jax
         self.root = root or default_root()
         if node is None:
@@ -117,28 +160,78 @@ class HotTierStore:
             else:
                 peers = [str(i) for i in range(jax.process_count())]
         self.peers = [_safe(p) for p in peers]
+        if slices is None:
+            env = os.environ.get("DSTPU_HOT_SLICES")
+            if env:
+                slices = [s.strip() for s in env.split(",")]
+        if isinstance(slices, (list, tuple)):
+            slices = {p: slices[i] if i < len(slices) else "0"
+                      for i, p in enumerate(self.peers)}
+        self.slice_of = {_safe(k): _safe(v)
+                         for k, v in (slices or {}).items()}
         if self.node not in self.peers:
             # a node outside the ring still stores locally (replicas
             # have nowhere meaningful to go); keep membership explicit
             self.peers = self.peers + [self.node]
-        self.replicas = max(0, int(replicas))
+        self.slice = self.slice_of.get(
+            self.node, _safe(os.environ.get("DSTPU_HOT_SLICE", "0")))
+        self.slice_of.setdefault(self.node, self.slice)
+        for p in self.peers:
+            self.slice_of.setdefault(p, "0")
+        self.slice_aware = len(set(self.slice_of.values())) > 1
+        replicas = max(0, int(replicas))
+        cap = max(0, len(self.peers) - 1)
+        if replicas > cap:
+            if not _CLAMP_WARNED[0]:
+                _CLAMP_WARNED[0] = True
+                logger.warning(
+                    f"hot tier: hot_replicas={replicas} exceeds the "
+                    f"ring's {len(self.peers)} peer(s) - 1; clamping to "
+                    f"{cap} — extra replicas would target the same "
+                    f"peers again (duplicate pushes, zero added "
+                    f"durability)")
+            replicas = cap
+        self.replicas = replicas
         self.keep_last = int(keep_last)
         self.counters = counters if counters is not None else {}
+        self.max_inflight_pushes = max(1, int(max_inflight_pushes))
         self._pool = futures.ThreadPoolExecutor(max_workers=1)
-        self._inflight = []
+        self._inflight = []       # [(tag, future)] — see push_async
 
     # ------------------------------------------------------------ topology
     def ring_neighbors(self):
-        """The K distinct peers after this node in ring order."""
-        if len(self.peers) <= 1:
+        """The K distinct peers after this node in ring order. Slice-
+        aware stores pick OTHER-slice peers first (still in ring order),
+        so a whole-slice loss leaves every shard a surviving copy; same-
+        slice peers only fill in when other slices cannot absorb K."""
+        if len(self.peers) <= 1 or self.replicas < 1:
             return []
         i = self.peers.index(self.node)
+        order = [self.peers[(i + k) % len(self.peers)]
+                 for k in range(1, len(self.peers))]
+        if self.slice_aware:
+            order = ([p for p in order if self.slice_of[p] != self.slice]
+                     + [p for p in order
+                        if self.slice_of[p] == self.slice])
         out = []
-        for k in range(1, self.replicas + 1):
-            p = self.peers[(i + k) % len(self.peers)]
+        for p in order:
             if p != self.node and p not in out:
                 out.append(p)
+            if len(out) >= self.replicas:
+                break
         return out
+
+    def _cross_slice(self, a, b):
+        return (self.slice_aware
+                and self.slice_of.get(a, "0") != self.slice_of.get(b, "0"))
+
+    def _recv_subdir(self, origin, target):
+        """Directory name (under the target's tag dir) a replica from
+        ``origin`` lands in — cross-slice provenance is burned into the
+        name so the replica TIER survives origin-slice purge."""
+        if self._cross_slice(origin, target):
+            return f"replica-from-{origin}"
+        return f"from-{origin}"
 
     def _node_dir(self, node):
         return os.path.join(self.root, node)
@@ -160,6 +253,23 @@ class HotTierStore:
             f.write(payload)
         os.replace(tmp, os.path.join(target_dir, fname))
 
+    def _count_push_error(self, msg):
+        self.counters["hot_push_errors"] = \
+            self.counters.get("hot_push_errors", 0) + 1
+        logger.warning(msg)
+
+    def _count(self, key):
+        self.counters[key] = self.counters.get(key, 0) + 1
+
+    def _fire_slice_boundary(self):
+        # the slice-death injection point: armed with kill=True it
+        # models every host of a slice dying at its push boundary
+        # (classed 'fatal' in fault_injection.BLAST_RADIUS — a plain
+        # FaultError here fails the push call synchronously, which only
+        # a harness arms)
+        if self.slice_aware:
+            fault_injection.fire("slice_loss")
+
     def push(self, tag, chunks, extra, shard_name=None):
         """Store this node's shard for ``tag`` locally and replicate it
         to the ring neighbors. Replica failures are ADVISORY (counted,
@@ -176,44 +286,104 @@ class HotTierStore:
             self._write_bytes(self._tag_dir(self.node, tag, "own"),
                               shard_name, payload)
         except OSError as e:
-            self.counters["hot_push_errors"] = \
-                self.counters.get("hot_push_errors", 0) + 1
-            logger.warning(f"hot tier: local store of {tag} failed: {e}")
+            self._count_push_error(
+                f"hot tier: local store of {tag} failed: {e}")
             return 0
         for peer in self.ring_neighbors():
             try:
                 fault_injection.fire("replica_push")
                 self._write_bytes(
-                    self._tag_dir(peer, tag, f"from-{self.node}"),
+                    self._tag_dir(peer, tag,
+                                  self._recv_subdir(self.node, peer)),
                     shard_name, payload)
                 ok += 1
+                if self._cross_slice(self.node, peer):
+                    self._count("replica_pushes")
             except fault_injection.SimulatedKill:
                 raise
             except Exception as e:  # noqa: BLE001 - advisory path
-                self.counters["hot_push_errors"] = \
-                    self.counters.get("hot_push_errors", 0) + 1
-                logger.warning(
+                self._count_push_error(
                     f"hot tier: replica push {tag} -> {peer} failed: {e}")
-        self.counters["hot_pushes"] = \
-            self.counters.get("hot_pushes", 0) + 1
+        self._count("hot_pushes")
         self.gc()
         return ok
 
     def push_async(self, tag, chunks, extra, shard_name=None):
         """Replicate off the training critical path (the PR-2 async-pool
         discipline). Degrades to an in-caller push when the pool is
-        gone (interpreter teardown)."""
+        gone (interpreter teardown).
+
+        Backlog bound: repeated advisory push failures (or a slow
+        tmpfs) must not let queued futures accumulate across tags, so
+        (a) a newer push of the SAME tag supersedes one still queued —
+        the superseded payload could never serve a restore the newer
+        one would not serve better — and (b) total pending pushes are
+        capped at ``max_inflight_pushes``, dropping the oldest
+        cancellable future. Every drop is a counted advisory
+        ``hot_push_errors``."""
+        self._fire_slice_boundary()
         # prune finished futures so a long run that saves every N steps
         # (and never loads) cannot grow the list unboundedly
-        self._inflight = [f for f in self._inflight if not f.done()]
+        self._inflight = [(t, f) for t, f in self._inflight
+                          if not f.done()]
+        pending = []
+        for t, f in self._inflight:
+            if t == tag and f.cancel():
+                self._count_push_error(
+                    f"hot tier: superseded queued push of {t!r} with a "
+                    f"newer payload")
+            else:
+                pending.append((t, f))
+        i = 0
+        while len(pending) >= self.max_inflight_pushes \
+                and i < len(pending):
+            t, f = pending[i]
+            if f.cancel():
+                pending.pop(i)
+                self._count_push_error(
+                    f"hot tier: push backlog over "
+                    f"{self.max_inflight_pushes}; dropped oldest queued "
+                    f"push of {t!r}")
+            else:
+                i += 1          # running — cannot be dropped
+        self._inflight = pending
         try:
             fut = self._pool.submit(self.push, tag, chunks, extra,
                                     shard_name)
         except RuntimeError:
             self.push(tag, chunks, extra, shard_name)
             return None
-        self._inflight.append(fut)
+        self._inflight.append((tag, fut))
         return fut
+
+    def push_zero_replica(self, tag, chunks, extra):
+        """Register the cross-slice ZeRO replica as a restore source.
+
+        Under MiCS the INNER_DP_AXES-partitioned master/opt state is
+        REPLICATED over ``data_outer`` — every slice already holds a
+        full copy in HBM. The engine hands this process's replica
+        shards (``serialization.extract_replica_chunks``) here at each
+        save; they land in our OWN subtree keyed by slice, so after the
+        canonical-writer slice dies (and its stores are purged) the
+        surviving slice restores from its own memory-resident copy with
+        zero persistent-storage reads. Advisory, like every hot push."""
+        import jax
+        self._fire_slice_boundary()
+        fname = f"shard-r{jax.process_index()}.npz"
+        try:
+            payload = self._serialize(chunks, extra)
+            self._write_bytes(
+                self._tag_dir(self.node, tag,
+                              f"zero-replica-{self.slice}"),
+                fname, payload)
+        except fault_injection.SimulatedKill:
+            raise
+        except Exception as e:  # noqa: BLE001 - advisory path
+            self._count_push_error(
+                f"hot tier: zero-replica push of {tag} failed: {e}")
+            return False
+        self._count("replica_pushes")
+        return True
 
     def push_collective(self, tag, chunks, extra, shard_name=None):
         """DCN transport: exchange the serialized shard with each ring
@@ -226,6 +396,7 @@ class HotTierStore:
         and logged, never raised — it must not cost the durable save
         the engine is about to make."""
         import jax
+        self._fire_slice_boundary()
         if jax.process_count() <= 1 or self.replicas < 1:
             return self.push(tag, chunks, extra, shard_name)
         try:
@@ -252,24 +423,33 @@ class HotTierStore:
         ok = 0
         for k in range(1, self.replicas + 1):
             fault_injection.fire("replica_push")
+            # the exchange rides DCN between slices; a partition there
+            # is advisory (caught by push_collective) — the durable
+            # save at this barrier still lands
+            fault_injection.fire("dcn_partition")
             recv, origin = ring_exchange_bytes(payload, shift=k)
             if recv is None:
                 continue
             origin_node = self.peers[origin % len(self.peers)]
             self._write_bytes(
-                self._tag_dir(self.node, tag, f"from-{origin_node}"),
+                self._tag_dir(self.node, tag,
+                              self._recv_subdir(origin_node, self.node)),
                 f"shard-{origin}.npz", recv)
             ok += 1
-        self.counters["hot_pushes"] = \
-            self.counters.get("hot_pushes", 0) + 1
+            if self._cross_slice(origin_node, self.node):
+                self._count("replica_pushes")
+        self._count("hot_pushes")
         self.gc()
         return ok
 
     def wait(self):
         """Drain in-flight async pushes (advisory failures already
-        swallowed inside push)."""
+        swallowed inside push; backlog-dropped futures were counted at
+        cancel time)."""
         pending, self._inflight = self._inflight, []
-        for fut in pending:
+        for _tag, fut in pending:
+            if fut.cancelled():
+                continue
             exc = fut.exception()
             if exc is not None and not isinstance(exc, Exception):
                 raise exc          # SimulatedKill et al.
@@ -300,44 +480,154 @@ class HotTierStore:
                 continue
         return sorted(seen, key=_step_key, reverse=True)
 
-    def _shard_sources(self, tag):
-        """-> {shard_name: (path, is_replica)} best source per shard
-        file: this node's own save first (a clean local read), then
-        replicas (our own received ones, then other nodes' subtrees) —
-        every replica read is a ``replica_fetch`` fire."""
+    def tier_tags(self):
+        """-> (hot_tags, replica_tags), each newest first. A tag is a
+        HOT candidate when at least one hot-class source exists (an
+        ``own`` save, or a same-slice peer replica); a REPLICA candidate
+        when at least one replica-class source exists (a cross-slice
+        ``replica-from-*`` shard, an other-slice subtree, or a
+        registered ``zero-replica-*`` set). A tag may be both — the
+        manager tries hot first and degrades down-tier."""
+        hot, replica = set(), set()
+        try:
+            nodes = os.listdir(self.root)
+        except OSError:
+            return [], []
+        for node in nodes:
+            nd = self._node_dir(node)
+            try:
+                tags = [t for t in os.listdir(nd)
+                        if os.path.isdir(os.path.join(nd, t))]
+            except OSError:
+                continue
+            for t in tags:
+                try:
+                    subs = os.listdir(os.path.join(nd, t))
+                except OSError:
+                    continue
+                for sub in subs:
+                    cls = self._source_class(node, sub)
+                    (replica if cls == "replica" else hot).add(t)
+        order = lambda s: sorted(s, key=_step_key, reverse=True)  # noqa: E731
+        return order(hot), order(replica)
+
+    def _source_class(self, node, sub):
+        """'own' | 'hot' | 'replica' for a source subtree: replica =
+        anything only the cross-slice replica tier may serve (burned-in
+        ``replica-from-*`` provenance, the registered zero-replica set,
+        or ANY subtree of an other-slice node). Without a slice map
+        every non-own source is 'hot' — the PR-7 single-host-loss
+        behavior, unchanged."""
+        if sub.startswith("zero-replica") or \
+                sub.startswith("replica-from-"):
+            return "replica"
+        if self.slice_aware and \
+                self.slice_of.get(node, self.slice) != self.slice:
+            return "replica"
+        if node == self.node and sub == "own":
+            return "own"
+        return "hot"
+
+    _CLASS_PRIO = {"own": 0, "hot": 1, "replica": 2}
+
+    def _shard_sources(self, tag, tier="replica"):
+        """-> {shard_name: (path, cls)} best source per shard file:
+        this node's own save first (a clean local read), then same-
+        slice replicas, then — only when ``tier='replica'`` — cross-
+        slice replica-tier sources. Every non-own read fires
+        ``replica_fetch``; replica-class reads additionally fire
+        ``replica_restore`` (see :meth:`load`)."""
+        max_prio = 1 if tier == "hot" else 2
         sources = {}
-        own = glob.glob(os.path.join(self._tag_dir(self.node, tag, "own"),
-                                     "shard-*.npz"))
-        for p in own:
-            sources.setdefault(os.path.basename(p), (p, False))
         try:
             others = sorted(n for n in os.listdir(self.root)
                             if n != self.node)
         except OSError:
             others = []
         for node in [self.node] + others:
-            pattern = os.path.join(self._tag_dir(node, tag), "*",
-                                   "shard-*.npz")
-            for p in sorted(glob.glob(pattern)):
-                sources.setdefault(os.path.basename(p), (p, True))
+            td = self._tag_dir(node, tag)
+            try:
+                subs = sorted(os.listdir(td))
+            except OSError:
+                continue
+            for sub in subs:
+                cls = self._source_class(node, sub)
+                if sub.startswith("zero-replica"):
+                    continue      # separate all-or-nothing sets
+                prio = self._CLASS_PRIO[cls]
+                if prio > max_prio:
+                    continue
+                for p in sorted(glob.glob(
+                        os.path.join(td, sub, "shard-*.npz"))):
+                    name = os.path.basename(p)
+                    cur = sources.get(name)
+                    if cur is None or prio < self._CLASS_PRIO[cur[1]]:
+                        sources[name] = (p, cls)
         return sources
 
-    def load(self, tag):
-        """Assemble ``tag`` from the best available sources. Raises
+    def _zero_replica_sets(self, tag):
+        """Complete per-slice ZeRO-replica shard sets for ``tag``, our
+        own slice's first — each is an all-or-nothing assembly fallback
+        (load_shard_files' per-leaf coverage check rejects a set whose
+        slice lost members before every replica shard landed)."""
+        by_slice = {}
+        try:
+            nodes = os.listdir(self.root)
+        except OSError:
+            return []
+        for node in nodes:
+            td = self._tag_dir(node, tag)
+            for d in glob.glob(os.path.join(td, "zero-replica-*")):
+                sl = os.path.basename(d)[len("zero-replica-"):]
+                for p in glob.glob(os.path.join(d, "shard-*.npz")):
+                    by_slice.setdefault(sl, {})[os.path.basename(p)] = p
+        order = sorted(by_slice, key=lambda s: (s != self.slice, s))
+        return [[by_slice[s][n] for n in sorted(by_slice[s])]
+                for s in order]
+
+    def load(self, tag, tier="replica"):
+        """Assemble ``tag`` from the best available sources, bounded by
+        ``tier``: 'hot' uses only own + same-slice replicas; 'replica'
+        (the default, and the pre-slice behavior when no slice map is
+        configured) additionally serves cross-slice replica shards and
+        falls back to a registered zero-replica set. Raises
         CheckpointCorruptionError/ValueError/OSError (the manager's
         FALLBACK_ERRORS) when shards are missing, CRC-invalid, or a
-        replica fetch fails — callers degrade to the durable tier."""
-        sources = self._shard_sources(tag)
-        if not sources:
+        replica fetch fails — callers degrade down-tier."""
+        from .manager import FALLBACK_ERRORS
+        sources = self._shard_sources(tag, tier=tier)
+        last_err = None
+        if sources:
+            files = []
+            for name in sorted(sources):
+                path, cls = sources[name]
+                if cls != "own":
+                    fault_injection.fire("replica_fetch")
+                if cls == "replica":
+                    fault_injection.fire("replica_restore")
+                files.append(path)
+            try:
+                return ser.load_shard_files(files, where=f"hot:{tag}")
+            except FALLBACK_ERRORS as e:
+                if tier == "hot":
+                    raise
+                last_err = e
+        if tier == "hot":
             raise FileNotFoundError(
                 f"hot tier: no shards for tag {tag!r} under {self.root}")
-        files = []
-        for name in sorted(sources):
-            path, is_replica = sources[name]
-            if is_replica:
-                fault_injection.fire("replica_fetch")
-            files.append(path)
-        return ser.load_shard_files(files, where=f"hot:{tag}")
+        for files in self._zero_replica_sets(tag):
+            for _ in files:
+                fault_injection.fire("replica_restore")
+            try:
+                return ser.load_shard_files(
+                    files, where=f"hot-zero-replica:{tag}")
+            except FALLBACK_ERRORS as e:
+                last_err = e
+                continue
+        if last_err is not None:
+            raise last_err
+        raise FileNotFoundError(
+            f"hot tier: no shards for tag {tag!r} under {self.root}")
 
     def load_best(self, tag=None):
         """Try candidates (an explicit tag, or every visible generation

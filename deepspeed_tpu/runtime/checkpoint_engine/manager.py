@@ -124,17 +124,19 @@ def load_candidates(load_dir, tag=None, hot_store=None):
     corrupt newest generation falls back to the previous durable one.
 
     With ``hot_store`` the candidate list grows a TIER dimension and the
-    return shape becomes ``[(tier, tag), ...]`` with the hot tier's
-    generations ordered before any durable one — the common single-host
-    loss restores from surviving in-memory replicas with zero
-    persistent-storage reads, degrading to the durable tier when
-    replicas are insufficient or CRC-invalid. Staleness guard: a hot
+    return shape becomes ``[(tier, tag), ...]`` ordered hot → replica →
+    durable — the common single-host loss restores from surviving
+    same-slice in-memory replicas, a whole-slice loss from the cross-
+    slice REPLICA tier (``replica-from-*`` shards and the registered
+    MiCS zero-replica; still zero persistent-storage reads), degrading
+    to the durable tier when replicas are insufficient or CRC-invalid.
+    Staleness guard (applied to BOTH in-memory tiers): a hot/replica
     generation OLDER than the published durable 'latest' is dropped
     (the advisory replica push can lag or fail without failing the
     save, so the RAM tier may hold only step N-1 after step N durably
     committed — serving it would silently roll a committed generation
-    back). A hot generation NEWER than 'latest' is kept: it is the
-    latest trained state even though its durable commit never landed.
+    back). A generation NEWER than 'latest' is kept: it is the latest
+    trained state even though its durable commit never landed.
 
     This list is THE tier-order definition — :func:`load_best_tiered`
     consumes it rather than re-deriving its own."""
@@ -147,18 +149,25 @@ def load_candidates(load_dir, tag=None, hot_store=None):
         durable.extend(t for t in tags if t != latest)
     if hot_store is None:
         return durable
-    if tag is not None:
-        # only a tag the tier actually holds is a hot candidate — a
-        # cold RAM tier after a full restart is routine, not a
-        # degradation, and must not fire the hot_fallbacks signal
-        hot = [tag] if tag in hot_store.tags() else []
+    # stores without tier_tags (older stubs) expose a single hot list
+    if hasattr(hot_store, "tier_tags"):
+        hot, replica = hot_store.tier_tags()
     else:
-        hot = hot_store.tags()
+        hot, replica = hot_store.tags(), []
+    if tag is not None:
+        # only a tag the tier actually holds is a hot/replica candidate
+        # — a cold RAM tier after a full restart is routine, not a
+        # degradation, and must not fire the hot_fallbacks signal
+        hot = [tag] if tag in hot else []
+        replica = [tag] if tag in replica else []
+    else:
         latest = durable[0] if durable else None
         if latest is not None:
             floor = _step_key(latest)
             hot = [t for t in hot if _step_key(t) >= floor]
+            replica = [t for t in replica if _step_key(t) >= floor]
     return ([("hot", t) for t in hot]
+            + [("replica", t) for t in replica]
             + [("durable", t) for t in durable])
 
 
@@ -214,38 +223,45 @@ def load_best(load_dir, tag=None, loader=None, counters=None):
 def load_best_tiered(load_dir, tag=None, hot_store=None, loader=None,
                      counters=None):
     """Tier-ordered load over the :func:`load_candidates` order: the
-    hot tier's surviving replicas first (minus stale generations — see
-    the staleness guard there), the durable generations second.
-    -> (tier, tag, flat, header); tier is 'hot' or 'durable' (None when
-    nothing exists anywhere). A hot candidate failing (missing shards,
-    CRC-invalid replica, poisoned ``replica_fetch``) degrades to the
-    durable tier — bumping ``counters['hot_fallbacks']`` — rather than
-    failing the resume."""
+    hot tier's surviving same-slice replicas first, then the cross-
+    slice REPLICA tier (both minus stale generations — see the
+    staleness guard there), the durable generations last.
+    -> (tier, tag, flat, header); tier is 'hot', 'replica' or 'durable'
+    (None when nothing exists anywhere). An in-memory candidate failing
+    (missing shards, CRC-invalid replica, poisoned ``replica_fetch``/
+    ``replica_restore``) degrades DOWN-TIER exactly once per tier —
+    bumping ``counters['hot_fallbacks']`` / ``['replica_fallbacks']``
+    — rather than failing the resume."""
     if hot_store is not None:
         tiered = load_candidates(load_dir, tag, hot_store=hot_store)
-        attempted = 0
+        attempted = {"hot": 0, "replica": 0}
         for tier, cand in tiered:
-            if tier != "hot":
+            if tier == "durable":
                 break             # durable phase delegates to load_best
-            attempted += 1
+            attempted[tier] += 1
             try:
-                flat, header = hot_store.load(cand)
+                if hasattr(hot_store, "tier_tags"):
+                    flat, header = hot_store.load(cand, tier=tier)
+                else:
+                    flat, header = hot_store.load(cand)
             except FALLBACK_ERRORS as e:
                 logger.warning(
-                    f"hot tier: generation {cand!r} not restorable "
+                    f"{tier} tier: generation {cand!r} not restorable "
                     f"({e}); trying the next tier/candidate")
                 continue
             if counters is not None:
-                counters["hot_restores"] = \
-                    counters.get("hot_restores", 0) + 1
-            return "hot", cand, flat, header
-        if attempted:
-            if counters is not None:
-                counters["hot_fallbacks"] = \
-                    counters.get("hot_fallbacks", 0) + 1
-            logger.warning(
-                "hot tier: no generation restorable from surviving "
-                "replicas; degrading to the durable tier")
+                key = ("hot_restores" if tier == "hot"
+                       else "replica_restores")
+                counters[key] = counters.get(key, 0) + 1
+            return tier, cand, flat, header
+        for tier, key in (("hot", "hot_fallbacks"),
+                          ("replica", "replica_fallbacks")):
+            if attempted[tier]:
+                if counters is not None:
+                    counters[key] = counters.get(key, 0) + 1
+                logger.warning(
+                    f"{tier} tier: no generation restorable from "
+                    f"surviving replicas; degrading down-tier")
     cand, flat, header = load_best(load_dir, tag, loader=loader,
                                    counters=counters)
     if cand is None:

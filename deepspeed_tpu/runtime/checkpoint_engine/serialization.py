@@ -217,6 +217,59 @@ def extract_local_chunks(tree):
     return chunks, index, meta
 
 
+def extract_replica_chunks(tree):
+    """-> (chunks, index, meta) for THIS process's CROSS-SLICE REPLICA
+    copy of ``tree``.
+
+    The mirror image of :func:`extract_local_chunks`: that one writes
+    each global shard exactly once (replica_id == 0 — the canonical
+    copy); this one collects the SECOND copy (replica_id == 1), which
+    under MiCS partitioning (shard over INNER_DP_AXES, replicate over
+    ``data_outer``) is the sibling slice's HBM-resident replica of
+    master/opt state. The hot tier persists these chunks as the
+    ``zero-replica`` restore source, so a slice that loses its sibling
+    can reassemble the full state from its own memory.
+
+    Chunk keys are ``{key}#r{pid}.{i}`` — disjoint from the canonical
+    ``{key}#{pid}.{i}`` namespace, so a replica shard file can never be
+    confused with (or double-fill) a canonical one. Every leaf gets an
+    index entry even when this process holds no replica of it: the
+    per-leaf coverage check in :func:`load_shard_files` then rejects an
+    incomplete replica set instead of resuming from a torn copy."""
+    import jax as _jax
+    flat, meta = flatten_state(tree)
+    chunks, index = {}, {}
+    pid = _jax.process_index()
+    for key, leaf in flat.items():
+        if isinstance(leaf, _jax.Array):
+            entry = {"shape": list(leaf.shape), "dtype": str(leaf.dtype),
+                     "chunks": []}
+            for i, sh in enumerate(leaf.addressable_shards):
+                if sh.replica_id != 1:
+                    continue
+                data = np.asarray(sh.data)
+                start = [0 if s.start is None else int(s.start)
+                         for s in sh.index]
+                ck = f"{key}#r{pid}.{i}"
+                chunks[ck] = data
+                entry["chunks"].append({"key": ck, "start": start})
+            index[key] = entry
+        else:
+            # host/numpy leaves are replicated on every host by
+            # construction; re-owned by process 0 like the canonical
+            # extraction
+            arr = np.asarray(leaf)
+            entry = {"shape": list(arr.shape), "dtype": str(arr.dtype),
+                     "chunks": []}
+            if pid == 0:
+                ck = f"{key}#r0.0"
+                chunks[ck] = arr
+                entry["chunks"].append(
+                    {"key": ck, "start": [0] * arr.ndim})
+            index[key] = entry
+    return chunks, index, meta
+
+
 def load_sharded(dirpath):
     """Read every shard-*.npz in ``dirpath`` and reassemble the global
     logical arrays. -> (flat dict path->array, normalized header)."""

@@ -273,6 +273,18 @@ class CheckpointEngineConfig:
     hot_replicas: object = 1          # int >= 0 | "auto" (winner cache)
     hot_root: str = ""
     hot_keep_last: int = 2
+    # async-push backlog bound (hot_tier.push_async): at most this many
+    # pending pushes; the oldest queued one is dropped (counted as an
+    # advisory hot_push_errors) and a newer push of the same tag
+    # supersedes a still-queued one
+    hot_max_inflight_pushes: int = 4
+    # preemption-graceful drain: on SIGTERM (TPU maintenance notice /
+    # elastic-agent forward) finish the in-flight step, force one
+    # hot+replica push and a flight-recorder dump, then exit with
+    # PREEMPTED_EXIT_CODE so the agent classifies 'preempted' (no
+    # backoff). "auto" = on iff supervised (ELASTIC_GENERATION in env
+    # or DSTPU_PREEMPT_DRAIN exported) | true | false.
+    preempt_drain: object = "auto"
 
     def __post_init__(self):
         if self.save_retries < 0:
@@ -299,6 +311,29 @@ class CheckpointEngineConfig:
                 f"checkpoint_engine.hot_keep_last must be >= 1 (the "
                 f"tier must hold at least the newest generation), got "
                 f"{self.hot_keep_last}")
+        if not isinstance(self.hot_max_inflight_pushes, int) \
+                or isinstance(self.hot_max_inflight_pushes, bool) \
+                or self.hot_max_inflight_pushes < 1:
+            raise DeepSpeedConfigError(
+                f"checkpoint_engine.hot_max_inflight_pushes must be an "
+                f"int >= 1 (the bound must admit at least one pending "
+                f"push), got {self.hot_max_inflight_pushes!r}")
+        if self.preempt_drain not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"checkpoint_engine.preempt_drain must be "
+                f"true|false|'auto', got {self.preempt_drain!r}")
+
+    def resolve_preempt_drain(self):
+        """'auto' arms the SIGTERM drain iff something supervises us —
+        an elastic agent (ELASTIC_GENERATION) or an operator export
+        (DSTPU_PREEMPT_DRAIN). Unsupervised runs keep the default
+        SIGTERM disposition: nothing would classify the distinct exit
+        code, and hijacking the signal would only delay teardown."""
+        import os
+        if self.preempt_drain != "auto":
+            return bool(self.preempt_drain)
+        return bool(os.environ.get("ELASTIC_GENERATION") is not None
+                    or os.environ.get("DSTPU_PREEMPT_DRAIN"))
 
     def resolve_hot_tier(self, nprocs=1):
         """'auto' turns the tier on iff an elastic launcher (or the

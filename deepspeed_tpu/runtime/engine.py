@@ -270,14 +270,32 @@ class DeepSpeedEngine:
                     "hot_replicas", hot_replicas_bucket(shard_mb,
                                                         self.mesh),
                     dtype_name(self.param_dtype), {"k": 1})["k"])
+            # the store clamps replicas (config ints AND the autotuned
+            # winner above both flow through here) to ring size - 1 with
+            # a one-time warning, and reads slice membership from
+            # DSTPU_HOT_SLICES (the elastic agent exports it) for
+            # cross-slice replica placement
             self.hot_store = HotTierStore(
                 root=ce_cfg.hot_root or None,
                 replicas=int(replicas),
                 keep_last=ce_cfg.hot_keep_last,
-                counters=self.checkpoint_engine.counters)
+                counters=self.checkpoint_engine.counters,
+                max_inflight_pushes=ce_cfg.hot_max_inflight_pushes)
         # which tier served the most recent load_checkpoint (None before
-        # any load / when nothing was found)
+        # any load / when nothing was found): 'hot' | 'replica' |
+        # 'durable'
         self.last_restore_tier = None
+        # preemption-graceful drain (tentpole of the slice-survivability
+        # work): a SIGTERM — TPU maintenance notice, or the elastic
+        # agent forwarding one — only SETS this flag; the in-flight
+        # train_batch finishes, then _preempt_drain forces one
+        # hot+replica push and a flight dump and exits with the
+        # distinct PREEMPTED_EXIT_CODE the agent maps to 'preempted'
+        # (healthy host kept, no backoff penalty)
+        self._preempt_requested = False
+        self._last_ckpt_save_dir = None
+        if ce_cfg.resolve_preempt_drain():
+            self._install_preempt_drain()
 
         self.timers = SynchronizedWallClockTimer()
         self.tput_timer = ThroughputTimer(
@@ -1363,7 +1381,66 @@ class DeepSpeedEngine:
         # restarted from 'latest' just like one that died outright
         from ..utils import touch_heartbeat
         touch_heartbeat()
+        if self._preempt_requested:
+            # SIGTERM arrived mid-step; the step above completed, so
+            # state is at a clean boundary — drain and exit
+            self._preempt_drain()
         return metrics["loss"]
+
+    # ----------------------------------------------------- preemption drain
+    def _install_preempt_drain(self):
+        """Chain a SIGTERM handler that only requests a drain. Installed
+        BEFORE the flight recorder's install_sigterm, so on a real
+        signal the recorder dumps first and then falls through to us
+        (its handler calls the previous disposition). Main-thread only
+        — a non-main-thread engine build keeps the prior disposition."""
+        import signal as _signal
+        import threading as _threading
+        if _threading.current_thread() is not _threading.main_thread():
+            return False
+
+        def _handler(signum, frame):
+            # flag only — no logging/IO in signal context; the message
+            # and the drain itself run at the next step boundary
+            self._preempt_requested = True
+            if callable(prev):
+                prev(signum, frame)
+
+        try:
+            prev = _signal.signal(_signal.SIGTERM, _handler)
+            return True
+        except (ValueError, OSError):
+            return False
+
+    def _preempt_drain(self):
+        """The graceful half of a preemption: force one hot+replica
+        push of the CURRENT step (zero persistent-storage reads on the
+        other side of the maintenance window), dump the flight
+        recorder with the preemption recorded at the tail, and exit
+        with the distinct code the elastic agent classifies as
+        'preempted' (healthy host, no backoff penalty). The forced
+        save is advisory — a failing push must not turn a clean
+        preemption into a crash-looking death."""
+        from ..elasticity.elastic_agent import PREEMPTED_EXIT_CODE
+        self._preempt_requested = False
+        logger.warning(
+            f"preemption notice (SIGTERM) at step {self.global_step}: "
+            f"forcing a hot+replica push, dumping the flight recorder, "
+            f"exiting {PREEMPTED_EXIT_CODE} (preempted)")
+        try:
+            if self._last_ckpt_save_dir is not None:
+                self.save_checkpoint(self._last_ckpt_save_dir)
+            if self.hot_store is not None:
+                self.hot_store.wait()
+        except Exception as e:  # noqa: BLE001 - drain is best-effort
+            logger.warning(f"preemption drain: forced push failed ({e}); "
+                           f"exiting preempted anyway")
+        if self.telemetry is not None:
+            self.telemetry.flight.record(
+                "preempted", step=self.global_step,
+                drained=self._last_ckpt_save_dir is not None)
+            self.telemetry.flight.dump(reason="preempted")
+        raise SystemExit(PREEMPTED_EXIT_CODE)
 
     def _collect_local_shards(self, tree, record_meta=False):
         """Multi-process offload: per leaf, the 1D concatenation of THIS
@@ -1561,6 +1638,12 @@ class DeepSpeedEngine:
              step),
             ("Train/Checkpoint/durable_restores", c["durable_restores"],
              step),
+            ("Train/Checkpoint/replica_pushes", c["replica_pushes"],
+             step),
+            ("Train/Checkpoint/replica_restores", c["replica_restores"],
+             step),
+            ("Train/Checkpoint/replica_fallbacks", c["replica_fallbacks"],
+             step),
         ])
 
     def _maybe_print(self, metrics):
@@ -1720,12 +1803,30 @@ class DeepSpeedEngine:
         # critical path (advisory — a hot-tier failure can never cost
         # the durable save). The dcn transport is collective, so it
         # runs in-caller at this save boundary (every process is here).
+        self._last_ckpt_save_dir = save_dir
         if self.hot_store is not None:
             if (os.environ.get("DSTPU_HOT_TRANSPORT") == "dcn"
                     and jax.process_count() > 1):
                 self.hot_store.push_collective(tag, chunks, extra)
             else:
                 self.hot_store.push_async(tag, chunks, extra)
+            if self.plan.cross_slice_replica():
+                # MiCS: master/opt replicate over data_outer — register
+                # the sibling-slice copy THIS process already holds in
+                # HBM as a replica-tier restore source. Its extra omits
+                # nprocs: the replica set's completeness is enforced by
+                # per-leaf chunk coverage, not by the canonical
+                # shard-file count
+                rchunks, ridx, rmeta = ser.extract_replica_chunks(
+                    self._ckpt_tree())
+                rextra = {
+                    "index": ridx,
+                    "__tree_meta__": rmeta,
+                    "user_extra": dict(extra["user_extra"],
+                                       nprocs=None,
+                                       zero_replica=True),
+                }
+                self.hot_store.push_zero_replica(tag, rchunks, rextra)
 
         from .checkpoint_engine import manager as ckpt_manager
         keep_last = getattr(self.config.checkpoint_engine, "keep_last", 0)
@@ -1841,7 +1942,8 @@ class DeepSpeedEngine:
         generation is loadable does it raise (resuming silently from
         scratch would be worse). An explicit ``tag`` is never
         substituted. ``self.last_restore_tier`` records which tier
-        ('hot'/'durable') served the load; with ``'hot'`` the returned
+        ('hot'/'replica'/'durable') served the load; with ``'hot'`` or
+        ``'replica'`` the returned
         path names the generation but may not exist on persistent
         storage (a hot generation whose durable commit never landed is
         deliberately restorable). Under an elastic agent

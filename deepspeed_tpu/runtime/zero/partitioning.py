@@ -128,6 +128,20 @@ class ZeroShardingPlan:
         return jax.tree.map(lambda s: NamedSharding(self.mesh, s), specs,
                             is_leaf=lambda x: isinstance(x, P))
 
+    def cross_slice_replica(self):
+        """True when this plan's master/opt partition REPLICATES over a
+        non-trivial ``data_outer`` axis (the MiCS shape: shard over
+        INNER_DP_AXES, replicate across slices). That replica is the
+        robustness half of ROADMAP item 2 — a full copy of master/opt
+        state resident in every slice's HBM, which the checkpoint hot
+        tier registers as the ``zero-replica`` restore source so a
+        surviving slice can restore without its dead sibling."""
+        if "data_outer" not in self.mesh.axis_names:
+            return False
+        return (self.stage >= 1
+                and "data_outer" not in self.partition_axes
+                and int(self.mesh.shape["data_outer"]) > 1)
+
     def describe(self):
         """JSON-able summary of the plan: stage, partition group sizes,
         and the master-partition spec per leaf path. Saved into every

@@ -31,6 +31,19 @@ Injection points currently wired (grep for ``fault_injection.fire``):
   host_loss       elasticity/elastic_agent.py membership change, once
                   per failed host (and hot_tier.purge_node) — the
                   host-RAM-loss boundary of the hot tier
+  slice_loss      checkpoint_engine hot_tier, once per slice-aware
+                  push boundary (arming with ``kill`` models a whole
+                  slice dying mid-training), and
+                  elasticity/elastic_agent.py, once per fully-lost
+                  slice at membership change
+  dcn_partition   checkpoint_engine hot_tier collective push, before
+                  each cross-slice ``ring_exchange_bytes`` — arming it
+                  models a DCN partition during the exchange (advisory:
+                  the durable save still lands)
+  replica_restore checkpoint_engine hot_tier, once per replica-TIER
+                  source read during assembly (cross-slice replicas and
+                  the registered ZeRO replica) — arming it poisons the
+                  replica tier so loads degrade to durable
   reshape         runtime/engine.py load_checkpoint, before the
                   reshape-on-resume path re-partitions state onto a
                   different topology
@@ -68,9 +81,38 @@ KNOWN_POINTS = (
     "commit",
     "replica_push",
     "replica_fetch",
+    "replica_restore",
+    "dcn_partition",
     "host_loss",
+    "slice_loss",
     "reshape",
 )
+
+# Blast-radius class per injection point — the contract the lint in
+# tests/unit/test_fault_points_lint.py enforces mechanically:
+#
+#   advisory   the failure is counted/logged and MUST NOT propagate to
+#              the save/load caller (the PR-7 "a push failure can never
+#              cost the durable save" rule; loads degrade down-tier)
+#   retryable  the save retry/degrade policy owns the failure — it may
+#              surface only as CheckpointSaveError after the budget
+#   fatal      the failure propagates (crash-consistency boundaries and
+#              process/host/slice-death points; only ``kill`` or a test
+#              harness is expected to observe them)
+BLAST_RADIUS = {
+    "d2h": "fatal",
+    "serialize": "retryable",
+    "write": "retryable",
+    "rename": "retryable",
+    "commit": "fatal",
+    "replica_push": "advisory",
+    "replica_fetch": "advisory",
+    "replica_restore": "advisory",
+    "dcn_partition": "advisory",
+    "host_loss": "fatal",
+    "slice_loss": "fatal",
+    "reshape": "fatal",
+}
 
 
 class FaultError(OSError):

@@ -350,6 +350,45 @@ def test_checkpoint_hot_tier_validation():
                              "checkpoint_engine": bad})
 
 
+def test_checkpoint_push_backlog_and_drain_knobs(monkeypatch):
+    cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1})
+    ce = cfg.checkpoint_engine
+    assert ce.hot_max_inflight_pushes == 4
+    assert ce.preempt_drain == "auto"
+    # 'auto' arms the drain iff something supervises the worker
+    for k in ("ELASTIC_GENERATION", "DSTPU_PREEMPT_DRAIN"):
+        monkeypatch.delenv(k, raising=False)
+    assert ce.resolve_preempt_drain() is False
+    monkeypatch.setenv("ELASTIC_GENERATION", "0")
+    assert ce.resolve_preempt_drain() is True
+    monkeypatch.delenv("ELASTIC_GENERATION")
+    monkeypatch.setenv("DSTPU_PREEMPT_DRAIN", "1")
+    assert ce.resolve_preempt_drain() is True
+    # explicit true/false beats the env either way
+    monkeypatch.delenv("DSTPU_PREEMPT_DRAIN")
+    on = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 1,
+         "checkpoint_engine": {"preempt_drain": True,
+                               "hot_max_inflight_pushes": 1}})
+    assert on.checkpoint_engine.resolve_preempt_drain() is True
+    assert on.checkpoint_engine.hot_max_inflight_pushes == 1
+    monkeypatch.setenv("ELASTIC_GENERATION", "0")
+    off = DeepSpeedConfig(
+        {"train_micro_batch_size_per_gpu": 1,
+         "checkpoint_engine": {"preempt_drain": False}})
+    assert off.checkpoint_engine.resolve_preempt_drain() is False
+
+
+def test_checkpoint_push_backlog_and_drain_validation():
+    for bad in ({"hot_max_inflight_pushes": 0},
+                {"hot_max_inflight_pushes": True},
+                {"hot_max_inflight_pushes": "many"},
+                {"preempt_drain": "on"}):
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                             "checkpoint_engine": bad})
+
+
 def test_pipeline_block_defaults():
     cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1})
     p = cfg.pipeline

@@ -399,3 +399,321 @@ class TestKillHostHotTierResume:
         assert int(resumed[0].split("step=")[1].split()[0]) >= 2
         gen1 = [ln for ln in lines if "gen=1" in ln]
         assert any("step=4" in ln for ln in gen1)
+
+
+class TestSliceLossReplicaResume:
+    """ISSUE 15 acceptance: a two-slice virtual mesh (one real process
+    per slice, slice membership via the agent's slices map); every host
+    of slice 0 dies mid-training at a save boundary via the armed
+    slice_loss point. The agent classifies dead_slice, relaunches the
+    surviving slice at data_outer - 1, and the resume is served by the
+    cross-slice REPLICA tier with zero durable reads. The poisoned
+    variant (replica_restore armed in the relaunch) degrades to the
+    durable tier and still converges to the baseline loss curve."""
+
+    WORKER = r"""
+        import os, sys, time
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, {repo!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        ndev = int(os.environ.get("WORLD_NHOSTS", "1"))
+        try:
+            jax.config.update("jax_num_cpu_devices", ndev)
+        except AttributeError:
+            os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={{ndev}}"
+                ).strip()
+        import numpy as np
+        import deepspeed_tpu
+        from deepspeed_tpu.models import GPT2, GPT2Config
+        import deepspeed_tpu.runtime.checkpoint_engine.serialization \
+            as ser
+
+        gen = int(os.environ.get("ELASTIC_GENERATION", "0"))
+        host = os.environ["WORKER_HOST"]
+        ckpt = {ckpt!r}
+
+        # count every durable shard read (the acceptance assertion)
+        durable_reads = []
+        _orig_load_file = ser.load_file
+        def _counting_load_file(path, *a, **kw):
+            if str(path).startswith(ckpt):
+                durable_reads.append(str(path))
+            return _orig_load_file(path, *a, **kw)
+        ser.load_file = _counting_load_file
+
+        cfg = GPT2Config(n_layer=1, n_head=2, d_model=32,
+                         max_seq_len=16, vocab_size=64, remat=False,
+                         dtype="float32")
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2(cfg),
+            config={{"train_micro_batch_size_per_gpu": 2,
+                     "steps_per_print": 0,
+                     "optimizer": {{"type": "Adam",
+                                    "params": {{"lr": 1e-3}}}},
+                     "zero_optimization": {{"stage": 1}}}})
+        if gen == 0:
+            # the agent exported DSTPU_HOT_SLICE(S): pushes go
+            # cross-slice, so slice 1 holds slice 0's shards
+            assert engine.hot_store is not None
+            assert engine.hot_store.slice_aware, "slice map not wired"
+        engine.load_checkpoint(ckpt)
+        with open({log!r}, "a") as f:
+            f.write(f"{{host}} gen={{gen}} resumed "
+                    f"step={{engine.global_step}} "
+                    f"tier={{engine.last_restore_tier}} "
+                    f"durable_reads={{len(durable_reads)}}\n")
+        rng = np.random.RandomState(0)
+        batch = {{"input_ids": rng.randint(
+            0, 64, (4, 16)).astype(np.int32)}}
+        while engine.global_step < 4:
+            loss = float(engine.train_batch(batch))
+            if host == "h0" or gen > 0:      # single writer per gen
+                # slice 0's armed slice_loss (skip=2, kill) fires at
+                # the THIRD save's hot-push boundary — BEFORE the
+                # step-3 durable write, so durable latest stays at 2
+                # and the step-2 cross-slice replica passes the
+                # staleness floor
+                engine.save_checkpoint(ckpt)
+                if engine.hot_store is not None:
+                    engine.hot_store.wait()
+            with open({log!r}, "a") as f:
+                f.write(f"{{host}} gen={{gen}} "
+                        f"step={{engine.global_step}} "
+                        f"loss={{loss:.6f}}\n")
+            if host == "h0" and gen == 0:
+                # slow writer: h1 logs its full (uninterrupted) loss
+                # trajectory first — the test's reference curve
+                time.sleep(3.0)
+    """
+
+    def _run(self, tmp_path, poison=False):
+        ckpt = str(tmp_path / "ckpt")
+        hot_root = str(tmp_path / "hot")
+        log = tmp_path / "steps.log"
+        worker = tmp_path / "worker.py"
+        worker.write_text(textwrap.dedent(self.WORKER.format(
+            repo=str(os.getcwd()), ckpt=ckpt, log=str(log))))
+
+        def launch(hosts, topology):
+            procs = []
+            for h in hosts:
+                env = dict(os.environ)
+                env.update(agent.worker_env(h))
+                env["WORKER_HOST"] = h
+                env["ELASTIC_GENERATION"] = str(agent.restart_count)
+                env["WORLD_NHOSTS"] = str(len(hosts))
+                if h == "h0" and agent.restart_count == 0:
+                    # the whole of slice 0 dies at its 3rd save
+                    env["DSTPU_FAULT_INJECT"] = \
+                        "slice_loss:1:skip=2:kill"
+                if poison and agent.restart_count > 0:
+                    env["DSTPU_FAULT_INJECT"] = "replica_restore:100"
+                procs.append((h, subprocess.Popen(
+                    [sys.executable, str(worker)], env=env)))
+            return procs
+
+        agent = DSElasticAgent(launch, ["h0", "h1"], poll_s=0.1,
+                               hot_root=hot_root,
+                               slices={"h0": "0", "h1": "1"})
+        assert agent.topology["do"] == 2         # two-slice mesh
+        final = agent.run()
+        assert final == ["h1"]
+        assert agent.restart_count == 1
+        # the WHOLE slice died together -> dead_slice, not dead
+        assert agent.last_failures == {"h0": "dead_slice"}
+        assert agent.topology["do"] == 1         # data_outer shrank
+        # the dead slice's store is purged (its RAM died with it)
+        assert not os.path.exists(os.path.join(hot_root, "h0"))
+        return log.read_text().strip().splitlines()
+
+    def test_slice_loss_resumes_from_replica_tier(self, tmp_path):
+        lines = self._run(tmp_path)
+        resumed = [ln for ln in lines
+                   if "gen=1" in ln and "resumed" in ln]
+        assert resumed, lines
+        # THE claim: the surviving slice restored from the cross-slice
+        # replica, ZERO durable reads, at the replicated step
+        assert "tier=replica" in resumed[0], resumed
+        assert "durable_reads=0" in resumed[0], resumed
+        assert int(resumed[0].split("step=")[1].split()[0]) >= 2
+        gen1 = [ln for ln in lines if "gen=1" in ln]
+        assert any("step=4" in ln for ln in gen1)
+        # loss curve continues within tolerance of the uninterrupted
+        # run (gen-0 h1: never killed, same seeds, same batch)
+        ref = {ln.split("step=")[1].split()[0]:
+               float(ln.split("loss=")[1])
+               for ln in lines if ln.startswith("h1 gen=0") and
+               "loss=" in ln}
+        got = {ln.split("step=")[1].split()[0]:
+               float(ln.split("loss=")[1])
+               for ln in lines if "gen=1" in ln and "loss=" in ln}
+        shared = sorted(set(ref) & set(got))
+        assert shared, (ref, got)
+        for s in shared:
+            np.testing.assert_allclose(got[s], ref[s], rtol=2e-4)
+
+    def test_poisoned_replica_degrades_to_durable(self, tmp_path):
+        lines = self._run(tmp_path, poison=True)
+        resumed = [ln for ln in lines
+                   if "gen=1" in ln and "resumed" in ln]
+        assert resumed, lines
+        # replica tier poisoned -> durable served the resume, and the
+        # run still converges to the baseline within tolerance
+        assert "tier=durable" in resumed[0], resumed
+        assert int(resumed[0].split("step=")[1].split()[0]) >= 2
+        gen1 = [ln for ln in lines if "gen=1" in ln]
+        assert any("step=4" in ln for ln in gen1)
+        ref = {ln.split("step=")[1].split()[0]:
+               float(ln.split("loss=")[1])
+               for ln in lines if ln.startswith("h1 gen=0") and
+               "loss=" in ln}
+        got = {ln.split("step=")[1].split()[0]:
+               float(ln.split("loss=")[1])
+               for ln in lines if "gen=1" in ln and "loss=" in ln}
+        shared = sorted(set(ref) & set(got))
+        assert shared, (ref, got)
+        for s in shared:
+            np.testing.assert_allclose(got[s], ref[s], rtol=2e-4)
+
+
+class TestPreemptDrain:
+    """ISSUE 15 tentpole (c) acceptance: SIGTERM to the AGENT is
+    forwarded to the worker, whose drain handler finishes the in-flight
+    step, forces one fresh hot generation + a flight-recorder dump
+    whose tail records the preemption, and exits PREEMPTED_EXIT_CODE —
+    which the agent classifies 'preempted' and relaunches without
+    backoff; the resume is served from the drained hot generation."""
+
+    WORKER = r"""
+        import os, sys, time
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        sys.path.insert(0, {repo!r})
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        ndev = int(os.environ.get("WORLD_NHOSTS", "1"))
+        try:
+            jax.config.update("jax_num_cpu_devices", ndev)
+        except AttributeError:
+            os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={{ndev}}"
+                ).strip()
+        import numpy as np
+        import deepspeed_tpu
+        from deepspeed_tpu.models import GPT2, GPT2Config
+
+        gen = int(os.environ.get("ELASTIC_GENERATION", "0"))
+        host = os.environ["WORKER_HOST"]
+        ckpt = {ckpt!r}
+        cfg = GPT2Config(n_layer=1, n_head=2, d_model=32,
+                         max_seq_len=16, vocab_size=64, remat=False,
+                         dtype="float32")
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=GPT2(cfg),
+            config={{"train_micro_batch_size_per_gpu": 2,
+                     "steps_per_print": 0,
+                     "optimizer": {{"type": "Adam",
+                                    "params": {{"lr": 1e-3}}}},
+                     "zero_optimization": {{"stage": 1}},
+                     "telemetry": {{"enabled": True,
+                                    "interval_steps": 1000,
+                                    "cluster_agg": False}}}})
+        engine.load_checkpoint(ckpt)
+        with open({log!r}, "a") as f:
+            f.write(f"{{host}} gen={{gen}} resumed "
+                    f"step={{engine.global_step}} "
+                    f"tier={{engine.last_restore_tier}}\n")
+        rng = np.random.RandomState(0)
+        batch = {{"input_ids": rng.randint(
+            0, 64, (engine.config.train_batch_size, 16)).astype(
+            np.int32)}}
+        # gen 0 runs until the forwarded SIGTERM drains it (the bound
+        # only guards against a lost signal); gen 1 proves the resume
+        target = 60 if gen == 0 else engine.global_step + 2
+        while engine.global_step < target:
+            loss = float(engine.train_batch(batch))
+            engine.save_checkpoint(ckpt)
+            if engine.hot_store is not None:
+                engine.hot_store.wait()
+            with open({log!r}, "a") as f:
+                f.write(f"{{host}} gen={{gen}} "
+                        f"step={{engine.global_step}} "
+                        f"loss={{loss:.6f}}\n")
+            if gen == 0:
+                time.sleep(0.2)      # window for the SIGTERM to land
+    """
+
+    def test_sigterm_drains_and_relaunches_without_backoff(
+            self, tmp_path):
+        import signal
+        import threading
+        import time as _time
+        ckpt = str(tmp_path / "ckpt")
+        hot_root = str(tmp_path / "hot")
+        fr_root = str(tmp_path / "fr")
+        log = tmp_path / "steps.log"
+        worker = tmp_path / "worker.py"
+        worker.write_text(textwrap.dedent(self.WORKER.format(
+            repo=str(os.getcwd()), ckpt=ckpt, log=str(log))))
+
+        def launch(hosts, topology):
+            procs = []
+            for h in hosts:
+                env = dict(os.environ)
+                env.update(agent.worker_env(h))
+                env["WORKER_HOST"] = h
+                env["ELASTIC_GENERATION"] = str(agent.restart_count)
+                procs.append((h, subprocess.Popen(
+                    [sys.executable, str(worker)], env=env)))
+            return procs
+
+        # the corrupt-class backoff is deliberately huge: if the drain
+        # exit were misclassified, the elapsed bound below would trip
+        agent = DSElasticAgent(
+            launch, ["h0"], poll_s=0.1, hot_root=hot_root,
+            flightrec_root=fr_root,
+            restart_backoff_s={"corrupt_ckpt": 300.0})
+
+        def _fire_sigterm():
+            # deliver once the worker has COMPLETED a step (handler
+            # installed, a hot generation exists to drain on top of)
+            deadline = _time.time() + 120
+            while _time.time() < deadline:
+                if log.exists() and "gen=0 step=" in log.read_text():
+                    os.kill(os.getpid(), signal.SIGTERM)
+                    return
+                _time.sleep(0.1)
+
+        prev = signal.getsignal(signal.SIGTERM)
+        t = threading.Thread(target=_fire_sigterm)
+        t0 = _time.time()
+        try:
+            t.start()
+            final = agent.run()
+        finally:
+            t.join()
+            signal.signal(signal.SIGTERM, prev)
+        assert final == ["h0"]
+        assert agent.restart_count == 1
+        assert agent.last_failures == {"h0": "preempted"}
+        assert _time.time() - t0 < 300           # no backoff penalty
+        # the flight dump's tail records the preemption
+        from deepspeed_tpu.monitor import flight_recorder
+        dump = flight_recorder.read_dump(fr_root, "h0")
+        assert dump is not None, "no flight dump from the drain"
+        assert dump["reason"] == "preempted"
+        kinds = [e["kind"] for e in dump["events"]]
+        assert kinds[-1] == "preempted"
+        drained = [e for e in dump["events"]
+                   if e["kind"] == "preempted"][-1]
+        assert drained["drained"] is True
+        # the relaunch resumed from the FRESH drained hot generation
+        lines = log.read_text().strip().splitlines()
+        resumed = [ln for ln in lines
+                   if "gen=1" in ln and "resumed" in ln]
+        assert resumed, lines
+        assert "tier=hot" in resumed[0], resumed
+        resumed_step = int(resumed[0].split("step=")[1].split()[0])
+        assert resumed_step == drained["step"], (resumed, drained)
+        assert any("gen=1" in ln and "loss=" in ln for ln in lines)

@@ -7,12 +7,15 @@ tier-1 (the subprocess-based end-to-end resume tests stay in
 test_elastic_agent.py's slow set)."""
 
 import os
+import signal
+import threading
 import time
 
 import pytest
 
 from deepspeed_tpu.elasticity.elastic_agent import (
-    CORRUPT_CKPT_EXIT_CODE, DSElasticAgent, WorldFailure)
+    CORRUPT_CKPT_EXIT_CODE, PREEMPTED_EXIT_CODE, DSElasticAgent,
+    WorldFailure)
 from deepspeed_tpu.utils import fault_injection
 
 
@@ -231,7 +234,7 @@ class TestSurvivingTopology:
                                chips_per_host=4, tensor_parallel=2,
                                expert_parallel=2)
         topo = agent.compute_topology(["a", "b", "c"])
-        assert topo == {"world": 12, "dp": 3, "tp": 2, "ep": 2,
+        assert topo == {"world": 12, "dp": 3, "do": 1, "tp": 2, "ep": 2,
                         "pipe": 1, "seq": 1, "hosts": ["a", "b", "c"]}
 
     def test_fixed_factors_gate_admissibility(self):
@@ -398,3 +401,139 @@ class TestHotTierPointing:
                 agent.run()
         finally:
             fault_injection.reset()
+
+
+SLICES = {"a": "0", "b": "0", "c": "1", "d": "1"}
+
+
+class TestSliceAwareness:
+    """ISSUE 15 tentpole (b): the agent learns slice membership,
+    computes data_outer over SURVIVING slices, classifies a whole-slice
+    failure as dead_slice, and drops a partially-lost slice whole."""
+
+    def test_topology_do_counts_surviving_slices(self):
+        agent = DSElasticAgent(lambda hosts: [], ["a", "b", "c", "d"],
+                               slices=SLICES)
+        assert agent.compute_topology(["a", "b", "c", "d"])["do"] == 2
+        # slice 1 gone: do shrinks, slice 0 keeps its intra-slice dp
+        topo = agent.compute_topology(["a", "b"])
+        assert topo["do"] == 1 and topo["dp"] == 2
+
+    def test_ragged_surviving_slices_rejected(self):
+        agent = DSElasticAgent(lambda hosts: [], ["a", "b", "c", "d"],
+                               slices=SLICES)
+        with pytest.raises(WorldFailure, match="ragged"):
+            agent.compute_topology(["a", "b", "c"])
+
+    def test_worker_env_exports_slice_membership(self, tmp_path):
+        agent = DSElasticAgent(lambda hosts: [], ["a", "b", "c", "d"],
+                               slices=SLICES, hot_root=str(tmp_path))
+        env = agent.worker_env("c")
+        assert env["DSTPU_HOT_SLICE"] == "1"
+        assert env["DSTPU_HOT_SLICES"] == "0,0,1,1"
+
+    def test_without_slices_no_slice_env(self, tmp_path):
+        agent = DSElasticAgent(lambda hosts: [], ["a", "b"],
+                               hot_root=str(tmp_path))
+        env = agent.worker_env("a")
+        assert "DSTPU_HOT_SLICE" not in env
+        assert "DSTPU_HOT_SLICES" not in env
+
+    def test_whole_slice_loss_classified_dead_slice(self, tmp_path):
+        """Every host of slice 1 dies together: ONE slice_loss fires,
+        all members classify dead_slice, do shrinks 2 -> 1, and the
+        dead slice's hot-tier stores are purged."""
+        root = str(tmp_path)
+        for h in ("c", "d"):
+            os.makedirs(os.path.join(root, h))
+
+        def rc_for(h, hosts):
+            return 1 if h in ("c", "d") and len(hosts) == 4 else 0
+
+        fault_injection.reset()
+        agent = DSElasticAgent(_launcher(rc_for),
+                               ["a", "b", "c", "d"], slices=SLICES,
+                               poll_s=0.001, hot_root=root)
+        try:
+            assert agent.run() == ["a", "b"]
+            assert agent.last_failures == {"c": "dead_slice",
+                                           "d": "dead_slice"}
+            assert fault_injection.injector.fired("slice_loss") == 1
+            assert agent.topology["do"] == 1
+            for h in ("c", "d"):
+                assert not os.path.isdir(os.path.join(root, h))
+        finally:
+            fault_injection.reset()
+
+    def test_partial_slice_loss_drops_the_whole_slice(self):
+        """Only c of slice 1 dies: the stranded healthy d is dropped
+        too (a data_outer mesh needs equal slice populations) — but the
+        failure stays an ordinary host death, NOT a dead_slice, and
+        slice_loss does not fire."""
+        def rc_for(h, hosts):
+            return 1 if h == "c" and len(hosts) == 4 else 0
+
+        fault_injection.reset()
+        agent = DSElasticAgent(_launcher(rc_for),
+                               ["a", "b", "c", "d"], slices=SLICES,
+                               poll_s=0.001)
+        try:
+            assert agent.run() == ["a", "b"]
+            assert agent.last_failures == {"c": "dead"}
+            assert fault_injection.injector.fired("slice_loss") == 0
+            assert agent.topology["do"] == 1
+        finally:
+            fault_injection.reset()
+
+
+class TestPreemption:
+    """ISSUE 15 tentpole (c): a PREEMPTED_EXIT_CODE exit means the
+    worker drained cleanly after SIGTERM — the host is healthy, the
+    world relaunches unshrunk with zero backoff."""
+
+    def test_preempted_exit_keeps_host_no_backoff(self):
+        tries = {"n": 0}
+
+        def rc_for(h, hosts):
+            if h == "a" and tries["n"] == 0:
+                tries["n"] += 1
+                return PREEMPTED_EXIT_CODE
+            return 0
+
+        agent = DSElasticAgent(
+            _launcher(rc_for), ["a", "b"], poll_s=0.001,
+            restart_backoff_s={"corrupt_ckpt": 30.0})
+        t0 = time.time()
+        final = agent.run()
+        assert final == ["a", "b"]               # world NOT shrunk
+        assert agent.restart_count == 1
+        assert agent.last_failures == {"a": "preempted"}
+        assert time.time() - t0 < 5.0            # zero-backoff class
+
+    def test_sigterm_forwarded_to_live_workers(self):
+        """The agent's SIGTERM handler flags the preemption notice and
+        terminates every live worker — invoked directly (real signal
+        delivery in-process is racy under pytest)."""
+        agent = DSElasticAgent(lambda hosts: [], ["a"])
+        prev = signal.getsignal(signal.SIGTERM)
+        try:
+            assert agent.install_sigterm_forwarding() is True
+            p = StubProc(rc=None)
+            agent._live_procs = {"a": p}
+            handler = signal.getsignal(signal.SIGTERM)
+            handler(signal.SIGTERM, None)
+            assert agent._preempt_notice is True
+            assert p.poll() == -15               # terminated, not -9
+        finally:
+            signal.signal(signal.SIGTERM, prev)
+
+    def test_forwarding_refused_off_main_thread(self):
+        agent = DSElasticAgent(lambda hosts: [], ["a"])
+        prev = signal.getsignal(signal.SIGTERM)
+        out = []
+        t = threading.Thread(
+            target=lambda: out.append(agent.install_sigterm_forwarding()))
+        t.start()
+        t.join()
+        assert out == [False]
+        assert signal.getsignal(signal.SIGTERM) is prev

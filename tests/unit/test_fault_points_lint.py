@@ -94,3 +94,128 @@ def test_new_points_exist_and_fire():
         assert fault_injection.injector.hits("reshape") == 1
     finally:
         fault_injection.reset()
+
+
+# --------------------------------------------------------- blast radius
+
+def test_every_point_declares_a_blast_radius():
+    """Satellite lint (ISSUE 15): every KNOWN_POINTS entry carries a
+    blast-radius class, and nothing stale lingers in the map — a new
+    injection point must DECLARE whether its failure is advisory,
+    retryable, or fatal before it ships."""
+    assert set(fault_injection.BLAST_RADIUS) == \
+        set(fault_injection.KNOWN_POINTS)
+    assert set(fault_injection.BLAST_RADIUS.values()) <= \
+        {"advisory", "retryable", "fatal"}
+
+
+@pytest.mark.chaos
+def test_advisory_points_never_propagate_to_the_save_path():
+    """The blast-radius contract, enforced behaviorally: arm EVERY
+    advisory point with an unlimited failure budget and drive the
+    push + tiered-load paths — nothing may raise, pushes report
+    failure through counters, and loads degrade down-tier."""
+    import numpy as np
+
+    from deepspeed_tpu.runtime.checkpoint_engine import hot_tier
+    from deepspeed_tpu.runtime.checkpoint_engine import manager
+    from deepspeed_tpu.runtime.checkpoint_engine import \
+        serialization as ser
+    from deepspeed_tpu.runtime.checkpoint_engine.engines import \
+        SyncCheckpointEngine
+
+    advisory = sorted(p for p, c in fault_injection.BLAST_RADIUS.items()
+                      if c == "advisory")
+    assert advisory == ["dcn_partition", "replica_fetch",
+                        "replica_push", "replica_restore"]
+    peers = ["h0", "h1", "h2", "h3"]
+    slices = {"h0": "0", "h1": "0", "h2": "1", "h3": "1"}
+    tree = {"w": np.arange(4, dtype=np.float32)}
+    chunks, index, meta = ser.extract_local_chunks(tree)
+    extra = {"index": index, "__tree_meta__": meta,
+             "user_extra": {"global_step": 1, "nprocs": 1}}
+
+    import tempfile
+    for point in advisory:
+        fault_injection.reset()
+        with tempfile.TemporaryDirectory() as td:
+            hot_root = os.path.join(td, "hot")
+            durable = os.path.join(td, "ckpt")
+            eng = SyncCheckpointEngine(None)
+            eng.save((chunks, extra),
+                     os.path.join(durable, "global_step1",
+                                  "shard-0.npz"),
+                     on_durable=lambda: manager.publish_latest(
+                         durable, "global_step1"))
+            counters = {}
+            stores = {h: hot_tier.HotTierStore(
+                root=hot_root, node=h, peers=peers, replicas=1,
+                slices=slices, counters=counters) for h in peers}
+            # a clean cross-slice generation to poison on the way back
+            stores["h0"].push("global_step1", chunks, extra,
+                              shard_name="shard-0.npz")
+            stores["h2"].push_zero_replica("global_step1", chunks, extra)
+            fault_injection.arm(point, fails=100)
+            # every push entry point swallows the armed failure
+            stores["h0"].push("global_step1", chunks, extra,
+                              shard_name="shard-0.npz")
+            stores["h0"].push_async("global_step1", chunks, extra,
+                                    shard_name="shard-0.npz")
+            assert stores["h0"].wait() is True
+            stores["h2"].push_zero_replica("global_step1", chunks, extra)
+            if point == "dcn_partition":
+                # only this branch may reach the collective impl: with
+                # a patched 2-process world, any OTHER armed point
+                # would let a real ring_exchange_bytes run single-proc
+                import jax
+                real = jax.process_count
+                jax.process_count = lambda: 2
+                try:
+                    assert stores["h0"].push_collective(
+                        "global_step1", chunks, extra,
+                        shard_name="shard-0.npz") == 0
+                finally:
+                    jax.process_count = real
+            # the tiered load degrades down-tier instead of raising
+            hot_tier.purge_node(hot_root, "h0")
+            hot_tier.purge_node(hot_root, "h1")
+            tier, tag, flat, _ = manager.load_best_tiered(
+                durable, hot_store=stores["h2"], counters=counters)
+            assert tag == "global_step1"
+            np.testing.assert_array_equal(flat["w"], tree["w"])
+            if point in ("replica_fetch", "replica_restore"):
+                assert tier == "durable", point
+            fault_injection.reset()
+        stores["h0"].shutdown()
+
+
+@pytest.mark.chaos
+def test_fatal_point_does_propagate():
+    """Counter-example pinning the other side of the contract: a
+    fatal-class point (slice_loss at the push boundary) propagates out
+    of the entry point instead of being swallowed."""
+    import numpy as np
+
+    from deepspeed_tpu.runtime.checkpoint_engine import hot_tier
+    from deepspeed_tpu.runtime.checkpoint_engine import \
+        serialization as ser
+
+    assert fault_injection.BLAST_RADIUS["slice_loss"] == "fatal"
+    tree = {"w": np.arange(4, dtype=np.float32)}
+    chunks, index, meta = ser.extract_local_chunks(tree)
+    extra = {"index": index, "__tree_meta__": meta,
+             "user_extra": {"global_step": 1, "nprocs": 1}}
+    import tempfile
+    fault_injection.reset()
+    try:
+        with tempfile.TemporaryDirectory() as td:
+            s = hot_tier.HotTierStore(
+                root=td, node="h0", peers=["h0", "h1"], replicas=1,
+                slices={"h0": "0", "h1": "1"})
+            fault_injection.arm("slice_loss", fails=1)
+            with pytest.raises(fault_injection.FaultError):
+                s.push_async("global_step1", chunks, extra,
+                             shard_name="shard-0.npz")
+            s.shutdown()
+    finally:
+        fault_injection.reset()

@@ -13,6 +13,7 @@ degrades to the durable tier instead of failing the resume.
 """
 
 import os
+import threading
 
 import numpy as np
 import pytest
@@ -27,6 +28,8 @@ from deepspeed_tpu.runtime.checkpoint_engine.engines import (
 pytestmark = pytest.mark.chaos
 
 PEERS = ["h0", "h1", "h2", "h3"]
+# two virtual slices: h0/h1 form slice 0, h2/h3 slice 1
+SLICES = {"h0": "0", "h1": "0", "h2": "1", "h3": "1"}
 
 
 @pytest.fixture(autouse=True)
@@ -380,3 +383,352 @@ class TestRetentionAndCandidates:
             durable, hot_store=stores["h0"])
         assert (tier, tag) == ("hot", "global_step4")
         assert header["extra"]["global_step"] == 4
+
+
+class TestSlicePlacement:
+    """Tentpole (a): slice-aware replica placement — pushes target
+    OTHER-slice peers first, with cross-slice provenance burned into
+    the receiving directory name."""
+
+    def test_cross_slice_neighbors_first(self, tmp_path):
+        for replicas, want in ((1, ["h2"]), (2, ["h2", "h3"]),
+                               (3, ["h2", "h3", "h1"])):
+            s = hot_tier.HotTierStore(root=str(tmp_path), node="h0",
+                                      peers=PEERS, replicas=replicas,
+                                      slices=SLICES)
+            assert s.ring_neighbors() == want
+
+    def test_without_slice_map_ring_order_unchanged(self, tmp_path):
+        s = hot_tier.HotTierStore(root=str(tmp_path), node="h0",
+                                  peers=PEERS, replicas=2)
+        assert s.ring_neighbors() == ["h1", "h2"]   # PR-7 behavior
+
+    def test_cross_slice_push_lands_with_provenance(self, tmp_path):
+        counters = {}
+        stores = _stores(tmp_path, slices=SLICES, counters=counters)
+        chunks, extra = _payload(3)
+        n = stores["h0"].push("global_step3", chunks, extra,
+                              shard_name="shard-0.npz")
+        assert n == 1
+        assert os.path.exists(os.path.join(
+            str(tmp_path), "h2", "global_step3", "replica-from-h0",
+            "shard-0.npz"))
+        assert counters["replica_pushes"] == 1
+
+    def test_slices_parsed_from_env(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DSTPU_HOT_SLICES", "0,0,1,1")
+        s = hot_tier.HotTierStore(root=str(tmp_path), node="h3",
+                                  peers=PEERS, replicas=1)
+        assert s.slice_aware and s.slice == "1"
+        assert s.ring_neighbors() == ["h0"]         # other slice first
+
+    def test_slice_loss_kill_fires_at_push_boundary(self, tmp_path):
+        """Arming slice_loss with kill models the whole slice dying at
+        the save boundary: the (fatal-class) kill propagates out of the
+        push entry point instead of being swallowed."""
+        stores = _stores(tmp_path, slices=SLICES)
+        fault_injection.arm("slice_loss", kill=True)
+        chunks, extra = _payload(3)
+        with pytest.raises(fault_injection.SimulatedKill):
+            stores["h0"].push_async("global_step3", chunks, extra,
+                                    shard_name="shard-0.npz")
+
+    def test_slice_loss_never_fires_without_slices(self, tmp_path):
+        stores = _stores(tmp_path)                  # no slice map
+        fault_injection.arm("slice_loss", kill=True)
+        chunks, extra = _payload(3)
+        stores["h0"].push_async("global_step3", chunks, extra,
+                                shard_name="shard-0.npz")
+        assert stores["h0"].wait() is True
+        assert fault_injection.injector.fired("slice_loss") == 0
+        stores["h0"].shutdown()
+
+    def test_dcn_partition_is_advisory(self, tmp_path, monkeypatch):
+        """A DCN partition during the collective push is counted and
+        swallowed — the durable save at that barrier still lands (the
+        own-store write precedes the exchange and survives)."""
+        import jax
+        counters = {}
+        stores = _stores(tmp_path, slices=SLICES, counters=counters)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        fault_injection.arm("dcn_partition", fails=1)
+        chunks, extra = _payload(3)
+        n = stores["h0"].push_collective("global_step3", chunks, extra,
+                                         shard_name="shard-0.npz")
+        assert n == 0
+        assert counters["hot_push_errors"] == 1
+        tag, _, _ = stores["h0"].load_best()
+        assert tag == "global_step3"
+
+
+class TestReplicaTier:
+    """Tentpole (a)+(b): the cross-slice replica as a first-class
+    restore tier — a WHOLE-slice loss restores from the surviving
+    slice's replica-from-* shards (or the registered MiCS zero-replica)
+    with zero persistent-storage reads."""
+
+    def _lose_slice0(self, hot_root):
+        hot_tier.purge_node(str(hot_root), "h0")
+        hot_tier.purge_node(str(hot_root), "h1")
+
+    def test_slice_loss_restores_from_replica_tier(self, tmp_path):
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=5)
+        stores = _stores(hot_root, slices=SLICES)
+        chunks, extra = _payload(5)
+        stores["h0"].push("global_step5", chunks, extra,
+                          shard_name="shard-0.npz")
+        self._lose_slice0(hot_root)
+        durable_reads = []
+
+        def loader(tag_dir):
+            durable_reads.append(tag_dir)
+            return ser.load_state(tag_dir)
+
+        counters = {}
+        tier, tag, flat, _ = manager.load_best_tiered(
+            durable, hot_store=stores["h2"], loader=loader,
+            counters=counters)
+        assert (tier, tag) == ("replica", "global_step5")
+        np.testing.assert_array_equal(flat["w"], _tree(5)["w"])
+        assert durable_reads == []                 # ZERO storage reads
+        assert counters["replica_restores"] == 1
+        assert counters.get("durable_restores", 0) == 0
+        assert fault_injection.injector.fired("replica_restore") >= 1
+
+    def test_zero_replica_set_is_a_restore_source(self, tmp_path):
+        """The registered MiCS zero-replica restores the surviving
+        slice from its OWN subtree even when no cross-slice push ever
+        landed."""
+        hot_root = tmp_path / "hot"
+        counters = {}
+        stores = _stores(hot_root, slices=SLICES, counters=counters)
+        chunks, index, meta = ser.extract_local_chunks(_tree(8))
+        rextra = {"index": index, "__tree_meta__": meta,
+                  "user_extra": {"global_step": 8,
+                                 "zero_replica": True}}
+        assert stores["h2"].push_zero_replica(
+            "global_step8", chunks, rextra) is True
+        assert counters["replica_pushes"] == 1
+        self._lose_slice0(hot_root)
+        hot, replica = stores["h2"].tier_tags()
+        assert (hot, replica) == ([], ["global_step8"])
+        tier, tag, flat, _ = manager.load_best_tiered(
+            str(tmp_path / "ckpt"), hot_store=stores["h2"],
+            counters=counters)
+        assert (tier, tag) == ("replica", "global_step8")
+        np.testing.assert_array_equal(flat["w"], _tree(8)["w"])
+
+    def test_poisoned_replica_restore_degrades_to_durable(
+            self, tmp_path):
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=5)
+        stores = _stores(hot_root, slices=SLICES)
+        chunks, extra = _payload(5)
+        stores["h0"].push("global_step5", chunks, extra,
+                          shard_name="shard-0.npz")
+        self._lose_slice0(hot_root)
+        fault_injection.arm("replica_restore", fails=100)
+        counters = {}
+        tier, tag, _, _ = manager.load_best_tiered(
+            durable, hot_store=stores["h2"], counters=counters)
+        assert (tier, tag) == ("durable", "global_step5")
+        assert counters["replica_fallbacks"] == 1
+        assert counters.get("hot_fallbacks", 0) == 0
+
+    def test_hot_tier_load_never_serves_replica_sources(self, tmp_path):
+        """tier='hot' is a strict subset: cross-slice sources are out
+        of bounds, so a hot-tier attempt over replica-only shards fails
+        down-tier instead of silently crossing tiers."""
+        hot_root = tmp_path / "hot"
+        stores = _stores(hot_root, slices=SLICES)
+        chunks, extra = _payload(5)
+        stores["h0"].push("global_step5", chunks, extra,
+                          shard_name="shard-0.npz")
+        self._lose_slice0(hot_root)
+        with pytest.raises(FileNotFoundError):
+            stores["h2"].load("global_step5", tier="hot")
+        flat, _ = stores["h2"].load("global_step5", tier="replica")
+        np.testing.assert_array_equal(flat["w"], _tree(5)["w"])
+
+
+class TestTieredOrderingProperty:
+    """Satellite 3: the tiered-restore ordering property over mixed-
+    staleness hot/replica/durable generations — stale in-memory
+    generations (older than the durable 'latest') are dropped, newer
+    ones kept, and a CRC-invalid replica degrades down-tier exactly
+    once."""
+
+    def _mixed(self, tmp_path):
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=1)
+        _durable_generation(durable, step=5)       # 'latest'
+        stores = _stores(hot_root, slices=SLICES)
+        for step in (4, 6):                        # h2's own = hot class
+            chunks, extra = _payload(step)
+            stores["h2"].push(f"global_step{step}", chunks, extra,
+                              shard_name="shard-0.npz")
+        for step in (3, 7):                        # h0 -> replica-from-h0
+            chunks, extra = _payload(step)
+            stores["h0"].push(f"global_step{step}", chunks, extra,
+                              shard_name="shard-0.npz")
+        hot_tier.purge_node(str(hot_root), "h0")
+        hot_tier.purge_node(str(hot_root), "h1")
+        return durable, stores["h2"]
+
+    def test_candidate_order_and_staleness_floor(self, tmp_path):
+        durable, survivor = self._mixed(tmp_path)
+        cands = manager.load_candidates(durable, hot_store=survivor)
+        assert cands == [("hot", "global_step6"),
+                         ("replica", "global_step7"),
+                         ("durable", "global_step5"),
+                         ("durable", "global_step1")]
+        # the property spelled out: stale hot (4) and stale replica (3)
+        # dropped; replica newer than 'latest' (7) kept
+        assert ("hot", "global_step4") not in cands
+        assert ("replica", "global_step3") not in cands
+
+    def test_best_tiered_serves_hot_before_replica(self, tmp_path):
+        durable, survivor = self._mixed(tmp_path)
+        counters = {}
+        tier, tag, _, header = manager.load_best_tiered(
+            durable, hot_store=survivor, counters=counters)
+        assert (tier, tag) == ("hot", "global_step6")
+        assert header["extra"]["global_step"] == 6
+        assert counters.get("replica_restores", 0) == 0
+
+    def test_crc_invalid_replica_degrades_exactly_once(self, tmp_path):
+        """Corrupt the only replica shard: the replica tier is
+        attempted, fails, and counts EXACTLY one replica_fallbacks —
+        then the durable tier serves."""
+        hot_root = tmp_path / "hot"
+        durable = str(tmp_path / "ckpt")
+        _durable_generation(durable, step=5)
+        stores = _stores(hot_root, slices=SLICES)
+        for step in (6, 7):
+            chunks, extra = _payload(step)
+            stores["h0"].push(f"global_step{step}", chunks, extra,
+                              shard_name="shard-0.npz")
+        hot_tier.purge_node(str(hot_root), "h0")
+        hot_tier.purge_node(str(hot_root), "h1")
+        for step in (6, 7):
+            replica = os.path.join(
+                str(hot_root), "h2", f"global_step{step}",
+                "replica-from-h0", "shard-0.npz")
+            size = os.path.getsize(replica)
+            with open(replica, "r+b") as f:
+                f.seek(size // 2)
+                chunk = f.read(4)
+                f.seek(size // 2)
+                f.write(bytes(b ^ 0xFF for b in chunk))
+        counters = {}
+        tier, tag, _, _ = manager.load_best_tiered(
+            durable, hot_store=stores["h2"], counters=counters)
+        assert (tier, tag) == ("durable", "global_step5")
+        assert counters["replica_fallbacks"] == 1  # once, not per tag
+        assert counters.get("hot_fallbacks", 0) == 0
+
+
+class TestReplicasClamp:
+    """Satellite 1: hot_replicas (config int or autotuned winner alike
+    — both flow through the constructor) is clamped to world_size - 1
+    with a one-time warning."""
+
+    def test_clamped_with_one_warning(self, tmp_path, monkeypatch):
+        # the package logger runs propagate=False, so record directly
+        msgs = []
+        monkeypatch.setattr(
+            hot_tier.logger, "warning",
+            lambda m, *a, **k: msgs.append(str(m)))
+        hot_tier._CLAMP_WARNED[0] = False
+        s = hot_tier.HotTierStore(root=str(tmp_path), node="h0",
+                                  peers=PEERS, replicas=9)
+        assert s.replicas == len(PEERS) - 1
+        assert len(s.ring_neighbors()) == len(PEERS) - 1
+        assert sum("clamping" in m for m in msgs) == 1
+        hot_tier.HotTierStore(root=str(tmp_path), node="h1",
+                              peers=PEERS, replicas=9)
+        assert sum("clamping" in m for m in msgs) == 1  # still once
+
+    def test_exact_fit_not_warned(self, tmp_path, monkeypatch):
+        msgs = []
+        monkeypatch.setattr(
+            hot_tier.logger, "warning",
+            lambda m, *a, **k: msgs.append(str(m)))
+        hot_tier._CLAMP_WARNED[0] = False
+        s = hot_tier.HotTierStore(root=str(tmp_path), node="h0",
+                                  peers=PEERS, replicas=3)
+        assert s.replicas == 3
+        assert not [m for m in msgs if "clamping" in m]
+
+
+class TestPushBacklogBound:
+    """Satellite 2: the async push backlog is bounded — a newer push of
+    the same tag supersedes a queued one, total pending pushes are
+    capped, and every drop is a counted advisory hot_push_errors."""
+
+    def test_backlog_capped_drops_oldest(self, tmp_path):
+        counters = {}
+        stores = _stores(tmp_path, peers=["h0", "h1"],
+                         counters=counters, max_inflight_pushes=2,
+                         keep_last=10)
+        s = stores["h0"]
+        gate = threading.Event()
+        s._pool.submit(gate.wait)       # occupy the single worker
+        try:
+            for step in range(1, 6):
+                chunks, extra = _payload(step)
+                s.push_async(f"global_step{step}", chunks, extra,
+                             shard_name="shard-0.npz")
+                assert len(s._inflight) <= 2       # the bound holds
+        finally:
+            gate.set()
+        assert counters["hot_push_errors"] == 3    # 3 oldest dropped
+        assert s.wait() is True
+        # only the surviving newest pushes ever wrote
+        own = sorted(os.listdir(os.path.join(str(tmp_path), "h0")))
+        assert own == ["global_step4", "global_step5"]
+        s.shutdown()
+
+    def test_newer_same_tag_supersedes_queued(self, tmp_path):
+        counters = {}
+        stores = _stores(tmp_path, peers=["h0", "h1"],
+                         counters=counters, max_inflight_pushes=4)
+        s = stores["h0"]
+        gate = threading.Event()
+        s._pool.submit(gate.wait)
+        try:
+            c1, e1 = _payload(1)
+            c2, e2 = _payload(2)
+            s.push_async("global_stepX", c1, e1,
+                         shard_name="shard-0.npz")
+            s.push_async("global_stepX", c2, e2,
+                         shard_name="shard-0.npz")
+            assert counters["hot_push_errors"] == 1
+            assert sum(1 for t, _ in s._inflight
+                       if t == "global_stepX") == 1
+        finally:
+            gate.set()
+        assert s.wait() is True
+        _, _, header = s.load_best()
+        assert header["extra"]["global_step"] == 2  # the NEWER payload
+        s.shutdown()
+
+    def test_running_push_is_never_dropped(self, tmp_path):
+        """Only queued (cancellable) futures can be dropped — a push
+        already executing survives even over the cap."""
+        counters = {}
+        stores = _stores(tmp_path, peers=["h0", "h1"],
+                         counters=counters, max_inflight_pushes=1,
+                         keep_last=10)
+        s = stores["h0"]
+        chunks, extra = _payload(1)
+        s.push_async("global_step1", chunks, extra,
+                     shard_name="shard-0.npz")
+        assert s.wait() is True
+        # the push ran (nothing to supersede it) and landed
+        assert s.load_best()[0] == "global_step1"
+        s.shutdown()
