@@ -25,12 +25,19 @@ p50 (template prefills served from cached KV blocks) with p99 TPOT
 within noise, and the row carries the engine's own hit-rate/CoW/
 eviction counters.
 
+``bench_router_traffic`` (``SERVE_REPLICAS=N``) is the serving-fleet
+robustness scenario: mixed-class Poisson traffic through the replica
+Router (inference/v2/router.py) as baseline / mid-run replica-kill /
+mid-run drain — per-class admitted/shed/expired/replayed counts and
+TTFT/TPOT percentiles per row, failover accounting asserted closed.
+
 Run on the chip:  python benchmarks/serve_bench.py
 Env: SERVE_MODELS=gpt2-350M,llama-1b  SERVE_BATCHES=1,8
      SERVE_PROMPT=1024  SERVE_DECODE=128  SERVE_MIXED=1
      SERVE_MIXED_MODEL=gpt2-350M  SERVE_EP_MOE=1
      SERVE_PREFIX=1  SERVE_PREFIX_MODEL=gpt2-350M  SERVE_PREFIX_N=24
-     SERVE_PREFIX_SHARE=0.75
+     SERVE_PREFIX_SHARE=0.75  SERVE_REPLICAS=2  SERVE_ROUTER_N=24
+     SERVE_ROUTER_MODEL=gpt2-350M  SERVE_ROUTER_RATE=2.0
 """
 
 import json
@@ -44,11 +51,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import jax  # noqa: E402
 
+from deepspeed_tpu.inference.v2 import Overloaded, Router  # noqa: E402
 from deepspeed_tpu.inference.v2.engine_v2 import (  # noqa: E402
     InferenceEngineV2, RaggedInferenceEngineConfig)
 from deepspeed_tpu.models import GPT2, PRESETS  # noqa: E402
 from deepspeed_tpu.models.llama import Llama, LlamaConfig  # noqa: E402
-from deepspeed_tpu.utils import groups  # noqa: E402
+from deepspeed_tpu.utils import fault_injection, groups  # noqa: E402
 
 # every bench row accumulates here; write_local_report() flushes the
 # tree-local artifact (also mid-run on interruption — see main())
@@ -714,6 +722,133 @@ def bench_shared_prefix(name="gpt2-350M", rate=2.0, n_requests=24,
     return rows
 
 
+def _router_drive(router, prompts, arrivals, decode_tokens, classes,
+                  kill_at_step=None, drain_at_step=None):
+    """Open-loop Poisson driver against the ROUTER (the front-end owns
+    the queue, so back-pressure shows up as typed Overloaded rejections
+    at put() — counted, not crashed). Optionally arms one
+    ``replica_death`` (mid-run kill) or drains replica 0 once
+    ``*_at_step`` router rounds have run."""
+    uids, rejected_at_put = [], 0
+    n = len(prompts)
+    start = time.perf_counter()
+    i = 0
+    steps = 0
+    injected = False
+    while i < n or router.has_work:
+        now = time.perf_counter() - start
+        while i < n and arrivals[i] <= now:
+            try:
+                uids.append(router.put(
+                    prompts[i], max_new_tokens=decode_tokens,
+                    eos_token_id=-1, klass=classes[i]))
+            except Overloaded:
+                rejected_at_put += 1
+            i += 1
+        if not router.has_work:
+            time.sleep(min(0.005, max(0.0, arrivals[i] - now)))
+            continue
+        router.step()
+        steps += 1
+        if not injected and steps >= (kill_at_step or 0) > 0:
+            injected = True
+            fault_injection.arm("replica_death", fails=1)
+        if not injected and steps >= (drain_at_step or 0) > 0:
+            injected = True
+            router.drain(router.replicas[0].name)
+    return time.perf_counter() - start, rejected_at_put, steps
+
+
+def _router_one(name, n_replicas, scenario, rate, n_requests, prompt_len,
+                decode_tokens, chunk, block_size, max_batch, seed):
+    """One fleet-traffic run: N in-process replica engines (shared
+    weights) behind the Router, mixed-class Poisson arrivals (class =
+    request index mod 3), one row with the router's per-class
+    accounting + latency percentiles. ``scenario``:
+
+      baseline      — nothing injected
+      replica-kill  — one armed replica_death mid-run (failover +
+                      byte-identical replay path under real traffic)
+      drain         — router.drain(r0) mid-run (scale-down: finish
+                      in-flight, no replay)
+    """
+    model = build_model(name)
+    groups.reset()
+    params = model.init(jax.random.key(0))
+    engines = []
+    for _ in range(n_replicas):
+        groups.reset()
+        engines.append(InferenceEngineV2(
+            model, params=params,
+            config=RaggedInferenceEngineConfig(
+                max_batch_size=max_batch, kv_block_size=block_size,
+                prompt_bucket=min(prompt_len, 512),
+                splitfuse_tokens=chunk, prefix_cache=True)))
+    router = Router(engines)
+    r = np.random.RandomState(seed)
+    V = model.config.vocab_size
+    prompts = [r.randint(0, V, (prompt_len,)) for _ in range(n_requests)]
+    classes = [i % 3 for i in range(n_requests)]
+    arrivals = np.cumsum(r.exponential(1.0 / rate, n_requests))
+    mid = max(2, n_requests // 2)
+    try:
+        wall, rejected_at_put, steps = _router_drive(
+            router, prompts, arrivals, decode_tokens, classes,
+            kill_at_step=mid if scenario == "replica-kill" else None,
+            drain_at_step=mid if scenario == "drain" else None)
+    finally:
+        fault_injection.reset()
+    snap = router.snapshot()
+    # zero-drop invariant: every admitted request left through exactly
+    # one typed exit (completed/expired/queued-shed); admission
+    # rejections are the shed counter's remainder
+    closed = (snap["completed"] + snap["expired"]
+              + (snap["shed"] - rejected_at_put)) == snap["admitted"]
+    return {
+        "model": name, "mode": "router-traffic",
+        "variant": {"fleet": n_replicas, "scenario": scenario},
+        "arrival_rate_qps": rate, "n_requests": n_requests,
+        "prompt_len": prompt_len, "decode_tokens": decode_tokens,
+        "splitfuse_tokens": chunk,
+        "queue_depth": router.resolved_queue_depth(),
+        "router_steps": steps, "wall_s": round(wall, 2),
+        "admitted": snap["admitted"], "completed": snap["completed"],
+        "shed": snap["shed"], "expired": snap["expired"],
+        "replayed": snap["replayed"], "failovers": snap["failovers"],
+        "rejected_at_put": rejected_at_put,
+        "accounting_closed": closed,
+        "replicas": snap["replicas"],
+        # per-class rows: admitted/completed/shed/expired/replayed and
+        # p50/p99 TTFT+TPOT measured by the router itself
+        "classes": {str(k): v for k, v in snap["classes"].items()},
+        "devices": len(jax.devices()),
+    }
+
+
+def bench_router_traffic(name="gpt2-350M", n_replicas=2, rate=2.0,
+                         n_requests=24, prompt_len=256, decode_tokens=64,
+                         chunk=256, block_size=64, max_batch=8, seed=0):
+    """Serving-fleet robustness sweep (SERVE_REPLICAS=N): the same
+    mixed-class Poisson traffic through baseline / mid-run replica-kill
+    / mid-run drain. The kill row's pass signal is failovers=1 with
+    accounting_closed (every admitted request completed or left through
+    a typed exit — zero drops); the drain row's is replayed=0. A
+    scenario that crashes records its error and the sweep continues."""
+    rows = []
+    for scenario in ("baseline", "replica-kill", "drain"):
+        try:
+            rows.append(_record(_router_one(
+                name, n_replicas, scenario, rate, n_requests, prompt_len,
+                decode_tokens, chunk, block_size, max_batch, seed)))
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            rows.append(_record({
+                "model": name, "mode": "router-traffic",
+                "variant": {"fleet": n_replicas, "scenario": scenario},
+                "error": f"{type(e).__name__}: {e}"[:300]}))
+        write_local_report()           # partial sweep already durable
+    return rows
+
+
 def bench_ep_moe(decode_tokens=16, block_size=16, chunk=16,
                  expert_parallel=2):
     """EP Mixtral serving: experts sharded over the 'expert' mesh axis,
@@ -817,6 +952,23 @@ def main():
             n_requests=int(os.environ.get("SERVE_PREFIX_N",
                                           "24" if on_tpu else "12")),
             **pf_kw)
+    n_replicas = int(os.environ.get("SERVE_REPLICAS", "0") or "0")
+    if n_replicas >= 2:
+        # fleet robustness rows (baseline / replica-kill / drain); same
+        # CPU smoke-scale discipline as SERVE_MIXED
+        on_tpu = jax.default_backend() == "tpu"
+        rt_kw = {} if on_tpu else dict(
+            prompt_len=48, decode_tokens=16, chunk=16, block_size=8,
+            max_batch=4, rate=8.0)
+        if "SERVE_ROUTER_RATE" in os.environ:
+            rt_kw["rate"] = float(os.environ["SERVE_ROUTER_RATE"])
+        bench_router_traffic(
+            name=os.environ.get("SERVE_ROUTER_MODEL",
+                                "gpt2-350M" if on_tpu else "tiny"),
+            n_replicas=n_replicas,
+            n_requests=int(os.environ.get("SERVE_ROUTER_N",
+                                          "24" if on_tpu else "9")),
+            **rt_kw)
     if os.environ.get("SERVE_EP_MOE", "1") == "1":
         bench_ep_moe()
     if os.environ.get("SERVE_QUANT", ""):

@@ -126,6 +126,19 @@ KNOB_TABLE = {
     "serving.prefix_cache_min_match": {
         "op": "prefix_cache", "resolver": "engine _resolve_prefix_cache "
         "dispatch; cold default 1 block (the hand-set value)"},
+    # serving-fleet router knobs (inference/v2/router.py RouterConfig;
+    # heuristic resolvers, no measured op — the lint's construction
+    # probes discover them as router.<field>)
+    "router.router_queue_depth": {
+        "op": None, "resolver": "heuristic: 4x aggregate decode slots "
+        "across live replicas (Router.resolved_queue_depth) — "
+        "capacity-proportional back-pressure"},
+    "router.shed_policy": {
+        "op": None, "resolver": "heuristic: lowest-class, newest-first "
+        "within the class (Router._shed_victim)"},
+    "router.prefix_affinity": {
+        "op": None, "resolver": "heuristic: on iff any live replica "
+        "runs a prefix cache (Router._affinity_on)"},
 }
 
 

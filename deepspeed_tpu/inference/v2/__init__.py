@@ -2,3 +2,5 @@ from .blocked_allocator import BlockedAllocator
 from .ragged import DSSequenceDescriptor, DSStateManager, RaggedBatchWrapper
 from .prefix_cache import PrefixCache, PrefixMatch
 from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+from .replica import Replica, ReplicaDead
+from .router import DeadlineExceeded, Overloaded, Router, RouterConfig

@@ -359,6 +359,45 @@ class InferenceEngineV2:
                 f"with get(flush=True))") from None
         return np.asarray(seq.generated, np.int32)
 
+    def cancel(self, uid):
+        """Withdraw a request (the router's deadline/shed path): queued
+        requests are dropped; in-flight sequences are flushed through
+        the prefix-cache-safe unref path — NO tree insert, because
+        cache contents past the prefill frontier are unverified — so
+        the pool accounting closes; a finished-but-unfetched result is
+        forgotten. Serving telemetry excludes the request from the
+        TTFT/TPOT windows (``on_reject``): a cancelled request has no
+        dispatch boundary to amortize against and would poison the
+        percentiles. Returns True when the uid was known."""
+        for i, r in enumerate(self._pending):
+            if r.uid == uid:
+                del self._pending[i]
+                if self.telemetry is not None:
+                    self.telemetry.on_reject(uid)
+                return True
+        if uid in self._results:
+            # finished before the cancel landed: telemetry already
+            # counted the completion; just forget the result
+            del self._results[uid]
+            return True
+        if uid not in self.state_mgr._seqs:
+            return False
+        try:
+            self._prefill_q.remove(uid)
+        except ValueError:
+            pass
+        seq = self.state_mgr.get_sequence(uid)
+        if seq.cow is not None:
+            # admitted but the CoW slice copy never ran: drop the
+            # claim's temporary source ref before the unref sweep
+            self.state_mgr.cow_complete(seq)
+        if self.kv_pool is not None:
+            self.kv_pool.release(seq.blocks)
+        self.state_mgr.flush(uid)
+        if self.telemetry is not None:
+            self.telemetry.on_reject(uid)
+        return True
+
     @property
     def has_work(self):
         return bool(self._pending) or self.state_mgr.n_active > 0

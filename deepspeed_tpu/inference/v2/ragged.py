@@ -79,6 +79,13 @@ class DSStateManager:
     def n_active(self):
         return sum(s is not None for s in self._slots)
 
+    @property
+    def free_slots(self):
+        """Open batch slots — the router's cheap per-replica load
+        probe (can_admit answers "this request now"; this answers
+        "how loaded")."""
+        return sum(s is None for s in self._slots)
+
     def get_sequence(self, uid):
         return self._seqs[uid]
 

@@ -152,6 +152,24 @@ TAG_SCHEMA = {
     "Serve/Telemetry/cow_copies":
         "cumulative copy-on-write block copies (partial-tail prefix "
         "hits that diverge inside a shared block)",
+
+    # --- serving fleet router (inference/v2/router.py; step = completed
+    #     router requests) ---
+    "Serve/Router/shed":
+        "cumulative requests rejected at admission or shed under "
+        "overload (typed Overloaded, surfaced through get())",
+    "Serve/Router/expired":
+        "cumulative requests flushed at a deadline boundary (typed "
+        "DeadlineExceeded; unref-without-insert, never served late)",
+    "Serve/Router/replayed":
+        "cumulative in-flight requests re-enqueued and replayed on a "
+        "survivor after a replica death",
+    "Serve/Router/failovers":
+        "cumulative replica deaths the router recovered from",
+    "Serve/Router/queue_depth":
+        "router queue depth when the window was emitted",
+    "Serve/Router/draining":
+        "replicas in the draining state when the window was emitted",
 }
 
 

@@ -805,6 +805,7 @@ class ServingTelemetry:
         self._ttft_ms = deque(maxlen=max_samples)
         self._tpot_ms = deque(maxlen=max_samples)
         self.completed = 0
+        self.rejected = 0
         self.active = 0
         self._emitted_at = 0
         # engine-attached PrefixCache (inference/v2/prefix_cache.py);
@@ -856,6 +857,19 @@ class ServingTelemetry:
             self._flush_pending(st, time.perf_counter())
         self.completed += 1
 
+    def on_reject(self, uid):
+        """A shed/expired/cancelled request leaves the accounting
+        entirely: it has no dispatch boundary to amortize against, so
+        leaving it in the maps would poison the TTFT/TPOT windows
+        (zero/None samples at the next dispatch) and ``completed``
+        would count requests that were never served. Percentile windows
+        therefore hold ONLY requests that actually produced tokens to
+        completion."""
+        st = self._live.pop(uid, None)
+        self._started.pop(uid, None)
+        if st is not None:
+            self.rejected += 1
+
     def percentiles(self):
         out = {
             "ttft_ms_p50": percentile(self._ttft_ms, 50),
@@ -865,6 +879,10 @@ class ServingTelemetry:
             "completed": self.completed,
             "active": self.active,
         }
+        if self.rejected:
+            # only present once a cancel/shed happened: router-off
+            # engine snapshots stay byte-identical to pre-router runs
+            out["rejected"] = self.rejected
         if self._prefix_cache is not None:
             s = self._prefix_cache.stats()
             elapsed = max(1e-9, time.perf_counter() - self._t0)

@@ -1,4 +1,5 @@
-"""Deterministic fault injection for the checkpoint/recovery subsystem.
+"""Deterministic fault injection for the checkpoint/recovery subsystem
+and the serving plane (router/replica front-end).
 
 The chaos tests (tests/unit/test_chaos_checkpoint.py) need to prove that
 a torn shard, a dying writer thread, or a crash between "bytes written"
@@ -47,6 +48,26 @@ Injection points currently wired (grep for ``fault_injection.fire``):
   reshape         runtime/engine.py load_checkpoint, before the
                   reshape-on-resume path re-partitions state onto a
                   different topology
+  serve_dispatch  inference/v2/replica.py Replica.submit, once per
+                  request handed to a replica engine — the router's
+                  dispatch boundary (retryable: the request re-queues
+                  at the front and re-routes next round)
+  serve_step      inference/v2/replica.py Replica.step, once per
+                  scheduler iteration (retryable: the replica health
+                  machine counts it; ``max_step_failures`` CONSECUTIVE
+                  failures = no recent step progress = the heartbeat
+                  contract broken, and the replica is declared dead)
+  replica_death   inference/v2/replica.py Replica.step, once per
+                  iteration — arming it models the replica worker
+                  dying mid-decode; the router (the supervising
+                  recovery layer, like the elastic agent for
+                  host_loss) re-enqueues its in-flight requests and
+                  replays them on a survivor
+  router_overload inference/v2/router.py overload detection, once per
+                  router step — arming it injects a forced overload
+                  round (advisory: load is shed as typed Overloaded
+                  rejections; it can never kill a replica or fail a
+                  request the shed policy would not have picked)
   kill            any of the above via ``kill=True`` — raises
                   SimulatedKill (BaseException) which NO layer retries,
                   modeling SIGKILL mid-save
@@ -86,6 +107,10 @@ KNOWN_POINTS = (
     "host_loss",
     "slice_loss",
     "reshape",
+    "serve_dispatch",
+    "serve_step",
+    "replica_death",
+    "router_overload",
 )
 
 # Blast-radius class per injection point — the contract the lint in
@@ -112,6 +137,17 @@ BLAST_RADIUS = {
     "host_loss": "fatal",
     "slice_loss": "fatal",
     "reshape": "fatal",
+    # serving plane: the router is the recovery layer above the
+    # replica, so "retryable" means the ROUTER's re-route/health policy
+    # owns the failure (not the checkpoint save policy), and the fatal
+    # replica_death propagates out of Replica.step() as ReplicaDead for
+    # the router to observe — mirroring how host_loss propagates to the
+    # elastic agent. router_overload is advisory: shedding is a typed,
+    # counted service decision and must never take a replica down.
+    "serve_dispatch": "retryable",
+    "serve_step": "retryable",
+    "replica_death": "fatal",
+    "router_overload": "advisory",
 }
 
 

@@ -127,7 +127,14 @@ def test_advisory_points_never_propagate_to_the_save_path():
     advisory = sorted(p for p, c in fault_injection.BLAST_RADIUS.items()
                       if c == "advisory")
     assert advisory == ["dcn_partition", "replica_fetch",
-                        "replica_push", "replica_restore"]
+                        "replica_push", "replica_restore",
+                        "router_overload"]
+    # router_overload is serving-plane: its never-kills-a-replica /
+    # never-fails-admitted-work contract is pinned behaviorally in
+    # test_router.py (TestRouterOverload.test_router_overload_point_is
+    # _advisory); the checkpoint drive
+    # below covers the storage-plane advisory points
+    advisory = [p for p in advisory if p != "router_overload"]
     peers = ["h0", "h1", "h2", "h3"]
     slices = {"h0": "0", "h1": "0", "h2": "1", "h3": "1"}
     tree = {"w": np.arange(4, dtype=np.float32)}
@@ -187,6 +194,18 @@ def test_advisory_points_never_propagate_to_the_save_path():
                 assert tier == "durable", point
             fault_injection.reset()
         stores["h0"].shutdown()
+
+
+def test_serving_points_declare_expected_blast_radius():
+    """ISSUE-17 serving plane: the router owns retryable failures
+    (re-route / health machine), replica_death propagates to it
+    (fatal), and overload shedding is a service decision that may never
+    take a replica down (advisory)."""
+    br = fault_injection.BLAST_RADIUS
+    assert br["serve_dispatch"] == "retryable"
+    assert br["serve_step"] == "retryable"
+    assert br["replica_death"] == "fatal"
+    assert br["router_overload"] == "advisory"
 
 
 @pytest.mark.chaos

@@ -129,6 +129,47 @@ def test_table_serving_knobs_really_accept_auto():
         f"not accept 'auto' (or are unvalidated): {sorted(stale)}")
 
 
+def discovered_router_auto_knobs():
+    """Construction probes over the serving-fleet RouterConfig
+    (inference/v2/router.py), same discovery rule: a router auto knob
+    (router_queue_depth, shed_policy, prefix_affinity) cannot land an
+    "auto" spelling without a router.<field> KNOB_TABLE row."""
+    from deepspeed_tpu.inference.v2.router import RouterConfig
+    found = set()
+    for f in dataclasses.fields(RouterConfig):
+        if _accepts(RouterConfig, f.name, "auto") \
+                and not _accepts(RouterConfig, f.name, _JUNK):
+            found.add(f.name)
+    return found
+
+
+def test_every_router_auto_knob_is_in_the_table():
+    missing = {f"router.{f}" for f in discovered_router_auto_knobs()} \
+        - set(KNOB_TABLE)
+    assert not missing, (
+        f"router config knobs accept 'auto' but declare no resolver "
+        f"in planner.KNOB_TABLE: {sorted(missing)} — add a "
+        f"router.<field> entry naming the heuristic that resolves each")
+
+
+def test_table_router_knobs_really_accept_auto():
+    discovered = {f"router.{f}"
+                  for f in discovered_router_auto_knobs()}
+    rows = {k for k in KNOB_TABLE if k.startswith("router.")}
+    stale = rows - discovered
+    assert not stale, (
+        f"KNOB_TABLE router rows name RouterConfig fields that do not "
+        f"accept 'auto' (or are unvalidated): {sorted(stale)}")
+
+
+def test_router_expected_knobs_are_discovered():
+    """Pin the ISSUE-17 knob set so a refactor cannot silently drop a
+    knob's validation (which would drop it from discovery and make the
+    reverse lint delete its row instead of failing)."""
+    assert {"router_queue_depth", "shed_policy", "prefix_affinity"} \
+        <= discovered_router_auto_knobs()
+
+
 def test_top_level_parallelism_accepts_auto():
     """The one auto knob living outside any block: top-level
     ``parallelism`` — "" and "auto" pass, junk raises."""

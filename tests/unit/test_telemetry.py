@@ -520,6 +520,57 @@ class TestServingTelemetry:
         st.on_finish(99)
         assert st.percentiles()["completed"] == 1
 
+    def test_shed_heavy_traffic_does_not_poison_the_windows(self):
+        """Regression (ISSUE-17 satellite): under shed-heavy traffic the
+        TTFT/TPOT windows must hold ONLY requests served to completion.
+        Before on_reject existed, shed/expired requests lingered in
+        _live/_started and the next dispatch amortized wall time across
+        their stale state, and 'completed' never matched reality."""
+        st = ServingTelemetry(interval=1)
+        for uid in range(10):
+            st.on_submit(uid)
+        # two served to completion (2 dispatch-amortized tokens each)
+        for uid in (0, 1):
+            st.on_token(uid)
+            st.on_token(uid)
+        st.on_dispatch(active=2)
+        ttft_after_serves = len(st._ttft_ms)
+        tpot_after_serves = len(st._tpot_ms)
+        for uid in (0, 1):
+            st.on_finish(uid)
+        # one shed AFTER producing a token (deadline-expired mid-decode)
+        st.on_token(5)
+        st.on_reject(5)
+        # the rest shed while still queued
+        for uid in (2, 3, 4, 6, 7, 8, 9):
+            st.on_reject(uid)
+        p = st.percentiles()
+        assert p["completed"] == 2
+        assert p["rejected"] == 8
+        assert not st._live and not st._started   # accounting emptied
+        # a dispatch after the rejects must add no poison samples: the
+        # windows still hold only what the two served requests produced
+        st.on_dispatch(active=0)
+        assert len(st._ttft_ms) == ttft_after_serves + 1   # + uid 5's
+        assert len(st._tpot_ms) == tpot_after_serves
+        # double-reject and reject-after-finish are idempotent no-ops
+        st.on_reject(5)
+        st.on_reject(0)
+        assert st.percentiles()["rejected"] == 8
+
+    def test_rejected_key_absent_without_rejects(self):
+        """Router-off byte-identity: the 'rejected' key may only appear
+        once a cancel/shed actually happened — a plain engine run's
+        snapshot stays identical to pre-router serving."""
+        st = ServingTelemetry()
+        st.on_submit(1)
+        st.on_token(1)
+        st.on_finish(1)
+        assert "rejected" not in st.percentiles()
+        st.on_submit(2)
+        st.on_reject(2)
+        assert st.percentiles()["rejected"] == 1
+
     def test_dispatch_skips_queued_requests(self):
         """Regression (review finding): on_dispatch runs per engine
         step — it must visit only requests past their first token, not
