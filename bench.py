@@ -39,6 +39,11 @@ variant can never cost the headline number:
                    BENCH_MOE_KERNEL=1/0): GPT2MoE ragged routing with
                    the Pallas grouped-GEMM kernel (ops/pallas/
                    grouped_matmul.py) vs lax.ragged_dot
+  weight_quant_on/off  the training-side int8 compute A/B
+                   (BENCH_INT8_MATMUL=1/0; quantize.int8_matmul routes
+                   both MLP projections through ops/pallas/
+                   quantization.int8_matmul — dynamic rowwise activation
+                   codes x per-channel weight codes, int32 accumulate)
   pipe_zb/gpipe/zb_offload  the pp=2 schedule + host-offload pair
                    (benchmarks/pipeline_probe.py subprocess on a
                    virtual pipe mesh — zero-bubble vs gpipe wall time,
@@ -169,6 +174,14 @@ _VARIANTS = {
     # defaults finally travel with the measurements.
     "autotune": ("autotune_on", {"BENCH_AUTOTUNE": "1"}),
     "autotune_off": ("autotune_off", {"BENCH_AUTOTUNE": "0"}),
+    # training-side W8A8 compute A/B (quantize.int8_matmul forced
+    # on/off; ops/pallas/quantization.int8_matmul in both MLP
+    # projections — dynamic rowwise activation codes x channelwise
+    # weight codes, int32 accumulate). _off pins the quantize block to
+    # false explicitly so an ambient BENCH_INT8_MATMUL can't silently
+    # turn the A/B into int8-vs-int8.
+    "weight_quant_on": ("weight_quant_on", {"BENCH_INT8_MATMUL": "1"}),
+    "weight_quant_off": ("weight_quant_off", {"BENCH_INT8_MATMUL": "0"}),
     # long-context A/B at 4x the headline sequence (micro bs scaled down
     # to fit): 'ring_on' routes attention through the zigzag ring
     # (sequence/ring.py) with the seq axis spanning every visible device
@@ -414,7 +427,7 @@ def main():
         "BENCH_VARIANTS",
         "mlp_down,bwd_qmajor,bwd_qmajor_512,1.3B,overlap,overlap_off,"
         "autotune,autotune_off,ring_on,ring_off,moe_on,moe_off,"
-        "moe_autotune,pipe")
+        "moe_autotune,weight_quant_on,weight_quant_off,pipe")
     if vnames and vnames != "none":
         # 'pipe' selects the subprocess probe below, not an in-process
         # re-timing — keep it out of the env-override variant loop

@@ -160,6 +160,16 @@ def build_bench_engine():
                                               "on_first_use")
     elif at == "0":
         autotune_cfg["mode"] = "off"
+    # BENCH_INT8_MATMUL=1/0: the training-side W8A8 compute lever
+    # (quantize.int8_matmul — ops/pallas/quantization.int8_matmul in
+    # gpt2._mlp; 'auto' defers to the mlp_int8 winner cache); unset
+    # omits the quantize block entirely (byte-identical programs)
+    quantize_cfg = {}
+    i8 = os.environ.get("BENCH_INT8_MATMUL", "")
+    if i8 in ("0", "1"):
+        quantize_cfg["int8_matmul"] = i8 == "1"
+    elif i8 == "auto":
+        quantize_cfg["int8_matmul"] = "auto"
     # BENCH_TELEMETRY=1: arm the telemetry block (monitor/telemetry.py)
     # so bench.py can read MFU/goodput/step percentiles straight off
     # engine.telemetry_report() — no monitor backend needed
@@ -190,6 +200,7 @@ def build_bench_engine():
                      if offload == "nvme" else {"device": "cpu"})}
                 if offload else {"stage": stage}),
             **({"comm_overlap": overlap_cfg} if overlap_cfg else {}),
+            **({"quantize": quantize_cfg} if quantize_cfg else {}),
             **({"autotune": autotune_cfg} if autotune_cfg else {}),
             **({"telemetry": telemetry_cfg} if telemetry_cfg else {}),
         })

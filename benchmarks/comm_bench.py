@@ -236,7 +236,11 @@ def cache_rows(results, mesh=None, axis="data", outer_axis="data_outer"):
     ``autotuning.kernel_cache.seed_entries`` ingests. The ICI row fits
     alpha-beta from the ppermute sweep (neighbor exchange — the purest
     single-link measure); the DCN row from the hierarchical
-    all_to_all_flat sweep when --outer carved a cross-slice axis.
+    all_to_all_flat sweep when --outer carved a cross-slice axis; the
+    'dcn_int8' row (dtype int8) from the qgZ-clamped staged sweep
+    (all_to_all_2stage_int8 — alpha-beta over LOGICAL payload bytes, so
+    the codec cost and any wire saving land in the coefficients; the
+    planner's ``_score`` prices dcn_quantize'd legs with it).
     ``comm_link`` rows live in the cache file only — never in the op
     REGISTRY — so dispatch ignores them; the planner's
     ``calibrate_links`` is their sole reader."""
@@ -251,8 +255,10 @@ def cache_rows(results, mesh=None, axis="data", outer_axis="data_outer"):
     for r in results:
         by_op.setdefault(r.get("op"), []).append(r)
     rows = []
-    for kind, op_name, shards in (("ici", "ppermute", W),
-                                  ("dcn", "all_to_all_flat", W * Wo)):
+    for kind, op_name, dtype, shards in (
+            ("ici", "ppermute", "float32", W),
+            ("dcn", "all_to_all_flat", "float32", W * Wo),
+            ("dcn_int8", "all_to_all_2stage_int8", "int8", W * Wo)):
         fit = _fit_alpha_beta(by_op.get(op_name, []), shards)
         if fit is None:
             continue
@@ -261,7 +267,7 @@ def cache_rows(results, mesh=None, axis="data", outer_axis="data_outer"):
                    key=lambda r: r["mb"], default=None)
         rows.append({
             "device_kind": device_kind(), "op": "comm_link",
-            "bucket": f"{topo},k{kind}", "dtype": "float32",
+            "bucket": f"{topo},k{kind}", "dtype": dtype,
             "params": {
                 "kind": kind,
                 "alpha_us": round(alpha * 1e6, 3),

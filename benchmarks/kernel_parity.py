@@ -501,6 +501,75 @@ def _quant(rng):
     _close(yp, x, "int8 roundtrip", dict(rtol=0, atol=0.08))
 
 
+def _mlp_wq(rng, bits):
+    """Fused weight-only dequant projection kernel (W8A16/W4A16 serving
+    FFN, ops/pallas/mlp_matmul.wq_matmul): kernel output vs the
+    dequantize-then-einsum reference, every layout orientation. The
+    reference uses the SAME quantized codes, so the gate isolates the
+    kernel's epilogue arithmetic from quantization error itself."""
+    import numpy as np
+    from deepspeed_tpu.ops.int8_weights import quantize_leaf
+    from deepspeed_tpu.ops.pallas.mlp_matmul import wq_matmul
+    ks = jax.random.split(rng, 2)
+    B, T, D, F = 2, 128, 128, 256
+    x = jax.random.normal(ks[0], (B, T, D), jnp.bfloat16)
+    w = np.asarray(jax.random.normal(ks[1], (D, F), jnp.float32)) * 0.1
+    qw = quantize_leaf(w, bits=bits)
+    wf = qw.dequant(jnp.float32)
+    for x_t, out_t in ((False, False), (False, True), (True, False),
+                       (True, True)):
+        xi = jnp.swapaxes(x, -1, -2) if x_t else x
+        got = wq_matmul(xi, qw, x_t=x_t, out_t=out_t, interpret=None)
+        ref = jnp.einsum("btd,df->bft" if out_t else "btd,df->btf",
+                         x.astype(jnp.float32), wf).astype(x.dtype)
+        _close(got, ref, f"mlp_wq{bits} x_t={x_t} out_t={out_t}",
+               dict(rtol=5e-2, atol=5e-2))
+
+
+def _moe_grouped_wq8(rng):
+    """Fused weight-only dequant grouped-SwiGLU chain (quantized expert
+    FFN serving, grouped_matmul.grouped_swiglu_wq): kernel vs the
+    dequantize-then-ragged_dot reference over uneven groups."""
+    import numpy as np
+    from deepspeed_tpu.ops.int8_weights import quantize_leaf
+    from deepspeed_tpu.ops.pallas.grouped_matmul import grouped_swiglu_wq
+    ks = jax.random.split(rng, 4)
+    S, E, M, F = 512, 8, 128, 256
+    x = jax.random.normal(ks[0], (S, M), jnp.bfloat16) * 0.3
+    mk = lambda k, sh: np.asarray(
+        jax.random.normal(k, sh, jnp.float32)) * 0.1
+    q1 = quantize_leaf(mk(ks[1], (E, M, F)), bits=8)
+    q3 = quantize_leaf(mk(ks[2], (E, M, F)), bits=8)
+    q2 = quantize_leaf(mk(ks[3], (E, F, M)), bits=8)
+    sizes = jnp.asarray(np.bincount(np.arange(S) * 7919 % E,
+                                    minlength=E), jnp.int32)
+    got = grouped_swiglu_wq(x, q1, q3, q2, sizes, interpret=None)
+    xf = x.astype(jnp.float32)
+    g = jax.lax.ragged_dot(xf, q1.dequant(jnp.float32), sizes)
+    u = jax.lax.ragged_dot(xf, q3.dequant(jnp.float32), sizes)
+    h = (g * jax.nn.sigmoid(g)) * u
+    ref = jax.lax.ragged_dot(h, q2.dequant(jnp.float32), sizes).astype(
+        x.dtype)
+    _close(got, ref, "moe_grouped_wq8", dict(rtol=5e-2, atol=5e-2))
+
+
+def _int8_tuned(rng, op):
+    """Tuned-winner gate for the W8A8 compute levers: whatever dispatch
+    resolves for this chip's bucket (cached winner or the cold-cache
+    {int8: 0} exact default) must pass the registry parity — so an int8
+    winner that drifted past the gate fails here, and can never have
+    been cached in the first place (search runs parity before
+    caching)."""
+    from deepspeed_tpu.autotuning import kernel_dispatch, kernel_registry
+    spec = kernel_registry.REGISTRY[op]
+    bucket = ("T512,D128,F512" if op == "mlp_int8"
+              else "S512,E8,M128,F256")
+    b = kernel_registry.parse_bucket(bucket)
+    params = kernel_dispatch.resolve(op, bucket, "bfloat16",
+                                     spec["defaults"](b))
+    spec["parity"](b, "bfloat16", params)
+
+
 # every shipped kernel path, gated individually (acceptance: the bench
 # JSON's kernels_parity enumerates each)
 _GATES = (
@@ -527,6 +596,15 @@ _GATES = (
     # grouped product, fwd + grads) and its tuned-winner re-prove
     ("moe_grouped", _moe_grouped),
     ("moe_grouped_tuned", _moe_grouped_tuned),
+    # fused weight-only dequant serving kernels (W8A16/W4A16 FFN +
+    # quantized expert chain) and the W8A8 compute levers' tuned-winner
+    # re-prove (cold default {int8: 0} is the exact fp program)
+    ("mlp_wq8", lambda r: _mlp_wq(r, 8)),
+    ("mlp_wq4", lambda r: _mlp_wq(r, 4)),
+    ("moe_grouped_wq8", _moe_grouped_wq8),
+    ("mlp_int8_tuned", lambda r: _int8_tuned(r, "mlp_int8")),
+    ("moe_grouped_int8_tuned",
+     lambda r: _int8_tuned(r, "moe_grouped_int8")),
     # the ring-attention carry-state blockwise flash step (chunk-pair
     # chaining + pair backward from the global lse)
     ("ring_block", _ring_block),

@@ -38,6 +38,8 @@ Env: SERVE_MODELS=gpt2-350M,llama-1b  SERVE_BATCHES=1,8
      SERVE_PREFIX=1  SERVE_PREFIX_MODEL=gpt2-350M  SERVE_PREFIX_N=24
      SERVE_PREFIX_SHARE=0.75  SERVE_REPLICAS=2  SERVE_ROUTER_N=24
      SERVE_ROUTER_MODEL=gpt2-350M  SERVE_ROUTER_RATE=2.0
+     SERVE_WQ=1  SERVE_WQ_MODEL=gpt2-350M   (weight_quant off/int8/int4
+     sweep — TPOT p50/p99 + weight HBM delta per variant; 0 disables)
 """
 
 import json
@@ -136,6 +138,15 @@ def build_model(name):
         # in seconds; not a measurement target
         from deepspeed_tpu.models import GPT2Config
         return GPT2(GPT2Config(n_layer=2, n_head=4, d_model=64,
+                               max_seq_len=1024, vocab_size=512,
+                               remat=False, dtype="float32"))
+    if name == "tiny-wq":
+        # weight-quant smoke point: like "tiny" but d_model=128 so the
+        # stacked block matmul weights clear quantize_tree's min_size
+        # floor (1<<16 elements) — at d_model=64 nothing quantizes and
+        # every weight_quant row would be a vacuous ratio-1.0
+        from deepspeed_tpu.models import GPT2Config
+        return GPT2(GPT2Config(n_layer=2, n_head=4, d_model=128,
                                max_seq_len=1024, vocab_size=512,
                                remat=False, dtype="float32"))
     if name == "gpt2-350M":
@@ -314,6 +325,98 @@ def bench_quant(name="llama2-7b", decode_tokens=32, block_size=128):
         "devices": len(jax.devices()),
     }
     return _record(out)
+
+
+def _weight_quant_one(name, wq, batch, prompt_len, decode_tokens,
+                      chunk, block_size, seed):
+    """One fused weight-quant serving run (engine ``weight_quant`` =
+    False | 'int8' | 'int4'): closed-loop batch decode with per-token
+    wall timestamps -> TPOT p50/p99 across requests, plus the param
+    pool's actual HBM footprint (the pool IS quantized — Int8Weight/
+    Int4Weight leaves — so the bytes are counted, not projected) and
+    the weight bytes a single decoded token streams."""
+    groups.reset()
+    model = build_model(name)
+    engine = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            max_batch_size=batch, kv_block_size=block_size,
+            prompt_bucket=min(prompt_len, 512), splitfuse_tokens=chunk,
+            weight_quant=wq))
+    weight_bytes = sum(x.nbytes for x in jax.tree.leaves(engine.params))
+    r = np.random.RandomState(seed)
+    V = model.config.vocab_size
+    w = engine.put(r.randint(0, V, (prompt_len,)), max_new_tokens=8,
+                   eos_token_id=-1)
+    while not engine.is_done(w):
+        engine.step()                  # warm prefill + decode programs
+    engine.get(w)
+
+    tok_times = {}
+    for _ in range(batch):
+        uid = engine.put(r.randint(0, V, (prompt_len,)),
+                         max_new_tokens=decode_tokens, eos_token_id=-1)
+        tok_times[uid] = []
+    t0 = time.perf_counter()
+    produced = 0
+    while engine.has_work:
+        out = engine.step()
+        t = time.perf_counter() - t0
+        for uid, _tok in out:
+            tok_times[uid].append(t)
+        produced += len(out)
+    wall = time.perf_counter() - t0
+    for uid in list(engine._results):
+        np.asarray(engine.get(uid))
+
+    tpot = [1e3 * (ts[-1] - ts[0]) / (len(ts) - 1)
+            for ts in tok_times.values()
+            if len(ts) >= 2 and ts[-1] != ts[0]]
+    return {
+        "model": name, "mode": "weight-quant",
+        "variant": {"weight_quant": wq or "off"},
+        "batch": batch, "prompt_len": prompt_len,
+        "decode_tokens": decode_tokens, "splitfuse_tokens": chunk,
+        "weight_hbm_mb": round(weight_bytes / 2**20, 2),
+        # every decode step streams the full weight pool once: the
+        # HBM-bandwidth bound per generated token (per sequence)
+        "weight_bytes_per_token_mb": round(weight_bytes / 2**20, 2),
+        "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p99": _pct(tpot, 99),
+        "decode_tokens_per_sec": (round(produced / wall, 1)
+                                  if produced else None),
+        "devices": len(jax.devices()),
+    }
+
+
+def bench_weight_quant(name="tiny", batch=4, prompt_len=128,
+                       decode_tokens=32, chunk=0, block_size=64, seed=0):
+    """Fused weight-only low-precision serving sweep (SERVE_WQ): the
+    same closed-loop decode at weight_quant off / int8 / int4. The
+    quantized rows carry their HBM delta vs the off row — the W8A16
+    capacity/bandwidth claim is the ~2x (int8) / ~4x (int4) weight
+    shrink with TPOT within noise of off on bandwidth-bound shapes.
+    A variant that crashes records its error and the sweep continues."""
+    rows = []
+    off_mb = None
+    for wq in (False, "int8", "int4"):
+        try:
+            row = _weight_quant_one(name, wq, batch, prompt_len,
+                                    decode_tokens, chunk, block_size,
+                                    seed)
+            if wq is False:
+                off_mb = row["weight_hbm_mb"]
+            elif off_mb:
+                row["weight_hbm_delta_mb"] = round(
+                    row["weight_hbm_mb"] - off_mb, 2)
+                row["weight_hbm_ratio_vs_off"] = round(
+                    row["weight_hbm_mb"] / off_mb, 3)
+            rows.append(_record(row))
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            rows.append(_record({
+                "model": name, "mode": "weight-quant",
+                "variant": {"weight_quant": wq or "off"},
+                "error": f"{type(e).__name__}: {e}"[:300]}))
+        write_local_report()           # partial sweep already durable
+    return rows
 
 
 def bench_kv_offload(name="gpt2-350M", batch=4, prompt_len=512,
@@ -971,6 +1074,17 @@ def main():
             **rt_kw)
     if os.environ.get("SERVE_EP_MOE", "1") == "1":
         bench_ep_moe()
+    if os.environ.get("SERVE_WQ", "1") != "0":
+        # fused weight-only serving rows (off / int8 / int4); same CPU
+        # smoke-scale discipline — off-TPU the tiny model produces all
+        # three rows in minutes
+        on_tpu = jax.default_backend() == "tpu"
+        wq_kw = {} if on_tpu else dict(
+            batch=4, prompt_len=64, decode_tokens=16, block_size=16)
+        bench_weight_quant(
+            name=os.environ.get("SERVE_WQ_MODEL",
+                                "gpt2-350M" if on_tpu else "tiny-wq"),
+            **wq_kw)
     if os.environ.get("SERVE_QUANT", ""):
         bench_quant(os.environ["SERVE_QUANT"])
     if os.environ.get("SERVE_KV_OFFLOAD", "") == "1":

@@ -276,6 +276,85 @@ def _mlp_parity(b, dtype, params):
                dict(rtol=5e-2, atol=5e-1 if n != "dh" else 5e-2))
 
 
+# ------------------------------------------------------------ mlp int8
+# W8A8 dense-MLP compute lever (quantize.int8_matmul="auto"): both
+# projections through ops/pallas/quantization.int8_matmul — dynamic
+# rowwise activation codes x channelwise weight codes, int32
+# accumulate, straight-through fp grads. The {int8: 0} default IS the
+# exact fp program (cold-cache contract); a measured winner flipping to
+# 1 must first survive the parity gate below, so the cache can never
+# hold an int8 winner whose numerics drifted past the gate.
+
+MLP_INT8_DEFAULTS = {"int8": 0}
+
+# quantization error tolerance for the W8A8 gate: symmetric 8-bit codes
+# carry ~0.4% rms error per operand; through two projections + gelu the
+# forward drifts ~1-2%, and the straight-through weight grads (up^T dy,
+# where 'up' came through the quantized forward) reach O(60) magnitude
+# in these step shapes with a few-per-mille tail at ~5% elementwise
+# drift. The gate exists to catch BROKEN numerics (wrong scales, sign
+# flips, garbage tiles — errors of order the activations themselves),
+# not to bound the quantization envelope, so the grad term is wide.
+_INT8_FWD_TOL = dict(rtol=1e-1, atol=1e-1)
+_INT8_GRAD_TOL = dict(rtol=2e-1, atol=4.0)
+
+
+def _mlp8_defaults(b):
+    return dict(MLP_INT8_DEFAULTS)
+
+
+def _mlp8_candidates(b):
+    return _dedup([dict(MLP_INT8_DEFAULTS), {"int8": 1}])
+
+
+def _mlp8_fn(params):
+    use8 = bool(params["int8"])
+
+    def f(h, wu, wd):
+        if use8:
+            from ..ops.pallas.quantization import int8_matmul
+            u = int8_matmul(h, wu)
+            return int8_matmul(jax.nn.gelu(u), wd)
+        return jax.nn.gelu(h @ wu) @ wd
+    return f
+
+
+def _mlp8_step(b, dtype, params):
+    f = _mlp8_fn(params)
+
+    def loss(h, wu, wd):
+        return jnp.sum(f(h, wu, wd).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, (0, 1, 2))
+
+    def step(carry):
+        h, wu, wd = carry
+        dh, dwu, dwd = g(h, wu, wd)
+        return (h + _EPS * dh.astype(h.dtype),
+                wu + _EPS * dwu.astype(wu.dtype),
+                wd + _EPS * dwd.astype(wd.dtype))
+
+    return step, _mlp_args(b, dtype, jax.random.key(0))
+
+
+def _mlp8_parity(b, dtype, params):
+    h, wu, wd = _mlp_args(b, dtype, jax.random.key(1))
+    f = _mlp8_fn(params)
+    ref = _mlp8_fn(MLP_INT8_DEFAULTS)
+    exact = not params["int8"]
+    _close(f(h, wu, wd), ref(h, wu, wd), f"mlp_int8 fwd {params}",
+           _TOL if exact else _INT8_FWD_TOL)
+
+    def lf(fn):
+        return lambda *a: jnp.sum(fn(*a).astype(jnp.float32) ** 2)
+
+    ga = jax.grad(lf(f), (0, 1, 2))(h, wu, wd)
+    gr = jax.grad(lf(ref), (0, 1, 2))(h, wu, wd)
+    for a, bb, n in zip(ga, gr, ("dh", "dwu", "dwd")):
+        _close(a, bb, f"mlp_int8 {n} {params}",
+               _TOL if exact else _INT8_GRAD_TOL)
+
+
 # ------------------------------------------------------------- layernorm
 # 'jnp' is the r05-proven model-level choice (fused_layernorm=False:
 # XLA's fused form wins inside real programs on v5e)
@@ -580,6 +659,74 @@ def _moe_parity(b, dtype, params):
     for a, bb, n in zip(ga, gr, ("dx", "dw1", "dw3", "dw2")):
         _close(a, bb, f"moe_grouped {n} {params}",
                dict(rtol=5e-2, atol=5e-1 if n != "dx" else 5e-2))
+
+
+# ------------------------------------------------- moe grouped int8
+# W8A8 expert-FFN compute lever (quantize.moe_int8_matmul="auto"): the
+# three grouped products through grouped_int8_matmul (int8 ragged_dot,
+# per-expert channelwise weight codes repeated onto rows by
+# group_sizes). {int8: 0} is the exact fp grouped-SwiGLU (cold-cache
+# contract); winners flipping to 1 must survive the parity gate.
+
+MOE_INT8_DEFAULTS = {"int8": 0}
+
+
+def _moe8_defaults(b):
+    return dict(MOE_INT8_DEFAULTS)
+
+
+def _moe8_candidates(b):
+    return _dedup([dict(MOE_INT8_DEFAULTS), {"int8": 1}])
+
+
+def _moe8_fn(params):
+    from ..moe.sharded_moe import _grouped_swiglu_ffn
+
+    def f(x, w1, w3, w2, group_sizes):
+        return _grouped_swiglu_ffn(
+            x, w1, w3, w2, group_sizes,
+            dict(MOE_GROUPED_DEFAULTS, int8=int(params["int8"])))
+    return f
+
+
+def _moe8_step(b, dtype, params):
+    f = _moe8_fn(params)
+    x, w1, w3, w2, gs = _moe_args(b, dtype, jax.random.key(0))
+
+    def loss(x, w1, w3, w2):
+        return jnp.sum(f(x, w1, w3, w2, gs).astype(jnp.float32) ** 2)
+
+    g = jax.grad(loss, (0, 1, 2, 3))
+
+    def step(carry):
+        x, w1, w3, w2 = carry
+        dx, d1, d3, d2 = g(x, w1, w3, w2)
+        return (x + _EPS * dx.astype(x.dtype),
+                w1 + _EPS * d1.astype(w1.dtype),
+                w3 + _EPS * d3.astype(w3.dtype),
+                w2 + _EPS * d2.astype(w2.dtype))
+
+    return step, (x, w1, w3, w2)
+
+
+def _moe8_parity(b, dtype, params):
+    bp = dict(b, S=min(b["S"], 512))     # cap parity cost
+    x, w1, w3, w2, gs = _moe_args(bp, dtype, jax.random.key(1))
+    f = _moe8_fn(params)
+    ref = _moe8_fn(MOE_INT8_DEFAULTS)
+    exact = not params["int8"]
+    _close(f(x, w1, w3, w2, gs), ref(x, w1, w3, w2, gs),
+           f"moe_grouped_int8 fwd {params}",
+           _TOL if exact else _INT8_FWD_TOL)
+
+    def lf(fn):
+        return lambda *a: jnp.sum(fn(*a, gs).astype(jnp.float32) ** 2)
+
+    ga = jax.grad(lf(f), (0, 1, 2, 3))(x, w1, w3, w2)
+    gr = jax.grad(lf(ref), (0, 1, 2, 3))(x, w1, w3, w2)
+    for a, bb, n in zip(ga, gr, ("dx", "dw1", "dw3", "dw2")):
+        _close(a, bb, f"moe_grouped_int8 {n} {params}",
+               _TOL if exact else _INT8_GRAD_TOL)
 
 
 # ------------------------------------------------- paged serving kernels
@@ -969,6 +1116,18 @@ REGISTRY = {
         "candidates": _moe_candidates,
         "make_step": _moe_step,
         "parity": _moe_parity,
+    },
+    "mlp_int8": {
+        "defaults": _mlp8_defaults,
+        "candidates": _mlp8_candidates,
+        "make_step": _mlp8_step,
+        "parity": _mlp8_parity,
+    },
+    "moe_grouped_int8": {
+        "defaults": _moe8_defaults,
+        "candidates": _moe8_candidates,
+        "make_step": _moe8_step,
+        "parity": _moe8_parity,
     },
     "paged_decode": {
         "defaults": _pgd_defaults,

@@ -73,6 +73,21 @@ KNOB_TABLE = {
     "moe.dcn_quantize": {
         "op": "dcn_quantize", "resolver": "moe_swiglu_ragged_ep "
         "dispatch; off cold (numerics)"},
+    "quantize.grad_dcn": {
+        "op": "dcn_quantize", "resolver": "engine._install_comm_overlap "
+        "override of comm_overlap.dcn_quantize (null defers); same "
+        "dispatch, off cold (numerics)"},
+    "quantize.moe_dcn": {
+        "op": "dcn_quantize", "resolver": "engine moe-block override of "
+        "moe.dcn_quantize (null defers); same dispatch, off cold "
+        "(numerics)"},
+    "quantize.int8_matmul": {
+        "op": "mlp_int8", "resolver": "gpt2._mlp W8A8 dispatch over the "
+        "mlp bucket; off cold (parity-gated winners only)"},
+    "quantize.moe_int8_matmul": {
+        "op": "moe_grouped_int8", "resolver": "sharded_moe."
+        "resolve_moe_int8 dispatch; off cold (parity-gated winners "
+        "only)"},
     "checkpoint_engine.hot_tier": {
         "op": None, "resolver": "heuristic: on iff the elastic launcher "
         "exported the ring env (resolve_hot_tier)"},
@@ -126,6 +141,10 @@ KNOB_TABLE = {
     "serving.prefix_cache_min_match": {
         "op": "prefix_cache", "resolver": "engine _resolve_prefix_cache "
         "dispatch; cold default 1 block (the hand-set value)"},
+    "serving.weight_quant": {
+        "op": None, "resolver": "heuristic: 'auto' resolves OFF "
+        "(engine_v2 — reserved for a measured HBM-pressure rule; every "
+        "cold program byte-identical to weight_quant=false)"},
     # serving-fleet router knobs (inference/v2/router.py RouterConfig;
     # heuristic resolvers, no measured op — the lint's construction
     # probes discover them as router.<field>)
@@ -412,18 +431,27 @@ def _estimate_state_bytes(model, mesh, offload):
     return int(dev)
 
 
-def _score(model, pod, mesh, schedule, M, offload, links, batch_tokens):
+def _score(model, pod, mesh, schedule, M, offload, links, batch_tokens,
+           dcn_quantize=False):
     """Wall-clock model of one optimizer step (ms) + term breakdown.
 
     Compute rides the PR-10 lock-step tick model: one unit = one
     microbatch's forward through one stage, backward 2 units, so the
     schedule's ``executor_tick_units`` sum prices its bubble; comm terms
     are alpha-beta per link class, discounted by the overlap fraction
-    the latency-hiding scheduler is expected to hide."""
+    the latency-hiding scheduler is expected to hide.
+
+    ``dcn_quantize``: price the cross-slice (data_outer) legs with the
+    measured 'dcn_int8' link class when the cache holds one (comm_bench
+    fits it from the int8 staged-a2a sweep: alpha-beta over LOGICAL
+    payload bytes, so the 4x wire shrink + codec cost land in the
+    fitted coefficients). Without a measured row the plain dcn link
+    stands in — the planner never invents a speedup it hasn't seen."""
     from ..runtime.pipe.schedule import executor_tick_units
     pp, do, dp = mesh["pipe"], mesh["data_outer"], mesh["data"]
     ep, sp, tp = mesh["expert"], mesh["seq"], mesh["tensor"]
     ici, dcn = links["ici"], links["dcn"]
+    dcn_q = links.get("dcn_int8", dcn) if dcn_quantize else dcn
     exposed = 1.0 - _HIDDEN_FRAC
 
     tokens_micro = batch_tokens / (dp * do * M)
@@ -448,7 +476,7 @@ def _score(model, pod, mesh, schedule, M, offload, links, batch_tokens):
     t_grad = _t_coll(gbytes, dp, ici, "ring") \
         + (layers - 1) * ici[0] * (dp > 1)
     if do > 1:
-        t_grad += _t_coll(gbytes / max(1, dp), do, dcn, "ring")
+        t_grad += _t_coll(gbytes / max(1, dp), do, dcn_q, "ring")
     terms["grad_reduce"] = t_grad * exposed
     # tensor-parallel activation reductions: ~2 psums per layer over tp
     if tp > 1:
@@ -470,7 +498,7 @@ def _score(model, pod, mesh, schedule, M, offload, links, batch_tokens):
         tok_b = tokens_micro * model.d_model * model.param_bytes
         t_one = _t_coll(tok_b, ep, ici, "shard")
         if do > 1:
-            t_one += _t_coll(tok_b, do, dcn, "shard")
+            t_one += _t_coll(tok_b, do, dcn_q, "shard")
         terms["expert_a2a"] = M * layers * 2 * t_one * exposed
     # host staging of the offloaded fp32 master + moments (and the
     # activation rings the schedule hides inside its drain ticks)
@@ -521,7 +549,8 @@ def _admissible_meshes(model, pod, pp_min=1, pp_max=None):
 
 def plan(model_desc, pod_desc, *, batch_tokens=None, pp_min=1,
          pp_max=None, schedules=("gpipe", "1f1b", "zb"),
-         micro_candidates=None, max_plans=8, cache=None):
+         micro_candidates=None, max_plans=8, cache=None,
+         dcn_quantize=False):
     """Enumerate-score-prune: returns a :class:`PlanReport` ranked by
     the modeled step wall. Plans whose device-resident state fails the
     HBM-fit margin are pruned (never ranked); offload variants move the
@@ -549,7 +578,8 @@ def plan(model_desc, pod_desc, *, batch_tokens=None, pp_min=1,
                 pruned += 1
                 continue
             wall, terms = _score(model, pod, mesh, schedule, M, offload,
-                                 links, batch_tokens)
+                                 links, batch_tokens,
+                                 dcn_quantize=dcn_quantize)
             plans.append(Plan(
                 mesh=dict(mesh), schedule=schedule, micro_batches=M,
                 offload=offload, wall_ms=round(wall, 6),
@@ -573,4 +603,18 @@ def plan_for_engine(model, raw_config):
     tb = raw_config.get("train_batch_size") \
         or raw_config.get("train_micro_batch_size_per_gpu")
     batch_tokens = (int(tb) * mdesc.max_seq_len) if tb else None
-    return plan(mdesc, pdesc, batch_tokens=batch_tokens)
+    # DCN-quantized pricing when the config COMMITS to it (True — an
+    # "auto" spelling resolves off on a cold cache, so pricing it
+    # quantized would rank meshes on a lever the engine may not pull);
+    # the quantize-block overrides win over the per-block spellings
+    qz = raw_config.get("quantize") or {}
+    co = raw_config.get("comm_overlap") or {}
+    moe = raw_config.get("moe") or {}
+    grad_q = qz.get("grad_dcn")
+    if grad_q is None:
+        grad_q = co.get("dcn_quantize", False)
+    moe_q = qz.get("moe_dcn")
+    if moe_q is None:
+        moe_q = moe.get("dcn_quantize", False)
+    return plan(mdesc, pdesc, batch_tokens=batch_tokens,
+                dcn_quantize=(grad_q is True or moe_q is True))

@@ -10,14 +10,23 @@ def shard_params(model, mesh, dtype, params=None, seed=0, topology=None,
     """Build NamedShardings from the model's ``partition_specs`` and place
     (or initialize) params under them, cast to ``dtype``.
 
-    ``quantize=True``: ZeRO-Inference weight-only int8 — block weights
-    are quantized HOST-SIDE (HBM never holds the bf16 copy) and placed
-    as Int8Weight pytree nodes; serving paths dequantize one layer at a
-    time (ops/int8_weights.py; reference inference/quantization/).
+    ``quantize``: ZeRO-Inference weight-only quantization — ``True`` /
+    ``"int8"`` for W8, ``"int4"`` for W4 (two codes per byte, packed
+    along the contracted dim). Block weights are quantized HOST-SIDE
+    (HBM never holds the bf16 copy) and placed as Int8Weight /
+    Int4Weight pytree nodes; serving paths dequantize one layer at a
+    time, or keep the FFN weights quantized for the fused-dequant
+    kernels when the engine sets ``_weight_quant_fused``
+    (ops/int8_weights.py; reference inference/quantization/).
 
     Returns (params, param_shardings)."""
     specs = model.partition_specs(topology)
+    if quantize not in (False, None, True, "int8", "int4"):
+        raise ValueError(
+            f"quantize must be False|True|'int8'|'int4', got "
+            f"{quantize!r}")
     if quantize:
+        bits = 4 if quantize == "int4" else 8
         from ..ops.int8_weights import (quantize_tree, quantized_shardings)
         if params is None:
             # init on HOST: the whole point is a model whose bf16 weights
@@ -31,7 +40,7 @@ def shard_params(model, mesh, dtype, params=None, seed=0, topology=None,
         # (not source + a full quantized copy)
         if not isinstance(params, dict):
             params = dict(params)
-        qtree = quantize_tree(params, consume=True)
+        qtree = quantize_tree(params, consume=True, bits=bits)
         del params
         # cast the un-quantized leaves (embeds/norms/biases) to dtype;
         # router weights stay fp32 (the same exclusion quantize_tree

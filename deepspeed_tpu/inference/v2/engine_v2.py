@@ -68,6 +68,22 @@ class RaggedInferenceEngineConfig:
     # per-channel scales, dequantized one layer at a time in-program —
     # ~2x weight-capacity over bf16, serving models bf16 cannot fit
     quantize_weights: bool = False
+    # Fused weight-only low-precision serving (W8A16 / W4A16): the
+    # param pool is quantized ONCE at engine build (per-output-channel
+    # scales; int4 packs two codes per byte along the contracted dim)
+    # and the FFN weights stay quantized through the paged programs —
+    # dequant happens inside the matmul kernels' flush epilogue
+    # (ops/pallas/mlp_matmul.wq_matmul, grouped_matmul.grouped_swiglu_wq)
+    # so HLO never materializes a dequantized weight tensor.
+    #   "auto" (default): resolves OFF on a cold cache — every compiled
+    #     program stays byte-identical to weight_quant=False. (Reserved
+    #     for a measured HBM-pressure heuristic; today auto == off.)
+    #   "int8" / "int4" force W8A16 / W4A16. False forces off.
+    # Distinct from quantize_weights (ZeRO-Inference capacity mode):
+    # that path dequantizes whole layers in-program; this one keeps the
+    # FFN weights quantized end-to-end for bandwidth. When both are
+    # set, weight_quant wins.
+    weight_quant: object = "auto"
     # ZeRO-Inference KV host offload (reference README.md:30 "and
     # KV-cache offload"): the logical block space lives in host RAM,
     # the device holds an LRU-cached pool of device_kv_blocks slots;
@@ -141,6 +157,10 @@ class RaggedInferenceEngineConfig:
             raise ValueError(
                 f"paged_block_c must be 'auto' or a positive int, got "
                 f"{self.paged_block_c!r}")
+        if self.weight_quant not in (False, "auto", "int8", "int4"):
+            raise ValueError(
+                f"weight_quant must be false|'auto'|'int8'|'int4', got "
+                f"{self.weight_quant!r}")
         if self.prefix_cache not in (True, False, "auto"):
             raise ValueError(
                 f"prefix_cache must be true|false|'auto', got "
@@ -213,6 +233,13 @@ class InferenceEngineV2:
         mcfg = model.config
         self.max_seq_len = mcfg.max_seq_len
 
+        # fused weight-only quant mode for this engine ("auto" resolves
+        # OFF — cold-cache programs byte-identical to weight_quant=False;
+        # reserved for a measured HBM-pressure heuristic)
+        self._weight_quant = (
+            config.weight_quant if config.weight_quant in ("int8", "int4")
+            else False)
+
         # serving-side measured dispatch: apply the engine's autotune
         # fields + paged-kernel knobs once now, and again at the top of
         # every program TRACE (_install_trace_state) — the knobs live
@@ -259,7 +286,8 @@ class InferenceEngineV2:
         self.dtype = dtype
         self.params, self.param_shardings = shard_params(
             model, self.mesh, dtype, params=params, seed=config.seed,
-            topology=topology, quantize=config.quantize_weights)
+            topology=topology,
+            quantize=self._weight_quant or config.quantize_weights)
         cache_sh = jax.tree.map(
             lambda s: NamedSharding(self.mesh, s), model.paged_cache_specs(),
             is_leaf=lambda x: isinstance(x, P))
@@ -447,6 +475,11 @@ class InferenceEngineV2:
             cache_path=self.config.autotune_cache)
         self.model._paged_kernel = self.config.paged_kernel
         self.model._paged_block_c = self.config.paged_block_c
+        # fused W8A16/W4A16: _layer_slice keeps the FFN weights
+        # quantized (model._WQ_KEEP) and _mlp routes them through the
+        # fused-dequant kernels; False = every path dequantizes whole
+        # slices as before
+        self.model._weight_quant_fused = self._weight_quant
 
     @staticmethod
     def _sample_per_slot(logits, rng, temps, top_ks, all_greedy=False):

@@ -708,6 +708,38 @@ class GPT2:
             raise ValueError(
                 f"unknown activation {self.config.activation!r}; "
                 f"expected one of {sorted(acts)}")
+        from ..ops.int8_weights import _is_q
+        if _is_q(layer["wup"]):
+            # weight-only quantized serving FFN (engine weight_quant):
+            # dequant fused into the projection kernel's flush epilogue
+            from ..ops.pallas.mlp_matmul import wq_matmul
+            u = wq_matmul(h, layer["wup"]) + layer["bup"]
+            up = acts[self.config.activation](u)
+            out = wq_matmul(up, layer["wdown"]) + layer["bdown"]
+            return out, jnp.zeros((), jnp.float32)
+        q8 = getattr(self, "_int8_matmul", False)
+        if q8 == "auto" and not seq_sharded:
+            # measured W8A8 lever (quantize.int8_matmul="auto"): the
+            # 'mlp_int8' winner for this shape bucket — winners must
+            # pass the registry parity gate before caching, and a cold
+            # cache keeps the exact fp program
+            from ..ops.pallas._common import dispatch, dtype_name, \
+                mlp_bucket
+            D, F = layer["wup"].shape
+            q8 = bool(dispatch("mlp_int8", mlp_bucket(h.shape[1], D, F),
+                               dtype_name(h.dtype), {"int8": 0})["int8"])
+        if q8 and q8 != "auto":
+            # W8A8 compute: dynamic rowwise activation codes x
+            # channelwise weight codes, int32 accumulate, straight-
+            # through fp grads (ops/pallas/quantization.int8_matmul)
+            from ..ops.pallas.quantization import int8_matmul
+            u = checkpoint_name(int8_matmul(h, layer["wup"])
+                                + layer["bup"], "mlp_up")
+            up = acts[self.config.activation](u)
+            up = constrain(up, P(BATCH_AXES,
+                                 "seq" if seq_sharded else None, "tensor"))
+            return (int8_matmul(up, layer["wdown"]) + layer["bdown"],
+                    jnp.zeros((), jnp.float32))
         mode = self._mlp_kernel_mode() if not seq_sharded else None
         mm_kw = dict(fuse_dw=self.config.mlp_kernel_fuse_dw)
         if mode == "auto":
@@ -778,7 +810,9 @@ class GPT2:
         Returns (x_out, carry)."""
         cfg = self.config
         from ..ops.int8_weights import dequant_tree
-        layer = dequant_tree(layer, _dtype(cfg))
+        keep = self._WQ_KEEP \
+            if getattr(self, "_weight_quant_fused", False) else ()
+        layer = dequant_tree(layer, _dtype(cfg), keep=keep)
         B, T = x.shape[0], x.shape[1]
         H, hd = cfg.n_head, cfg.d_head
         h = self._ln(x, layer["ln1_scale"], layer["ln1_bias"])
@@ -893,12 +927,20 @@ class GPT2:
         L = self.config.n_layer
         return {"k": [spec] * L, "v": [spec] * L}
 
+    # FFN weight keys the fused-dequant serving path keeps quantized
+    # (engine_v2 sets _weight_quant_fused; _mlp routes them through
+    # wq_matmul's fused epilogue)
+    _WQ_KEEP = ("wup", "wdown")
+
     def _layer_slice(self, params, i):
         """Static per-layer view of the stacked block params (int8
-        serving weights dequantize here, one layer at a time)."""
+        serving weights dequantize here, one layer at a time; under the
+        fused weight-quant path the FFN weights stay quantized)."""
         from ..ops.int8_weights import dequant_tree
         sl = jax.tree.map(lambda a: a[i], params["blocks"])
-        return dequant_tree(sl, _dtype(self.config))
+        keep = self._WQ_KEEP \
+            if getattr(self, "_weight_quant_fused", False) else ()
+        return dequant_tree(sl, _dtype(self.config), keep=keep)
 
     def apply_paged_prefill(self, params, input_ids, cache, token_blocks,
                             token_offsets, length):

@@ -415,6 +415,27 @@ class Llama:
         cfg = self.config
         h = self._norm(x, layer, 2)
         pb = cfg.mlp_bias_on
+        from ..ops.int8_weights import _is_q
+        if _is_q(layer["wup"]):
+            # weight-only quantized serving FFN (engine weight_quant):
+            # int8/int4 weight tiles stream HBM->VMEM with dequant fused
+            # into the projection kernel's flush epilogue — no
+            # dequantized weight tensor materializes
+            from ..ops.pallas.mlp_matmul import wq_matmul
+            if not cfg.mlp_gated:
+                u = wq_matmul(h, layer["wup"])
+                if pb:
+                    u = u + layer["bup"]
+                act = jax.nn.gelu(u, approximate=cfg.mlp_act == "gelu_tanh")
+                out = wq_matmul(act, layer["wdown"])
+                return out + layer["bdown"] if pb else out
+            g = wq_matmul(h, layer["wgate"])
+            u = wq_matmul(h, layer["wup"])
+            if pb:
+                g = g + layer["bgate"]
+                u = u + layer["bup"]
+            out = wq_matmul(jax.nn.silu(g) * u, layer["wdown"])
+            return out + layer["bdown"] if pb else out
         if not cfg.mlp_gated:                 # falcon/phi plain-gelu MLP
             u = h @ layer["wup"]
             if pb:
@@ -640,12 +661,21 @@ class Llama:
         L = self.config.n_layer
         return {"k": [spec] * L, "v": [spec] * L}
 
+    # FFN weight keys the fused-dequant serving path keeps quantized
+    # (engine_v2 sets _weight_quant_fused; _mlp consumes them via
+    # wq_matmul / grouped_swiglu_wq)
+    _WQ_KEEP = ("wgate", "wup", "wdown")
+
     def _layer_slice(self, params, i):
         from ..ops.int8_weights import dequant_tree
         sl = jax.tree.map(lambda a: a[i], params["blocks"])
         # ZeRO-Inference weight-only serving: int8 block weights
-        # dequantize one layer at a time (identity on bf16 trees)
-        return dequant_tree(sl, jnp.dtype(self.config.dtype))
+        # dequantize one layer at a time (identity on bf16 trees);
+        # under the fused path the FFN weights stay quantized and the
+        # projection kernels dequantize in their epilogues
+        keep = self._WQ_KEEP \
+            if getattr(self, "_weight_quant_fused", False) else ()
+        return dequant_tree(sl, jnp.dtype(self.config.dtype), keep=keep)
 
     def apply_paged_prefill(self, params, input_ids, cache, token_blocks,
                             token_offsets, length):

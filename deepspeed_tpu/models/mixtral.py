@@ -78,14 +78,21 @@ class Mixtral(Llama):
         blocks["moe_w2"] = nrm(ks[3], (L, E, F, D), res_std)
         return params
 
+    # fused weight-quant serving keeps the expert FFN weights quantized
+    # (consumed by _grouped_swiglu_ffn -> grouped_swiglu_wq)
+    _WQ_KEEP = ("moe_w1", "moe_w3", "moe_w2")
+
     def _moe_knobs(self):
-        """(grouped_kernel, hierarchical, dcn_quantize) from the
-        engine-installed ``moe`` config block; module defaults when no
+        """(grouped_kernel, hierarchical, dcn_quantize, int8_matmul)
+        from the engine-installed ``moe`` config block plus the
+        QuantizeConfig int8-compute lever; module defaults when no
         engine installed one (direct model use)."""
         cfg = getattr(self, "_moe_cfg", None)
+        q8 = getattr(self, "_moe_int8", False)
         if cfg is None:
-            return "auto", "auto", False
-        return cfg.grouped_kernel, cfg.hierarchical_a2a, cfg.dcn_quantize
+            return "auto", "auto", False, q8
+        return (cfg.grouped_kernel, cfg.hierarchical_a2a,
+                cfg.dcn_quantize, q8)
 
     def partition_specs(self, topology=None):
         specs = super().partition_specs(topology)
@@ -102,7 +109,7 @@ class Mixtral(Llama):
         eaxis = "expert"
         if topology is not None:
             from ..moe.sharded_moe import resolve_hierarchical_a2a
-            _, hier_knob, _ = self._moe_knobs()
+            _, hier_knob, _, _ = self._moe_knobs()
             if resolve_hierarchical_a2a(
                     hier_knob, topology.axis_size("data_outer"),
                     self.config.num_experts,
@@ -126,14 +133,15 @@ class Mixtral(Llama):
         B, T, D = x.shape
         E, k = cfg.num_experts, cfg.moe_top_k
         h = _rms_norm(x, layer["rms2"], cfg.rms_eps)
-        grouped, hier, dcn_q = self._moe_knobs()
+        grouped, hier, dcn_q, q8 = self._moe_knobs()
         mesh = jax.sharding.get_abstract_mesh()
         if not mesh.empty and mesh.shape.get("expert", 1) > 1:
             from ..moe.sharded_moe import moe_swiglu_ragged_ep
             y = moe_swiglu_ragged_ep(
                 h, layer["moe_gate"], layer["moe_w1"], layer["moe_w3"],
                 layer["moe_w2"], k=k, hierarchical=hier,
-                dcn_quantize=dcn_q, grouped_kernel=grouped)
+                dcn_quantize=dcn_q, grouped_kernel=grouped,
+                int8_matmul=q8)
             return y.astype(x.dtype)
         xs = h.reshape(-1, D)
         S = xs.shape[0]
@@ -151,10 +159,15 @@ class Mixtral(Llama):
         group_sizes = jnp.bincount(flat_exp, length=E).astype(jnp.int32)
 
         from ..moe.sharded_moe import (_grouped_swiglu_ffn,
-                                       resolve_grouped_params)
-        gp = resolve_grouped_params(grouped, S * k, E, D,
-                                    layer["moe_w1"].shape[-1], xr.dtype)
-        o = _grouped_swiglu_ffn(xr, layer["moe_w1"], layer["moe_w3"],
+                                       resolve_grouped_params,
+                                       resolve_moe_int8)
+        w1 = layer["moe_w1"]
+        F = w1.scale.shape[-1] if hasattr(w1, "scale") else w1.shape[-1]
+        gp = resolve_grouped_params(grouped, S * k, E, D, F, xr.dtype)
+        if q8:
+            gp = dict(gp, int8=resolve_moe_int8(q8, S * k, E, D, F,
+                                                xr.dtype))
+        o = _grouped_swiglu_ffn(xr, w1, layer["moe_w3"],
                                 layer["moe_w2"], group_sizes, gp)
         unsorted = jnp.zeros_like(o).at[order].set(o)
         y = jnp.sum((unsorted * flat_w[:, None]).reshape(S, k, D), axis=1)

@@ -77,6 +77,24 @@ def _grouped_dot(xs, w, group_sizes, params):
 
 
 def _grouped_swiglu_ffn(xs, w1, w3, w2, group_sizes, params):
+    from ..ops.int8_weights import _is_q
+    if _is_q(w1):
+        # weight-only quantized experts (serving): dequant fused into
+        # the grouped kernel's flush epilogue — int8/int4 bytes stream
+        # HBM->VMEM, no dequantized (E, K, N) tensor materializes
+        from ..ops.pallas.grouped_matmul import grouped_swiglu_wq
+        return grouped_swiglu_wq(xs, w1, w3, w2, group_sizes,
+                                 block_m=int(params["block_m"]),
+                                 block_n=int(params["block_n"]),
+                                 block_k=int(params["block_k"]))
+    if params.get("int8"):
+        # dynamic int8 activation x weight compute (autotune lever
+        # 'moe_grouped_int8'): per-row activation scales, int32
+        # accumulate, straight-through fp backward
+        from ..ops.pallas.quantization import grouped_int8_matmul
+        g = grouped_int8_matmul(xs, w1, group_sizes)
+        u = grouped_int8_matmul(xs, w3, group_sizes)
+        return grouped_int8_matmul(jax.nn.silu(g) * u, w2, group_sizes)
     if params.get("backend") == "kernel":
         from ..ops.pallas.grouped_matmul import grouped_swiglu
         return grouped_swiglu(xs, w1, w3, w2, group_sizes,
@@ -86,6 +104,21 @@ def _grouped_swiglu_ffn(xs, w1, w3, w2, group_sizes, params):
     g = lax.ragged_dot(xs, w1, group_sizes)
     u = lax.ragged_dot(xs, w3, group_sizes)
     return lax.ragged_dot(jax.nn.silu(g) * u, w2, group_sizes)
+
+
+def resolve_moe_int8(knob, rows, E_loc, M, F, dtype):
+    """Resolve the MoE int8-compute lever ("auto" consults the
+    'moe_grouped_int8' winner cache; a cold cache resolves 0 — byte-
+    identical program). Returns 0/1 to merge into the grouped params."""
+    if knob in (False, None):
+        return 0
+    if knob is True:
+        return 1
+    from ..ops.pallas._common import (dispatch, dtype_name,
+                                      moe_grouped_bucket)
+    return int(dispatch("moe_grouped_int8",
+                        moe_grouped_bucket(rows, E_loc, M, F),
+                        dtype_name(dtype), {"int8": 0})["int8"])
 
 
 def _capacity(num_tokens, num_experts, capacity_factor, min_capacity):
@@ -363,7 +396,8 @@ def resolve_hierarchical_a2a(knob, outer_size, E, ep, *, tokens=0,
 def moe_swiglu_ragged_ep(tokens, gate_w, w1, w3, w2, k=2, *,
                          expert_axis="expert", outer_axis="data_outer",
                          hierarchical="auto", dcn_quantize=False,
-                         grouped_kernel="auto", return_counts=False):
+                         grouped_kernel="auto", int8_matmul=False,
+                         return_counts=False):
     """EXPERT-PARALLEL dropless SwiGLU MoE for the serving models
     (mixtral): the same pack / all_to_all / per-shard grouped-GEMM /
     exchange-back machinery as :func:`moe_layer_ragged_ep`, with the
@@ -514,8 +548,13 @@ def moe_swiglu_ragged_ep(tokens, gate_w, w1, w3, w2, k=2, *,
         xs = rx[g_order]
         es = re[g_order]
         group_sizes = jnp.bincount(re, length=E_loc).astype(jnp.int32)
+        F_dim = w1.scale.shape[-1] if hasattr(w1, "scale") \
+            else w1.shape[-1]
         gp = resolve_grouped_params(grouped_kernel, ep_total * cap,
-                                    E_loc, M, w1.shape[-1], x.dtype)
+                                    E_loc, M, F_dim, x.dtype)
+        if int8_matmul:
+            gp = dict(gp, int8=resolve_moe_int8(
+                int8_matmul, ep_total * cap, E_loc, M, F_dim, x.dtype))
         out = _grouped_swiglu_ffn(xs, w1, w3, w2, group_sizes, gp)
         if tn is not None:
             # row-parallel down projection: F is 'tensor'-sharded, so

@@ -16,6 +16,14 @@ scale[..., 0, o] = absmax over In of column o / 127 — the standard
 weight-only recipe (per-column scaling keeps matmul outputs calibrated
 without per-block gather complexity, and the scale tensor shards
 exactly like the weight's output dim).
+
+W4A16 (``Int4Weight``): same per-output-channel scheme with a [-7, 7]
+code range (scale = absmax / 7) and codes packed two-per-byte along the
+CONTRACTED axis -2 — the layout documented in
+``ops/pallas/quantization.py`` (``pack_int4``). Weights whose In dim is
+odd fall back to int8. The fused-dequant kernels
+(``mlp_matmul.wq_matmul`` / ``grouped_matmul.grouped_swiglu_wq``)
+stream the packed bytes HBM->VMEM and unpack+rescale in-kernel.
 """
 
 import jax
@@ -48,13 +56,53 @@ class Int8Weight:
         return f"Int8Weight(q={self.q.shape}, scale={self.scale.shape})"
 
 
+@jax.tree_util.register_pytree_node_class
+class Int4Weight:
+    """int4 weight (codes packed two-per-byte along the contracted
+    axis -2, see pack_int4 layout in ops/pallas/quantization.py) +
+    per-output-channel fp32 scale. ``q.shape[-2]`` is In // 2."""
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    def dequant(self, dtype):
+        from .pallas.quantization import unpack_int4
+        codes = unpack_int4(jnp.asarray(self.q))
+        return (codes.astype(jnp.float32) * self.scale).astype(dtype)
+
+    def tree_flatten(self):
+        return (self.q, self.scale), None
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children)
+
+    def __repr__(self):
+        return f"Int4Weight(q={self.q.shape}, scale={self.scale.shape})"
+
+
 def _is_q(x):
-    return isinstance(x, Int8Weight)
+    return isinstance(x, (Int8Weight, Int4Weight))
 
 
-def quantize_leaf(w):
-    """Host-side per-channel symmetric int8 quantization of one weight."""
+def _pack_int4_np(q):
+    lo = q[..., 0::2, :].astype(np.uint8) & 0xF
+    hi = (q[..., 1::2, :].astype(np.uint8) & 0xF) << 4
+    return (hi | lo).astype(np.int8)
+
+
+def quantize_leaf(w, bits=8):
+    """Host-side per-channel symmetric int8/int4 quantization of one
+    weight. ``bits=4`` falls back to int8 when the contracted (-2) dim
+    is odd (the two-per-byte packing needs it even)."""
     w = np.asarray(w, np.float32)
+    if bits == 4 and w.shape[-2] % 2 == 0:
+        absmax = np.max(np.abs(w), axis=-2, keepdims=True)
+        scale = (absmax / 7.0).astype(np.float32)
+        scale_safe = np.where(scale == 0, 1.0, scale)
+        q = np.clip(np.rint(w / scale_safe), -7, 7).astype(np.int8)
+        return Int4Weight(_pack_int4_np(q), scale)
     absmax = np.max(np.abs(w), axis=-2, keepdims=True)
     scale = (absmax / 127.0).astype(np.float32)
     scale_safe = np.where(scale == 0, 1.0, scale)
@@ -63,7 +111,7 @@ def quantize_leaf(w):
 
 
 def quantize_tree(params, min_size=1 << 16, consume=False,
-                  exclude_keys=("moe_gate",)):
+                  exclude_keys=("moe_gate",), bits=8):
     """Quantize the ``blocks`` sub-tree's float weights with >= 2 dims
     and >= min_size elements (embeddings / norms / biases / the head
     stay in the model dtype — matching the reference's linear-layer-only
@@ -94,7 +142,7 @@ def quantize_tree(params, min_size=1 << 16, consume=False,
         # jnp.issubdtype: host bf16 (ml_dtypes) is floating too
         if (in_blocks and arr.ndim >= 2 and arr.size >= min_size
                 and jnp.issubdtype(arr.dtype, jnp.floating)):
-            return quantize_leaf(arr)
+            return quantize_leaf(arr, bits=bits)
         return arr if consume else tree
     return walk(params, False)
 
@@ -115,7 +163,7 @@ def cast_unquantized(tree, dtype, exclude_keys=("moe_gate",)):
                         else walk(tree[k])) for k in tree}
         if isinstance(tree, (list, tuple)):
             return type(tree)(walk(v) for v in tree)
-        if isinstance(tree, Int8Weight):
+        if _is_q(tree):
             return tree
         a = np.asarray(tree)
         return a.astype(dt) if jnp.issubdtype(a.dtype, jnp.floating) \
@@ -123,12 +171,26 @@ def cast_unquantized(tree, dtype, exclude_keys=("moe_gate",)):
     return walk(tree)
 
 
-def dequant_tree(tree, dtype):
-    """Replace Int8Weight nodes with dequantized ``dtype`` arrays
-    (identity on unquantized trees)."""
-    return jax.tree.map(
-        lambda x: x.dequant(dtype) if _is_q(x) else x, tree,
-        is_leaf=_is_q)
+def dequant_tree(tree, dtype, keep=()):
+    """Replace Int8Weight/Int4Weight nodes with dequantized ``dtype``
+    arrays (identity on unquantized trees). ``keep`` names dict keys
+    whose quantized nodes are passed through UNTOUCHED — the serving
+    fused-dequant path keeps FFN weights quantized (the kernel streams
+    int bytes and dequantizes in its flush epilogue) while everything
+    else dequantizes per layer as before."""
+    if not keep:
+        return jax.tree.map(
+            lambda x: x.dequant(dtype) if _is_q(x) else x, tree,
+            is_leaf=_is_q)
+
+    def walk(t):
+        if isinstance(t, dict):
+            return {k: (t[k] if k in keep and _is_q(t[k]) else walk(t[k]))
+                    for k in t}
+        if isinstance(t, (list, tuple)):
+            return type(t)(walk(v) for v in t)
+        return t.dequant(dtype) if _is_q(t) else t
+    return walk(tree)
 
 
 def has_quantized(tree):
@@ -147,8 +209,8 @@ def quantized_shardings(specs, params, mesh):
             entries = list(spec) + [None] * (ndim - len(spec))
             s_entries = list(entries)
             s_entries[-2] = None
-            return Int8Weight(NamedSharding(mesh, P(*entries)),
-                              NamedSharding(mesh, P(*s_entries)))
+            return type(param)(NamedSharding(mesh, P(*entries)),
+                               NamedSharding(mesh, P(*s_entries)))
         return NamedSharding(mesh, spec)
 
     return jax.tree.map(walk, specs, params,

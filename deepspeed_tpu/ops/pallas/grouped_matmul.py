@@ -451,3 +451,199 @@ def grouped_swiglu(x, w1, w3, w2, group_sizes, *, block_m=128,
         interpret = _interpret_default()
     return _swiglu_diff(x, w1, w3, w2, group_sizes.astype(jnp.int32),
                         tm, tn, tk, bool(interpret))
+
+
+# ----------------------------------------- weight-only quantized forward
+def _unpack4(p):
+    """(tk//2, tn) packed int4 tile -> (tk, tn) int8 codes (layout in
+    ops/pallas/quantization.py: low nibble = even row, high = odd)."""
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+    hi = jnp.right_shift(p, 4)
+    return jnp.stack([lo, hi], axis=1).reshape(2 * p.shape[0], p.shape[1])
+
+
+def _gmm_wq_kernel(gid_ref, mtid_ref, st_ref, en_ref, nt_ref,
+                   x_ref, w_ref, s_ref, o_ref, acc, *, tm, nk, int4):
+    """_gmm_kernel with a quantized weight operand: int8/int4 expert
+    tiles widen in VMEM and the per-(expert, output-channel) scale
+    multiplies the f32 accumulator once in the flush — each logical
+    tile writes only ITS group's rows, so a row tile straddling two
+    experts still gets each expert's own scale."""
+    i = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _zero():
+        acc[...] = jnp.zeros_like(acc)
+
+    x = x_ref[...].astype(jnp.float32)
+    w = w_ref[0]
+    if int4:
+        w = _unpack4(w)
+    acc[...] += lax.dot_general(
+        x, w.astype(jnp.float32), (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _flush():
+        g = gid_ref[i]
+        mt = mtid_ref[i]
+        mask = _row_mask(mt, g, st_ref, en_ref, i < nt_ref[0], tm)
+        s = s_ref[0, 0]                # (tn,) this expert's scales
+        prev_mt = jnp.where(i == 0, -1, mtid_ref[jnp.maximum(i - 1, 0)])
+        prev = jnp.where(mt != prev_mt,
+                         jnp.zeros_like(o_ref[...]), o_ref[...])
+        o_ref[...] = jnp.where(mask,
+                               (acc[...] * s[None, :]).astype(o_ref.dtype),
+                               prev)
+
+
+def _gmm_wq(x, q, s, group_sizes, *, tm, tn, tk, int4, interpret):
+    """Grouped matmul with quantized weights: q (E, K, N) int8 (or
+    (E, K//2, N) packed int4), s (E, 1, N) per-channel scales."""
+    M, K = x.shape
+    E, _, N = s.shape[0], q.shape[1], s.shape[2]
+    gids, mtids, starts, ends, num = _group_metadata(group_sizes, M, tm, E)
+    G = int(gids.shape[0])
+    w_blk = (1, tk // 2, tn) if int4 else (1, tk, tn)
+    w_spec = pl.BlockSpec(w_blk,
+                          lambda j, i, kk, gid, mtid, st, en, nt:
+                          (gid[i], kk, j))
+    s_spec = pl.BlockSpec((1, 1, tn),
+                          lambda j, i, kk, gid, mtid, st, en, nt:
+                          (gid[i], 0, j))
+    return pl.pallas_call(
+        functools.partial(_gmm_wq_kernel, tm=tm, nk=K // tk, int4=int4),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(N // tn, G, K // tk),
+            in_specs=[
+                pl.BlockSpec((tm, tk),
+                             lambda j, i, kk, gid, mtid, st, en, nt:
+                             (mtid[i], kk)),
+                w_spec, s_spec,
+            ],
+            out_specs=pl.BlockSpec(
+                (tm, tn),
+                lambda j, i, kk, gid, mtid, st, en, nt: (mtid[i], j)),
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32)],
+        ),
+        out_shape=_sds((M, N), x.dtype, x),
+        interpret=interpret,
+    )(gids, mtids, starts, ends, num, x, q, s)
+
+
+def _swiglu_up_wq_kernel(gid_ref, mtid_ref, st_ref, en_ref, nt_ref,
+                         x_ref, w1_ref, s1_ref, w3_ref, s3_ref, o_ref,
+                         gacc, uacc, *, tm, nk, int4):
+    i = pl.program_id(1)
+    kk = pl.program_id(2)
+
+    @pl.when(kk == 0)
+    def _zero():
+        gacc[...] = jnp.zeros_like(gacc)
+        uacc[...] = jnp.zeros_like(uacc)
+
+    x = x_ref[...].astype(jnp.float32)
+    w1 = w1_ref[0]
+    w3 = w3_ref[0]
+    if int4:
+        w1 = _unpack4(w1)
+        w3 = _unpack4(w3)
+    gacc[...] += lax.dot_general(x, w1.astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+    uacc[...] += lax.dot_general(x, w3.astype(jnp.float32),
+                                 (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _flush():
+        g = gid_ref[i]
+        mt = mtid_ref[i]
+        mask = _row_mask(mt, g, st_ref, en_ref, i < nt_ref[0], tm)
+        # dequant scales first (per accumulator), THEN silu*mul — the
+        # epilogue nonlinearity sees the same values the fp math would
+        gg = gacc[...] * s1_ref[0, 0][None, :]
+        uu = uacc[...] * s3_ref[0, 0][None, :]
+        h = (gg * jax.nn.sigmoid(gg)) * uu
+        prev_mt = jnp.where(i == 0, -1, mtid_ref[jnp.maximum(i - 1, 0)])
+        prev = jnp.where(mt != prev_mt,
+                         jnp.zeros_like(o_ref[...]), o_ref[...])
+        o_ref[...] = jnp.where(mask, h.astype(o_ref.dtype), prev)
+
+
+def _swiglu_up_wq(x, q1, s1, q3, s3, group_sizes, *, tm, tn, tk, int4,
+                  interpret):
+    M, K = x.shape
+    E, F = s1.shape[0], s1.shape[2]
+    gids, mtids, starts, ends, num = _group_metadata(group_sizes, M, tm, E)
+    G = int(gids.shape[0])
+    w_blk = (1, tk // 2, tn) if int4 else (1, tk, tn)
+    w_spec = pl.BlockSpec(w_blk,
+                          lambda j, i, kk, gid, mtid, st, en, nt:
+                          (gid[i], kk, j))
+    s_spec = pl.BlockSpec((1, 1, tn),
+                          lambda j, i, kk, gid, mtid, st, en, nt:
+                          (gid[i], 0, j))
+    return pl.pallas_call(
+        functools.partial(_swiglu_up_wq_kernel, tm=tm, nk=K // tk,
+                          int4=int4),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=5,
+            grid=(F // tn, G, K // tk),
+            in_specs=[
+                pl.BlockSpec((tm, tk),
+                             lambda j, i, kk, gid, mtid, st, en, nt:
+                             (mtid[i], kk)),
+                w_spec, s_spec, w_spec, s_spec,
+            ],
+            out_specs=pl.BlockSpec(
+                (tm, tn),
+                lambda j, i, kk, gid, mtid, st, en, nt: (mtid[i], j)),
+            scratch_shapes=[pltpu.VMEM((tm, tn), jnp.float32),
+                            pltpu.VMEM((tm, tn), jnp.float32)],
+        ),
+        out_shape=_sds((M, F), x.dtype, x),
+        interpret=interpret,
+    )(gids, mtids, starts, ends, num, x, q1, s1, q3, s3)
+
+
+def grouped_swiglu_wq(x, w1, w3, w2, group_sizes, *, block_m=128,
+                      block_n=128, block_k=128, interpret=None):
+    """``grouped_swiglu`` with quantized expert weights (``Int8Weight``
+    / ``Int4Weight``, all three the same width): int8/int4 tiles stream
+    HBM->VMEM, per-(expert, channel) scales fold into the flush
+    epilogues, fp32 accumulation throughout. Serving-only (no vjp).
+    Shapes the tiling rules reject fall back to dequant + ragged_dot
+    (materializing the dequantized experts for that call only)."""
+    from ..int8_weights import Int4Weight, Int8Weight
+    ws = (w1, w3, w2)
+    if not all(isinstance(w, (Int8Weight, Int4Weight)) for w in ws):
+        raise TypeError("grouped_swiglu_wq needs Int8Weight/Int4Weight "
+                        "expert weights")
+    int4s = [isinstance(w, Int4Weight) for w in ws]
+    int4 = int4s[0]
+    K = x.shape[1]
+    F = w1.scale.shape[-1]
+    Kd = w2.scale.shape[-1]
+    fit = _blocks_fit(x.shape[0], K, F, block_m, block_n, block_k)
+    fit_dn = fit and _pick_block(Kd, block_k)
+    ok = (fit is not None and fit_dn is not None and fit_dn == fit[2]
+          and all(i4 == int4 for i4 in int4s)
+          and (not int4 or (fit[2] % 2 == 0 and fit[1] % 2 == 0)))
+    if not ok:
+        g = lax.ragged_dot(x, w1.dequant(x.dtype), group_sizes)
+        u = lax.ragged_dot(x, w3.dequant(x.dtype), group_sizes)
+        return lax.ragged_dot(jax.nn.silu(g) * u, w2.dequant(x.dtype),
+                              group_sizes)
+    tm, tn, tk = fit
+    if interpret is None:
+        interpret = _interpret_default()
+    gs = group_sizes.astype(jnp.int32)
+    xp, M = _pad_rows(x, tm)
+    h = _swiglu_up_wq(xp, w1.q, w1.scale, w3.q, w3.scale, gs,
+                      tm=tm, tn=tn, tk=tk, int4=int4,
+                      interpret=bool(interpret))
+    return _gmm_wq(h, w2.q, w2.scale, gs, tm=tm, tn=tk, tk=tn,
+                   int4=int4, interpret=bool(interpret))[:M]

@@ -270,3 +270,131 @@ def mlp_matmul(x, w, *, x_t=False, out_t=False, block_t=256,
         return _ref_proj(x, w, x_t, out_t)
     return _proj(x, w, bool(x_t), bool(out_t), bt, bo, bk,
                  bool(fuse_dw), bool(interpret))
+
+
+# ----------------------------------------- weight-only quantized forward
+def _unpack_int4_tile(p):
+    """(bk//2, bm) packed int4 tile -> (bk, bm) int8 codes (layout in
+    ops/pallas/quantization.py: low nibble = even row, high = odd)."""
+    lo = jnp.right_shift(jnp.left_shift(p, 4), 4)
+    hi = jnp.right_shift(p, 4)
+    return jnp.stack([lo, hi], axis=1).reshape(2 * p.shape[0], p.shape[1])
+
+
+def _mm_wq_kernel(a_ref, b_ref, s_ref, o_ref, acc, *, a_t, out_t, nk,
+                  int4):
+    """_mm_kernel with a quantized weight operand: the b tile arrives as
+    int8 codes (or two-per-byte int4), is widened in VMEM, and the
+    per-output-channel scale multiplies the f32 accumulator ONCE in the
+    flush epilogue — legal because the scale lives on the non-contracted
+    dim, so it commutes with the K accumulation. No dequantized (K, M)
+    tensor ever exists in HBM."""
+    kk = pl.program_id(3)
+
+    @pl.when(kk == 0)
+    def _zero():
+        acc[...] = jnp.zeros_like(acc)
+
+    a = a_ref[0].astype(jnp.float32)   # (bn, bk) | (bk, bn) when a_t
+    b = b_ref[...]                     # (bk, bm) int8 | (bk//2, bm) packed
+    if int4:
+        b = _unpack_int4_tile(b)
+    bf = b.astype(jnp.float32)
+    ca = 0 if a_t else 1
+    if out_t:                          # (bm, bn) = b . a
+        acc[...] += lax.dot_general(
+            bf, a, (((0,), (ca,)), ((), ())),
+            preferred_element_type=jnp.float32)
+    else:                              # (bn, bm) = a . b
+        acc[...] += lax.dot_general(
+            a, bf, (((ca,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+    @pl.when(kk == nk - 1)
+    def _flush():
+        s = s_ref[0]                   # (bm,) per-output-channel scales
+        scaled = acc[...] * (s[:, None] if out_t else s[None, :])
+        o_ref[0] = scaled.astype(o_ref.dtype)
+
+
+def _mm_wq(a, q, s, *, a_t, out_t, bn, bm, bk, out_dtype, int4,
+           interpret):
+    """Quantized-weight _mm: q is int8 (K, M) or packed int4 (K//2, M),
+    s is the (1, M) per-output-channel scale."""
+    P = a.shape[0]
+    if a_t:
+        K, N = a.shape[1], a.shape[2]
+    else:
+        N, K = a.shape[1], a.shape[2]
+    M = s.shape[1]
+    grid = (P, N // bn, M // bm, K // bk)
+
+    a_spec = pl.BlockSpec((1, bk, bn), lambda p, i, j, k: (p, k, i)) \
+        if a_t else pl.BlockSpec((1, bn, bk), lambda p, i, j, k: (p, i, k))
+    b_blk = (bk // 2, bm) if int4 else (bk, bm)
+    b_spec = pl.BlockSpec(b_blk, lambda p, i, j, k: (k, j))
+    s_spec = pl.BlockSpec((1, bm), lambda p, i, j, k: (0, j))
+    o_spec = pl.BlockSpec((1, bm, bn), lambda p, i, j, k: (p, j, i)) \
+        if out_t else pl.BlockSpec((1, bn, bm), lambda p, i, j, k: (p, i, j))
+    o_shape = (P, M, N) if out_t else (P, N, M)
+    acc_shape = (bm, bn) if out_t else (bn, bm)
+
+    return pl.pallas_call(
+        functools.partial(_mm_wq_kernel, a_t=a_t, out_t=out_t,
+                          nk=K // bk, int4=int4),
+        grid=grid,
+        in_specs=[a_spec, b_spec, s_spec],
+        out_specs=o_spec,
+        out_shape=_sds(o_shape, out_dtype, a),
+        scratch_shapes=[pltpu.VMEM(acc_shape, jnp.float32)],
+        interpret=interpret,
+    )(a, q, s)
+
+
+def _ref_proj_wq(x, w, x_t, out_t):
+    """jnp fallback with the wq kernel's numerics (f32 codes x f32
+    activation, one scale multiply, one output round). Materializes the
+    dequantized weight for this call only — taken for shapes the tiling
+    rules reject (e.g. tiny decode batches)."""
+    wf = w.dequant(jnp.float32)
+    eq = ("bkt,km->b" + ("mt" if out_t else "tm")) if x_t \
+        else ("btk,km->b" + ("mt" if out_t else "tm"))
+    return jnp.einsum(eq, x.astype(jnp.float32), wf,
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def wq_matmul(x, w, *, x_t=False, out_t=False, block_t=256, block_o=256,
+              block_k=512, interpret=None):
+    """Forward-only ``x @ dequant(w)`` for a quantized weight operand
+    (``Int8Weight`` / ``Int4Weight`` from ``ops/int8_weights.py``):
+    int8/int4 weight tiles stream HBM->VMEM, dequant is fused into the
+    kernel epilogue (fp32 accumulate, scale-then-cast in the flush).
+    Serving-only — no vjp; the training path keeps full-precision
+    weights.
+
+    x: (B, T, K) (or 2D (T, K), lifted to B=1); returns (B, T, M)
+    honouring ``x_t``/``out_t`` exactly like ``mlp_matmul``.
+    """
+    from ..int8_weights import Int4Weight, Int8Weight
+    if not isinstance(w, (Int8Weight, Int4Weight)):
+        raise TypeError(f"wq_matmul needs Int8Weight/Int4Weight, "
+                        f"got {type(w).__name__}")
+    int4 = isinstance(w, Int4Weight)
+    squeeze = x.ndim == 2
+    if squeeze:
+        x = x[None]
+    K = x.shape[1] if x_t else x.shape[2]
+    T = x.shape[2] if x_t else x.shape[1]
+    M = w.scale.shape[-1]
+    if interpret is None:
+        interpret = _interpret_default()
+    bt = _pick_block(T, block_t, lane=True)
+    bo = _pick_block(M, block_o, lane=True)
+    bk = _pick_block(K, block_k, lane=True)
+    if None in (bt, bo, bk) or min(T, M, K) < 8 or (int4 and bk % 2):
+        out = _ref_proj_wq(x, w, x_t, out_t)
+    else:
+        out = _mm_wq(x, w.q, w.scale.reshape(1, M), a_t=x_t, out_t=out_t,
+                     bn=bt, bm=bo, bk=bk, out_dtype=x.dtype, int4=int4,
+                     interpret=bool(interpret))
+    return out[0] if squeeze else out

@@ -535,6 +535,57 @@ class MoEConfig:
 
 
 @dataclass
+class QuantizeConfig:
+    """One roof for the training engine's low-precision levers
+    (runtime/engine.py consumes it at build). Every field is a planner
+    knob — "auto" spellings resolve from the autotune winner cache with
+    cold-cache defaults equal to the hand-set values, so a config that
+    only adds ``{"quantize": {}}`` compiles byte-identical programs.
+
+      grad_dcn         int8 block-quantize round trip on the DCN
+                       (data_outer) leg of the staged ZeRO grad
+                       reduction. None (default) defers to
+                       comm_overlap.dcn_quantize; true|false|"auto"
+                       OVERRIDE it (one quantize block can steer a
+                       config whose comm_overlap block is shared).
+      moe_dcn          same, for the MoE hierarchical all_to_all's DCN
+                       legs; None defers to moe.dcn_quantize.
+      int8_matmul      W8A8 dense-MLP compute (ops/pallas/quantization
+                       .int8_matmul — dynamic rowwise activation codes x
+                       channelwise weight codes, int32 accumulate,
+                       straight-through fp grads). false (default) |
+                       true | "auto" (the 'mlp_int8' winner cache per
+                       shape bucket; winners must pass the registry
+                       parity gate before caching, cold cache = off).
+      moe_int8_matmul  W8A8 expert-FFN compute (grouped_int8_matmul
+                       over lax.ragged_dot): false | true | "auto"
+                       (the 'moe_grouped_int8' winner cache).
+    """
+    grad_dcn: object = None          # None | bool | "auto"
+    moe_dcn: object = None           # None | bool | "auto"
+    int8_matmul: object = False      # bool | "auto"
+    moe_int8_matmul: object = False  # bool | "auto"
+
+    def __post_init__(self):
+        if self.grad_dcn not in (None, True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"quantize.grad_dcn must be null|true|false|'auto', got "
+                f"{self.grad_dcn!r}")
+        if self.moe_dcn not in (None, True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"quantize.moe_dcn must be null|true|false|'auto', got "
+                f"{self.moe_dcn!r}")
+        if self.int8_matmul not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"quantize.int8_matmul must be true|false|'auto', got "
+                f"{self.int8_matmul!r}")
+        if self.moe_int8_matmul not in (True, False, "auto"):
+            raise DeepSpeedConfigError(
+                f"quantize.moe_int8_matmul must be true|false|'auto', "
+                f"got {self.moe_int8_matmul!r}")
+
+
+@dataclass
 class AutotuneConfig:
     """Measured kernel dispatch (autotuning/kernel_dispatch.py): kernel
     tunables set to "auto" (flash blocks / mlp_kernel / fused_layernorm
@@ -775,6 +826,7 @@ class DeepSpeedConfig:
         self.comm_overlap = _take(config, CommOverlapConfig, "comm_overlap")
         self.sequence = _take(config, SequenceConfig, "sequence")
         self.moe = _take(config, MoEConfig, "moe")
+        self.quantize = _take(config, QuantizeConfig, "quantize")
         self.autotune = _take(config, AutotuneConfig, "autotune")
         self.telemetry = _take(config, TelemetryConfig, "telemetry")
         self.activation_checkpointing = _take(

@@ -182,13 +182,31 @@ class DeepSpeedEngine:
         # dispatch + hierarchical ICI->DCN expert all_to_all staging
         # (moe/sharded_moe.py; mixtral._mlp and the MoE layers consult
         # model._moe_cfg per dispatch)
+        moe_cfg = self.config.moe
+        qz = self.config.quantize
+        if qz.moe_dcn is not None:
+            # 'quantize' block override: moe_dcn=None defers to
+            # moe.dcn_quantize, anything else steers the MoE DCN legs
+            import dataclasses as _dc
+            moe_cfg = _dc.replace(moe_cfg, dcn_quantize=qz.moe_dcn)
         try:
-            self.model._moe_cfg = self.config.moe
+            self.model._moe_cfg = moe_cfg
         except (AttributeError, TypeError):   # frozen/slotted models
             log_dist(
                 "moe config block could not be installed on the model "
                 "(attribute assignment rejected); MoE layers will use "
                 "the module defaults", ranks=[0])
+        # W8A8 compute levers (quantize block): models consult these at
+        # trace time (gpt2._mlp / mixtral._moe_knobs); False defaults
+        # keep the compiled programs byte-identical
+        try:
+            self.model._int8_matmul = qz.int8_matmul
+            self.model._moe_int8 = qz.moe_int8_matmul
+        except (AttributeError, TypeError):   # frozen/slotted models
+            log_dist(
+                "quantize config block could not be installed on the "
+                "model (attribute assignment rejected); int8 matmul "
+                "levers will use the module defaults", ranks=[0])
         self.zero_stage = self.config.zero.stage
         self.param_dtype = self.config.precision_dtype
         # pipeline block (config 'pipeline'): schedule / microbatch /
@@ -1052,6 +1070,11 @@ class DeepSpeedEngine:
             bucket_mb = int(dispatch("comm_bucket", gbucket, dt_name,
                                      {"bucket_mb": 32})["bucket_mb"])
         dcn_quantize = co.dcn_quantize
+        # 'quantize' block override (one roof for the low-precision
+        # levers): grad_dcn=None defers to comm_overlap.dcn_quantize
+        qz_grad = self.config.quantize.grad_dcn
+        if qz_grad is not None:
+            dcn_quantize = qz_grad
         if dcn_quantize == "auto":
             dcn_quantize = bool(dispatch("dcn_quantize", gbucket, dt_name,
                                          {"quantize": 0})["quantize"])

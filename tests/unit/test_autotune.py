@@ -41,6 +41,8 @@ _BUCKETS = {
     "fused_ce": "N128,D128,V384",
     "ring_block": "T64,d32",
     "moe_grouped_mm": "S128,E4,M128,F256",
+    "mlp_int8": "T128,D128,F512",
+    "moe_grouped_int8": "S128,E4,M128,F256",
     "paged_decode": "B4,MB4,BS16,kh2,g2,d32",
     "paged_chunk": "C16,MB4,BS16,kh2,g2,d32",
     "pipe_microbatch": "S2,B8,T128,D128",

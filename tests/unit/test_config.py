@@ -181,6 +181,60 @@ def test_moe_block_validation():
                         dp_world_size=8)
 
 
+def test_quantize_block_defaults_and_parses():
+    cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
+    qz = cfg.quantize
+    # None = defer to the per-subsystem knobs (comm_overlap.dcn_quantize
+    # / moe.dcn_quantize); the compute levers default hard-off so a
+    # config without the block is byte-identical to one with defaults
+    assert qz.grad_dcn is None
+    assert qz.moe_dcn is None
+    assert qz.int8_matmul is False
+    assert qz.moe_int8_matmul is False
+    cfg = DeepSpeedConfig({
+        "train_batch_size": 8,
+        "quantize": {"grad_dcn": True, "moe_dcn": False,
+                     "int8_matmul": True, "moe_int8_matmul": True},
+    }, dp_world_size=8)
+    qz = cfg.quantize
+    assert qz.grad_dcn is True
+    assert qz.moe_dcn is False
+    assert qz.int8_matmul is True
+    assert qz.moe_int8_matmul is True
+
+
+def test_quantize_block_auto_spellings_roundtrip():
+    raw = {
+        "train_batch_size": 8,
+        "quantize": {"grad_dcn": "auto", "moe_dcn": "auto",
+                     "int8_matmul": "auto", "moe_int8_matmul": "auto"},
+    }
+    cfg = DeepSpeedConfig(raw, dp_world_size=8)
+    qz = cfg.quantize
+    assert qz.grad_dcn == "auto"
+    assert qz.moe_dcn == "auto"
+    assert qz.int8_matmul == "auto"
+    assert qz.moe_int8_matmul == "auto"
+    # same dict parses twice to the same block (input never mutated)
+    cfg2 = DeepSpeedConfig(raw, dp_world_size=8)
+    assert cfg2.quantize.int8_matmul == "auto"
+    assert cfg2.quantize.grad_dcn == "auto"
+
+
+def test_quantize_block_validation():
+    for field, bad in [
+        ("grad_dcn", "yes"),
+        ("moe_dcn", "sometimes"),
+        ("int8_matmul", "fast"),
+        ("int8_matmul", None),
+        ("moe_int8_matmul", "yes"),
+    ]:
+        with pytest.raises(DeepSpeedConfigError):
+            DeepSpeedConfig({"train_batch_size": 8,
+                             "quantize": {field: bad}},
+                            dp_world_size=8)
+
+
 def test_autotune_defaults():
     cfg = DeepSpeedConfig({"train_batch_size": 8}, dp_world_size=8)
     at = cfg.autotune

@@ -33,6 +33,7 @@ _BLOCKS = {
     "comm_overlap": cfg_mod.CommOverlapConfig,
     "sequence": cfg_mod.SequenceConfig,
     "moe": cfg_mod.MoEConfig,
+    "quantize": cfg_mod.QuantizeConfig,
     "autotune": cfg_mod.AutotuneConfig,
     "telemetry": cfg_mod.TelemetryConfig,
 }
