@@ -219,6 +219,74 @@ def _splitfuse(rng):
     groups.reset()
 
 
+def _speculative(rng):
+    """Draft-model speculative decoding vs plain decode: greedy
+    spec-on output must be byte-identical to spec-off for BOTH model
+    families (the verify program rides each family's own
+    apply_paged_verify), and a mid-speculation cancel() must leave the
+    target and draft allocators with zero leaked blocks."""
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2
+    from deepspeed_tpu.models import GPT2, GPT2Config, Llama, LlamaConfig
+    from deepspeed_tpu.utils import groups
+    base = {"dtype": "float32", "kv_block_size": 8, "prompt_bucket": 16,
+            "max_batch_size": 4}
+    rs = np.random.RandomState(0)
+    prompts = [rs.randint(1, 255, (n,)).astype(np.int32)
+               for n in (5, 11, 16)]
+    families = (
+        ("gpt2",
+         GPT2(GPT2Config(n_layer=2, n_head=4, d_model=64,
+                         max_seq_len=128, vocab_size=256, remat=False,
+                         dtype="float32")),
+         GPT2(GPT2Config(n_layer=1, n_head=2, d_model=32,
+                         max_seq_len=128, vocab_size=256, remat=False,
+                         dtype="float32"))),
+        ("llama",
+         Llama(LlamaConfig(n_layer=2, n_head=4, n_kv_heads=2,
+                           d_model=64, max_seq_len=128, vocab_size=256,
+                           remat=False, dtype="float32")),
+         Llama(LlamaConfig(n_layer=1, n_head=2, n_kv_heads=1,
+                           d_model=32, max_seq_len=128, vocab_size=256,
+                           remat=False, dtype="float32"))),
+    )
+    for name, model, draft in families:
+        params = model.init(jax.random.key(0))
+        dparams = draft.init(jax.random.key(1))
+        groups.reset()
+        plain = InferenceEngineV2(model, params=params,
+                                  config=dict(base))
+        want = plain.generate_all(prompts, max_new_tokens=10)
+        groups.reset()
+        spec = InferenceEngineV2(
+            model, params=params,
+            config=dict(base, spec_draft=True, spec_k=4),
+            draft_model=draft, draft_params=dparams)
+        got = spec.generate_all(prompts, max_new_tokens=10)
+        for w, g in zip(want, got):
+            np.testing.assert_array_equal(
+                np.asarray(g), np.asarray(w),
+                err_msg=f"speculative decode ({name})")
+        tel = spec.telemetry.percentiles()
+        assert tel.get("spec_rounds", 0) > 0, \
+            f"speculation never engaged ({name})"
+        # mid-speculation cancel: step until the sequence is actively
+        # speculating, withdraw it, and audit both pools
+        uid = spec.put(prompts[1], max_new_tokens=32)
+        while True:
+            spec.step()
+            seq = spec.state_mgr._seqs.get(uid)
+            if seq is not None and seq.draft_blocks:
+                break
+        assert spec.cancel(uid) is True
+        alloc = spec.state_mgr.allocator
+        assert alloc.free_blocks == alloc.total_blocks, \
+            f"leaked target blocks after mid-spec cancel ({name})"
+        da = spec.state_mgr.draft_allocator
+        assert da.free_blocks == da.total_blocks, \
+            f"leaked draft blocks after mid-spec cancel ({name})"
+    groups.reset()
+
+
 def _mlp_matmul(rng):
     from deepspeed_tpu.ops.pallas.mlp_matmul import _ref_proj, mlp_matmul
     B, T, K, M = 2, 256, 512, 256
@@ -581,6 +649,9 @@ _GATES = (
     ("flash_window", _flash_window),
     ("evoformer", _evoformer),
     ("splitfuse", _splitfuse),
+    # draft-model speculation: spec-on greedy byte-identity (gpt2 +
+    # llama) and the mid-speculation cancel() zero-leak audit
+    ("speculative", _speculative),
     ("mlp_matmul", _mlp_matmul),
     ("paged", _paged),
     # the SplitFuse chunked-prefill paged kernel + the tuned-winner

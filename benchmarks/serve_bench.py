@@ -40,6 +40,10 @@ Env: SERVE_MODELS=gpt2-350M,llama-1b  SERVE_BATCHES=1,8
      SERVE_ROUTER_MODEL=gpt2-350M  SERVE_ROUTER_RATE=2.0
      SERVE_WQ=1  SERVE_WQ_MODEL=gpt2-350M   (weight_quant off/int8/int4
      sweep — TPOT p50/p99 + weight HBM delta per variant; 0 disables)
+     SERVE_SPEC=1  SERVE_SPEC_MODEL=gpt2-350M  SERVE_SPEC_KS=2,4
+     (speculative decoding sweep — off baseline, oracle-draft spec_k
+     rows with acceptance-rate + tokens-per-verify-step counters, and
+     the adversarial random-token fallback row; 0 disables)
 """
 
 import json
@@ -414,6 +418,144 @@ def bench_weight_quant(name="tiny", batch=4, prompt_len=128,
             rows.append(_record({
                 "model": name, "mode": "weight-quant",
                 "variant": {"weight_quant": wq or "off"},
+                "error": f"{type(e).__name__}: {e}"[:300]}))
+        write_local_report()           # partial sweep already durable
+    return rows
+
+
+def _build_draft(name):
+    """Narrow draft counterpart of a bench model (~1/8 the compute of
+    the target: fewer/narrower layers, same vocab)."""
+    from dataclasses import replace
+    from deepspeed_tpu.models import GPT2Config
+    if name in ("tiny", "tiny-wq"):
+        return GPT2(GPT2Config(n_layer=1, n_head=2, d_model=32,
+                               max_seq_len=1024, vocab_size=512,
+                               remat=False, dtype="float32"))
+    if name == "gpt2-350M":
+        return GPT2(replace(PRESETS["350M"], n_layer=4, n_head=8,
+                            d_model=512, max_seq_len=2048))
+    if name == "llama-1b":
+        return Llama(LlamaConfig(n_layer=4, n_head=8, n_kv_heads=4,
+                                 d_model=512, d_ff=1408,
+                                 max_seq_len=2048, vocab_size=32000))
+    raise ValueError(f"no draft sizing for {name}")
+
+
+def _spec_one(name, spec_k, workload, batch, prompt_len, decode_tokens,
+              chunk, block_size, seed):
+    """One speculative serving run: closed-loop batch decode with
+    per-token wall timestamps. ``spec_k=0`` = speculation off (the
+    baseline row). Workloads: "shared-template" is the synthetic
+    high-acceptance traffic (the draft shares the target's weights —
+    the oracle-draft bound, every round commits k+1 tokens);
+    "random-token" is the adversarial low-acceptance traffic (an
+    independently-initialized draft + the acceptance floor pinned at
+    1.0, so the per-sequence fallback latch engages after
+    SPEC_MIN_ROUNDS and the row measures speculation's worst-case
+    overhead over plain decode)."""
+    groups.reset()
+    model = build_model(name)
+    spec_on = spec_k > 0
+    kw = {}
+    if spec_on:
+        if workload == "shared-template":
+            draft = build_model(name)      # oracle: same config+seed
+        else:
+            draft = _build_draft(name)
+        kw = dict(draft_model=draft)
+    engine = InferenceEngineV2(
+        model, RaggedInferenceEngineConfig(
+            max_batch_size=batch, kv_block_size=block_size,
+            prompt_bucket=min(prompt_len, 512), splitfuse_tokens=chunk,
+            spec_draft=spec_on, spec_k=max(1, spec_k)), **kw)
+    if spec_on and workload == "shared-template":
+        # oracle draft: share the target's weights outright — the
+        # draft's argmax always equals the target's, so every round
+        # commits k+1 tokens (the tokens-per-verify-step upper bound)
+        engine.draft_params = engine.params
+    if spec_on and workload == "random-token":
+        engine._spec_floor = 1.0           # adversarial: always latch
+    r = np.random.RandomState(seed)
+    V = model.config.vocab_size
+    w = engine.put(r.randint(0, V, (prompt_len,)), max_new_tokens=8,
+                   eos_token_id=-1)
+    while not engine.is_done(w):
+        engine.step()                 # warm every program variant
+    engine.get(w)
+
+    tok_times = {}
+    for _ in range(batch):
+        uid = engine.put(r.randint(0, V, (prompt_len,)),
+                         max_new_tokens=decode_tokens, eos_token_id=-1)
+        tok_times[uid] = []
+    t0 = time.perf_counter()
+    produced = 0
+    while engine.has_work:
+        out = engine.step()
+        t = time.perf_counter() - t0
+        for uid, _tok in out:
+            tok_times[uid].append(t)
+        produced += len(out)
+    wall = time.perf_counter() - t0
+    for uid in list(engine._results):
+        np.asarray(engine.get(uid))
+
+    tpot = [1e3 * (ts[-1] - ts[0]) / (len(ts) - 1)
+            for ts in tok_times.values()
+            if len(ts) >= 2 and ts[-1] != ts[0]]
+    tel = engine.telemetry.percentiles()
+    row = {
+        "model": name, "mode": "speculative",
+        "variant": {"spec": "on" if spec_on else "off",
+                    "spec_k": spec_k, "workload": workload},
+        "batch": batch, "prompt_len": prompt_len,
+        "decode_tokens": decode_tokens, "splitfuse_tokens": chunk,
+        "tpot_ms_p50": _pct(tpot, 50), "tpot_ms_p99": _pct(tpot, 99),
+        "decode_tokens_per_sec": (round(produced / wall, 1)
+                                  if produced else None),
+        # zero-verify-step guard: the telemetry only carries spec keys
+        # once a verify round ran, so off rows (and spec-on rows whose
+        # traffic never speculated) report None — never a NaN from a
+        # 0/0 percentile window
+        "spec_rounds": tel.get("spec_rounds"),
+        "acceptance_rate_pct": tel.get("spec_acceptance_pct"),
+        "tokens_per_verify_step": tel.get("spec_tokens_per_verify_step"),
+        "devices": len(jax.devices()),
+    }
+    if spec_on and workload == "random-token":
+        row["acceptance_floor"] = 1.0
+        row["fallback_engaged"] = tel.get("spec_rounds") is not None
+    da = engine.state_mgr.draft_allocator
+    if da is not None:
+        assert da.free_blocks == da.total_blocks, "leaked draft blocks"
+    return row
+
+
+def bench_speculative(name="tiny", batch=4, prompt_len=64,
+                      decode_tokens=32, chunk=16, block_size=16,
+                      spec_ks=(2, 4), seed=0):
+    """Speculative-decoding sweep (SERVE_SPEC): plain decode baseline,
+    then draft-on at each ``spec_k`` under the synthetic
+    high-acceptance workload (oracle draft — the tokens-per-verify-step
+    upper bound, > 1.5 expected at spec_k=4), then the adversarial
+    random-token row where the acceptance-floor fallback engages and
+    p99 TPOT must stay within noise of the baseline. A variant that
+    crashes records its error and the sweep continues."""
+    rows = []
+    variants = [(0, "shared-template")]
+    variants += [(k, "shared-template") for k in spec_ks]
+    variants += [(max(spec_ks), "random-token")]
+    for spec_k, workload in variants:
+        try:
+            rows.append(_record(_spec_one(
+                name, spec_k, workload, batch, prompt_len,
+                decode_tokens, chunk, block_size, seed)))
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            rows.append(_record({
+                "model": name, "mode": "speculative",
+                "variant": {"spec": "on" if spec_k else "off",
+                            "spec_k": spec_k, "workload": workload},
                 "error": f"{type(e).__name__}: {e}"[:300]}))
         write_local_report()           # partial sweep already durable
     return rows
@@ -1085,6 +1227,20 @@ def main():
             name=os.environ.get("SERVE_WQ_MODEL",
                                 "gpt2-350M" if on_tpu else "tiny-wq"),
             **wq_kw)
+    if os.environ.get("SERVE_SPEC", "1") != "0":
+        # speculative decoding rows (off / spec_k sweep / adversarial
+        # fallback); same CPU smoke-scale discipline — off-TPU the tiny
+        # model produces every row in minutes
+        on_tpu = jax.default_backend() == "tpu"
+        sp_kw = {} if on_tpu else dict(
+            batch=4, prompt_len=64, decode_tokens=24, chunk=16,
+            block_size=16)
+        bench_speculative(
+            name=os.environ.get("SERVE_SPEC_MODEL",
+                                "gpt2-350M" if on_tpu else "tiny"),
+            spec_ks=tuple(int(k) for k in os.environ.get(
+                "SERVE_SPEC_KS", "2,4").split(",")),
+            **sp_kw)
     if os.environ.get("SERVE_QUANT", ""):
         bench_quant(os.environ["SERVE_QUANT"])
     if os.environ.get("SERVE_KV_OFFLOAD", "") == "1":

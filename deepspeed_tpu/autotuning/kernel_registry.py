@@ -1079,6 +1079,85 @@ def _pfx_parity(b, dtype, params):
             f"tail (cached_len {2 * BS - 1}), got {m.cached_len}")
 
 
+# -------------------------------------------- speculative-decode policy
+# Draft-model speculation (inference/v2/speculative.py) is scheduling
+# policy like prefix_cache, but its payoff is an acceptance-rate bet:
+# one verify round costs a (k+1)-position target pass plus k draft
+# decode steps, and commits 1 + (accepted) tokens. The step prices that
+# trade on one device with the same matmul-rows emulation as
+# prefix_cache: per-COMMITTED-token work for the candidate under a
+# fixed synthetic acceptance model (per-token acceptance p=0.7 — the
+# shared-template serving traffic the bench's high-acceptance workload
+# models; r=0.125 draft/target cost ratio, the "narrow draft" sizing
+# the README recommends). k too large for the traffic's acceptance
+# decays committed tokens toward 1 + p/(1-p) while the verify span
+# keeps growing — the cost term prices exactly that knee.
+
+
+def _spec_defaults(b):
+    from ..inference.v2.speculative import SPEC_DEFAULTS
+    return dict(SPEC_DEFAULTS)
+
+
+def _spec_candidates(b):
+    cands = [_spec_defaults(b)]
+    cands.append({"enabled": 0, "spec_k": 0, "floor_pct": 35})
+    for k in (2, 4, 8):
+        cands.append({"enabled": 1, "spec_k": k, "floor_pct": 35})
+    return _dedup(cands)
+
+
+def _spec_per_token_cost(params):
+    """Target-pass-equivalents per committed token under the synthetic
+    acceptance model: verify touches k+1 positions, the draft adds
+    k*r, and the round commits the expected accepted prefix + bonus.
+    Disabled = plain decode = 1.0 by construction."""
+    k = int(params["spec_k"])
+    if not int(params["enabled"]) or k < 1:
+        return 1.0
+    p, r = 0.7, 0.125
+    committed = 1.0 + sum(p ** j for j in range(1, k + 1))
+    return ((k + 1) + k * r) / committed
+
+
+def _spec_step(b, dtype, params):
+    rows = max(8, int(8 * b["B"] * _spec_per_token_cost(params)))
+    D = 128
+    ks = jax.random.split(jax.random.key(3), 2)
+    x = jax.random.normal(ks[0], (rows, D), dtype) * 0.3
+    w = jax.random.normal(ks[1], (D, D), dtype) / math.sqrt(D)
+
+    def step(carry):
+        x, w = carry
+        y = jax.nn.gelu(x @ w) @ w.T
+        x = x + _EPS * y.astype(x.dtype)
+        return (x, w)
+
+    return step, (x, w)
+
+
+def _spec_parity(b, dtype, params):
+    """The candidate changes scheduling, not math — check knob ranges
+    and the acceptance rule's invariants (greedy acceptance is the
+    byte-identity guardrail, so its host kernel is pinned here too)."""
+    k = int(params["spec_k"])
+    if int(params["enabled"]) and k < 1:
+        raise AssertionError(
+            f"spec_decode candidate enabled with spec_k={k} < 1")
+    fl = int(params["floor_pct"])
+    if not 0 <= fl <= 100:
+        raise AssertionError(
+            f"spec_decode candidate floor_pct={fl} outside [0, 100]")
+    from ..inference.v2.speculative import longest_accept
+    if longest_accept([5, 6, 7], [5, 6, 7, 8]) != 3:
+        raise AssertionError("longest_accept full-accept broken")
+    if longest_accept([5, 9, 7], [5, 6, 7, 8]) != 1:
+        raise AssertionError(
+            "longest_accept must stop at the FIRST mismatch")
+    if longest_accept([9, 6, 7], [5, 6, 7, 8]) != 0:
+        raise AssertionError("longest_accept first-token reject broken")
+
+
 # ---------------------------------------------------------------- table
 REGISTRY = {
     "flash_attention": {
@@ -1152,6 +1231,12 @@ REGISTRY = {
         "candidates": _pfx_candidates,
         "make_step": _pfx_step,
         "parity": _pfx_parity,
+    },
+    "spec_decode": {
+        "defaults": _spec_defaults,
+        "candidates": _spec_candidates,
+        "make_step": _spec_step,
+        "parity": _spec_parity,
     },
 }
 

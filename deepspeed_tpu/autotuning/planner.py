@@ -141,6 +141,15 @@ KNOB_TABLE = {
     "serving.prefix_cache_min_match": {
         "op": "prefix_cache", "resolver": "engine _resolve_prefix_cache "
         "dispatch; cold default 1 block (the hand-set value)"},
+    "serving.spec_draft": {
+        "op": "spec_decode", "resolver": "engine resolve_spec dispatch "
+        "(inference/v2/speculative.py); cold default ENABLED — the real "
+        "opt-in gate is the draft_model constructor argument, without "
+        "which no speculative program exists"},
+    "serving.spec_k": {
+        "op": "spec_decode", "resolver": "engine resolve_spec dispatch; "
+        "cold default 4 proposals per verify round, acceptance-aware "
+        "cost term prices the k-vs-acceptance knee"},
     "serving.weight_quant": {
         "op": None, "resolver": "heuristic: 'auto' resolves OFF "
         "(engine_v2 — reserved for a measured HBM-pressure rule; every "

@@ -126,6 +126,21 @@ class RaggedInferenceEngineConfig:
     # winner cache's measured knee; below it, scheduling + CoW overhead
     # beats the skipped prefill). Cold default: 1 block.
     prefix_cache_min_match: object = "auto"
+    # Draft-model speculative decoding (ROADMAP 1(b)): a narrow draft
+    # model proposes ``spec_k`` tokens per greedy sequence per round
+    # and the target verifies all k+1 positions in ONE batched pass
+    # riding the split-fuse chunk kernel; greedy acceptance keeps the
+    # output streams byte-identical to plain decode. The OPT-IN is the
+    # ``draft_model`` argument to the engine constructor — with no
+    # draft model, scheduling and every compiled program are unchanged
+    # whatever these knobs say (the PR 13 cold-cache discipline).
+    #   spec_draft: "auto" (the winner cache's choice for this pool
+    #     bucket; cold default ON once a draft model is present) |
+    #     True (raises without a draft model, or under kv_host_offload
+    #     — the draft pool has no offload tier) | False
+    #   spec_k: "auto" (winner cache; cold default 4) | int >= 1
+    spec_draft: object = "auto"
+    spec_k: object = "auto"
     # serving-side autotune dispatch state, applied COMPLETE at engine
     # construction and at this engine's program traces ("" = env/default
     # resolution — DSTPU_AUTOTUNE, default cache_only; an earlier
@@ -178,6 +193,17 @@ class RaggedInferenceEngineConfig:
             raise ValueError(
                 f"prefix_cache_blocks must be an int >= 0, got "
                 f"{self.prefix_cache_blocks!r}")
+        if self.spec_draft not in (True, False, "auto"):
+            raise ValueError(
+                f"spec_draft must be true|false|'auto', got "
+                f"{self.spec_draft!r}")
+        if self.spec_k != "auto" and (
+                not isinstance(self.spec_k, int)
+                or isinstance(self.spec_k, bool)
+                or self.spec_k < 1):
+            raise ValueError(
+                f"spec_k must be 'auto' or an int >= 1, got "
+                f"{self.spec_k!r}")
         if self.prefix_cache is True and self.kv_host_offload:
             raise ValueError(
                 "prefix_cache=True is incompatible with kv_host_offload: "
@@ -214,7 +240,8 @@ class InferenceEngineV2:
     ``get(uid)`` returns the generated tokens."""
 
     def __init__(self, model, config=None, params=None, topology=None,
-                 monitor=None, **kwargs):
+                 monitor=None, draft_model=None, draft_params=None,
+                 **kwargs):
         if isinstance(config, dict):
             config = RaggedInferenceEngineConfig(**{**config, **kwargs})
         elif config is None:
@@ -309,6 +336,63 @@ class InferenceEngineV2:
                                                dtype=dtype),
                 out_shardings=cache_sh)()
 
+        # --- draft-model speculative decoding (ROADMAP 1(b)) ---
+        # own allocator + cache pool over the same block geometry; the
+        # draft is narrow, so the pool is a small fraction of the
+        # target's. With no draft model nothing below exists and the
+        # engine is byte-identical to the pre-speculation engine.
+        self.draft_model = None
+        self._spec_k = 0
+        self._spec_floor = 0.0
+        if config.spec_draft is True and draft_model is None:
+            raise ValueError(
+                "spec_draft=True requires a draft model (pass "
+                "draft_model= to the engine)")
+        if draft_model is not None and config.kv_host_offload:
+            if config.spec_draft is True:
+                raise ValueError(
+                    "spec_draft=True is incompatible with "
+                    "kv_host_offload: the draft pool has no offload "
+                    "tier to keep residency honest — use one or the "
+                    "other")
+            draft_model = None            # "auto"/False resolve off
+        if draft_model is not None:
+            from .speculative import resolve_spec
+            spec_on, spec_k, spec_floor = resolve_spec(
+                config.spec_draft, config.spec_k,
+                B=config.max_batch_size, NB=num_blocks, BS=BS,
+                dtype=config.dtype)
+            if spec_on:
+                if draft_model.config.vocab_size != mcfg.vocab_size:
+                    raise ValueError(
+                        f"draft/target vocab mismatch: "
+                        f"{draft_model.config.vocab_size} vs "
+                        f"{mcfg.vocab_size} — speculation verifies "
+                        f"draft token ids against target argmax, the "
+                        f"vocabularies must be the same")
+                from .blocked_allocator import BlockedAllocator
+                self.draft_model = draft_model
+                self._spec_k = spec_k
+                self._spec_floor = spec_floor
+                self.state_mgr.draft_allocator = BlockedAllocator(
+                    num_blocks)
+                self.draft_params, self._draft_param_sh = shard_params(
+                    draft_model, self.mesh, dtype, params=draft_params,
+                    seed=config.seed + 1, topology=topology)
+                self._draft_cache_sh = jax.tree.map(
+                    lambda s: NamedSharding(self.mesh, s),
+                    draft_model.paged_cache_specs(),
+                    is_leaf=lambda x: isinstance(x, P))
+                with jax.set_mesh(self.mesh):
+                    self.draft_cache = jax.jit(
+                        lambda: draft_model.init_paged_cache(
+                            num_blocks, BS, dtype=dtype),
+                        out_shardings=self._draft_cache_sh)()
+                self._propose_jit = None
+                self._verify_jit = None
+                self._draft_chunk_jit = None
+                self._install_trace_state()   # now covers the draft
+
         self._pending = deque()
         self._results = {}            # uid -> generated tokens (finished)
         self._rng = jax.random.key(config.seed + 23)
@@ -326,9 +410,11 @@ class InferenceEngineV2:
 
     # ------------------------------------------------------------- requests
     def put(self, prompt, max_new_tokens=32, eos_token_id=-1, uid=None,
-            temperature=None, top_k=None):
+            temperature=None, top_k=None, klass=0):
         """Queue a generation request (sampling params per request, like
-        FastGen; None = the engine-config defaults). Returns its uid."""
+        FastGen; None = the engine-config defaults; ``klass`` = the
+        router's request class, keying the per-class acceptance EMAs in
+        serving telemetry). Returns its uid."""
         if uid is None:
             uid = self._uid_next
             self._uid_next += 1
@@ -359,7 +445,8 @@ class InferenceEngineV2:
                          else float(temperature)),
             top_k=(self.config.top_k if top_k is None else int(top_k))))
         if self.telemetry is not None:
-            self.telemetry.on_submit(uid)   # TTFT clock starts at submit
+            # TTFT clock starts at submit; the class keys acceptance EMAs
+            self.telemetry.on_submit(uid, klass=klass)
         return uid
 
     def is_done(self, uid):
@@ -480,6 +567,13 @@ class InferenceEngineV2:
         # fused-dequant kernels; False = every path dequantizes whole
         # slices as before
         self.model._weight_quant_fused = self._weight_quant
+        draft = getattr(self, "draft_model", None)
+        if draft is not None:
+            # the draft traces under the same kernel knobs but never
+            # under fused weight-quant (its params shard unquantized)
+            draft._paged_kernel = self.config.paged_kernel
+            draft._paged_block_c = self.config.paged_block_c
+            draft._weight_quant_fused = False
 
     @staticmethod
     def _sample_per_slot(logits, rng, temps, top_ks, all_greedy=False):
@@ -643,6 +737,90 @@ class InferenceEngineV2:
                 in_shardings=(self._cache_sh, None, None, None),
                 out_shardings=self._cache_sh)
         return self._cow_jit
+
+    def _get_draft_chunk(self):
+        """Draft-side catch-up chunk: ingest a span of COMMITTED tokens
+        into the draft cache — the draft's prefill. It replays the real
+        token history from the descriptor, so prefix-cache-served
+        prompt tokens (which the target never recomputed) and any
+        plain-decoded stretch before speculation engaged are covered by
+        the same program. Logits are discarded — proposals only come
+        from the propose program."""
+        if self._draft_chunk_jit is None:
+            draft = self.draft_model
+
+            def dchunk(params, cache, ids, tb, to, start, tlen, table):
+                self._install_trace_state()
+                _logits, cache = draft.apply_paged_chunk(
+                    params, ids, cache, tb, to, start, tlen, table)
+                return cache
+
+            self._draft_chunk_jit = jax.jit(
+                dchunk, donate_argnums=(1,),
+                in_shardings=(self._draft_param_sh, self._draft_cache_sh)
+                + (None,) * 6,
+                out_shardings=self._draft_cache_sh)
+        return self._draft_chunk_jit
+
+    def _get_propose(self):
+        """ONE program: a re-ingest step + ``spec_k`` greedy draft
+        decode steps, each proposal feeding the next in-trace (the
+        draft-side analogue of the fused decode dispatch). The
+        re-ingest writes the second-to-last committed token's KV at its
+        own position: after a fully-accepted round that position holds
+        nothing (the draft never saw its own last proposal fed back),
+        and after a partial round the rewrite is byte-idempotent — so
+        the draft needs no per-round gap bookkeeping."""
+        if self._propose_jit is None:
+            draft = self.draft_model
+            k = self._spec_k
+
+            def propose(params, cache, tokens2, lengths, tables):
+                self._install_trace_state()
+                _lg, cache = draft.apply_paged_decode(
+                    params, tokens2[:, 0], lengths, cache, tables)
+                cur = tokens2[:, 1]
+                lengths = lengths + 1
+                props = []
+                for _ in range(k):
+                    logits, cache = draft.apply_paged_decode(
+                        params, cur, lengths, cache, tables)
+                    # only greedy sequences speculate, so the draft is
+                    # always greedy too
+                    cur = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                    lengths = lengths + 1
+                    props.append(cur)
+                return jnp.stack(props, axis=1), cache
+
+            self._propose_jit = jax.jit(
+                propose, donate_argnums=(1,),
+                in_shardings=(self._draft_param_sh, self._draft_cache_sh,
+                              None, None, None),
+                out_shardings=(None, self._draft_cache_sh))
+        return self._propose_jit
+
+    def _get_verify(self):
+        """Batched verify: all k+1 positions of every speculating slot
+        in ONE pass through the split-fuse chunk kernel
+        (apply_paged_verify), returning the target's greedy next token
+        at every position — the host takes the longest accepted prefix
+        plus the bonus token."""
+        if self._verify_jit is None:
+            model = self.model
+
+            def verify(params, cache, tokens, lengths, tables):
+                self._install_trace_state()
+                logits, cache = model.apply_paged_verify(
+                    params, tokens, lengths, cache, tables)
+                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                        cache)
+
+            self._verify_jit = jax.jit(
+                verify, donate_argnums=(1,),
+                in_shardings=(self.param_shardings, self._cache_sh,
+                              None, None, None),
+                out_shardings=(None, self._cache_sh))
+        return self._verify_jit
 
     def _apply_cow(self, seq):
         fn = self._get_cow_copy()
@@ -912,7 +1090,16 @@ class InferenceEngineV2:
             return []
         if self.kv_pool is not None:
             return self._step_offload_decode()
-        batch = mgr.decode_batch()
+        if self.draft_model is not None:
+            return self._step_spec_decode()
+        return self._plain_decode()
+
+    def _plain_decode(self, uids=None):
+        """The pre-speculation decode dispatch, unchanged: n fused
+        decode steps over the given slots (all active slots when
+        ``uids`` is None)."""
+        mgr = self.state_mgr
+        batch = mgr.decode_batch(uids)
         if not batch.active.any():
             return []
         self._rng, sub = jax.random.split(self._rng)
@@ -924,6 +1111,175 @@ class InferenceEngineV2:
                                   batch.temps, batch.top_ks,
                                   not bool(batch.temps.any()))
         return self._post_decode_tokens(batch, np.asarray(toks))
+
+    # ------------------------------------------------- speculative decoding
+    def _spec_candidate(self, seq):
+        """Greedy, not floor-latched, and far enough from its budget
+        tail that a full k-token span stays inside the blocks allocated
+        up-front — tail sequences ride plain decode (at most k extra
+        plain steps), so speculation never writes past a block table."""
+        return (self.draft_model is not None and seq.spec_on
+                and seq.temperature == 0.0
+                and len(seq.prompt) + seq.max_new_tokens
+                - seq.seen_tokens >= self._spec_k)
+
+    @property
+    def spec_pending(self):
+        """True when the next step() would run a verify dispatch — the
+        replica boundary gates its ``serve_verify`` chaos point on
+        this, so chaos tests can target mid-speculation state."""
+        if self.draft_model is None or self._prefill_q:
+            return False
+        mgr = self.state_mgr
+        for uid in mgr._slots:
+            if uid is None:
+                continue
+            seq = mgr.get_sequence(uid)
+            if seq.generated and self._spec_candidate(seq):
+                return True
+        return False
+
+    def _step_spec_decode(self):
+        """Acceptance-aware scheduling: partition the decoding slots
+        into a SPEC set (greedy, latched on, draft pool has room) and a
+        PLAIN set. The spec set runs propose -> batched verify -> host
+        acceptance; the plain set runs the UNCHANGED decode program in
+        its own dispatch — adversarial (low-acceptance) traffic latches
+        off per sequence and pays exactly the plain-decode cost."""
+        mgr = self.state_mgr
+        spec, plain = [], []
+        for uid in list(mgr._slots):
+            if uid is None:
+                continue
+            seq = mgr.get_sequence(uid)
+            if not seq.generated:
+                continue
+            if not self._spec_candidate(seq):
+                plain.append(uid)
+                continue
+            if not seq.draft_blocks and not mgr.alloc_draft(seq):
+                plain.append(uid)     # draft pool full: plain decode
+                continue
+            while seq.draft_len < seq.seen_tokens - 2:
+                self._draft_catchup(seq)
+            spec.append(uid)
+        out = []
+        if spec:
+            out.extend(self._spec_round(spec))
+        if plain:
+            out.extend(self._plain_decode(set(plain)))
+        return out
+
+    def _draft_catchup(self, seq):
+        """Ingest one chunk of committed history into the draft cache
+        (the draft's prefill, riding its own chunk program): tokens
+        [draft_len, seen-1) from prompt+generated, written at their
+        absolute positions in the sequence's draft blocks."""
+        mgr = self.state_mgr
+        BS = mgr.block_size
+        C = self.config.splitfuse_tokens or self.config.prompt_bucket
+        hist = (seq.prompt if not seq.generated else np.concatenate(
+            [seq.prompt, np.asarray(seq.generated, np.int32)]))
+        off = seq.draft_len
+        true_len = min(C, seq.seen_tokens - 1 - off)
+        ids = np.zeros((1, C), np.int32)
+        ids[0, :true_len] = hist[off:off + true_len]
+        idx = np.arange(off, off + true_len)
+        tb = np.zeros((C,), np.int32)
+        to = np.zeros((C,), np.int32)
+        tb[:true_len] = np.asarray(seq.draft_blocks, np.int32)[idx // BS]
+        to[:true_len] = (idx % BS).astype(np.int32)
+        table = np.zeros((self.max_blocks_per_seq,), np.int32)
+        table[:len(seq.draft_blocks)] = seq.draft_blocks
+        fn = self._get_draft_chunk()
+        with jax.set_mesh(self.mesh):
+            self.draft_cache = fn(
+                self.draft_params, self.draft_cache, ids, tb, to,
+                np.int32(off), np.int32(true_len), table)
+        seq.draft_len = off + true_len
+
+    def _spec_round(self, uids):
+        """One propose/verify round for the spec set. Each sequence
+        commits its accepted prefix plus the target's bonus token —
+        every committed token is a target-argmax output, which is what
+        keeps greedy streams byte-identical to plain decode."""
+        mgr, k = self.state_mgr, self._spec_k
+        uid_set = set(uids)
+        pb = mgr.propose_batch(uid_set)
+        with jax.set_mesh(self.mesh):
+            props, self.draft_cache = self._get_propose()(
+                self.draft_params, self.draft_cache, pb.tokens,
+                pb.lengths, pb.block_tables)
+        props = np.asarray(props)                           # (B, k)
+        proposals = {uid: props[slot]
+                     for slot, uid in enumerate(mgr._slots)
+                     if uid in uid_set}
+        vb = mgr.verify_batch(proposals, k)
+        for uid in uids:
+            mgr.begin_spec(mgr.get_sequence(uid), proposals[uid])
+        try:
+            with jax.set_mesh(self.mesh):
+                nxt, self.cache = self._get_verify()(
+                    self.params, self.cache, vb.tokens, vb.lengths,
+                    vb.block_tables)
+            nxt = np.asarray(nxt)                           # (B, k+1)
+        except BaseException:
+            # an interrupted verify must not leave speculative tokens
+            # in ``generated`` — unwind before the failure propagates,
+            # or the replica/router retry would replay corrupt state
+            for uid in uids:
+                mgr.rollback_spec(mgr.get_sequence(uid))
+            raise
+        from .speculative import (SPEC_EMA_ALPHA, SPEC_MIN_ROUNDS,
+                                  longest_accept)
+        out = []
+        for slot, uid in enumerate(list(mgr._slots)):
+            if uid is None or uid not in uid_set:
+                continue
+            seq = mgr.get_sequence(uid)
+            mgr.rollback_spec(seq)
+            pre_seen = seq.seen_tokens
+            d, t = proposals[uid], nxt[slot]
+            a = longest_accept(d, t)
+            commit = [int(x) for x in d[:a]] + [int(t[a])]
+            seq.spec_rounds += 1
+            seq.spec_accepted += a
+            frac = a / k
+            seq.spec_ema = frac if seq.spec_ema is None else \
+                (1 - SPEC_EMA_ALPHA) * seq.spec_ema \
+                + SPEC_EMA_ALPHA * frac
+            if self.telemetry is not None:
+                self.telemetry.on_spec_round(
+                    uid, accepted=a, proposed=k, committed=len(commit))
+            out.extend(self._post_tokens(seq, commit))
+            if uid in self._results or uid not in mgr._seqs:
+                continue                        # retired mid-span
+            # the draft holds the committed history through seen-1 on
+            # a partial round, seen-2 on a full one (its own last
+            # proposal was never fed back; re-ingest covers the gap)
+            seq.draft_len = pre_seen + (a if a < k else k - 1)
+            if seq.spec_rounds >= SPEC_MIN_ROUNDS \
+                    and seq.spec_ema < self._spec_floor:
+                # acceptance floor: latch plain decode for this
+                # sequence and return its over-allocated draft blocks
+                seq.spec_on = False
+                mgr.drop_draft(seq)
+        return out
+
+    def _post_tokens(self, seq, tokens):
+        """Feed a committed multi-token span (accepted proposals +
+        bonus) one at a time: EOS or budget retires mid-span and the
+        tail is discarded, exactly like _post_decode_tokens discards
+        post-finish dispatch tokens. Returns the accepted (uid, token)
+        pairs."""
+        out = []
+        uid = seq.uid
+        for tok in tokens:
+            if uid in self._results:
+                break
+            self._post_token(seq, tok)
+            out.append((uid, tok))
+        return out
 
     def _post_decode_tokens(self, batch, toks):
         """Feed (n, B) decode outputs to their sequences; returns the

@@ -40,6 +40,26 @@ class DSSequenceDescriptor:
     # copy-on-write of the first plen tokens before prefill resumes
     cached_len: int = 0
     cow: tuple = None
+    # Speculative decoding (draft-model propose + batched verify):
+    # ``spec_on`` is the per-sequence eligibility latch — the engine
+    # clears it permanently when the acceptance EMA falls below the
+    # floor or the draft pool cannot hold the sequence, and the
+    # sequence rides plain decode from then on. ``draft_blocks`` is the
+    # sequence's slice of the DRAFT allocator (always whole-owned: the
+    # draft cache never feeds the prefix cache, so rollback/free is a
+    # strict free). ``draft_len`` counts COMMITTED tokens whose KV the
+    # draft cache holds (positions 0..draft_len-1); the propose
+    # program's re-ingest step covers a one-token gap, so the sequence
+    # is spec-eligible while draft_len >= seen_tokens - 2.
+    # ``spec_inflight`` brackets a proposal span tentatively appended
+    # to ``generated`` between begin_spec and rollback_spec.
+    spec_on: bool = True
+    spec_inflight: int = 0
+    draft_blocks: list = field(default_factory=list)
+    draft_len: int = 0
+    spec_ema: float = None
+    spec_rounds: int = 0
+    spec_accepted: int = 0
 
     @property
     def seen_tokens(self):
@@ -73,6 +93,11 @@ class DSStateManager:
         # prefixes back — all block lifetimes then run through
         # refcounts (unref) instead of strict whole-ownership free()
         self.prefix_cache = None
+        # engine-attached DRAFT-pool allocator (speculative decoding):
+        # when set, retire/flush also release each sequence's
+        # draft_blocks so no exit path (EOS, cancel, deadline
+        # withdrawal mid-speculation) can leak draft blocks
+        self.draft_allocator = None
 
     # ------------------------------------------------------------- tracking
     @property
@@ -183,11 +208,13 @@ class DSStateManager:
         else:
             self.allocator.free(seq.blocks)
         seq.blocks = []
+        self.drop_draft(seq)
         seq.done = True
         self._slots[self._slots.index(uid)] = None
 
     def flush(self, uid):
         seq = self._seqs.pop(uid)
+        self.drop_draft(seq)
         if seq.blocks:
             if self.prefix_cache is not None:
                 # cancelled mid-flight: cache contents past the prefill
@@ -198,6 +225,54 @@ class DSStateManager:
                 self.allocator.free(seq.blocks)
             if self._slots.count(uid):
                 self._slots[self._slots.index(uid)] = None
+
+    # ------------------------------------------------------- speculation
+    def alloc_draft(self, seq):
+        """Reserve the sequence's DRAFT-pool blocks (same block count as
+        its target budget — the draft cache mirrors the sequence's
+        position range). Returns False (and latches ``spec_on`` off)
+        when the draft pool cannot hold it; the sequence then rides
+        plain decode, it is never an admission failure."""
+        if self.draft_allocator is None or not seq.spec_on:
+            return False
+        needed = len(seq.blocks)
+        if self.draft_allocator.free_blocks < needed:
+            seq.spec_on = False
+            return False
+        seq.draft_blocks = self.draft_allocator.allocate(needed)
+        return True
+
+    def drop_draft(self, seq):
+        """Return the sequence's draft blocks (fallback latch, retire,
+        cancel — every path that ends speculation frees here, so the
+        draft allocator closes at zero leaked blocks)."""
+        if seq.draft_blocks:
+            self.draft_allocator.free(seq.draft_blocks)
+            seq.draft_blocks = []
+
+    def begin_spec(self, seq, proposals):
+        """Tentatively append the draft's proposals: ``seen_tokens``
+        includes the in-flight span for the duration of the verify
+        dispatch, and ``rollback_spec`` unwinds it. Target/prefix-cache
+        block state is deliberately untouched — rollback must never
+        disturb refcounts (the sequence's blocks cover its full budget
+        up-front, so a speculative span never allocates)."""
+        assert seq.spec_inflight == 0, "nested speculation span"
+        seq.generated.extend(int(t) for t in proposals)
+        seq.spec_inflight = len(proposals)
+
+    def rollback_spec(self, seq, keep=0):
+        """Unwind the in-flight span down to its first ``keep`` accepted
+        tokens: rejected tokens leave ``generated``/``seen_tokens``, and
+        the cache positions they wrote are now past the committed
+        frontier — masked dead by every attention path and overwritten
+        when real tokens land there. Returns the number unwound."""
+        drop = seq.spec_inflight - keep
+        assert drop >= 0
+        if drop:
+            del seq.generated[-drop:]
+        seq.spec_inflight = 0
+        return drop
 
     # ---------------------------------------------------------- step builds
     def token_placement(self, seq):
@@ -210,8 +285,11 @@ class DSStateManager:
         offs = (idx % self.block_size).astype(np.int32)
         return blocks, offs
 
-    def decode_batch(self):
-        """RaggedBatchWrapper for one decode step over all active slots."""
+    def decode_batch(self, uids=None):
+        """RaggedBatchWrapper for one decode step over all active slots.
+        ``uids``: optional subset — the speculative scheduler splits a
+        step into a spec set and a plain set, and the plain set's decode
+        dispatch must carry only its own slots."""
         B, MB = self.max_batch, self.max_blocks_per_seq
         tokens = np.zeros((B,), np.int32)
         lengths = np.zeros((B,), np.int32)
@@ -220,7 +298,7 @@ class DSStateManager:
         temps = np.zeros((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
         for slot, uid in enumerate(self._slots):
-            if uid is None:
+            if uid is None or (uids is not None and uid not in uids):
                 continue
             seq = self._seqs[uid]
             if not seq.generated:
@@ -240,3 +318,58 @@ class DSStateManager:
         return RaggedBatchWrapper(tokens=tokens, lengths=lengths,
                                   block_tables=tables, active=active,
                                   temps=temps, top_ks=top_ks)
+
+    def propose_batch(self, uids):
+        """Draft-side metadata for one propose dispatch over the spec
+        set: tokens (B, 2) = [re-ingest token (position seen-2), start
+        token (position seen-1)], lengths (B,) = seen_tokens - 2, block
+        tables over the DRAFT pool. The re-ingest token erases the
+        draft's one-token catch-up gap: after a fully-accepted round
+        the draft never saw its own last proposal's KV, and after a
+        partial round the rewrite is byte-idempotent — so eligibility
+        never needs per-sequence gap bookkeeping beyond draft_len."""
+        B, MB = self.max_batch, self.max_blocks_per_seq
+        tokens = np.zeros((B, 2), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        tables = np.zeros((B, MB), np.int32)
+        active = np.zeros((B,), bool)
+        for slot, uid in enumerate(self._slots):
+            if uid is None or uid not in uids:
+                continue
+            seq = self._seqs[uid]
+            hist = (seq.prompt[-1] if len(seq.generated) < 2
+                    else seq.generated[-2])
+            tokens[slot] = (int(hist), int(seq.generated[-1]))
+            lengths[slot] = seq.seen_tokens - 2
+            nb = len(seq.draft_blocks)
+            tables[slot, :nb] = seq.draft_blocks
+        return RaggedBatchWrapper(tokens=tokens, lengths=lengths,
+                                  block_tables=tables, active=active)
+
+    def verify_batch(self, proposals, k):
+        """Target-side metadata for one verify dispatch: tokens
+        (B, k+1) = [last committed token, then the k proposals],
+        lengths (B,) = seen_tokens - 1 (the first input token's write
+        position, exactly the plain-decode contract), target block
+        tables. ``proposals``: {uid: [k draft tokens]}. Build this
+        BEFORE begin_spec — the last committed token must not be a
+        proposal."""
+        B, MB = self.max_batch, self.max_blocks_per_seq
+        tokens = np.zeros((B, k + 1), np.int32)
+        lengths = np.zeros((B,), np.int32)
+        tables = np.zeros((B, MB), np.int32)
+        active = np.zeros((B,), bool)
+        for slot, uid in enumerate(self._slots):
+            if uid is None or uid not in proposals:
+                continue
+            seq = self._seqs[uid]
+            assert seq.spec_inflight == 0, \
+                "verify_batch must precede begin_spec"
+            active[slot] = True
+            tokens[slot, 0] = seq.generated[-1]
+            tokens[slot, 1:] = proposals[uid]
+            lengths[slot] = seq.seen_tokens - 1
+            nb = len(seq.blocks)
+            tables[slot, :nb] = seq.blocks
+        return RaggedBatchWrapper(tokens=tokens, lengths=lengths,
+                                  block_tables=tables, active=active)

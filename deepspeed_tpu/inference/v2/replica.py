@@ -147,13 +147,28 @@ class Replica:
             return 0
         return int(pc.match(np.asarray(prompt, np.int32)).cached_len)
 
-    def submit(self, uid, prompt, max_new_tokens, eos_token_id=-1):
+    @property
+    def spec_acceptance(self):
+        """Speculative-decoding acceptance EMA of this replica's engine
+        (global, [0, 1]) — None when the engine has no draft model, no
+        telemetry, or has not run a verify round yet. The router's
+        health snapshot surfaces it per replica."""
+        tel = getattr(self.engine, "telemetry", None)
+        if tel is None:
+            return None
+        fn = getattr(tel, "spec_acceptance_ema", None)
+        return fn() if fn is not None else None
+
+    def submit(self, uid, prompt, max_new_tokens, eos_token_id=-1,
+               klass=0):
         """Hand one admitted request to the engine. ``serve_dispatch``
         fires FIRST (retryable): an injected dispatch failure leaves no
-        partial state and the router re-queues the request."""
+        partial state and the router re-queues the request. ``klass``
+        rides through to the engine so serving telemetry can key its
+        acceptance EMAs by request class."""
         fault_injection.fire("serve_dispatch")
         self.engine.put(prompt, max_new_tokens=max_new_tokens,
-                        eos_token_id=eos_token_id, uid=uid)
+                        eos_token_id=eos_token_id, uid=uid, klass=klass)
         self.inflight.append(uid)
 
     def cancel(self, uid):
@@ -182,6 +197,13 @@ class Replica:
         # — no layer may convert it into a recoverable event.
         try:
             fault_injection.fire("serve_step")
+            if getattr(self.engine, "spec_pending", False):
+                # the next step would run a speculative verify dispatch:
+                # ``serve_verify`` (retryable) models a failure landing
+                # exactly there, while proposals are tentatively
+                # appended — the engine's rollback must leave no trace
+                # and the failover replay must stay byte-identical
+                fault_injection.fire("serve_verify")
             out = self.engine.step()
         except fault_injection.FaultError as e:
             self.step_failures += 1

@@ -502,7 +502,7 @@ class Router:
             self._queue.popleft()
             try:
                 rep.submit(req.uid, req.prompt, req.max_new_tokens,
-                           req.eos_token_id)
+                           req.eos_token_id, klass=req.klass)
             except fault_injection.FaultError:
                 # retryable dispatch fault: nothing partial happened —
                 # back to the front, re-route next round
@@ -575,13 +575,22 @@ class Router:
                 "tpot_ms_p50": percentile(st["tpot_ms"], 50),
                 "tpot_ms_p99": percentile(st["tpot_ms"], 99),
             }
-        return {
+        out = {
             **self.counters,
             "queue_depth": len(self._queue),
             "draining": sum(r.draining for r in self.replicas),
             "replicas": {r.name: r.state for r in self.replicas},
             "classes": classes,
         }
+        # per-replica speculative acceptance EMA — only present when at
+        # least one replica engine actually ran a verify round, so
+        # spec-off fleets keep the pre-speculation snapshot shape
+        spec = {r.name: round(r.spec_acceptance, 3)
+                for r in self.replicas
+                if getattr(r, "spec_acceptance", None) is not None}
+        if spec:
+            out["spec_acceptance_ema"] = spec
+        return out
 
     def _maybe_emit(self):
         if self.monitor is None \

@@ -1,0 +1,88 @@
+"""Speculative-decoding policy: knob resolution and acceptance math.
+
+Draft-model speculation in the v2 engine (Leviathan et al. 2023 /
+DeepSpeed-FastGen style, greedy-only): a small draft model proposes
+``spec_k`` tokens per greedy sequence per step, and the target verifies
+all ``k+1`` positions in one batched multi-token pass through the
+paged-attention verify program. Acceptance is exact-greedy: a proposal
+survives only while it equals the target's argmax at the same position,
+and the first divergence is replaced by the target's own argmax (the
+"bonus" token) — every committed token is a target-argmax output, so
+greedy streams are byte-identical to plain decode.
+
+This module is the host-side policy half: what "auto" resolves to for
+the ``spec_draft`` / ``spec_k`` engine knobs (winner-cache consulted,
+same dispatch discipline as prefix_cache.py), the EMA constants for the
+per-sequence acceptance floor, and the ``longest_accept`` kernel of the
+acceptance rule. The device programs and scheduling live in
+engine_v2.py; block bookkeeping in ragged.py.
+"""
+
+# Hand-set policy defaults — what "auto" resolves to on a COLD winner
+# cache. Unlike prefix_cache, ``enabled: 1`` is the safe cold default
+# here because speculation has a second, explicit opt-in gate: the
+# engine only speculates when a ``draft_model`` was passed to the
+# constructor. With no draft model the resolver is never consulted and
+# every compiled program is byte-identical to the pre-speculation
+# engine; with one, the caller has already asked for speculation and
+# the knobs only shape it. The registry op (autotuning/kernel_registry
+# "spec_decode") re-exports these as its defaults.
+SPEC_DEFAULTS = {
+    "enabled": 1,
+    "spec_k": 4,
+    "floor_pct": 35,     # acceptance-EMA floor, percent of spec_k
+}
+
+# Per-sequence acceptance EMA: ema <- (1-a)*ema + a*(accepted/k) after
+# every verify round. A sequence latches to plain decode once its EMA
+# sits below the floor after at least SPEC_MIN_ROUNDS rounds — enough
+# rounds that one unlucky round can't latch a healthy sequence, few
+# enough that adversarial (random-token) traffic stops paying the
+# draft+verify overhead almost immediately.
+SPEC_EMA_ALPHA = 0.25
+SPEC_MIN_ROUNDS = 3
+
+
+def spec_bucket(B, NB, BS):
+    """Winner-cache bucket for the speculation policy op: batch slots,
+    pool blocks (power-of-two rounded — the draft pool mirrors the
+    target pool, so pool pressure gates whether a draft cache fits),
+    exact block size."""
+    from ...ops.pallas._common import pow2_bucket
+    return f"B{pow2_bucket(B)},NB{pow2_bucket(NB)},BS{int(BS)}"
+
+
+def resolve_spec(spec_draft, spec_k, B, NB, BS, dtype):
+    """Resolve engine ``spec_draft`` / ``spec_k``: "auto" consults the
+    autotune winner cache for this pool-shape bucket (falling back to
+    :data:`SPEC_DEFAULTS` on a miss); True/False and ints force.
+    Returns (enabled, k, floor) with ``floor`` the acceptance-EMA
+    fallback threshold in [0, 1]."""
+    win = None
+    if spec_draft == "auto" or spec_k == "auto":
+        from ...ops.pallas._common import dispatch, dtype_name
+        win = dispatch("spec_decode", spec_bucket(B, NB, BS),
+                       dtype_name(dtype), dict(SPEC_DEFAULTS))
+    enabled = bool(win["enabled"]) if spec_draft == "auto" \
+        else bool(spec_draft)
+    k = int(win["spec_k"]) if spec_k == "auto" else int(spec_k)
+    floor_pct = int(win["floor_pct"]) if win is not None \
+        else SPEC_DEFAULTS["floor_pct"]
+    if k < 1:
+        enabled = False
+    return enabled, k, floor_pct / 100.0
+
+
+def longest_accept(proposed, target_next):
+    """Greedy acceptance: length of the longest prefix of ``proposed``
+    (k draft tokens) matching ``target_next`` (k+1 target argmaxes,
+    where ``target_next[j]`` is the target's prediction *after* seeing
+    proposal j tokens of context). Position j is accepted iff
+    ``proposed[j] == target_next[j]``; the first mismatch — whose
+    correct replacement is ``target_next[a]`` — ends the round."""
+    a = 0
+    for j in range(len(proposed)):
+        if int(proposed[j]) != int(target_next[j]):
+            break
+        a += 1
+    return a

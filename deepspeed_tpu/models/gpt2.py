@@ -1136,6 +1136,92 @@ class GPT2:
             vs_out.append(vc)
         return self.head(params, x)[:, 0], {"k": ks_out, "v": vs_out}
 
+    def apply_paged_verify(self, params, tokens, lengths, cache,
+                           block_tables):
+        """Speculative-verify step: C tokens per slot in ONE pass.
+
+        tokens: (B, C) — per slot, the last committed token followed by
+        the draft proposals; lengths: (B,) tokens already in cache (the
+        first input token's position, i.e. ``seen_tokens - 1``);
+        block_tables: (B, MB) as in decode (inactive slots all-scratch
+        with lengths 0). Returns (logits (B, C, V), cache) — logits at
+        EVERY position, so the host can take the longest accepted
+        prefix plus the bonus token.
+
+        This is the batched split-fuse ride: each slot's C-token span is
+        a chunk with ``start=lengths[b]``/``true_len=C`` through the
+        same ``paged_chunk_attention`` kernel the prefill chunks use;
+        the dense fallback is the batched gather the decode reference
+        uses, with a per-slot causal frontier. Writes beyond a slot's
+        committed frontier land in its already-allocated blocks and are
+        either committed (accepted) or harmlessly overwritten next step
+        (rejected) — callers guarantee every slot has k tokens of block
+        budget left (the engine never speculates inside the tail).
+        """
+        cfg = self.config
+        dt = _dtype(cfg)
+        B, C = tokens.shape
+        H, hd = cfg.n_head, cfg.d_head
+        BS = cache["k"][0].shape[2]
+        MB = block_tables.shape[1]
+        S = MB * BS
+
+        linpos = lengths[:, None] + jnp.arange(C)[None, :]       # (B, C)
+        pos = jnp.minimum(linpos, cfg.max_seq_len - 1)
+        x = (params["wte"][tokens] + params["wpe"][pos]).astype(dt)
+        dst_block = jnp.take_along_axis(
+            block_tables, jnp.minimum(linpos // BS, MB - 1), axis=1)
+        dst_off = linpos % BS
+        fb, fo = dst_block.reshape(-1), dst_off.reshape(-1)
+        q_pos = linpos[:, :, None]                            # (B, C, 1)
+        k_pos = jnp.arange(S)[None, None, :]                  # (1, 1, S)
+        mask = (k_pos <= q_pos) \
+            & (k_pos < (lengths + C)[:, None, None])
+        from ..ops.pallas.paged_attention import (paged_chunk_attention,
+                                                  resolve_paged_chunk)
+        use_kernel, block_c = resolve_paged_chunk(
+            getattr(self, "_paged_kernel", "auto"),
+            getattr(self, "_paged_block_c", "auto"),
+            C, MB, BS, H, 1, hd, dt)
+
+        ks_out, vs_out = [], []
+        for i in range(cfg.n_layer):
+            layer = self._layer_slice(params, i)
+            kc0, vc0 = cache["k"][i], cache["v"][i]
+            w = cfg.attn_layer_windows[i] if cfg.attn_layer_windows else 0
+            m = mask & (q_pos - k_pos < w) if w else mask
+
+            def attn_fn(q, kk, v, kc0=kc0, vc0=vc0, m=m, w=w):
+                kc = kc0.at[fb, :, fo].set(
+                    kk.reshape(B * C, H, hd).astype(kc0.dtype))
+                vc = vc0.at[fb, :, fo].set(
+                    v.reshape(B * C, H, hd).astype(vc0.dtype))
+                if use_kernel:
+                    attn = jnp.stack([
+                        paged_chunk_attention(
+                            q[b], kc, vc, block_tables[b], lengths[b],
+                            jnp.int32(C),
+                            scale=None if cfg.scale_attn else 1.0,
+                            window=w, block_c=block_c)
+                        for b in range(B)])
+                    return attn, (kc, vc)
+                gk = kc[block_tables].transpose(0, 1, 3, 2, 4) \
+                    .reshape(B, S, H, hd)
+                gv = vc[block_tables].transpose(0, 1, 3, 2, 4) \
+                    .reshape(B, S, H, hd)
+                scores = jnp.einsum("bthd,bshd->bhts", q, gk,
+                                    preferred_element_type=jnp.float32)
+                if cfg.scale_attn:
+                    scores = scores / math.sqrt(hd)
+                scores = jnp.where(m[:, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+                return jnp.einsum("bhts,bshd->bthd", probs, gv), (kc, vc)
+
+            x, (kc, vc) = self._block_core(x, layer, attn_fn)
+            ks_out.append(kc)
+            vs_out.append(vc)
+        return self.head(params, x), {"k": ks_out, "v": vs_out}
+
     # --- loss ---
     def loss(self, params, batch, *, rng=None, train=True, seq_sharded=False,
              ltd_keep=None):

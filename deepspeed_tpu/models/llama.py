@@ -898,3 +898,86 @@ class Llama:
             ks_out.append(kc)
             vs_out.append(vc)
         return self.head(params, x)[:, 0], {"k": ks_out, "v": vs_out}
+
+    def apply_paged_verify(self, params, tokens, lengths, cache,
+                           block_tables):
+        """Speculative-verify step: C tokens per slot in ONE pass (see
+        GPT2.apply_paged_verify — same contract; llama families add
+        RoPE at each slot's absolute positions, GQA-native kernel reads,
+        and the ALiBi/sliding-window biases of the chunk path).
+
+        tokens: (B, C); lengths: (B,) = first input token's position;
+        block_tables: (B, MB). Returns (logits (B, C, V), cache)."""
+        cfg = self.config
+        dt = jnp.dtype(cfg.dtype)
+        B, C = tokens.shape
+        H, KVH, hd = cfg.n_head, cfg.n_kv_heads, cfg.d_head
+        BS = cache["k"][0].shape[2]
+        MB = block_tables.shape[1]
+        S = MB * BS
+        linpos = lengths[:, None] + jnp.arange(C)[None, :]       # (B, C)
+        pos = jnp.minimum(linpos, cfg.max_seq_len - 1)
+        x = params["wte"][tokens].astype(dt)
+        if cfg.embed_norm:
+            x = _layer_norm(x, params["embed_ln_s"], params["embed_ln_b"],
+                            cfg.rms_eps)
+        dst_block = jnp.take_along_axis(
+            block_tables, jnp.minimum(linpos // BS, MB - 1), axis=1)
+        dst_off = linpos % BS
+        fb, fo = dst_block.reshape(-1), dst_off.reshape(-1)
+        q_pos = linpos[:, :, None]                            # (B, C, 1)
+        k_pos = jnp.arange(S)[None, None, :]                  # (1, 1, S)
+        mask = (k_pos <= q_pos) \
+            & (k_pos < (lengths + C)[:, None, None])
+        mask = self._window_mask(mask, q_pos, k_pos)
+        from ..ops.pallas.paged_attention import (paged_chunk_attention,
+                                                  resolve_paged_chunk)
+        use_kernel, block_c = resolve_paged_chunk(
+            False if cfg.alibi else getattr(self, "_paged_kernel",
+                                            "auto"),   # no bias input
+            getattr(self, "_paged_block_c", "auto"),
+            C, MB, BS, KVH, H // KVH, hd, dt)
+
+        ks_out, vs_out = [], []
+        for i in range(cfg.n_layer):
+            layer = self._layer_slice(params, i)
+            kc0, vc0 = cache["k"][i], cache["v"][i]
+            q, kk, v = self._attn_proj(x, layer)       # (B, C, ., hd)
+            q = self._rope(q, pos)
+            kk = self._rope(kk, pos)
+            kc = kc0.at[fb, :, fo].set(
+                kk.reshape(B * C, KVH, hd).astype(kc0.dtype))
+            vc = vc0.at[fb, :, fo].set(
+                v.reshape(B * C, KVH, hd).astype(vc0.dtype))
+            if use_kernel:
+                attn = jnp.stack([
+                    paged_chunk_attention(
+                        q[b], kc, vc, block_tables[b], lengths[b],
+                        jnp.int32(C), window=cfg.sliding_window,
+                        block_c=block_c)
+                    for b in range(B)])
+            else:
+                gk = kc[block_tables].transpose(0, 1, 3, 2, 4) \
+                    .reshape(B, S, KVH, hd)
+                gv = vc[block_tables].transpose(0, 1, 3, 2, 4) \
+                    .reshape(B, S, KVH, hd)
+                gk = _repeat_kv(gk, H // KVH)
+                gv = _repeat_kv(gv, H // KVH)
+                scores = jnp.einsum("bthd,bshd->bhts", q, gk,
+                                    preferred_element_type=jnp.float32)
+                scores = scores / math.sqrt(hd)
+                if cfg.alibi:
+                    scores = scores + self._alibi_bias(
+                        jnp.arange(S))[None, :, None, :]
+                scores = jnp.where(mask[:, None], scores, -1e30)
+                probs = jax.nn.softmax(scores, axis=-1).astype(dt)
+                attn = jnp.einsum("bhts,bshd->bthd", probs, gv)
+            attn_out = self._wo(attn.reshape(B, C, H * hd), layer)
+            if cfg.parallel_block:
+                x = x + attn_out + self._mlp(x, layer)
+            else:
+                x = x + attn_out
+                x = x + self._mlp(x, layer)
+            ks_out.append(kc)
+            vs_out.append(vc)
+        return self.head(params, x), {"k": ks_out, "v": vs_out}

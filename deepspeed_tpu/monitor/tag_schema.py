@@ -153,6 +153,17 @@ TAG_SCHEMA = {
         "cumulative copy-on-write block copies (partial-tail prefix "
         "hits that diverge inside a shared block)",
 
+    # --- speculative decoding (inference/v2/speculative.py; emitted
+    #     only once the engine has run a verify round) ---
+    "Serve/Telemetry/spec_rounds":
+        "cumulative speculative verify rounds since engine construction",
+    "Serve/Telemetry/spec_acceptance_pct":
+        "draft tokens accepted by greedy verification, pct of all "
+        "proposed since engine construction",
+    "Serve/Telemetry/spec_tokens_per_verify_step":
+        "tokens committed per verify round (accepted prefix + bonus "
+        "token; 1.0 would mean speculation is pure overhead)",
+
     # --- serving fleet router (inference/v2/router.py; step = completed
     #     router requests) ---
     "Serve/Router/shed":

@@ -812,13 +812,25 @@ class ServingTelemetry:
         # when set, its hit/eviction/CoW counters ride percentiles()
         # and the Serve/Telemetry fan-out
         self._prefix_cache = None
+        # speculative decoding: per-round counters plus acceptance-rate
+        # EMAs keyed by request class (the router's priority klass) —
+        # all zero/empty and absent from percentiles() until the first
+        # on_spec_round, so spec-off snapshots stay byte-identical
+        self._klass = {}                 # uid -> request class
+        self.spec_rounds = 0
+        self.spec_proposed = 0
+        self.spec_accepted = 0
+        self.spec_committed = 0
+        self._spec_ema = None            # global acceptance EMA
+        self._spec_class_ema = {}        # klass -> acceptance EMA
         self._t0 = time.perf_counter()
 
     def attach_prefix_cache(self, cache):
         self._prefix_cache = cache
 
-    def on_submit(self, uid):
+    def on_submit(self, uid, klass=0):
         self._live[uid] = _ReqTimes(time.perf_counter())
+        self._klass[uid] = int(klass)
 
     def on_token(self, uid):
         """First token => TTFT sample; later tokens accumulate for the
@@ -850,9 +862,37 @@ class ServingTelemetry:
         if active is not None:
             self.active = int(active)
 
+    def on_spec_round(self, uid, accepted, proposed, committed):
+        """One speculative verify round for ``uid``: ``accepted`` of
+        ``proposed`` draft tokens survived greedy verification and
+        ``committed`` tokens (accepted + bonus) entered the stream.
+        Updates the global and per-request-class acceptance EMAs the
+        scheduler/router read for fallback and placement."""
+        self.spec_rounds += 1
+        self.spec_proposed += int(proposed)
+        self.spec_accepted += int(accepted)
+        self.spec_committed += int(committed)
+        frac = accepted / max(1, proposed)
+        a = 0.25                          # matches SPEC_EMA_ALPHA
+        self._spec_ema = frac if self._spec_ema is None \
+            else (1 - a) * self._spec_ema + a * frac
+        k = self._klass.get(uid, 0)
+        prev = self._spec_class_ema.get(k)
+        self._spec_class_ema[k] = frac if prev is None \
+            else (1 - a) * prev + a * frac
+
+    def spec_acceptance_ema(self, klass=None):
+        """Acceptance-rate EMA in [0, 1] — per request class when
+        ``klass`` is given, global otherwise; None before the first
+        verify round (spec off, or nothing speculated yet)."""
+        if klass is None:
+            return self._spec_ema
+        return self._spec_class_ema.get(int(klass))
+
     def on_finish(self, uid):
         st = self._live.pop(uid, None)
         self._started.pop(uid, None)
+        self._klass.pop(uid, None)
         if st is not None and st.t_first is not None:
             self._flush_pending(st, time.perf_counter())
         self.completed += 1
@@ -867,6 +907,7 @@ class ServingTelemetry:
         completion."""
         st = self._live.pop(uid, None)
         self._started.pop(uid, None)
+        self._klass.pop(uid, None)
         if st is not None:
             self.rejected += 1
 
@@ -891,6 +932,19 @@ class ServingTelemetry:
                 s["cached_tokens"] / elapsed, 1)
             out["prefix_evictions"] = s["evicted_blocks"]
             out["cow_copies"] = s["cow_copies"]
+        if self.spec_rounds:
+            # only present once a verify round ran: the zero-verify-step
+            # guard — spec-off (and spec-on-but-idle) windows carry no
+            # spec keys at all rather than NaN/zero-division rows
+            out["spec_rounds"] = self.spec_rounds
+            out["spec_acceptance_pct"] = round(
+                100.0 * self.spec_accepted / max(1, self.spec_proposed),
+                1)
+            out["spec_tokens_per_verify_step"] = round(
+                self.spec_committed / self.spec_rounds, 2)
+            out["spec_class_acceptance_ema"] = {
+                k: round(v, 3)
+                for k, v in sorted(self._spec_class_ema.items())}
         return out
 
     def maybe_emit(self):
@@ -915,7 +969,16 @@ class ServingTelemetry:
                 ("Serve/Telemetry/cached_tokens_per_sec",
                  "cached_tokens_per_sec"),
                 ("Serve/Telemetry/prefix_evictions", "prefix_evictions"),
-                ("Serve/Telemetry/cow_copies", "cow_copies")):
+                ("Serve/Telemetry/cow_copies", "cow_copies"),
+                # speculative decoding (only present once a verify
+                # round ran; spec_class_acceptance_ema is a dict and
+                # rides percentiles()/snapshots only, not the scalar
+                # event fan-out)
+                ("Serve/Telemetry/spec_rounds", "spec_rounds"),
+                ("Serve/Telemetry/spec_acceptance_pct",
+                 "spec_acceptance_pct"),
+                ("Serve/Telemetry/spec_tokens_per_verify_step",
+                 "spec_tokens_per_verify_step")):
             if p.get(key) is not None:
                 events.append((tag, p[key], step))
         self.monitor.write_events(events)

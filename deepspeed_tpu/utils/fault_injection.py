@@ -63,6 +63,14 @@ Injection points currently wired (grep for ``fault_injection.fire``):
                   recovery layer, like the elastic agent for
                   host_loss) re-enqueues its in-flight requests and
                   replays them on a survivor
+  serve_verify    inference/v2/replica.py Replica.step, once per
+                  iteration whose next engine step would run a
+                  speculative verify dispatch (``engine.spec_pending``)
+                  — arming it models a failure landing mid-speculation
+                  (retryable: the replica health machine counts it like
+                  serve_step; the engine's rollback must leave no
+                  speculative tokens behind and a failover replay must
+                  stay byte-identical)
   router_overload inference/v2/router.py overload detection, once per
                   router step — arming it injects a forced overload
                   round (advisory: load is shed as typed Overloaded
@@ -109,6 +117,7 @@ KNOWN_POINTS = (
     "reshape",
     "serve_dispatch",
     "serve_step",
+    "serve_verify",
     "replica_death",
     "router_overload",
 )
@@ -146,6 +155,7 @@ BLAST_RADIUS = {
     # counted service decision and must never take a replica down.
     "serve_dispatch": "retryable",
     "serve_step": "retryable",
+    "serve_verify": "retryable",
     "replica_death": "fatal",
     "router_overload": "advisory",
 }

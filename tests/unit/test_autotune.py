@@ -47,6 +47,7 @@ _BUCKETS = {
     "paged_chunk": "C16,MB4,BS16,kh2,g2,d32",
     "pipe_microbatch": "S2,B8,T128,D128",
     "prefix_cache": "B4,NB16,BS16",
+    "spec_decode": "B4,NB16,BS16",
     # collective-bearing ops (autotuning/collective_ops.py): the mesh
     # topology signature is folded into the bucket string; the step
     # builders clamp requested axes to the devices actually present, so

@@ -204,6 +204,9 @@ def test_serving_points_declare_expected_blast_radius():
     br = fault_injection.BLAST_RADIUS
     assert br["serve_dispatch"] == "retryable"
     assert br["serve_step"] == "retryable"
+    # ISSUE-19: a verify-dispatch failure mid-speculation is owned by
+    # the same replica health machine as serve_step — never fatal
+    assert br["serve_verify"] == "retryable"
     assert br["replica_death"] == "fatal"
     assert br["router_overload"] == "advisory"
 

@@ -1,0 +1,394 @@
+"""Speculative decoding (tier-1, ISSUE 19): acceptance math, knob
+resolution, host-side rollback accounting (seen_tokens unwind, draft
+block frees, prefix-cache refcounts surviving rejection), greedy
+byte-identity spec-on vs spec-off, the acceptance-floor fallback latch,
+DeadlineExceeded withdrawal mid-speculation through the router, and the
+``serve_verify`` chaos point (retryable absorb + failover replay).
+
+Engines follow the test_router.py fast pattern: tiny GPT2, module-cached
+params, compile-heavy clean-completion tests share engines."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deepspeed_tpu.autotuning import kernel_dispatch
+from deepspeed_tpu.inference.v2 import (DeadlineExceeded,
+                                        InferenceEngineV2, Router)
+from deepspeed_tpu.inference.v2.ragged import DSStateManager
+from deepspeed_tpu.inference.v2.speculative import (SPEC_DEFAULTS,
+                                                    SPEC_MIN_ROUNDS,
+                                                    longest_accept,
+                                                    resolve_spec)
+from deepspeed_tpu.models import GPT2, GPT2Config
+from deepspeed_tpu.utils import fault_injection, groups
+
+
+@pytest.fixture(autouse=True)
+def _pristine_dispatch(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSTPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "kernel_autotune.json"))
+    monkeypatch.delenv("DSTPU_AUTOTUNE", raising=False)
+    kernel_dispatch.reset()
+    fault_injection.reset()
+    yield
+    fault_injection.reset()
+    kernel_dispatch.reset()
+
+
+_CFG = GPT2Config(n_layer=2, n_head=4, d_model=64, max_seq_len=128,
+                  vocab_size=256, remat=False, dtype="float32")
+_DCFG = GPT2Config(n_layer=1, n_head=2, d_model=32, max_seq_len=128,
+                   vocab_size=256, remat=False, dtype="float32")
+_PARAMS = {}
+
+
+def _params(which="t"):
+    if which not in _PARAMS:
+        _PARAMS[which] = (GPT2(_CFG).init(jax.random.key(0)) if which == "t"
+                          else GPT2(_DCFG).init(jax.random.key(1)))
+    return _PARAMS[which]
+
+
+_BASE = {"dtype": "float32", "kv_block_size": 8, "prompt_bucket": 16,
+         "max_batch_size": 2, "splitfuse_tokens": 16,
+         "decode_steps_per_dispatch": 2}
+
+
+def _engine(spec=False, **kw):
+    groups.reset()
+    draft = {}
+    if spec:
+        draft = dict(draft_model=GPT2(_DCFG), draft_params=_params("d"))
+        kw.setdefault("spec_draft", True)
+        kw.setdefault("spec_k", 4)
+    return InferenceEngineV2(GPT2(_CFG), params=_params("t"),
+                             config=dict(_BASE, **kw), **draft)
+
+
+# compile-heavy clean-completion tests share one plain + one spec engine
+_SHARED = {}
+
+
+def _shared(spec):
+    key = "spec" if spec else "plain"
+    if key not in _SHARED:
+        _SHARED[key] = _engine(spec=spec)
+    return _SHARED[key]
+
+
+def _prompts(seed, n, lo=6, hi=20):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(1, 255, size=rs.randint(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _run(router, max_rounds=400):
+    rounds = 0
+    while router.has_work:
+        router.step()
+        rounds += 1
+        assert rounds < max_rounds, "router failed to drain"
+    return rounds
+
+
+def _pools_closed(eng):
+    alloc = eng.state_mgr.allocator
+    tree = eng.prefix_cache.tree_blocks if eng.prefix_cache else 0
+    assert alloc.free_blocks + tree == alloc.total_blocks
+    da = eng.state_mgr.draft_allocator
+    if da is not None:
+        assert da.free_blocks == da.total_blocks, "leaked draft blocks"
+
+
+# ---------------------------------------------------------------------------
+# pure host math + knob resolution
+# ---------------------------------------------------------------------------
+
+class TestAcceptanceMath:
+    def test_longest_accept(self):
+        assert longest_accept([1, 2, 3], [1, 2, 3, 4]) == 3
+        assert longest_accept([1, 9, 3], [1, 2, 3, 4]) == 1
+        assert longest_accept([9, 2, 3], [1, 2, 3, 4]) == 0
+        assert longest_accept([], [7]) == 0
+        # stops at the FIRST mismatch even if later positions re-align
+        assert longest_accept([1, 9, 3, 4], [1, 2, 3, 4, 5]) == 1
+
+    def test_resolve_spec_cold_defaults(self):
+        on, k, floor = resolve_spec("auto", "auto", B=4, NB=64, BS=8,
+                                    dtype="float32")
+        assert on == bool(SPEC_DEFAULTS["enabled"])
+        assert k == SPEC_DEFAULTS["spec_k"]
+        assert floor == SPEC_DEFAULTS["floor_pct"] / 100.0
+
+    def test_resolve_spec_forced(self):
+        on, k, _ = resolve_spec(False, 8, B=4, NB=64, BS=8,
+                                dtype="float32")
+        assert on is False and k == 8
+        on, k, _ = resolve_spec(True, 2, B=4, NB=64, BS=8,
+                                dtype="float32")
+        assert on is True and k == 2
+
+
+# ---------------------------------------------------------------------------
+# rollback accounting: host-only, no device programs involved
+# ---------------------------------------------------------------------------
+
+class TestRollbackAccounting:
+    def _mgr(self):
+        from deepspeed_tpu.inference.v2 import BlockedAllocator
+        m = DSStateManager(num_blocks=17, block_size=4, max_batch=2,
+                           max_blocks_per_seq=4)
+        m.draft_allocator = BlockedAllocator(17)
+        return m
+
+    def test_rollback_unwinds_seen_tokens_exactly(self):
+        m = self._mgr()
+        _, seq = m.admit(1, np.arange(6), max_new_tokens=8)
+        seq.generated.append(42)
+        pre = seq.seen_tokens
+        m.begin_spec(seq, [7, 8, 9, 10])
+        assert seq.seen_tokens == pre + 4
+        with pytest.raises(AssertionError):
+            m.begin_spec(seq, [1])          # nested span forbidden
+        assert m.rollback_spec(seq) == 4
+        assert seq.seen_tokens == pre
+        assert seq.generated == [42]
+        assert seq.spec_inflight == 0
+
+    def test_rollback_keeps_accepted_prefix(self):
+        m = self._mgr()
+        _, seq = m.admit(1, np.arange(6), max_new_tokens=8)
+        seq.generated.append(42)
+        m.begin_spec(seq, [7, 8, 9, 10])
+        assert m.rollback_spec(seq, keep=2) == 2
+        assert seq.generated == [42, 7, 8]
+
+    def test_draft_blocks_freed_on_every_exit_path(self):
+        m = self._mgr()
+        da = m.draft_allocator
+        total = da.free_blocks
+        _, s1 = m.admit(1, np.arange(6), max_new_tokens=8)
+        _, s2 = m.admit(2, np.arange(6), max_new_tokens=8)
+        assert m.alloc_draft(s1) and m.alloc_draft(s2)
+        assert da.free_blocks == total - len(s1.blocks) - len(s2.blocks)
+        m.retire(1)                      # EOS/budget exit
+        m.flush(1)
+        m.flush(2)                       # cancel exit (no retire first)
+        assert da.free_blocks == total
+        assert s1.draft_blocks == [] and s2.draft_blocks == []
+
+    def test_draft_pool_exhaustion_latches_plain_decode(self):
+        from deepspeed_tpu.inference.v2 import BlockedAllocator
+        m = DSStateManager(num_blocks=17, block_size=4, max_batch=2,
+                           max_blocks_per_seq=4)
+        m.draft_allocator = BlockedAllocator(3)   # room for 2 blocks
+        _, s1 = m.admit(1, np.arange(9), max_new_tokens=7)  # 4 blocks
+        assert not m.alloc_draft(s1)
+        assert s1.spec_on is False                # latched, not an error
+        assert not m.alloc_draft(s1)              # latch is sticky
+
+    def test_prefix_cache_refcounts_survive_rollback(self):
+        """begin/rollback never touch block state: a sequence whose
+        prompt was served from shared (refcount > 1) prefix-cache
+        blocks keeps exactly its refs across a rejected span, and
+        retire closes the accounting."""
+        from deepspeed_tpu.inference.v2 import BlockedAllocator
+        from deepspeed_tpu.inference.v2.prefix_cache import PrefixCache
+        m = DSStateManager(num_blocks=17, block_size=4, max_batch=2,
+                           max_blocks_per_seq=4)
+        m.draft_allocator = BlockedAllocator(17)
+        m.prefix_cache = PrefixCache(m.allocator, 4, min_match_blocks=1)
+        toks = np.arange(8, dtype=np.int32)
+        m.prefix_cache.release(toks.tolist(), m.allocator.allocate(2))
+        _, seq = m.admit(1, np.concatenate([toks, [99, 98, 97]]),
+                         max_new_tokens=5)
+        assert seq.cached_len > 0, "prefix hit expected"
+        shared = seq.blocks[0]
+        refs_before = m.allocator.refcount(shared)
+        assert refs_before == 2          # tree ref + sequence ref
+        seq.generated.append(42)
+        m.begin_spec(seq, [7, 8, 9])
+        m.rollback_spec(seq)
+        assert m.allocator.refcount(shared) == refs_before
+        m.retire(1)
+        m.flush(1)
+        # every block free or tree-adopted, nothing double-unreffed
+        assert m.allocator.free_blocks + m.prefix_cache.tree_blocks \
+            == m.allocator.total_blocks
+
+
+# ---------------------------------------------------------------------------
+# engine: greedy byte-identity + acceptance-floor fallback
+# ---------------------------------------------------------------------------
+
+class TestEngineSpeculates:
+    def test_greedy_spec_on_matches_spec_off(self):
+        prompts = _prompts(1, 3)
+        ref = _shared(False).generate_all(prompts, max_new_tokens=10)
+        eng = _shared(True)
+        assert eng.draft_model is not None
+        outs = eng.generate_all(prompts, max_new_tokens=10)
+        for o, r in zip(outs, ref):
+            np.testing.assert_array_equal(o, r)
+        _pools_closed(eng)
+        # speculation actually ran (not silently plain decode) and the
+        # telemetry guard keys appeared
+        p = eng.telemetry.percentiles()
+        assert p.get("spec_rounds", 0) > 0
+        assert p["spec_tokens_per_verify_step"] >= 1.0
+
+    def test_sampled_sequences_never_speculate(self):
+        """temperature > 0 rides plain decode: speculation is greedy
+        acceptance only."""
+        eng = _shared(True)
+        uid = eng.put(_prompts(2, 1)[0], max_new_tokens=6,
+                      temperature=0.8, top_k=4)
+        rounds0 = eng.telemetry.spec_rounds
+        while eng.has_work:
+            eng.step()
+        out = eng.get(uid)
+        assert len(out) == 6
+        assert eng.telemetry.spec_rounds == rounds0
+        _pools_closed(eng)
+
+    def test_acceptance_floor_latches_fallback_and_output_is_identical(
+            self):
+        """With the floor forced above any achievable EMA, every
+        sequence latches to plain decode after SPEC_MIN_ROUNDS verify
+        rounds — and the output stays byte-identical (fallback is the
+        unchanged plain program)."""
+        prompts = _prompts(1, 2)
+        # long enough that SPEC_MIN_ROUNDS verify rounds happen before
+        # the budget retires the sequence even at full acceptance
+        # (k+1 commits per round)
+        ref = _shared(False).generate_all(prompts, max_new_tokens=18)
+        eng = _shared(True)
+        floor0 = eng._spec_floor
+        try:
+            eng._spec_floor = 1.1
+            uids = [eng.put(p, max_new_tokens=18) for p in prompts]
+            latched = {}
+            while eng.has_work:
+                eng.step()
+                for uid in uids:
+                    seq = eng.state_mgr._seqs.get(uid)
+                    if seq is not None and not seq.spec_on:
+                        latched[uid] = (seq.spec_rounds,
+                                        list(seq.draft_blocks))
+            for uid, r in zip(uids, ref):
+                np.testing.assert_array_equal(eng.get(uid), r)
+            assert set(latched) == set(uids), "floor never latched"
+            for rounds, draft_blocks in latched.values():
+                assert rounds >= SPEC_MIN_ROUNDS
+                assert draft_blocks == []    # latch returned the blocks
+            _pools_closed(eng)
+        finally:
+            eng._spec_floor = floor0
+
+    def test_spec_draft_true_without_draft_model_raises(self):
+        with pytest.raises(ValueError, match="requires a draft model"):
+            groups.reset()
+            InferenceEngineV2(GPT2(_CFG), params=_params("t"),
+                              config=dict(_BASE, spec_draft=True))
+
+    def test_vocab_mismatch_raises(self):
+        bad = GPT2Config(n_layer=1, n_head=2, d_model=32,
+                         max_seq_len=128, vocab_size=128, remat=False,
+                         dtype="float32")
+        with pytest.raises(ValueError, match="vocab mismatch"):
+            groups.reset()
+            InferenceEngineV2(GPT2(_CFG), params=_params("t"),
+                              config=dict(_BASE, spec_draft=True),
+                              draft_model=GPT2(bad))
+
+
+# ---------------------------------------------------------------------------
+# router: deadline withdrawal mid-speculation + serve_verify chaos
+# ---------------------------------------------------------------------------
+
+class TestRouterIntegration:
+    def test_deadline_withdrawal_mid_speculation(self):
+        """A request expiring while its sequence is actively
+        speculating is withdrawn through cancel() -> flush(): typed
+        DeadlineExceeded, target AND draft pools close with zero
+        leaked blocks."""
+        eng = _shared(True)
+        router = Router([eng])
+        clock = {"t": 0.0}
+        router._now = lambda: clock["t"]
+        uid = router.put(_prompts(3, 1)[0], max_new_tokens=64,
+                         deadline_ms=5000)
+        for _ in range(3):
+            router.step()                      # genuinely decoding
+        req = router._reqs[uid]
+        assert req.state == "inflight" and req.n_tokens > 0
+        seq = eng.state_mgr._seqs[uid]
+        assert seq.draft_blocks, "speculation never engaged"
+        clock["t"] = 10.0
+        router.step()
+        with pytest.raises(DeadlineExceeded):
+            router.get(uid)
+        assert uid not in eng.state_mgr._seqs
+        _pools_closed(eng)
+        assert not router.has_work
+
+    def test_serve_verify_fault_is_absorbed_and_output_identical(self):
+        """Retryable ``serve_verify`` faults below the health threshold
+        are absorbed by the replica health machine; the engine's
+        rollback leaves no speculative tokens behind, so the final
+        stream is still byte-identical to plain decode."""
+        prompts = _prompts(1, 1)
+        ref = _shared(False).generate_all(prompts, max_new_tokens=10)
+        eng = _shared(True)
+        router = Router([eng], max_step_failures=3)
+        fault_injection.arm("serve_verify", fails=2)   # absorbed: 2 < 3
+        uid = router.put(prompts[0], max_new_tokens=10)
+        _run(router)
+        assert router.replicas[0].live
+        assert router.replicas[0].step_failures == 2
+        assert fault_injection.injector.hits("serve_verify") == 2
+        np.testing.assert_array_equal(router.get(uid), ref[0])
+        _pools_closed(eng)
+
+    def test_serve_verify_heartbeat_break_fails_over_byte_identically(
+            self):
+        """PR 17 failover replay covering speculation state: the
+        speculating replica breaks its heartbeat on armed serve_verify
+        faults mid-speculation, the router replays on the survivor, and
+        the replayed greedy stream is byte-identical."""
+        prompts = _prompts(1, 1)
+        ref = _shared(False).generate_all(prompts, max_new_tokens=10)
+        # one fresh engine to kill; the survivor reuses the shared spec
+        # engine (nothing after this test touches it) — a full fresh
+        # compile of a second spec engine buys no extra coverage
+        e1, e2 = _engine(spec=True), _shared(True)
+        router = Router([e1, e2], max_step_failures=2)
+        uid = router.put(prompts[0], max_new_tokens=10)
+        fault_injection.arm("serve_verify", fails=2)   # breaks heartbeat
+        _run(router)
+        snap = router.snapshot()
+        assert snap["failovers"] == 1 and snap["replayed"] == 1
+        assert sum(r.dead for r in router.replicas) == 1
+        np.testing.assert_array_equal(router.get(uid), ref[0])
+        # the survivor ran verify rounds -> snapshot surfaces its EMA
+        snap = router.snapshot()
+        assert "spec_acceptance_ema" in snap
+        survivor = next(r for r in router.replicas if r.live)
+        assert 0.0 <= snap["spec_acceptance_ema"][survivor.name] <= 1.0
+        _pools_closed(next(r.engine for r in router.replicas if r.live))
+
+    def test_spec_off_snapshot_has_no_spec_keys(self):
+        """Zero-verify guard at the router layer: a spec-off fleet's
+        snapshot carries no spec_acceptance_ema key and the engine's
+        percentiles no spec_* keys — shapes stay byte-identical to the
+        pre-speculation serving stack."""
+        eng = _shared(False)
+        router = Router([eng])
+        uid = router.put(_prompts(4, 1)[0], max_new_tokens=4)
+        _run(router)
+        router.get(uid)
+        assert "spec_acceptance_ema" not in router.snapshot()
+        assert not any(k.startswith("spec")
+                       for k in eng.telemetry.percentiles())
