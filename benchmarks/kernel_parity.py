@@ -287,6 +287,84 @@ def _speculative(rng):
     groups.reset()
 
 
+def _kv_handoff(rng):
+    """Disaggregated prefill/decode handoff vs colocated decode: run
+    prefill on engine P with the decode hold engaged, stream the KV
+    blocks + descriptor through the wire format into engine D, and the
+    completed greedy output must be byte-identical to a colocated
+    reference — for BOTH model families (gpt2 rides the bucketed
+    prefill path, llama/GQA rides the split-fuse chunked path). The
+    re-export from D before it decodes proves the scatter placed every
+    block payload byte-exactly; pool audits prove both sides close
+    their accounting."""
+    from deepspeed_tpu.inference.v2 import InferenceEngineV2, kv_transfer
+    from deepspeed_tpu.models import GPT2, GPT2Config, Llama, LlamaConfig
+    from deepspeed_tpu.utils import groups
+    base = {"dtype": "float32", "kv_block_size": 8, "prompt_bucket": 16,
+            "max_batch_size": 4}
+    rs = np.random.RandomState(2)
+    prompt = rs.randint(1, 255, (21,)).astype(np.int32)
+    families = (
+        ("gpt2", {},
+         GPT2(GPT2Config(n_layer=2, n_head=4, d_model=64,
+                         max_seq_len=128, vocab_size=256, remat=False,
+                         dtype="float32"))),
+        ("llama", {"splitfuse_tokens": 16},
+         Llama(LlamaConfig(n_layer=2, n_head=4, n_kv_heads=2,
+                           d_model=64, max_seq_len=128, vocab_size=256,
+                           remat=False, dtype="float32"))),
+    )
+    for name, extra, model in families:
+        params = model.init(jax.random.key(0))
+        groups.reset()
+        ref = InferenceEngineV2(model, params=params,
+                                config=dict(base, **extra))
+        want = ref.generate_all([prompt], max_new_tokens=8)[0]
+        groups.reset()
+        P = InferenceEngineV2(model, params=params,
+                              config=dict(base, **extra))
+        groups.reset()
+        D = InferenceEngineV2(model, params=params,
+                              config=dict(base, **extra))
+        uid = P.put(prompt, max_new_tokens=8)
+        P.hold_decode(uid)
+        while True:
+            P.step()
+            seq = P.state_mgr._seqs.get(uid)
+            if seq is not None and seq.generated:
+                break
+        state, _ = P.export_handoff(uid)
+        payload = kv_transfer.export_sequence(P, uid)
+        kv_transfer.import_sequence(D, payload)
+        P.release_handoff(uid)
+        alloc = P.state_mgr.allocator
+        assert alloc.free_blocks == alloc.total_blocks, \
+            f"prefill side leaked blocks after handoff ({name})"
+        # round-trip proof: what D would export is byte-identical to
+        # what P exported — the scatter landed every payload exactly
+        state2, kv2 = D.export_handoff(uid)
+        assert state2 == state, f"handoff state drifted ({name})"
+        _, flat = kv_transfer.unpack_handoff(payload)
+        from deepspeed_tpu.runtime.checkpoint_engine.serialization \
+            import flatten_state
+        flat2, _meta = flatten_state(kv2)
+        for key, arr in flat.items():
+            np.testing.assert_array_equal(
+                np.asarray(flat2[key]), np.asarray(arr),
+                err_msg=f"KV block payload {key} not byte-identical "
+                        f"after import ({name})")
+        while not D.is_done(uid):
+            D.step()
+        got = D.get(uid)
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"disaggregated output != colocated ({name})")
+        da = D.state_mgr.allocator
+        assert da.free_blocks == da.total_blocks, \
+            f"decode side leaked blocks after completion ({name})"
+    groups.reset()
+
+
 def _mlp_matmul(rng):
     from deepspeed_tpu.ops.pallas.mlp_matmul import _ref_proj, mlp_matmul
     B, T, K, M = 2, 256, 512, 256
@@ -652,6 +730,9 @@ _GATES = (
     # draft-model speculation: spec-on greedy byte-identity (gpt2 +
     # llama) and the mid-speculation cancel() zero-leak audit
     ("speculative", _speculative),
+    # disaggregated prefill/decode: P->D KV-block handoff byte-identity
+    # vs colocated (gpt2 + llama/GQA) + both-side pool-closure audits
+    ("kv_handoff", _kv_handoff),
     ("mlp_matmul", _mlp_matmul),
     ("paged", _paged),
     # the SplitFuse chunked-prefill paged kernel + the tuned-winner

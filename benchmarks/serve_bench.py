@@ -1094,6 +1094,184 @@ def bench_router_traffic(name="gpt2-350M", n_replicas=2, rate=2.0,
     return rows
 
 
+def _disagg_one(name, fleet, scenario, rate, n_requests, short_prompt,
+                long_prompt, n_interference, decode_tokens, chunk,
+                block_size, max_batch, seed):
+    """One disaggregated-serving run: a role-labeled fleet (shared
+    weights) behind the phase-aware router, short decode-heavy klass-0
+    traffic with an optional burst of long-prefill klass-1 interference
+    landing mid-run. Returns one row with the router's handoff/wire
+    counters and the DECODE-CLASS (klass 0) latency percentiles — the
+    headline comparison is klass-0 p99 TPOT under interference:
+    colocated fleets interleave the long prefill chunks into every
+    decode batch, a prefill/decode split keeps the decode replicas'
+    iteration time flat. ``scenario``:
+
+      quiet              — short traffic only
+      interference       — + long-prefill burst at the run's midpoint
+      interference-kill  — + one armed replica_death mid-run (handoff
+                           failover / colocated-degradation path under
+                           real traffic; accounting must stay closed)
+    """
+    from deepspeed_tpu.inference.v2.replica import Replica
+    model = build_model(name)
+    long_prompt = min(long_prompt,
+                      model.config.max_seq_len - decode_tokens)
+    short_prompt = min(short_prompt, long_prompt)
+    groups.reset()
+    params = model.init(jax.random.key(0))
+    replicas = []
+    for i, role in enumerate(fleet):
+        groups.reset()
+        eng = InferenceEngineV2(
+            model, params=params,
+            config=RaggedInferenceEngineConfig(
+                max_batch_size=max_batch, kv_block_size=block_size,
+                prompt_bucket=min(long_prompt, 512),
+                splitfuse_tokens=chunk))
+        replicas.append(Replica(f"{role[:1]}{i}", eng, role=role))
+    router = Router(replicas)
+    r = np.random.RandomState(seed)
+    V = model.config.vocab_size
+
+    # warm every program OUTSIDE the measured traffic: each engine's
+    # chunk/fused/decode programs, and for prefill/decode pairs the
+    # handoff gather/scatter jits + wire codec (compiles landing inside
+    # a driven request's TTFT would swamp the smoke-scale percentiles)
+    for rep in replicas:
+        eng = rep.engine
+        w1 = eng.put(r.randint(0, V, (short_prompt,)),
+                     max_new_tokens=8, eos_token_id=-1)
+        for _ in range(2):
+            eng.step()
+        w2 = eng.put(r.randint(0, V, (long_prompt,)), max_new_tokens=2,
+                     eos_token_id=-1)
+        while not (eng.is_done(w1) and eng.is_done(w2)):
+            eng.step()
+        eng.get(w1), eng.get(w2)
+    from deepspeed_tpu.inference.v2 import kv_transfer
+    pre = [x for x in replicas if x.role == "prefill"]
+    dec = [x for x in replicas if x.role == "decode"]
+    for i, P in enumerate(pre):
+        D = dec[i % len(dec)] if dec else None
+        if D is None:
+            break
+        wu = P.engine.put(r.randint(0, V, (short_prompt,)),
+                          max_new_tokens=4, eos_token_id=-1)
+        P.engine.hold_decode(wu)
+        while True:
+            P.engine.step()
+            seq = P.engine.state_mgr._seqs.get(wu)
+            if seq is not None and seq.generated:
+                break
+        kv_transfer.import_sequence(
+            D.engine, kv_transfer.export_sequence(P.engine, wu))
+        P.engine.release_handoff(wu)
+        while not D.engine.is_done(wu):
+            D.engine.step()
+        D.engine.get(wu)
+
+    prompts = [r.randint(0, V, (short_prompt,))
+               for _ in range(n_requests)]
+    classes = [0] * n_requests
+    arrivals = list(np.cumsum(r.exponential(1.0 / rate, n_requests)))
+    if scenario != "quiet":
+        # the interference burst: n_interference long prefills all
+        # arriving at once at the run's midpoint
+        t_burst = arrivals[n_requests // 2]
+        prompts += [r.randint(0, V, (long_prompt,))
+                    for _ in range(n_interference)]
+        classes += [1] * n_interference
+        arrivals += [t_burst] * n_interference
+        order = np.argsort(np.asarray(arrivals), kind="stable")
+        prompts = [prompts[i] for i in order]
+        classes = [classes[i] for i in order]
+        arrivals = [arrivals[i] for i in order]
+    mid = max(2, len(prompts) // 2)
+    try:
+        wall, rejected_at_put, steps = _router_drive(
+            router, prompts, np.asarray(arrivals), decode_tokens,
+            classes,
+            kill_at_step=mid if scenario == "interference-kill"
+            else None)
+    finally:
+        fault_injection.reset()
+    snap = router.snapshot()
+    closed = (snap["completed"] + snap["expired"]
+              + (snap["shed"] - rejected_at_put)) == snap["admitted"]
+    k0 = snap["classes"].get(0, {})
+    return {
+        "model": name, "mode": "disagg-serving",
+        "variant": {"fleet": "+".join(fleet), "scenario": scenario},
+        "arrival_rate_qps": rate, "n_requests": len(prompts),
+        "short_prompt": short_prompt, "long_prompt": long_prompt,
+        "n_interference": n_interference if scenario != "quiet" else 0,
+        "decode_tokens": decode_tokens, "splitfuse_tokens": chunk,
+        "router_steps": steps, "wall_s": round(wall, 2),
+        "admitted": snap["admitted"], "completed": snap["completed"],
+        "shed": snap["shed"], "expired": snap["expired"],
+        "replayed": snap["replayed"], "failovers": snap["failovers"],
+        "rejected_at_put": rejected_at_put,
+        "accounting_closed": closed,
+        "handoffs": snap["handoffs"],
+        "kv_stream_bytes": snap["kv_stream_bytes"],
+        "kv_stream_ms": round(snap["kv_stream_ms"], 2),
+        "kv_stream_retries": snap["kv_stream_retries"],
+        "replicas": snap["replicas"],
+        "roles": snap.get("roles"),
+        # the headline numbers: klass-0 (short, decode-heavy) latency
+        # as the router measured it — compare p99 TPOT across variants
+        "decode_class": {
+            "ttft_ms_p50": k0.get("ttft_ms_p50"),
+            "ttft_ms_p99": k0.get("ttft_ms_p99"),
+            "tpot_ms_p50": k0.get("tpot_ms_p50"),
+            "tpot_ms_p99": k0.get("tpot_ms_p99"),
+            "completed": k0.get("completed"),
+        },
+        "classes": {str(k): v for k, v in snap["classes"].items()},
+        "devices": len(jax.devices()),
+    }
+
+
+def bench_disagg(name="gpt2-350M", rate=2.0, n_requests=24,
+                 short_prompt=64, long_prompt=1024, n_interference=4,
+                 decode_tokens=64, chunk=256, block_size=64,
+                 max_batch=8, seed=0):
+    """Disaggregated prefill/decode sweep (SERVE_DISAGG): the same
+    short-request traffic through colocated vs phase-split fleets,
+    quiet and under a long-prefill interference burst. The headline
+    read: colocated klass-0 p99 TPOT degrades under the burst (every
+    decode batch pays for the interleaved prefill chunks) while the
+    1P+1D / 2P+2D fleets hold it flat, paying kv_stream_bytes over the
+    wire instead. The kill variant arms one replica_death mid-run —
+    its pass signal is accounting_closed with the fleet degrading to
+    colocated (decode death) or failing over (prefill death). A
+    variant that crashes records its error and the sweep continues."""
+    variants = [
+        (["colocated", "colocated"], "quiet"),
+        (["colocated", "colocated"], "interference"),
+        (["prefill", "decode"], "quiet"),
+        (["prefill", "decode"], "interference"),
+        (["prefill", "prefill", "decode", "decode"], "interference"),
+        (["prefill", "decode"], "interference-kill"),
+    ]
+    rows = []
+    for fleet, scenario in variants:
+        try:
+            rows.append(_record(_disagg_one(
+                name, fleet, scenario, rate, n_requests, short_prompt,
+                long_prompt, n_interference, decode_tokens, chunk,
+                block_size, max_batch, seed)))
+        except Exception as e:  # noqa: BLE001 — keep sweeping
+            rows.append(_record({
+                "model": name, "mode": "disagg-serving",
+                "variant": {"fleet": "+".join(fleet),
+                            "scenario": scenario},
+                "error": f"{type(e).__name__}: {e}"[:300]}))
+        write_local_report()           # partial sweep already durable
+    return rows
+
+
 def bench_ep_moe(decode_tokens=16, block_size=16, chunk=16,
                  expert_parallel=2):
     """EP Mixtral serving: experts sharded over the 'expert' mesh axis,
@@ -1214,6 +1392,24 @@ def main():
             n_requests=int(os.environ.get("SERVE_ROUTER_N",
                                           "24" if on_tpu else "9")),
             **rt_kw)
+    if os.environ.get("SERVE_DISAGG", "1") != "0":
+        # disaggregated prefill/decode rows (colocated vs 1P+1D vs
+        # 2P+2D under long-prefill interference); same CPU smoke-scale
+        # discipline — off-TPU the tiny model produces every row in
+        # minutes
+        on_tpu = jax.default_backend() == "tpu"
+        dg_kw = {} if on_tpu else dict(
+            short_prompt=16, long_prompt=96, n_interference=3,
+            decode_tokens=24, chunk=16, block_size=8, max_batch=4,
+            rate=8.0)
+        if "SERVE_DISAGG_RATE" in os.environ:
+            dg_kw["rate"] = float(os.environ["SERVE_DISAGG_RATE"])
+        bench_disagg(
+            name=os.environ.get("SERVE_DISAGG_MODEL",
+                                "gpt2-350M" if on_tpu else "tiny"),
+            n_requests=int(os.environ.get("SERVE_DISAGG_N",
+                                          "24" if on_tpu else "10")),
+            **dg_kw)
     if os.environ.get("SERVE_EP_MOE", "1") == "1":
         bench_ep_moe()
     if os.environ.get("SERVE_WQ", "1") != "0":

@@ -1158,6 +1158,98 @@ def _spec_parity(b, dtype, params):
         raise AssertionError("longest_accept first-token reject broken")
 
 
+# ------------------------------------------------- op: kv_handoff
+# Disaggregated prefill/decode serving (inference/v2/kv_transfer.py +
+# router phase-aware dispatch). The knob is WHERE decode happens, not a
+# kernel shape: colocated decode pays for the split-fuse prefill chunks
+# interleaved into its batch (each long prefill steals decode
+# iterations from every co-resident sequence), disaggregated decode
+# pays the one-time KV-block stream over DCN instead. The cost model
+# prices exactly that trade per committed decode token; the candidate
+# emulation scales a fixed matmul step by it, same device-honest idiom
+# as spec_decode.
+
+
+def _kvh_defaults(b):
+    # colocated is the cold default: the disabled program must stay
+    # byte-identical to the pre-disaggregation engine
+    return {"disaggregate": 0}
+
+
+def _kvh_candidates(b):
+    return [{"disaggregate": 0}, {"disaggregate": 1}]
+
+
+def _kvh_per_token_cost(b, params):
+    """Decode-iteration-equivalents per committed token. Colocated: a
+    P-token prompt arriving mid-decode injects ceil(P/C) split-fuse
+    chunk dispatches into the decode stream, amortized over G decode
+    tokens per sequence. Disaggregated: the KV stream for the same
+    prompt costs wire_bytes/DCN_rate, measured in decode-step units,
+    amortized over the same G."""
+    P, C, G = 1024.0, 256.0, 128.0           # prompt, chunk, gen tokens
+    if not int(params["disaggregate"]):
+        return 1.0 + (P / C) / G
+    # KV wire bytes for the prompt: 2 (k+v) * layers * kv_heads *
+    # head_dim * itemsize, padded to the block grid
+    L, Hkv, hd, itemsize, BS = 24.0, 8.0, 128.0, 2.0, 64.0
+    wire = 2.0 * L * Hkv * hd * itemsize * math.ceil(P / BS) * BS
+    # DCN effective rate per decode-step-time: ~25 GB/s link, ~4 ms
+    # decode step -> bytes movable in one decode iteration
+    dcn_bytes_per_step = 25e9 * 0.004
+    return 1.0 + (wire / dcn_bytes_per_step) / G
+
+
+def _kvh_step(b, dtype, params):
+    rows = max(8, int(8 * b["B"] * _kvh_per_token_cost(b, params)))
+    D = 128
+    ks = jax.random.split(jax.random.key(11), 2)
+    x = jax.random.normal(ks[0], (rows, D), dtype) * 0.3
+    w = jax.random.normal(ks[1], (D, D), dtype) / math.sqrt(D)
+
+    def step(carry):
+        x, w = carry
+        y = jax.nn.gelu(x @ w) @ w.T
+        x = x + _EPS * y.astype(x.dtype)
+        return (x, w)
+
+    return step, (x, w)
+
+
+def _kvh_parity(b, dtype, params):
+    """The candidate changes placement, not math — pin the knob range
+    and the wire format's integrity contract: a handoff payload must
+    round-trip state + KV bytes exactly, and a corrupted payload must
+    be REJECTED, never imported (silent KV corruption would break the
+    colocated-vs-disaggregated byte-identity guarantee)."""
+    d = int(params["disaggregate"])
+    if d not in (0, 1):
+        raise AssertionError(
+            f"kv_handoff candidate disaggregate={d} outside (0, 1)")
+    from ..inference.v2 import kv_transfer
+    state = {"uid": 7, "prompt": [1, 2, 3], "generated": [4],
+             "cached_len": 0}
+    tree = {"k": [np.arange(12, dtype=np.float32).reshape(3, 4)],
+            "v": [np.ones((3, 4), np.float32) * 0.5]}
+    payload = kv_transfer.pack_handoff(state, tree)
+    got_state, flat = kv_transfer.unpack_handoff(payload)
+    if got_state != state:
+        raise AssertionError("kv_handoff state round-trip broken")
+    for key, ref in (("k/0", tree["k"][0]), ("v/0", tree["v"][0])):
+        if not np.array_equal(np.asarray(flat[key]), ref):
+            raise AssertionError(
+                f"kv_handoff KV leaf {key} not byte-identical")
+    bad = bytearray(payload)
+    bad[-1] ^= 0xFF
+    try:
+        kv_transfer.unpack_handoff(bytes(bad))
+    except kv_transfer.KVWireError:
+        pass
+    else:
+        raise AssertionError(
+            "kv_handoff accepted a corrupted payload (CRC must reject)")
+
+
 # ---------------------------------------------------------------- table
 REGISTRY = {
     "flash_attention": {
@@ -1237,6 +1329,12 @@ REGISTRY = {
         "candidates": _spec_candidates,
         "make_step": _spec_step,
         "parity": _spec_parity,
+    },
+    "kv_handoff": {
+        "defaults": _kvh_defaults,
+        "candidates": _kvh_candidates,
+        "make_step": _kvh_step,
+        "parity": _kvh_parity,
     },
 }
 

@@ -167,6 +167,17 @@ KNOB_TABLE = {
     "router.prefix_affinity": {
         "op": None, "resolver": "heuristic: on iff any live replica "
         "runs a prefix cache (Router._affinity_on)"},
+    "router.disaggregate": {
+        "op": "kv_handoff", "resolver": "heuristic: on iff both phase "
+        "roles (prefill + decode) are live in the fleet "
+        "(Router._disagg_on, re-resolved every round); the kv_handoff "
+        "cost model prices KV wire bytes over DCN against the decode "
+        "iterations a colocated prefill chunk steals"},
+    "replica.role": {
+        "op": "kv_handoff", "resolver": "deployment-time constructor "
+        "choice (Replica(role=...)): colocated | prefill | decode — "
+        "not auto-resolved; the router's disaggregate knob reads the "
+        "fleet's role mix"},
 }
 
 

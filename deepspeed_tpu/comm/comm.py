@@ -171,6 +171,39 @@ def get_local_device_count():
     return jax.local_device_count()
 
 
+# Byte-transport payload ceiling. The padded ring buffer is ONE dense
+# uint8 array materialized per process (and permuted in one collective)
+# — an unbounded payload would silently turn a metadata hop into a
+# multi-GiB device allocation sized by the LARGEST process's payload.
+# Callers moving more than this (full KV caches, checkpoint shards)
+# must chunk at a higher layer; the typed CommPayloadError is raised
+# BEFORE the single-process early-return so the contract is testable
+# everywhere.
+MAX_PAYLOAD_BYTES = 1 << 30
+
+
+class CommPayloadError(ValueError):
+    """Payload exceeds the byte-transport contract
+    (``MAX_PAYLOAD_BYTES``): refuse loudly instead of materializing an
+    oversized padded ring buffer on every process."""
+
+
+def _check_payload(payload, fn):
+    n = len(payload)
+    if n > MAX_PAYLOAD_BYTES:
+        raise CommPayloadError(
+            f"{fn}: payload of {n} bytes exceeds MAX_PAYLOAD_BYTES="
+            f"{MAX_PAYLOAD_BYTES}; chunk at the caller")
+
+
+def _padded_width(lengths):
+    """Ring-wide padded buffer width: at least 1 so an all-empty
+    exchange still builds a valid nonzero permute buffer (zero-length
+    payloads are legal; a ``zeros((0,))`` global array is not a valid
+    one-row-per-process collective operand)."""
+    return max(1, int(np.max(lengths)))
+
+
 def ring_exchange_bytes(payload, shift=1):
     """Host-level byte exchange around the PROCESS ring: send ``payload``
     to process ``(pid + shift) % nprocs`` over the accelerator fabric
@@ -184,7 +217,13 @@ def ring_exchange_bytes(payload, shift=1):
     hot checkpoint tier (checkpoint_engine/hot_tier.py) uses this as
     its ``dcn`` replica transport; payloads are length-prefixed and
     padded to the ring-wide max so one permute moves everything.
+
+    Payload contract: zero-length payloads are legal (the receiver gets
+    ``b""`` from that origin — the padded buffer is floored at one
+    byte); payloads above ``MAX_PAYLOAD_BYTES`` raise the typed
+    :class:`CommPayloadError` before any collective runs.
     """
+    _check_payload(payload, "ring_exchange_bytes")
     nproc = jax.process_count()
     if nproc <= 1:
         return None, None
@@ -193,7 +232,7 @@ def ring_exchange_bytes(payload, shift=1):
     # one length allgather sizes the padded buffer identically everywhere
     lengths = np.asarray(multihost_utils.process_allgather(
         np.asarray([data.size], np.int64))).reshape(-1)
-    width = int(lengths.max())
+    width = _padded_width(lengths)
     buf = np.zeros((width,), np.uint8)
     buf[:data.size] = data
     # one device per process, mesh axis 'proc': the permute between
@@ -232,7 +271,12 @@ def allgather_bytes(payload):
     layer's cluster aggregation (monitor/telemetry.py) uses this to
     pool per-host step-time metrics at flush boundaries. Collective:
     every process must call at the same point.
+
+    Same payload contract as :func:`ring_exchange_bytes`: zero-length
+    payloads are legal, oversize payloads raise
+    :class:`CommPayloadError` before any collective runs.
     """
+    _check_payload(payload, "allgather_bytes")
     nproc = jax.process_count()
     if nproc <= 1:
         return None
@@ -240,7 +284,7 @@ def allgather_bytes(payload):
     data = np.frombuffer(bytes(payload), dtype=np.uint8)
     lengths = np.asarray(multihost_utils.process_allgather(
         np.asarray([data.size], np.int64))).reshape(-1)
-    width = max(1, int(lengths.max()))
+    width = _padded_width(lengths)
     buf = np.zeros((width,), np.uint8)
     buf[:data.size] = data
     stacked = np.asarray(multihost_utils.process_allgather(buf))

@@ -402,6 +402,13 @@ class InferenceEngineV2:
         self._chunk_jit = None        # chunk-only (no decoders running)
         self._cow_jit = None          # prefix-cache partial-tail copy
         self._prefill_q = deque()     # uids mid-chunked-prefill (SplitFuse)
+        # disaggregated prefill/decode handoff (kv_transfer.py): uids
+        # parked out of every decode dispatch until their KV streams to
+        # a decode replica, plus the export gather / donated import
+        # scatter programs (lazy, the _get_cow_copy idiom)
+        self._decode_hold = set()
+        self._kv_export_jit = None
+        self._kv_import_jit = None
         self._uid_next = 0
         log_dist(
             f"v2 engine ready: tp={config.tensor_parallel} blocks="
@@ -484,6 +491,7 @@ class InferenceEngineV2:
         TTFT/TPOT windows (``on_reject``): a cancelled request has no
         dispatch boundary to amortize against and would poison the
         percentiles. Returns True when the uid was known."""
+        self._decode_hold.discard(uid)
         for i, r in enumerate(self._pending):
             if r.uid == uid:
                 del self._pending[i]
@@ -830,6 +838,205 @@ class InferenceEngineV2:
                             np.int32(plen))
         self.state_mgr.cow_complete(seq)   # drops the claim ref on src
 
+    # -------------------------------- disaggregated prefill/decode handoff
+    def hold_decode(self, uid):
+        """Park ``uid`` out of every decode dispatch. A prefill-role
+        replica holds each sequence here once submitted: it runs
+        chunked prefill to the last prompt token, posts the first
+        generated token, and then waits for its KV handoff to a decode
+        replica instead of decoding locally."""
+        self._decode_hold.add(uid)
+
+    def release_decode_hold(self, uid=None):
+        """Release one park (or all of them with ``uid=None`` — the
+        router flips a fleet back to colocated when its last decode
+        replica dies, and every held sequence must resume decoding
+        HERE rather than deadlock)."""
+        if uid is None:
+            self._decode_hold.clear()
+        else:
+            self._decode_hold.discard(uid)
+
+    def _get_kv_export(self):
+        """Handoff export: gather one sequence's KV block payloads out
+        of the paged cache in ONE compiled program. The block-id vector
+        is a traced operand padded to the per-sequence table shape, so
+        every handoff shares the program. NOT donated — the prefill
+        replica keeps serving from its cache, and export must be
+        repeatable for stream-failure retries."""
+        if self._kv_export_jit is None:
+            def gather(cache, src):
+                return jax.tree.map(lambda p: p[src], cache)
+
+            self._kv_export_jit = jax.jit(
+                gather, in_shardings=(self._cache_sh, None))
+        return self._kv_export_jit
+
+    def _get_kv_import(self):
+        """Handoff import: scatter received block payloads into freshly
+        allocated block ids in place — the donated ``_get_cow_copy``
+        idiom, so the import never copies the whole cache. Pad rows of
+        the destination vector map to block 0, the scratch block, which
+        every dispatch overwrites by design."""
+        if self._kv_import_jit is None:
+            def scatter(cache, kv, dst):
+                return jax.tree.map(
+                    lambda p, s: p.at[dst].set(s), cache, kv)
+
+            self._kv_import_jit = jax.jit(
+                scatter, donate_argnums=(0,),
+                in_shardings=(self._cache_sh, None, None),
+                out_shardings=self._cache_sh)
+        return self._kv_import_jit
+
+    def export_handoff(self, uid):
+        """Export half of the handoff: -> (descriptor state dict, host
+        KV tree sliced to the blocks the sequence wrote). The sequence
+        is NOT removed — :meth:`release_handoff` runs only after the
+        decode side confirms the import, so a failed stream retries
+        from unchanged state.
+
+        Byte-identity is by construction: the gathered blocks hold
+        positions ``0..seen_tokens-2`` — exactly the cache state a
+        colocated decode dispatch would attend, because the last
+        generated token's KV is written by the decode step that
+        consumes it."""
+        if self.kv_pool is not None:
+            raise RuntimeError(
+                "KV handoff is incompatible with kv_host_offload: "
+                "block payloads live in the host pool, not the device "
+                "cache — run prefill-role replicas without offload")
+        mgr = self.state_mgr
+        seq = mgr.get_sequence(uid)
+        if not seq.generated:
+            raise RuntimeError(
+                f"uid {uid} has no first token yet — only "
+                f"prefill-complete sequences hand off")
+        n = mgr.blocks_needed(seq.seen_tokens - 1)
+        src = np.zeros((self.max_blocks_per_seq,), np.int32)
+        src[:len(seq.blocks)] = seq.blocks
+        with jax.set_mesh(self.mesh):
+            kv = self._get_kv_export()(self.cache, src)
+        kv_host = jax.tree.map(lambda a: np.asarray(a)[:n], kv)
+        t_submit = None
+        klass = 0
+        if self.telemetry is not None:
+            t_submit = self.telemetry.submit_stamp(uid)
+            klass = self.telemetry.klass_of(uid)
+        state = {
+            "uid": int(uid),
+            "prompt": [int(t) for t in seq.prompt],
+            "generated": [int(t) for t in seq.generated],
+            "cached_len": int(seq.cached_len),
+            "max_new_tokens": int(seq.max_new_tokens),
+            "eos_token_id": int(seq.eos_token_id),
+            "temperature": float(seq.temperature),
+            "top_k": int(seq.top_k),
+            "klass": int(klass),
+            "t_submit": t_submit,
+        }
+        return state, kv_host
+
+    def import_handoff(self, state, kv_flat):
+        """Import half of the handoff: rebuild the wire's KV tree
+        against this engine's cache template, validate the layout,
+        allocate the sequence's full budget from THIS pool, scatter the
+        received payloads in one donated program, and bind the
+        descriptor straight into the decode batch
+        (``prefill_offset = len(prompt)`` — every prompt position's KV
+        just arrived). Serving telemetry registers the request anchored
+        at the ORIGINAL submit stamp. Returns the uid."""
+        from ...runtime.checkpoint_engine import serialization as ser
+        from .kv_transfer import KVWireError
+        if self.kv_pool is not None:
+            raise RuntimeError(
+                "KV handoff is incompatible with kv_host_offload: "
+                "imported blocks would bypass residency tracking — run "
+                "decode-role replicas without offload")
+        mgr = self.state_mgr
+        uid = int(state["uid"])
+        if uid in mgr._seqs or uid in self._results:
+            raise RuntimeError(f"handoff uid {uid} already live here")
+        prompt = np.asarray(state["prompt"], np.int32)
+        generated = [int(t) for t in state["generated"]]
+        max_new = int(state["max_new_tokens"])
+        kv = ser.unflatten_into(
+            jax.tree.map(lambda _p: 0, self.cache), kv_flat)
+        # layout guard: a gpt2-shaped payload must never scatter into a
+        # llama (GQA) cache — per-block shapes and dtypes must match
+        # the local cache exactly, and every leaf must carry the same
+        # block count
+        n_blocks = set()
+
+        def _check(p, s):
+            if not hasattr(s, "shape") or s.shape[1:] != p.shape[1:] \
+                    or s.dtype != p.dtype:
+                raise KVWireError(
+                    f"handoff KV layout mismatch: payload block shape "
+                    f"{getattr(s, 'shape', None)}/"
+                    f"{getattr(s, 'dtype', None)} vs local cache "
+                    f"{p.shape}/{p.dtype}")
+            n_blocks.add(int(s.shape[0]))
+            return p
+
+        jax.tree.map(_check, self.cache, kv)
+        if len(n_blocks) != 1:
+            raise KVWireError(
+                f"handoff KV payload has inconsistent block counts "
+                f"across layers: {sorted(n_blocks)}")
+        n = n_blocks.pop()
+        total = len(prompt) + max_new
+        need = mgr.blocks_needed(total)
+        if need > self.max_blocks_per_seq or n > need \
+                or total > self.max_seq_len:
+            raise KVWireError(
+                f"handoff sequence needs {need} blocks / {total} "
+                f"tokens — beyond this engine's per-sequence capacity")
+        if mgr.free_slot() is None or \
+                mgr.allocator.available_blocks < need:
+            raise RuntimeError(
+                "decode replica cannot admit handoff (no free "
+                "slot/blocks) — the router must back-pressure "
+                "(can_accept) before streaming")
+        blocks = mgr.allocator.allocate(need)
+        MB = self.max_blocks_per_seq
+        dst = np.zeros((MB,), np.int32)     # pads scatter into scratch
+        dst[:n] = blocks[:n]
+
+        def _pad(s):
+            buf = np.zeros((MB,) + s.shape[1:], s.dtype)
+            buf[:n] = s
+            return buf
+
+        kv_pad = jax.tree.map(_pad, kv)
+        with jax.set_mesh(self.mesh):
+            self.cache = self._get_kv_import()(self.cache, kv_pad, dst)
+        mgr.admit_imported(
+            uid, prompt, generated, max_new, blocks,
+            eos_token_id=int(state["eos_token_id"]),
+            temperature=float(state["temperature"]),
+            top_k=int(state["top_k"]))
+        if self.telemetry is not None:
+            self.telemetry.on_handoff_in(
+                uid, klass=int(state.get("klass", 0)),
+                submit_ts=state.get("t_submit"))
+        return uid
+
+    def release_handoff(self, uid):
+        """The decode side confirmed the import: drop the sequence
+        HERE (the prefill side). ``retire`` inserts the verified
+        prompt+first-token prefix into the local prefix cache — its KV
+        was fully written by this replica's prefill — and releases
+        blocks/slot; ``flush`` drops the descriptor without surfacing
+        a result; telemetry forgets the request WITHOUT counting a
+        rejection, keeping its TTFT sample (the first token was
+        produced here) in the window."""
+        self._decode_hold.discard(uid)
+        self.state_mgr.retire(uid)
+        self.state_mgr.flush(uid)
+        if self.telemetry is not None:
+            self.telemetry.on_handoff_out(uid)
+
     def _step_splitfuse_chunk(self):
         """Run one fused dispatch: the next chunk of the oldest
         prefilling sequence + n decode steps (chunk-only when nothing is
@@ -883,7 +1090,7 @@ class InferenceEngineV2:
                 self._post_token(seq, int(np.asarray(c_tok)[0]))
             return self._step_offload_decode()
 
-        batch = mgr.decode_batch()
+        batch = mgr.decode_batch(exclude=self._decode_hold)
         self._rng, sub = jax.random.split(self._rng)
         c_temp = np.asarray([seq.temperature], np.float32)
         c_topk = np.asarray([seq.top_k], np.int32)
@@ -971,6 +1178,9 @@ class InferenceEngineV2:
             self.telemetry.on_token(seq.uid)
         if ((seq.eos_token_id >= 0 and token == seq.eos_token_id)
                 or len(seq.generated) >= seq.max_new_tokens):
+            # a held sequence that finishes AT its first token (EOS or
+            # max_new_tokens=1) never needs the handoff — drop the park
+            self._decode_hold.discard(seq.uid)
             self._results[seq.uid] = np.asarray(seq.generated, np.int32)
             if self.telemetry is not None:
                 self.telemetry.on_finish(seq.uid)
@@ -1016,7 +1226,7 @@ class InferenceEngineV2:
         mgr = self.state_mgr
         pool = self.kv_pool
         n = max(1, self.config.decode_steps_per_dispatch)
-        batch = mgr.decode_batch()
+        batch = mgr.decode_batch(exclude=self._decode_hold)
         if not batch.active.any():
             return []
         groups = self._offload_decode_groups(batch, n)
@@ -1099,7 +1309,7 @@ class InferenceEngineV2:
         decode steps over the given slots (all active slots when
         ``uids`` is None)."""
         mgr = self.state_mgr
-        batch = mgr.decode_batch(uids)
+        batch = mgr.decode_batch(uids, exclude=self._decode_hold)
         if not batch.active.any():
             return []
         self._rng, sub = jax.random.split(self._rng)
@@ -1132,7 +1342,7 @@ class InferenceEngineV2:
             return False
         mgr = self.state_mgr
         for uid in mgr._slots:
-            if uid is None:
+            if uid is None or uid in self._decode_hold:
                 continue
             seq = mgr.get_sequence(uid)
             if seq.generated and self._spec_candidate(seq):
@@ -1149,7 +1359,7 @@ class InferenceEngineV2:
         mgr = self.state_mgr
         spec, plain = [], []
         for uid in list(mgr._slots):
-            if uid is None:
+            if uid is None or uid in self._decode_hold:
                 continue
             seq = mgr.get_sequence(uid)
             if not seq.generated:

@@ -184,6 +184,33 @@ class DSStateManager:
         self._slots[slot] = uid
         return slot, seq
 
+    def admit_imported(self, uid, prompt, generated, max_new_tokens,
+                       blocks, eos_token_id=-1, temperature=0.0,
+                       top_k=0):
+        """Bind a handed-off sequence (disaggregated prefill/decode):
+        the prompt's KV was prefilled on ANOTHER replica and just
+        landed in ``blocks`` — allocated from THIS pool's allocator and
+        whole-owned (refcount 1) — so the descriptor enters the decode
+        batch directly: ``prefill_offset`` covers the full prompt and
+        ``generated`` already holds the first token produced by the
+        prefill side. ``cached_len`` stays 0: the blocks were imported,
+        not claimed from this replica's radix tree (retire will insert
+        the verified prefix into the local tree like any other
+        sequence). Returns (slot, descriptor)."""
+        slot = self.free_slot()
+        assert slot is not None, "no free batch slot"
+        assert uid not in self._seqs, f"uid {uid} already live here"
+        seq = DSSequenceDescriptor(
+            uid=uid, prompt=np.asarray(prompt, np.int32),
+            max_new_tokens=max_new_tokens, eos_token_id=eos_token_id,
+            temperature=temperature, top_k=top_k)
+        seq.blocks = list(blocks)
+        seq.generated = [int(t) for t in generated]
+        seq.prefill_offset = len(seq.prompt)
+        self._seqs[uid] = seq
+        self._slots[slot] = uid
+        return slot, seq
+
     def cow_complete(self, seq):
         """The engine's device-side CoW slice copy landed: drop the
         claim's temporary ref on the source block."""
@@ -285,11 +312,13 @@ class DSStateManager:
         offs = (idx % self.block_size).astype(np.int32)
         return blocks, offs
 
-    def decode_batch(self, uids=None):
+    def decode_batch(self, uids=None, exclude=None):
         """RaggedBatchWrapper for one decode step over all active slots.
         ``uids``: optional subset — the speculative scheduler splits a
         step into a spec set and a plain set, and the plain set's decode
-        dispatch must carry only its own slots."""
+        dispatch must carry only its own slots. ``exclude``: uids parked
+        out of decode entirely — a prefill-role replica holds finished
+        prefills here until their KV handoff lands on a decode replica."""
         B, MB = self.max_batch, self.max_blocks_per_seq
         tokens = np.zeros((B,), np.int32)
         lengths = np.zeros((B,), np.int32)
@@ -298,7 +327,8 @@ class DSStateManager:
         temps = np.zeros((B,), np.float32)
         top_ks = np.zeros((B,), np.int32)
         for slot, uid in enumerate(self._slots):
-            if uid is None or (uids is not None and uid not in uids):
+            if uid is None or (uids is not None and uid not in uids) \
+                    or (exclude is not None and uid in exclude):
                 continue
             seq = self._seqs[uid]
             if not seq.generated:

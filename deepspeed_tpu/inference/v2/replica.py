@@ -37,6 +37,16 @@ LIVE = "live"
 DRAINING = "draining"
 DEAD = "dead"
 
+# Phase roles for disaggregated serving. "colocated" replicas run
+# prefill and decode interleaved through split-fuse (the pre-disagg
+# behavior and the default). In an actively disaggregated fleet (the
+# router turns it on iff both phase roles are live), "prefill"
+# replicas run chunked prefill to the last prompt token, post the
+# first generated token, then park the sequence for a KV handoff
+# instead of decoding; "decode" replicas take no fresh dispatches and
+# admit handed-off sequences directly into their decode batch.
+ROLES = ("colocated", "prefill", "decode")
+
 
 class ReplicaDead(RuntimeError):
     """Terminal replica failure. Raised out of :meth:`Replica.step` —
@@ -53,9 +63,20 @@ class ReplicaDead(RuntimeError):
 class Replica:
     """Health-tracked handle around one in-process replica engine."""
 
-    def __init__(self, name, engine, max_step_failures=3):
+    def __init__(self, name, engine, max_step_failures=3,
+                 role="colocated"):
+        if role not in ROLES:
+            raise ValueError(
+                f"role must be one of {ROLES}, got {role!r}")
         self.name = name
         self.engine = engine
+        self.role = role
+        # router-driven: the prefill-role park/handoff behavior engages
+        # only while the FLEET is actually disaggregated (both phase
+        # roles live) — the router re-resolves this every round, so a
+        # fleet that loses its last decode replica degrades to
+        # colocated behavior instead of deadlocking held sequences
+        self._disaggregated = False
         self.state = LIVE
         # True when the terminal state was reached via a clean drain
         # (finished in-flight, nothing replayed) rather than a failure
@@ -169,6 +190,10 @@ class Replica:
         fault_injection.fire("serve_dispatch")
         self.engine.put(prompt, max_new_tokens=max_new_tokens,
                         eos_token_id=eos_token_id, uid=uid, klass=klass)
+        if self._disaggregated:
+            # prefill role: the sequence prefills here, posts its first
+            # token, then waits for the KV handoff instead of decoding
+            self.engine.hold_decode(uid)
         self.inflight.append(uid)
 
     def cancel(self, uid):
@@ -177,6 +202,68 @@ class Replica:
         if uid in self.inflight:
             self.inflight.remove(uid)
             self.engine.cancel(uid)
+
+    # ------------------------------------- disaggregated prefill/decode
+    def set_disaggregated(self, on):
+        """Router hook, called every round with the fleet-wide verdict.
+        Only a prefill-role replica ever engages; flipping OFF releases
+        every parked sequence so it resumes decoding HERE (the
+        colocated-degradation path when the decode side is gone)."""
+        on = bool(on) and self.role == "prefill"
+        if self._disaggregated and not on:
+            self.engine.release_decode_hold()
+        self._disaggregated = on
+
+    def handoff_ready(self):
+        """uids parked after completing prefill (first token posted) —
+        the router streams these to a decode replica. Empty unless this
+        is a prefill replica in an actively disaggregated fleet."""
+        if not self._disaggregated or self.dead:
+            return []
+        eng = self.engine
+        ready = []
+        for uid in self.inflight:
+            if uid not in eng._decode_hold:
+                continue    # finished at its first token, or not parked
+            seq = eng.state_mgr._seqs.get(uid)
+            if seq is not None and seq.generated:
+                ready.append(uid)
+        return ready
+
+    def export_handoff(self, uid):
+        """Serialize ``uid``'s KV blocks + descriptor state to wire
+        bytes. The sequence stays owned here until
+        :meth:`finish_handoff` — a failed stream retries from unchanged
+        state."""
+        from . import kv_transfer
+        return kv_transfer.export_sequence(self.engine, uid)
+
+    def import_handoff(self, payload):
+        """Decode side of the handoff. ``replica_death`` fires first —
+        arming it here models the decode replica dying MID-TRANSFER;
+        the router observes :class:`ReplicaDead` and re-enqueues the
+        request at the front for a colocated / re-prefill replay
+        (byte-identical by greedy construction). The retryable
+        ``kv_import`` point fires inside the import path BEFORE any
+        decode-side mutation. Returns the imported uid; the router owns
+        the in-flight bookkeeping."""
+        if self.state == DEAD:
+            raise ReplicaDead(self.name, "handoff import after death")
+        try:
+            fault_injection.fire("replica_death")
+        except fault_injection.FaultError as e:
+            self.mark_dead("injected replica death mid-transfer")
+            raise ReplicaDead(self.name, str(e)) from e
+        from . import kv_transfer
+        return kv_transfer.import_sequence(self.engine, payload)
+
+    def finish_handoff(self, uid):
+        """The decode side confirmed the import: release the sequence
+        here (prefix insert + pool close, no rejection counted) and
+        drop it from this replica's in-flight list."""
+        if uid in self.inflight:
+            self.inflight.remove(uid)
+        self.engine.release_handoff(uid)
 
     # --------------------------------------------------------------- step
     def step(self):

@@ -46,6 +46,7 @@ import numpy as np
 from ...utils import fault_injection
 from ...utils.logging import log_dist
 from ...monitor.telemetry import percentile
+from . import kv_transfer
 from .replica import Replica, ReplicaDead
 
 
@@ -89,6 +90,13 @@ class RouterConfig:
     # prefix: "auto" = on iff any replica runs a prefix cache
     # (Router._affinity_on); True/False force
     prefix_affinity: object = "auto"
+    # disaggregated prefill/decode serving: "auto" = on iff both a
+    # prefill-role AND a decode-role replica are live
+    # (Router._disagg_on — the fleet degrades to colocated behavior
+    # when either side is gone); True forces (construction raises
+    # unless both roles are present); False keeps every replica
+    # colocated whatever its role says
+    disaggregate: object = "auto"
     # overload detection: sustained p99 SLO breach (0 = disabled; the
     # queue-depth watermark below is always armed) over breach_rounds
     # consecutive router steps
@@ -122,6 +130,10 @@ class RouterConfig:
             raise ValueError(
                 f"prefix_affinity must be true|false|'auto', got "
                 f"{self.prefix_affinity!r}")
+        if self.disaggregate not in (True, False, "auto"):
+            raise ValueError(
+                f"disaggregate must be true|false|'auto', got "
+                f"{self.disaggregate!r}")
         for name in ("slo_ttft_ms", "slo_tpot_ms"):
             v = getattr(self, name)
             if not isinstance(v, (int, float)) \
@@ -180,7 +192,8 @@ class Router:
     (typed exceptions for shed/expired). See the module docstring for
     the four robustness layers."""
 
-    def __init__(self, replicas, config=None, monitor=None, **kwargs):
+    def __init__(self, replicas, config=None, monitor=None,
+                 kv_transport=None, **kwargs):
         if isinstance(config, dict):
             config = RouterConfig(**{**config, **kwargs})
         elif config is None:
@@ -197,6 +210,21 @@ class Router:
         names = [r.name for r in self.replicas]
         if len(set(names)) != len(names):
             raise ValueError(f"duplicate replica names: {names}")
+        roles = {r.role for r in self.replicas}
+        if config.disaggregate is True \
+                and not {"prefill", "decode"} <= roles:
+            raise ValueError(
+                f"disaggregate=True needs at least one prefill-role "
+                f"and one decode-role replica; fleet roles: "
+                f"{sorted(roles)}")
+        # handoff byte transport: in-process queue by default (the
+        # tier-1-testable fallback); multi-host fleets pass
+        # kv_transfer.DcnRingTransport
+        self._kv_transport = kv_transport if kv_transport is not None \
+            else kv_transfer.InProcQueueTransport()
+        # per-round cache of _disagg_on(), re-resolved at the top of
+        # every step so role changes (deaths, drains) take effect
+        self._disagg = False
         self.monitor = monitor
         self._queue = deque()             # RouterRequest, FIFO
         self._reqs = {}                   # uid -> RouterRequest
@@ -207,7 +235,9 @@ class Router:
         self._now = time.monotonic        # tests override for fake time
         self.counters = {"admitted": 0, "completed": 0, "shed": 0,
                          "expired": 0, "replayed": 0, "failovers": 0,
-                         "dispatch_retries": 0}
+                         "dispatch_retries": 0, "handoffs": 0,
+                         "kv_stream_bytes": 0, "kv_stream_ms": 0.0,
+                         "kv_stream_retries": 0}
         self._class_stats = {}
         log_dist(f"router ready: {len(self.replicas)} replicas, "
                  f"queue_depth={config.router_queue_depth}", ranks=[0])
@@ -233,6 +263,18 @@ class Router:
     def _resolved_shed_policy(self):
         pol = self.config.shed_policy
         return "lowest-class" if pol == "auto" else pol
+
+    def _disagg_on(self):
+        """Disaggregated dispatch is active iff configured on AND both
+        phase roles are live — a fleet that loses its last decode (or
+        prefill) replica degrades to colocated behavior (roles become
+        preferences, not partitions) instead of deadlocking parked
+        sequences. Re-resolved every router round."""
+        if self.config.disaggregate is False:
+            return False
+        alive = [r for r in self.replicas if not r.dead]
+        return any(r.role == "prefill" for r in alive) \
+            and any(r.role == "decode" for r in alive)
 
     def _cstat(self, klass):
         if klass not in self._class_stats:
@@ -319,6 +361,10 @@ class Router:
         collect finished requests, complete drains. Returns the
         (uid, token) pairs produced this round."""
         now = self._now()
+        self._disagg = self._disagg_on()
+        for rep in self.replicas:
+            if not rep.dead:
+                rep.set_disaggregated(self._disagg)
         self._expire_queued(now)
         self._maybe_shed()
         self._dispatch(now)
@@ -346,6 +392,7 @@ class Router:
                 req.n_tokens += 1
                 out.append((uid, tok))
             self._collect_finished(rep)
+        self._do_handoffs()
         self._expire_inflight(self._now())
         self._finish_drains()
         if not any(not r.dead for r in self.replicas) and self.has_work:
@@ -468,8 +515,9 @@ class Router:
     # ------------------------------------------------------------- dispatch
     def _pick_replica(self, req):
         cands = [r for r in self.replicas
-                 if r.can_accept(len(req.prompt), req.max_new_tokens,
-                                 prompt=req.prompt)]
+                 if (not self._disagg or r.role != "decode")
+                 and r.can_accept(len(req.prompt), req.max_new_tokens,
+                                  prompt=req.prompt)]
         if not cands:
             return None
         if self._affinity_on():
@@ -511,6 +559,99 @@ class Router:
                 break
             req.state = "inflight"
             req.replica = rep.name
+
+    # ------------------------------------------------------------- handoffs
+    def _pick_decode(self, req):
+        """Least-loaded live decode-role replica with slot + pool
+        capacity for the handed-off sequence (round-robin tie-break,
+        like _pick_replica). None = back-pressure: the sequence stays
+        parked on its prefill replica and retries next round."""
+        cands = [r for r in self.replicas
+                 if r.role == "decode"
+                 and r.can_accept(len(req.prompt), req.max_new_tokens)]
+        if not cands:
+            return None
+        n = len(self.replicas)
+        idx = {r.name: i for i, r in enumerate(self.replicas)}
+        cands.sort(key=lambda r: (len(r.inflight),
+                                  (idx[r.name] - self._rr) % n))
+        self._rr += 1
+        return cands[0]
+
+    def _do_handoffs(self):
+        """Stream prefill-complete sequences to decode replicas. The
+        ordering makes every failure safe: the prefill replica keeps
+        full ownership until the decode side confirms the import, so a
+        ``kv_stream``/``kv_import`` fault retries next round from
+        unchanged state, and a decode-replica death mid-transfer falls
+        back to a front-of-queue replay (:meth:`_handoff_death`)."""
+        if not self._disagg:
+            return
+        for rep in list(self.replicas):
+            if rep.dead or rep.role != "prefill":
+                continue
+            for uid in rep.handoff_ready():
+                req = self._reqs.get(uid)
+                if req is None or req.state != "inflight":
+                    continue
+                dst = self._pick_decode(req)
+                if dst is None:
+                    continue          # back-pressure: stays parked
+                t0 = self._now()
+                try:
+                    payload = rep.export_handoff(uid)
+                    self._kv_transport.send(payload)
+                    wire = self._kv_transport.recv()
+                except fault_injection.FaultError:
+                    # retryable stream fault: nothing moved
+                    self.counters["kv_stream_retries"] += 1
+                    continue
+                try:
+                    dst.import_handoff(wire)
+                except fault_injection.FaultError:
+                    # retryable import fault: fired before any
+                    # decode-side mutation, nothing moved
+                    self.counters["kv_stream_retries"] += 1
+                    continue
+                except ReplicaDead:
+                    self._handoff_death(rep, dst, req)
+                    return            # roles changed mid-round: stop
+                rep.finish_handoff(uid)
+                dst.inflight.append(uid)
+                req.replica = dst.name
+                self.counters["handoffs"] += 1
+                self.counters["kv_stream_bytes"] += len(payload)
+                self.counters["kv_stream_ms"] += \
+                    (self._now() - t0) * 1e3
+
+    def _handoff_death(self, src, dst, req):
+        """``dst`` died importing ``req``'s KV mid-transfer. The import
+        fires before any decode-side allocation, so ``dst`` holds
+        nothing of ``req``; ``src`` still owns the sequence — cancel it
+        there (the flush/unref path, pool accounting closes) and
+        re-enqueue at the FRONT. ``dst``'s OTHER in-flight requests
+        take the normal failover path. With the decode side gone the
+        fleet degrades to colocated and the replay re-prefills —
+        byte-identical by greedy construction."""
+        src.cancel(req.uid)
+        req.state = "queued"
+        req.replica = None
+        req.tokens = None
+        req.t_first = None
+        req.t_last = None
+        req.n_tokens = 0
+        req.replays += 1
+        self.counters["replayed"] += 1
+        self._cstat(req.klass)["replayed"] += 1
+        self._queue.appendleft(req)
+        self._failover(dst)
+        self._disagg = self._disagg_on()
+        for rep in self.replicas:
+            if not rep.dead:
+                rep.set_disaggregated(self._disagg)
+        log_dist(f"router: decode replica {dst.name} died mid-transfer;"
+                 f" request {req.uid} replayed from the front",
+                 ranks=[0])
 
     # ------------------------------------------------------------- failover
     def _failover(self, rep):
@@ -590,6 +731,17 @@ class Router:
                 if getattr(r, "spec_acceptance", None) is not None}
         if spec:
             out["spec_acceptance_ema"] = spec
+        # per-role fleet summary — only present when the fleet actually
+        # declares phase roles, so all-colocated fleets keep the
+        # pre-disaggregation snapshot shape byte-identical
+        if any(r.role != "colocated" for r in self.replicas):
+            out["roles"] = {r.name: r.role for r in self.replicas}
+            out["prefill_inflight"] = sum(
+                len(r.inflight) for r in self.replicas
+                if r.role == "prefill")
+            out["decode_inflight"] = sum(
+                len(r.inflight) for r in self.replicas
+                if r.role == "decode")
         return out
 
     def _maybe_emit(self):
@@ -601,7 +753,7 @@ class Router:
             return
         self._emitted_at = done
         step = done
-        self.monitor.write_events([
+        events = [
             ("Serve/Router/shed", self.counters["shed"], step),
             ("Serve/Router/expired", self.counters["expired"], step),
             ("Serve/Router/replayed", self.counters["replayed"], step),
@@ -609,4 +761,20 @@ class Router:
             ("Serve/Router/queue_depth", len(self._queue), step),
             ("Serve/Router/draining",
              sum(r.draining for r in self.replicas), step),
-        ])
+        ]
+        if any(r.role != "colocated" for r in self.replicas):
+            events += [
+                ("Serve/Router/handoffs",
+                 self.counters["handoffs"], step),
+                ("Serve/Router/kv_stream_bytes",
+                 self.counters["kv_stream_bytes"], step),
+                ("Serve/Router/kv_stream_ms",
+                 round(self.counters["kv_stream_ms"], 3), step),
+                ("Serve/Router/prefill_inflight",
+                 sum(len(r.inflight) for r in self.replicas
+                     if r.role == "prefill"), step),
+                ("Serve/Router/decode_inflight",
+                 sum(len(r.inflight) for r in self.replicas
+                     if r.role == "decode"), step),
+            ]
+        self.monitor.write_events(events)

@@ -181,6 +181,22 @@ TAG_SCHEMA = {
         "router queue depth when the window was emitted",
     "Serve/Router/draining":
         "replicas in the draining state when the window was emitted",
+
+    # --- disaggregated prefill/decode serving (router handoff path;
+    #     emitted only when the fleet runs phase-specialized roles) ---
+    "Serve/Router/handoffs":
+        "cumulative prefill->decode KV handoffs completed",
+    "Serve/Router/kv_stream_bytes":
+        "cumulative KV wire bytes streamed across completed handoffs",
+    "Serve/Router/kv_stream_ms":
+        "cumulative wall time spent exporting/streaming/importing KV "
+        "across completed handoffs",
+    "Serve/Router/prefill_inflight":
+        "requests in flight on prefill-role replicas when the window "
+        "was emitted (per-role queue depth)",
+    "Serve/Router/decode_inflight":
+        "requests in flight on decode-role replicas when the window "
+        "was emitted (per-role queue depth)",
 }
 
 

@@ -823,6 +823,12 @@ class ServingTelemetry:
         self.spec_committed = 0
         self._spec_ema = None            # global acceptance EMA
         self._spec_class_ema = {}        # klass -> acceptance EMA
+        # disaggregated serving: requests that left via a prefill->
+        # decode handoff (out) or arrived through one (in). Zero and
+        # absent from percentiles() on colocated engines, so
+        # disagg-off snapshots stay byte-identical.
+        self.handoffs_in = 0
+        self.handoffs_out = 0
         self._t0 = time.perf_counter()
 
     def attach_prefix_cache(self, cache):
@@ -911,6 +917,51 @@ class ServingTelemetry:
         if st is not None:
             self.rejected += 1
 
+    # --------------------------- disaggregated prefill/decode handoff
+    def submit_stamp(self, uid):
+        """Original submit time (``time.perf_counter`` domain) of a
+        live request — exported with the KV handoff payload so the
+        decode side anchors its windows on the ORIGINAL submit, not
+        its own admit time. Peek only; the request stays live here
+        until :meth:`on_handoff_out`."""
+        st = self._live.get(uid)
+        return None if st is None else st.t_put
+
+    def klass_of(self, uid):
+        """Request class of a live request (0 when unknown) — carried
+        across the handoff so per-class windows stay coherent."""
+        return self._klass.get(uid, 0)
+
+    def on_handoff_out(self, uid):
+        """The request left THIS engine via a prefill->decode handoff:
+        forget it WITHOUT counting a rejection — its TTFT sample (the
+        first token was produced here) stays in the window, and the
+        decode side owns the rest of its accounting."""
+        self._live.pop(uid, None)
+        self._started.pop(uid, None)
+        self._klass.pop(uid, None)
+        self.handoffs_out += 1
+
+    def on_handoff_in(self, uid, klass=0, submit_ts=None):
+        """Register a handed-off request on the DECODE side, anchored
+        at the ORIGINAL submit stamp carried over the wire (decode-side
+        admit time would hide the whole prefill+stream latency). The
+        request arrives already STARTED — its first token was produced
+        by the prefill replica, so no second TTFT sample is recorded
+        here; subsequent tokens amortize TPOT from this boundary.
+
+        Clock-domain caveat: the stamp is exact for the in-process
+        transport (same ``perf_counter`` domain). Over the DCN
+        transport the stamp comes from another host's clock — counters
+        stay exact, latency windows are advisory there."""
+        now = time.perf_counter()
+        st = _ReqTimes(now if submit_ts is None else float(submit_ts))
+        st.t_first = st.t_last = now
+        self._live[uid] = st
+        self._started[uid] = st
+        self._klass[uid] = int(klass)
+        self.handoffs_in += 1
+
     def percentiles(self):
         out = {
             "ttft_ms_p50": percentile(self._ttft_ms, 50),
@@ -924,6 +975,11 @@ class ServingTelemetry:
             # only present once a cancel/shed happened: router-off
             # engine snapshots stay byte-identical to pre-router runs
             out["rejected"] = self.rejected
+        if self.handoffs_in or self.handoffs_out:
+            # only present once a handoff touched this engine:
+            # colocated snapshots stay byte-identical
+            out["handoffs_in"] = self.handoffs_in
+            out["handoffs_out"] = self.handoffs_out
         if self._prefix_cache is not None:
             s = self._prefix_cache.stats()
             elapsed = max(1e-9, time.perf_counter() - self._t0)

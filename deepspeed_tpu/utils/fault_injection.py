@@ -76,6 +76,17 @@ Injection points currently wired (grep for ``fault_injection.fire``):
                   round (advisory: load is shed as typed Overloaded
                   rejections; it can never kill a replica or fail a
                   request the shed policy would not have picked)
+  kv_stream       inference/v2/kv_transfer.py transport ``send``, once
+                  per prefill->decode handoff payload (retryable: the
+                  prefill replica keeps full ownership until the decode
+                  side confirms the import, so the router leaves the
+                  sequence parked and retries next round from
+                  unchanged state)
+  kv_import       inference/v2/kv_transfer.py import_sequence, before
+                  the handoff payload is unpacked into the decode
+                  replica's allocator/cache (retryable: fires before
+                  any decode-side mutation, so a failed import leaves
+                  both replicas unchanged and the router retries)
   kill            any of the above via ``kill=True`` — raises
                   SimulatedKill (BaseException) which NO layer retries,
                   modeling SIGKILL mid-save
@@ -120,6 +131,8 @@ KNOWN_POINTS = (
     "serve_verify",
     "replica_death",
     "router_overload",
+    "kv_stream",
+    "kv_import",
 )
 
 # Blast-radius class per injection point — the contract the lint in
@@ -158,6 +171,12 @@ BLAST_RADIUS = {
     "serve_verify": "retryable",
     "replica_death": "fatal",
     "router_overload": "advisory",
+    # disaggregated serving handoff (ISSUE 20): both halves fire BEFORE
+    # any state moves — the prefill replica owns the sequence until the
+    # decode side confirms the import — so the router's retry-next-round
+    # policy owns these failures end to end
+    "kv_stream": "retryable",
+    "kv_import": "retryable",
 }
 
 

@@ -48,6 +48,7 @@ _BUCKETS = {
     "pipe_microbatch": "S2,B8,T128,D128",
     "prefix_cache": "B4,NB16,BS16",
     "spec_decode": "B4,NB16,BS16",
+    "kv_handoff": "B4",
     # collective-bearing ops (autotuning/collective_ops.py): the mesh
     # topology signature is folded into the bucket string; the step
     # builders clamp requested axes to the devices actually present, so

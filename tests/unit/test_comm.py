@@ -94,3 +94,36 @@ def test_init_distributed_single_host():
     dist.init_distributed()
     assert dist.is_initialized()
     assert dist.get_rank() == 0
+
+
+# ------------------------------------------------- byte-payload contract
+
+def test_ring_exchange_bytes_single_process_zero_length():
+    """Zero-length payloads are legal; single-process worlds return the
+    no-peer sentinel without touching any collective."""
+    from deepspeed_tpu.comm import comm as comm_mod
+    assert comm_mod.ring_exchange_bytes(b"") == (None, None)
+    assert comm_mod.allgather_bytes(b"") is None
+
+
+def test_padded_width_floors_all_empty_exchange_at_one():
+    """The zero-length guard itself: an all-empty ring still sizes a
+    one-byte buffer (zeros((0,)) is not a valid per-process operand)."""
+    from deepspeed_tpu.comm import comm as comm_mod
+    assert comm_mod._padded_width(np.zeros((4,), np.int64)) == 1
+    assert comm_mod._padded_width(np.asarray([0, 7, 3])) == 7
+
+
+def test_oversize_payload_raises_typed_error(monkeypatch):
+    """Payloads above MAX_PAYLOAD_BYTES raise CommPayloadError BEFORE
+    any collective — checked first, so the contract holds (and is
+    testable) even in a single-process world."""
+    from deepspeed_tpu.comm import comm as comm_mod
+    monkeypatch.setattr(comm_mod, "MAX_PAYLOAD_BYTES", 8)
+    import pytest as _pytest
+    with _pytest.raises(comm_mod.CommPayloadError):
+        comm_mod.ring_exchange_bytes(b"123456789")
+    with _pytest.raises(comm_mod.CommPayloadError):
+        comm_mod.allgather_bytes(b"123456789")
+    # at the limit is fine
+    assert comm_mod.ring_exchange_bytes(b"12345678") == (None, None)

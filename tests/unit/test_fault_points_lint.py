@@ -209,6 +209,11 @@ def test_serving_points_declare_expected_blast_radius():
     assert br["serve_verify"] == "retryable"
     assert br["replica_death"] == "fatal"
     assert br["router_overload"] == "advisory"
+    # ISSUE-20: both halves of the KV handoff fire BEFORE any state
+    # moves, so the router's retry-next-round policy owns them — a
+    # stream or import failure must never kill either replica
+    assert br["kv_stream"] == "retryable"
+    assert br["kv_import"] == "retryable"
 
 
 @pytest.mark.chaos

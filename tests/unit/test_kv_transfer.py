@@ -1,0 +1,516 @@
+"""Disaggregated prefill/decode serving (tier-1): the KV handoff wire
+format (framing, CRC, typed corruption rejection), the in-process and
+DCN transports, engine-level export/import with colocated byte-identity
+and pool-closure audits, the router's phase-aware dispatch (1P+1D
+greedy streams byte-identical to colocated, including a
+prefix-cache-hit prompt), TTFT accounting spanning the handoff (one
+sample per request), and the chaos paths: retryable kv_stream /
+kv_import faults, decode-replica death mid-transfer -> front-of-queue
+byte-identical replay, and a cancel while parked awaiting handoff with
+both replicas' accounting closed.
+
+Engines follow the test_router.py fast pattern: tiny GPT2,
+module-cached params + a module-cached P/D engine pair for
+clean-completion tests."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deepspeed_tpu.autotuning import kernel_dispatch
+from deepspeed_tpu.inference.v2 import (DeadlineExceeded,
+                                        InferenceEngineV2, Router)
+from deepspeed_tpu.inference.v2 import kv_transfer
+from deepspeed_tpu.inference.v2.kv_transfer import (DcnRingTransport,
+                                                    InProcQueueTransport,
+                                                    KVTransferError,
+                                                    KVWireError,
+                                                    pack_handoff,
+                                                    unpack_handoff)
+from deepspeed_tpu.inference.v2.replica import Replica
+from deepspeed_tpu.models import GPT2, GPT2Config
+from deepspeed_tpu.monitor.tag_schema import TAG_SCHEMA
+from deepspeed_tpu.utils import fault_injection, groups
+
+
+@pytest.fixture(autouse=True)
+def _pristine_dispatch(tmp_path, monkeypatch):
+    monkeypatch.setenv("DSTPU_AUTOTUNE_CACHE",
+                       str(tmp_path / "kernel_autotune.json"))
+    monkeypatch.delenv("DSTPU_AUTOTUNE", raising=False)
+    kernel_dispatch.reset()
+    fault_injection.reset()
+    yield
+    fault_injection.reset()
+    kernel_dispatch.reset()
+
+
+_CFG = GPT2Config(n_layer=2, n_head=4, d_model=64, max_seq_len=128,
+                  vocab_size=256, remat=False, dtype="float32")
+_PARAMS = None
+
+
+def _params():
+    global _PARAMS
+    if _PARAMS is None:
+        _PARAMS = GPT2(_CFG).init(jax.random.key(0))
+    return _PARAMS
+
+
+_BASE = {"dtype": "float32", "kv_block_size": 8, "prompt_bucket": 16,
+         "max_batch_size": 2, "splitfuse_tokens": 16,
+         "decode_steps_per_dispatch": 2,
+         "prefix_cache_min_match": 1}
+
+
+def _engine(**kw):
+    groups.reset()
+    return InferenceEngineV2(GPT2(_CFG), params=_params(),
+                             config=dict(_BASE, **kw))
+
+
+# Clean-completion tests share one module-cached P/D pair (the prefill
+# engine carries a prefix cache so the handoff release's retire/insert
+# path is exercised; the decode engine is plain so its pool audit is
+# the strict free==total form). Every request leaves through get() or
+# a typed exit, so the engines stay reusable; each test builds its OWN
+# Replica/Router wrappers.
+_PAIR = None
+_REF = None
+
+
+def _pair():
+    global _PAIR
+    if _PAIR is None:
+        _PAIR = (_engine(prefix_cache=True), _engine())
+    return _PAIR
+
+
+def _prompts(seed, n, lo=6, hi=20):
+    rs = np.random.RandomState(seed)
+    return [rs.randint(1, 255, size=rs.randint(lo, hi)).astype(np.int32)
+            for _ in range(n)]
+
+
+def _refs():
+    """Colocated greedy reference for _prompts(3, 4) at max_new 8,
+    computed on the plain decode engine (which ends clean)."""
+    global _REF
+    if _REF is None:
+        _REF = [_pair()[1].generate_all([p], max_new_tokens=8)[0]
+                for p in _prompts(3, 4)]
+    return _REF
+
+
+def _run(router, max_rounds=400):
+    rounds = 0
+    while router.has_work:
+        router.step()
+        rounds += 1
+        assert rounds < max_rounds, "router failed to drain"
+    return rounds
+
+
+def _pool_closed(eng):
+    alloc = eng.state_mgr.allocator
+    tree = eng.prefix_cache.tree_blocks if eng.prefix_cache else 0
+    assert alloc.free_blocks + tree == alloc.total_blocks, (
+        f"leaked blocks: free={alloc.free_blocks} tree={tree} "
+        f"total={alloc.total_blocks}")
+
+
+def _disagg_router(**kw):
+    P, D = _pair()
+    reps = [Replica("p0", P, role="prefill"),
+            Replica("d0", D, role="decode")]
+    return Router(reps, **kw), reps
+
+
+def _tree():
+    return {"k": [np.arange(12, dtype=np.float32).reshape(3, 4)],
+            "v": [np.full((3, 4), 0.5, np.float32)]}
+
+
+_STATE = {"uid": 3, "prompt": [1, 2], "generated": [9],
+          "cached_len": 0, "max_new_tokens": 8, "eos_token_id": -1,
+          "temperature": 0.0, "top_k": 0, "klass": 1, "t_submit": 12.5}
+
+
+# ---------------------------------------------------------------------------
+# wire format
+# ---------------------------------------------------------------------------
+
+class TestWireFormat:
+    def test_roundtrip(self):
+        tree = _tree()
+        state, flat = unpack_handoff(pack_handoff(_STATE, tree))
+        assert state == _STATE
+        assert set(flat) == {"k/0", "v/0"}
+        np.testing.assert_array_equal(flat["k/0"], tree["k"][0])
+        np.testing.assert_array_equal(flat["v/0"], tree["v"][0])
+
+    def test_bfloat16_roundtrip(self):
+        """npz loses extension dtypes (bfloat16 loads back as raw void
+        bytes): the wire carries a dtype map and unpack views the bytes
+        back, so a bfloat16-serving fleet hands off losslessly."""
+        bf = np.asarray(jnp.arange(24, dtype=jnp.bfloat16).reshape(2, 3, 4))
+        state, flat = unpack_handoff(
+            pack_handoff(_STATE, {"k": [bf], "v": [bf * 0.5]}))
+        assert state == _STATE
+        assert flat["k/0"].dtype == np.dtype("bfloat16")
+        np.testing.assert_array_equal(flat["k/0"].view(np.uint16),
+                                      bf.view(np.uint16))
+        np.testing.assert_array_equal(
+            flat["v/0"].view(np.uint16),
+            np.asarray(bf * 0.5).view(np.uint16))
+
+    def test_truncated_rejected(self):
+        payload = pack_handoff(_STATE, _tree())
+        with pytest.raises(KVWireError, match="truncated"):
+            unpack_handoff(payload[:8])
+        with pytest.raises(KVWireError, match="truncated"):
+            unpack_handoff(b"")
+        with pytest.raises(KVWireError, match="body length"):
+            unpack_handoff(payload[:-3])
+
+    def test_bad_magic_and_version_rejected(self):
+        payload = bytearray(pack_handoff(_STATE, _tree()))
+        bad = bytearray(payload)
+        bad[:4] = b"NOPE"
+        with pytest.raises(KVWireError, match="magic"):
+            unpack_handoff(bytes(bad))
+        bad = bytearray(payload)
+        bad[4] = 0xEE                      # version field
+        with pytest.raises(KVWireError, match="version"):
+            unpack_handoff(bytes(bad))
+
+    def test_crc_flip_rejected(self):
+        payload = bytearray(pack_handoff(_STATE, _tree()))
+        payload[-1] ^= 0xFF
+        with pytest.raises(KVWireError):
+            unpack_handoff(bytes(payload))
+
+    def test_missing_descriptor_state_rejected(self):
+        """A well-formed serialization image that carries no handoff
+        descriptor is not a handoff — refuse it, typed."""
+        import io
+        import struct
+        import zlib
+
+        from deepspeed_tpu.runtime.checkpoint_engine import \
+            serialization as ser
+        body_io = io.BytesIO()
+        ser.save_file(body_io, _tree())    # no extra_meta
+        body = body_io.getvalue()
+        payload = kv_transfer._HEADER.pack(
+            kv_transfer.MAGIC, kv_transfer.WIRE_VERSION, len(body),
+            zlib.crc32(body) & 0xFFFFFFFF) + body
+        with pytest.raises(KVWireError, match="descriptor"):
+            unpack_handoff(payload)
+
+
+class TestTransports:
+    def test_inproc_queue_fifo_and_counters(self):
+        t = InProcQueueTransport()
+        t.send(b"abc")
+        t.send(b"defg")
+        assert t.sent_bytes == 7
+        assert t.recv() == b"abc"
+        assert t.recv() == b"defg"
+        with pytest.raises(KVTransferError, match="empty"):
+            t.recv()
+
+    def test_dcn_transport_needs_multi_process(self):
+        with pytest.raises(KVTransferError, match="multi-process"):
+            DcnRingTransport().send(b"abc")
+
+
+# ---------------------------------------------------------------------------
+# engine-level handoff
+# ---------------------------------------------------------------------------
+
+def _prefill_until_first_token(eng, prompt, max_new=8, uid=None):
+    uid = eng.put(prompt, max_new_tokens=max_new, eos_token_id=-1,
+                  uid=uid)
+    eng.hold_decode(uid)
+    for _ in range(64):
+        eng.step()
+        seq = eng.state_mgr._seqs.get(uid)
+        if seq is not None and seq.generated:
+            return uid
+    raise AssertionError("prefill never posted a first token")
+
+
+class TestEngineHandoff:
+    def test_export_import_byte_identity(self):
+        P, D = _pair()
+        prompt = _prompts(3, 4)[0]
+        want = _refs()[0]
+        uid = _prefill_until_first_token(P, prompt, uid=7001)
+        payload = kv_transfer.export_sequence(P, uid)
+        assert kv_transfer.import_sequence(D, payload) == uid
+        P.release_handoff(uid)
+        _pool_closed(P)
+        while not D.is_done(uid):
+            D.step()
+        np.testing.assert_array_equal(np.asarray(D.get(uid)),
+                                      np.asarray(want))
+        _pool_closed(D)
+
+    def test_export_before_first_token_rejected(self):
+        P, _ = _pair()
+        rs = np.random.RandomState(9)
+        prompt = rs.randint(1, 255, size=40).astype(np.int32)
+        uid = P.put(prompt, max_new_tokens=4, uid=7002)
+        P.hold_decode(uid)
+        P.step()                # admits + first chunk: mid-prefill
+        assert P.state_mgr._seqs[uid].generated == []
+        with pytest.raises(RuntimeError, match="first token"):
+            P.export_handoff(uid)
+        assert P.cancel(uid) is True
+        _pool_closed(P)
+
+    def test_duplicate_import_rejected(self):
+        P, D = _pair()
+        uid = _prefill_until_first_token(P, _prompts(3, 4)[2], uid=7003)
+        payload = kv_transfer.export_sequence(P, uid)
+        kv_transfer.import_sequence(D, payload)
+        with pytest.raises(RuntimeError, match="already live"):
+            kv_transfer.import_sequence(D, payload)
+        P.release_handoff(uid)
+        assert D.cancel(uid) is True
+        _pool_closed(P)
+        _pool_closed(D)
+
+    def test_layout_mismatch_rejected(self):
+        """The gpt2-vs-llama guard: a payload whose per-block shape
+        does not match the importing engine's cache is refused before
+        any allocation or scatter."""
+        P, _ = _pair()
+        other = _engine(kv_block_size=16)  # different block shape
+        uid = _prefill_until_first_token(P, _prompts(3, 4)[3], uid=7004)
+        payload = kv_transfer.export_sequence(P, uid)
+        state, flat = unpack_handoff(payload)
+        with pytest.raises(KVWireError, match="layout"):
+            other.import_handoff(state, flat)
+        alloc = other.state_mgr.allocator
+        assert alloc.free_blocks == alloc.total_blocks
+        assert P.cancel(uid) is True
+        _pool_closed(P)
+
+    def test_cancel_parked_sequence_closes_pool(self):
+        P, _ = _pair()
+        uid = _prefill_until_first_token(P, _prompts(3, 4)[0], uid=7005)
+        assert uid in P._decode_hold
+        assert P.cancel(uid) is True
+        assert uid not in P._decode_hold
+        _pool_closed(P)
+
+
+# ---------------------------------------------------------------------------
+# router: phase-aware dispatch
+# ---------------------------------------------------------------------------
+
+class TestDisaggRouter:
+    def test_auto_resolution_and_validation(self):
+        P, D = _pair()
+        r_colo = Router([Replica("a", P), Replica("b", D)])
+        assert r_colo._disagg_on() is False
+        assert "roles" not in r_colo.snapshot()
+        r_dis, _ = _disagg_router()
+        assert r_dis._disagg_on() is True
+        r_off, _ = _disagg_router(config={"disaggregate": False})
+        assert r_off._disagg_on() is False
+        with pytest.raises(ValueError, match="prefill"):
+            Router([Replica("a", P, role="prefill")],
+                   config={"disaggregate": True})
+        with pytest.raises(ValueError, match="role"):
+            Replica("x", P, role="verifier")
+
+    def test_greedy_byte_identity_and_single_ttft_sample(self):
+        """The tentpole invariant: 1P+1D greedy streams byte-identical
+        to colocated, TTFT is sampled exactly once per request (on the
+        prefill side), the handoff counters account every stream, and
+        both pools close."""
+        router, reps = _disagg_router()
+        want = _refs()
+        uids = [router.put(p, max_new_tokens=8)
+                for p in _prompts(3, 4)]
+        _run(router)
+        for uid, w in zip(uids, want):
+            np.testing.assert_array_equal(np.asarray(router.get(uid)),
+                                          np.asarray(w))
+        snap = router.snapshot()
+        assert snap["handoffs"] == 4
+        assert snap["kv_stream_bytes"] > 0
+        assert snap["kv_stream_retries"] == 0
+        assert snap["completed"] == 4 and snap["admitted"] == 4
+        # exactly one TTFT sample per request, anchored at submit
+        assert len(router._cstat(0)["ttft_ms"]) == 4
+        assert snap["roles"] == {"p0": "prefill", "d0": "decode"}
+        assert snap["prefill_inflight"] == 0
+        assert snap["decode_inflight"] == 0
+        P, D = _pair()
+        _pool_closed(P)
+        _pool_closed(D)
+        # the decode engine's own telemetry saw the handoffs arrive
+        assert D.telemetry.percentiles()["handoffs_in"] >= 4
+
+    def test_prefix_hit_prompt_byte_identity(self):
+        """A handed-off sequence whose prompt HITS the prefill
+        replica's prefix cache (radix-claimed shared blocks in its
+        table) must still stream byte-identically — the export gathers
+        claimed blocks read-only and the import re-owns them."""
+        router, _ = _disagg_router()
+        prompt = _prompts(3, 4)[0]
+        want = _refs()[0]
+        u1 = router.put(prompt, max_new_tokens=8)
+        _run(router)
+        # release_handoff retired the verified prompt into p0's prefix
+        # cache; the SAME prompt now prefills through a radix hit
+        u2 = router.put(prompt, max_new_tokens=8)
+        _run(router)
+        np.testing.assert_array_equal(np.asarray(router.get(u1)),
+                                      np.asarray(want))
+        np.testing.assert_array_equal(np.asarray(router.get(u2)),
+                                      np.asarray(want))
+        assert router.snapshot()["handoffs"] == 2
+        P, D = _pair()
+        _pool_closed(P)
+        _pool_closed(D)
+
+    def test_disagg_tags_registered(self):
+        for tag in ("Serve/Router/handoffs", "Serve/Router/kv_stream_bytes",
+                    "Serve/Router/kv_stream_ms",
+                    "Serve/Router/prefill_inflight",
+                    "Serve/Router/decode_inflight"):
+            assert tag in TAG_SCHEMA
+
+
+# ---------------------------------------------------------------------------
+# chaos: stream/import faults, death mid-transfer, parked cancel
+# ---------------------------------------------------------------------------
+
+@pytest.mark.chaos
+class TestHandoffChaos:
+    def test_kv_stream_fault_retries_next_round(self):
+        """An injected stream failure moves nothing: the sequence stays
+        parked on the prefill side and the next round's retry streams
+        it — output byte-identical, one retry counted."""
+        router, _ = _disagg_router()
+        fault_injection.arm("kv_stream", fails=1)
+        uid = router.put(_prompts(3, 4)[1], max_new_tokens=8)
+        _run(router)
+        np.testing.assert_array_equal(np.asarray(router.get(uid)),
+                                      np.asarray(_refs()[1]))
+        snap = router.snapshot()
+        assert snap["kv_stream_retries"] == 1
+        assert snap["handoffs"] == 1
+        assert snap["failovers"] == 0
+        P, D = _pair()
+        _pool_closed(P)
+        _pool_closed(D)
+
+    def test_kv_import_fault_retries_next_round(self):
+        """Same contract on the import half: the point fires before any
+        decode-side mutation, so the retry re-exports and re-streams
+        from unchanged prefill state."""
+        router, _ = _disagg_router()
+        fault_injection.arm("kv_import", fails=1)
+        uid = router.put(_prompts(3, 4)[2], max_new_tokens=8)
+        _run(router)
+        np.testing.assert_array_equal(np.asarray(router.get(uid)),
+                                      np.asarray(_refs()[2]))
+        snap = router.snapshot()
+        assert snap["kv_stream_retries"] == 1
+        assert snap["handoffs"] == 1
+        P, D = _pair()
+        _pool_closed(P)
+        _pool_closed(D)
+
+    def test_decode_death_mid_transfer_replays_byte_identical(self):
+        """Decode replica dies importing the payload: the request is
+        re-enqueued at the FRONT, the fleet degrades to colocated, the
+        replay re-prefills on the survivor and the output is
+        byte-identical; both pools close and accounting stays zero-drop
+        (the wire payload it was mid-importing is discarded — the
+        import fires before any decode-side state moves)."""
+        router, (P_rep, D_rep) = _disagg_router()
+        prompt = _prompts(3, 4)[3]
+        want = _refs()[3]
+        uid = router.put(prompt, max_new_tokens=8)
+        # orchestrate up to the brink of the handoff OUTSIDE router
+        # rounds so the armed death lands exactly at D's import fire
+        router._disagg = router._disagg_on()
+        for rep in router.replicas:
+            rep.set_disaggregated(True)
+        router._dispatch(router._now())
+        for _ in range(64):
+            if P_rep.handoff_ready():
+                break
+            P_rep.engine.step()
+        assert P_rep.handoff_ready() == [uid]
+        # this round: P's step() fires replica_death once (consumed by
+        # skip=1), then _do_handoffs reaches D's import fire -> injects
+        fault_injection.arm("replica_death", fails=1, skip=1)
+        router.step()
+        assert D_rep.dead
+        assert not P_rep.dead
+        req = router._reqs[uid]
+        assert req.replays == 1
+        _run(router)                       # colocated replay on P
+        np.testing.assert_array_equal(np.asarray(router.get(uid)),
+                                      np.asarray(want))
+        snap = router.snapshot()
+        assert snap["failovers"] == 1
+        assert snap["replayed"] == 1
+        assert snap["handoffs"] == 0
+        assert (snap["completed"] + snap["expired"] + snap["shed"]
+                == snap["admitted"] == 1)
+        # exactly one TTFT sample despite the replay
+        assert len(router._cstat(0)["ttft_ms"]) == 1
+        P, D = _pair()
+        _pool_closed(P)
+        _pool_closed(D)
+
+    def test_cancel_while_parked_awaiting_handoff(self):
+        """Deadline expiry of a sequence parked for handoff (decode
+        side back-pressured): the cancel runs on the PREFILL side
+        through the flush/unref path — both replicas' accounting
+        closes, nothing streamed."""
+        router, (P_rep, D_rep) = _disagg_router()
+        P, D = _pair()
+        # back-pressure: fill the decode engine's slots directly so
+        # _pick_decode finds no capacity and the sequence stays parked
+        busy = [D.put(p, max_new_tokens=48, eos_token_id=-1, uid=u)
+                for p, u in zip(_prompts(5, 2), (9101, 9102))]
+        for _ in range(2):
+            D.step()
+        uid = router.put(_prompts(3, 4)[0], max_new_tokens=8)
+        router.step()
+        for _ in range(64):
+            if P_rep.handoff_ready():
+                break
+            P_rep.engine.step()
+        router.step()                      # handoff attempt: no capacity
+        assert router._reqs[uid].state == "inflight"
+        assert router.snapshot()["handoffs"] == 0
+        # now the deadline passes while still parked
+        router._reqs[uid].deadline_ms = 1e-9
+        router.step()
+        with pytest.raises(DeadlineExceeded):
+            router.get(uid)
+        assert uid not in P._decode_hold
+        _pool_closed(P)
+        snap = router.snapshot()
+        assert snap["expired"] == 1 and snap["handoffs"] == 0
+        assert (snap["completed"] + snap["expired"] + snap["shed"]
+                == snap["admitted"] == 1)
+        # drain the back-pressure load; the decode pool closes too
+        while not all(D.is_done(u) for u in busy):
+            D.step()
+        for u in busy:
+            D.get(u)
+        _pool_closed(D)

@@ -167,8 +167,17 @@ def test_router_expected_knobs_are_discovered():
     """Pin the ISSUE-17 knob set so a refactor cannot silently drop a
     knob's validation (which would drop it from discovery and make the
     reverse lint delete its row instead of failing)."""
-    assert {"router_queue_depth", "shed_policy", "prefix_affinity"} \
-        <= discovered_router_auto_knobs()
+    assert {"router_queue_depth", "shed_policy", "prefix_affinity",
+            "disaggregate"} <= discovered_router_auto_knobs()
+
+
+def test_disaggregation_knobs_are_in_the_table():
+    """Pin the disaggregated-serving rows: the router's disaggregate
+    knob and the replica role choice must both route to the kv_handoff
+    registry op (the cost model pricing KV wire bytes against stolen
+    decode iterations)."""
+    assert KNOB_TABLE["router.disaggregate"]["op"] == "kv_handoff"
+    assert KNOB_TABLE["replica.role"]["op"] == "kv_handoff"
 
 
 def test_top_level_parallelism_accepts_auto():

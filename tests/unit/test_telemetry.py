@@ -571,6 +571,53 @@ class TestServingTelemetry:
         st.on_reject(2)
         assert st.percentiles()["rejected"] == 1
 
+    def test_handoff_anchoring_spans_replicas(self):
+        """Regression (ISSUE-20 satellite): a prefill->decode handoff
+        must keep ONE latency story per request. The prefill side keeps
+        its TTFT sample (the first token was produced there) and
+        forgets the request WITHOUT counting a rejection; the decode
+        side registers the request anchored at the ORIGINAL submit
+        stamp and must never take a second TTFT sample."""
+        tel_p = ServingTelemetry(interval=1)
+        tel_d = ServingTelemetry(interval=1)
+        tel_p.on_submit(7, klass=2)
+        time.sleep(0.01)
+        tel_p.on_token(7)                  # TTFT sampled on P
+        stamp = tel_p.submit_stamp(7)
+        assert stamp is not None
+        assert tel_p.klass_of(7) == 2
+        ttft_samples = len(tel_p._ttft_ms)
+        tel_p.on_handoff_out(7)
+        p = tel_p.percentiles()
+        assert p.get("rejected", 0) == 0   # handoff is not a shed
+        assert len(tel_p._ttft_ms) == ttft_samples  # sample survives
+        assert 7 not in tel_p._live and 7 not in tel_p._started
+        assert p["handoffs_out"] == 1
+        tel_d.on_handoff_in(7, klass=2, submit_ts=stamp)
+        assert tel_d.klass_of(7) == 2
+        assert tel_d.submit_stamp(7) == stamp   # original anchor
+        tel_d.on_token(7)
+        tel_d.on_token(7)
+        tel_d.on_dispatch(active=1)
+        d = tel_d.percentiles()
+        assert "ttft_ms_p50" not in d or d["ttft_ms_p50"] is None, \
+            "decode side must not take a second TTFT sample"
+        assert d["tpot_ms_p50"] is not None
+        assert d["handoffs_in"] == 1
+        tel_d.on_finish(7)
+        assert tel_d.percentiles()["completed"] == 1
+
+    def test_handoff_keys_absent_without_handoffs(self):
+        """Disagg-off byte-identity: the handoffs_in/out keys may only
+        appear once a handoff actually happened — a colocated engine's
+        snapshot stays identical to pre-disaggregation serving."""
+        st = ServingTelemetry()
+        st.on_submit(1)
+        st.on_token(1)
+        st.on_finish(1)
+        p = st.percentiles()
+        assert "handoffs_in" not in p and "handoffs_out" not in p
+
     def test_dispatch_skips_queued_requests(self):
         """Regression (review finding): on_dispatch runs per engine
         step — it must visit only requests past their first token, not
